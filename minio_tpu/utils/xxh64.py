@@ -1,0 +1,79 @@
+"""Pure-Python XXH64 (seed 0) — used to check the erasure golden vectors.
+
+The reference validates its erasure codec at boot against golden xxhash64
+digests (reference: cmd/erasure-coding.go:152-209, via cespare/xxhash). We
+only need it for the self-test's 256-byte vectors, so a straightforward
+implementation suffices; nothing on the data path uses it.
+"""
+
+from __future__ import annotations
+
+import struct
+
+_PRIME1 = 0x9E3779B185EBCA87
+_PRIME2 = 0xC2B2AE3D27D4EB4F
+_PRIME3 = 0x165667B19E3779F9
+_PRIME4 = 0x85EBCA77C2B2AE63
+_PRIME5 = 0x27D4EB2F165667C5
+_MASK = 0xFFFFFFFFFFFFFFFF
+
+
+def _rotl(x: int, r: int) -> int:
+    return ((x << r) | (x >> (64 - r))) & _MASK
+
+
+def _round(acc: int, lane: int) -> int:
+    acc = (acc + lane * _PRIME2) & _MASK
+    acc = _rotl(acc, 31)
+    return (acc * _PRIME1) & _MASK
+
+
+def _merge_round(acc: int, val: int) -> int:
+    acc ^= _round(0, val)
+    return ((acc * _PRIME1) + _PRIME4) & _MASK
+
+
+def xxh64(data: bytes, seed: int = 0) -> int:
+    n = len(data)
+    p = 0
+    if n >= 32:
+        v1 = (seed + _PRIME1 + _PRIME2) & _MASK
+        v2 = (seed + _PRIME2) & _MASK
+        v3 = seed & _MASK
+        v4 = (seed - _PRIME1) & _MASK
+        limit = n - 32
+        while p <= limit:
+            lanes = struct.unpack_from("<4Q", data, p)
+            v1 = _round(v1, lanes[0])
+            v2 = _round(v2, lanes[1])
+            v3 = _round(v3, lanes[2])
+            v4 = _round(v4, lanes[3])
+            p += 32
+        h = (_rotl(v1, 1) + _rotl(v2, 7) + _rotl(v3, 12) + _rotl(v4, 18)) & _MASK
+        h = _merge_round(h, v1)
+        h = _merge_round(h, v2)
+        h = _merge_round(h, v3)
+        h = _merge_round(h, v4)
+    else:
+        h = (seed + _PRIME5) & _MASK
+    h = (h + n) & _MASK
+    while p + 8 <= n:
+        (lane,) = struct.unpack_from("<Q", data, p)
+        h ^= _round(0, lane)
+        h = (_rotl(h, 27) * _PRIME1 + _PRIME4) & _MASK
+        p += 8
+    if p + 4 <= n:
+        (lane,) = struct.unpack_from("<I", data, p)
+        h ^= (lane * _PRIME1) & _MASK
+        h = (_rotl(h, 23) * _PRIME2 + _PRIME3) & _MASK
+        p += 4
+    while p < n:
+        h ^= (data[p] * _PRIME5) & _MASK
+        h = (_rotl(h, 11) * _PRIME1) & _MASK
+        p += 1
+    h ^= h >> 33
+    h = (h * _PRIME2) & _MASK
+    h ^= h >> 29
+    h = (h * _PRIME3) & _MASK
+    h ^= h >> 32
+    return h

@@ -1,0 +1,69 @@
+import numpy as np
+import pytest
+
+from minio_tpu.erasure.codec import Erasure, ReconstructError, ceil_frac
+from minio_tpu.erasure.selftest import erasure_self_test, BLOCK_SIZE_V2
+
+
+def test_golden_selftest_host():
+    erasure_self_test()  # raises on any byte mismatch vs reference
+
+
+def test_shard_size_math():
+    e = Erasure(8, 4, BLOCK_SIZE_V2)
+    assert e.shard_size() == ceil_frac(BLOCK_SIZE_V2, 8) == 131072
+    assert e.shard_file_size(0) == 0
+    assert e.shard_file_size(-1) == -1
+    assert e.shard_file_size(BLOCK_SIZE_V2) == 131072
+    assert e.shard_file_size(BLOCK_SIZE_V2 + 1) == 131072 + 1
+    # offsets clamp at shard file size
+    assert e.shard_file_offset(0, BLOCK_SIZE_V2, BLOCK_SIZE_V2) == 131072
+
+
+@pytest.mark.parametrize("k,m", [(2, 2), (4, 2), (8, 4), (5, 3)])
+def test_encode_reconstruct_roundtrip(k, m):
+    rng = np.random.default_rng(42)
+    data = rng.integers(0, 256, size=1 << 16, dtype=np.uint8).tobytes()
+    e = Erasure(k, m, BLOCK_SIZE_V2)
+    shards = e.encode_data(data)
+    assert len(shards) == k + m
+    # Drop m arbitrary shards (mix of data+parity), reconstruct data.
+    victims = list(range(1, 1 + m))
+    saved = [shards[v].copy() for v in victims]
+    for v in victims:
+        shards[v] = np.zeros(0, dtype=np.uint8)
+    e.decode_data_blocks(shards)
+    for v, s in zip(victims, saved):
+        if v < k:
+            assert np.array_equal(shards[v], s)
+    assert e.join(shards, len(data)) == data
+
+
+def test_reconstruct_all_parity():
+    k, m = 4, 2
+    e = Erasure(k, m, BLOCK_SIZE_V2)
+    data = bytes(range(256)) * 17
+    shards = e.encode_data(data)
+    want = [s.copy() for s in shards]
+    shards[0] = np.zeros(0, dtype=np.uint8)
+    shards[5] = np.zeros(0, dtype=np.uint8)
+    e.decode_data_and_parity_blocks(shards)
+    for a, b in zip(shards, want):
+        assert np.array_equal(a, b)
+
+
+def test_too_few_shards_raises():
+    k, m = 4, 2
+    e = Erasure(k, m, BLOCK_SIZE_V2)
+    shards = e.encode_data(b"x" * 1024)
+    for i in range(3):
+        shards[i] = np.zeros(0, dtype=np.uint8)
+    with pytest.raises(ReconstructError):
+        e.decode_data_blocks(shards)
+
+
+def test_empty_input():
+    e = Erasure(4, 2, BLOCK_SIZE_V2)
+    shards = e.encode_data(b"")
+    assert len(shards) == 6 and all(s.size == 0 for s in shards)
+    e.decode_data_blocks(shards)  # no-op

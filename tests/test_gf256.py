@@ -1,0 +1,55 @@
+import numpy as np
+
+from minio_tpu.ops import gf256
+
+
+def test_field_basics():
+    assert gf256.gf_mul(0, 77) == 0
+    assert gf256.gf_mul(1, 77) == 77
+    # 2*142 wraps the reducing polynomial 0x11D
+    assert gf256.gf_mul(2, 0x8E) == ((0x8E << 1) ^ 0x11D) & 0xFF
+    for a in (1, 2, 3, 0x53, 0xCA, 255):
+        inv = gf256.gf_div(1, a)
+        assert gf256.gf_mul(a, inv) == 1
+
+
+def test_exp_matches_repeated_mul():
+    for a in (0, 1, 2, 5, 0x1D, 0xFF):
+        acc = 1
+        for n in range(10):
+            assert gf256.gf_exp(a, n) == acc
+            acc = gf256.gf_mul(acc, a)
+
+
+def test_coding_matrix_systematic():
+    for k, m in [(2, 2), (4, 2), (8, 4), (12, 3)]:
+        mat = gf256.coding_matrix(k, m)
+        assert mat.shape == (k + m, k)
+        assert np.array_equal(mat[:k], np.eye(k, dtype=np.uint8))
+
+
+def test_inverse_roundtrip():
+    rng = np.random.default_rng(0)
+    for n in (2, 5, 8):
+        # random invertible matrix (retry until invertible)
+        while True:
+            m = rng.integers(0, 256, size=(n, n), dtype=np.uint8)
+            try:
+                inv = gf256.gf_inverse(m)
+                break
+            except ValueError:
+                continue
+        assert np.array_equal(gf256.gf_matmul(m, inv), np.eye(n, dtype=np.uint8))
+
+
+def test_bit_matrix_equivalence():
+    rng = np.random.default_rng(1)
+    mat = rng.integers(0, 256, size=(3, 5), dtype=np.uint8)
+    data = rng.integers(0, 256, size=(5, 64), dtype=np.uint8)
+    want = gf256.gf_matvec_bytes(mat, data)
+    bm = gf256.bit_matrix(mat)  # [24, 40]
+    bits = np.unpackbits(data[:, None, :], axis=1, bitorder="little").reshape(40, 64)
+    out_bits = (bm.astype(np.int32) @ bits.astype(np.int32)) & 1
+    got = np.packbits(out_bits.reshape(3, 8, 64).astype(np.uint8), axis=1,
+                      bitorder="little").reshape(3, 64)
+    assert np.array_equal(want, got)
