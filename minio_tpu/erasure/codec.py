@@ -141,7 +141,7 @@ class Erasure:
         shard_len = shards[present[0]].shape[0]
         for i in present:
             if shards[i].shape[0] != shard_len:
-                raise ValueError("shard size mismatch")
+                raise ShardSizeError("shard size mismatch")
 
         # Use the first k surviving shards, like the reference's dependency.
         use = tuple(present[:k])
@@ -169,11 +169,14 @@ class Erasure:
     def decode_data_blocks(self, shards: list[Optional[np.ndarray]]) -> None:
         """Reconstruct only the data shards (reference: DecodeDataBlocks).
 
-        No-op when nothing or everything is missing (0-byte payload case).
+        No-op when no shard is missing, or for the degenerate single-shard
+        case. All-empty with n > 1 raises ReconstructError — total loss
+        must surface as a read-quorum error, never as silent success
+        (matches the reference, whose early-return is only reachable for
+        n == 1 because its zero-scan breaks on the first empty shard).
         """
-        any_zero = any(s is None or s.size == 0 for s in shards)
-        all_zero = all(s is None or s.size == 0 for s in shards)
-        if not any_zero or all_zero:
+        missing = any(s is None or s.size == 0 for s in shards)
+        if not missing or len(shards) == 1:
             return
         self._reconstruct(shards, data_only=True)
 
@@ -188,5 +191,14 @@ class Erasure:
         return flat[:out_size].tobytes()
 
 
-class ReconstructError(Exception):
+class CodecError(Exception):
+    """Base for erasure-codec data errors (callers map these to quorum
+    errors / heal triggers, never to crashes)."""
+
+
+class ReconstructError(CodecError):
     """Too few shards to reconstruct (maps to errErasureReadQuorum)."""
+
+
+class ShardSizeError(CodecError):
+    """A surviving shard has the wrong length (truncated/corrupt read)."""

@@ -44,14 +44,17 @@ def _build_tables() -> tuple[np.ndarray, np.ndarray]:
 
 EXP_TABLE, LOG_TABLE = _build_tables()
 
-# Full 256x256 multiplication table (64 KiB) — the workhorse for host-side
-# encode/verify paths and for generating per-coefficient lookup tables.
-_a = np.arange(256, dtype=np.uint16)
-_MUL = np.zeros((256, 256), dtype=np.uint8)
-_nz = _a[1:]
-_log_sum = LOG_TABLE[_nz][:, None].astype(np.int32) + LOG_TABLE[_nz][None, :].astype(np.int32)
-_MUL[1:, 1:] = EXP_TABLE[_log_sum % 255]
-MUL_TABLE = _MUL
+def _build_mul_table() -> np.ndarray:
+    """Full 256x256 multiplication table (64 KiB) — the workhorse for
+    host-side encode/verify paths and per-coefficient lookup tables."""
+    nz = np.arange(1, 256, dtype=np.uint16)
+    log_sum = (LOG_TABLE[nz][:, None].astype(np.int32)
+               + LOG_TABLE[nz][None, :].astype(np.int32))
+    mul = np.zeros((256, 256), dtype=np.uint8)
+    mul[1:, 1:] = EXP_TABLE[log_sum % 255]
+    return mul
+
+MUL_TABLE = _build_mul_table()
 
 
 def gf_mul(a: int, b: int) -> int:

@@ -1,11 +1,20 @@
-"""Test env: force JAX onto a virtual 8-device CPU mesh before jax imports.
+"""Test env: force JAX onto a virtual 8-device CPU mesh.
 
-Multi-chip sharding tests run against this mesh (real multi-chip hardware is
-exercised by the driver's dryrun; benches use the real chip).
+Multi-chip sharding tests run against this mesh (real TPU hardware is
+exercised by the driver's dryrun and bench.py; the axon TPU tunnel adds
+~150 ms per host round-trip, which would dominate the suite).
+
+The axon sitecustomize imports jax at interpreter startup with
+JAX_PLATFORMS=axon already latched into jax.config, so mutating
+os.environ here is too late — update the live config instead.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
