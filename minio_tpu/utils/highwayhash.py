@@ -1,0 +1,189 @@
+"""HighwayHash-256 — the reference's bitrot checksum algorithm.
+
+The reference protects every erasure shard block with keyed
+HighwayHash-256 (reference: cmd/bitrot.go:28,37,55-59, via
+github.com/minio/highwayhash with AVX2/NEON lane kernels). This is a
+from-scratch implementation of the public HighwayHash algorithm
+(Google, https://github.com/google/highwayhash) written as vectorized
+numpy over a leading stream axis, so MANY shard blocks hash in parallel
+— the same lane-parallel trick the SIMD kernels use, applied across
+streams instead. The per-packet recurrence is sequential by
+construction; parallelism comes from hashing independent shard blocks
+(one stream per shard x block), which is exactly the shape of the bitrot
+workload (each shard block is checksummed independently,
+cmd/bitrot-streaming.go:44-75).
+
+Correctness oracles (both must hold, enforced in tests):
+  * the reference's bitrotSelfTest golden digests (cmd/bitrot.go:224-232)
+    — covers packet updates + finalize for sizes 0,32,...,992;
+  * the magic bitrot key itself: HighwayHash-256 of the first 100
+    decimals of pi (utf-8) under a zero key equals
+    magicHighwayHash256Key (cmd/bitrot.go:36-37) — covers the
+    remainder (non-multiple-of-32) path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_U64 = np.uint64
+_MASK32 = _U64(0xFFFFFFFF)
+
+_INIT0 = np.array([0xDBE6D5D5FE4CCE2F, 0xA4093822299F31D0,
+                   0x13198A2E03707344, 0x243F6A8885A308D3], dtype=_U64)
+_INIT1 = np.array([0x3BD39E10CB0EF593, 0xC0ACF169B5F18A8C,
+                   0xBE5466CF34E90C6C, 0x452821E638D01377], dtype=_U64)
+
+# The reference's magic bitrot key (cmd/bitrot.go:37): HH-256 of the first
+# 100 decimals of pi under a zero key.
+MAGIC_KEY = bytes.fromhex(
+    "4be734fa8e238acd263e83e6bb968552040f935da39f441497e09d1322de36a0")
+
+
+def _rot32(x: np.ndarray) -> np.ndarray:
+    """Swap the 32-bit halves of each uint64."""
+    return (x >> _U64(32)) | (x << _U64(32))
+
+
+class HighwayState:
+    """Vectorized HighwayHash state over S independent streams.
+
+    All four state vectors are uint64 arrays of shape [S, 4]. Every method
+    advances all streams in lockstep; streams are completely independent.
+    """
+
+    def __init__(self, key: bytes, streams: int = 1):
+        if len(key) != 32:
+            raise ValueError("HighwayHash-256 requires a 32-byte key")
+        self._key_lanes = np.frombuffer(key, dtype="<u8").astype(_U64)
+        self.streams = streams
+        self.reset()
+
+    def reset(self) -> None:
+        s = self.streams
+        k = self._key_lanes
+        self.v0 = np.broadcast_to(_INIT0 ^ k, (s, 4)).copy()
+        self.v1 = np.broadcast_to(_INIT1 ^ _rot32(k), (s, 4)).copy()
+        self.mul0 = np.broadcast_to(_INIT0, (s, 4)).copy()
+        self.mul1 = np.broadcast_to(_INIT1, (s, 4)).copy()
+
+    # -- core permutation ---------------------------------------------------
+
+    def _zipper_merge_add(self, v1e, v0e, add1, add0, idx1, idx0):
+        """add{0,1}[:, idx] += zipper-merge of the (v1e, v0e) lane pair."""
+        u = _U64
+        m = lambda x: u(x)  # noqa: E731 - terse 64-bit literals
+        add0[:, idx0] += ((((v0e & m(0xFF000000)) | (v1e & m(0xFF00000000))) >> u(24))
+                          | (((v0e & m(0xFF0000000000)) | (v1e & m(0xFF000000000000))) >> u(16))
+                          | (v0e & m(0xFF0000)) | ((v0e & m(0xFF00)) << u(32))
+                          | ((v1e & m(0xFF00000000000000)) >> u(8)) | (v0e << u(56)))
+        add1[:, idx1] += ((((v1e & m(0xFF000000)) | (v0e & m(0xFF00000000))) >> u(24))
+                          | (v1e & m(0xFF0000)) | ((v1e & m(0xFF0000000000)) >> u(16))
+                          | ((v1e & m(0xFF00)) << u(24)) | ((v0e & m(0xFF000000000000)) >> u(8))
+                          | ((v1e & m(0xFF)) << u(48)) | (v0e & m(0xFF00000000000000)))
+
+    def update(self, lanes: np.ndarray) -> None:
+        """One 32-byte packet per stream: lanes uint64 [S, 4]."""
+        v0, v1, mul0, mul1 = self.v0, self.v1, self.mul0, self.mul1
+        v1 += mul0 + lanes
+        mul0 ^= (v1 & _MASK32) * (v0 >> _U64(32))
+        v0 += mul1
+        mul1 ^= (v0 & _MASK32) * (v1 >> _U64(32))
+        self._zipper_merge_add(v1[:, 1], v1[:, 0], v0, v0, 1, 0)
+        self._zipper_merge_add(v1[:, 3], v1[:, 2], v0, v0, 3, 2)
+        self._zipper_merge_add(v0[:, 1], v0[:, 0], v1, v1, 1, 0)
+        self._zipper_merge_add(v0[:, 3], v0[:, 2], v1, v1, 3, 2)
+
+    def update_packets(self, packets: np.ndarray) -> None:
+        """packets: uint8 [S, n_packets, 32] — sequential over n_packets."""
+        lanes = packets.reshape(self.streams, -1, 32).view("<u8").astype(_U64)
+        for p in range(lanes.shape[1]):
+            self.update(lanes[:, p, :])
+
+    def update_remainder(self, tail: np.ndarray, size_mod32: int) -> None:
+        """Final partial packet: tail uint8 [S, size_mod32], 0 < size_mod32 < 32."""
+        s = self.streams
+        size_mod4 = size_mod32 & 3
+        rem = size_mod32 & ~3
+        packet = np.zeros((s, 32), dtype=np.uint8)
+        packet[:, :rem] = tail[:, :rem]
+        self.v0 += (_U64(size_mod32) << _U64(32)) + _U64(size_mod32)
+        # Rotate each 32-bit half of every v1 lane left by size_mod32 bits.
+        c = _U64(size_mod32)
+        lo = self.v1 & _MASK32
+        hi = self.v1 >> _U64(32)
+        if size_mod32:
+            lo = ((lo << c) | (lo >> (_U64(32) - c))) & _MASK32
+            hi = ((hi << c) | (hi >> (_U64(32) - c))) & _MASK32
+        self.v1 = (hi << _U64(32)) | lo
+        if size_mod32 & 16:
+            for i in range(4):
+                packet[:, 28 + i] = tail[:, rem + i + size_mod4 - 4]
+        elif size_mod4:
+            packet[:, 16] = tail[:, rem]
+            packet[:, 17] = tail[:, rem + (size_mod4 >> 1)]
+            packet[:, 18] = tail[:, rem + size_mod4 - 1]
+        self.update(packet.reshape(s, 1, 32).view("<u8").astype(_U64)[:, 0, :])
+
+    def _permute_and_update(self) -> None:
+        v0 = self.v0
+        permuted = np.empty_like(v0)
+        permuted[:, 0] = _rot32(v0[:, 2])
+        permuted[:, 1] = _rot32(v0[:, 3])
+        permuted[:, 2] = _rot32(v0[:, 0])
+        permuted[:, 3] = _rot32(v0[:, 1])
+        self.update(permuted)
+
+    def finalize256(self) -> np.ndarray:
+        """Returns uint8 [S, 32]. State is consumed (call reset to reuse)."""
+        for _ in range(10):
+            self._permute_and_update()
+        h = np.empty((self.streams, 4), dtype=_U64)
+        self._modular_reduction(self.v1[:, 1] + self.mul1[:, 1],
+                                self.v1[:, 0] + self.mul1[:, 0],
+                                self.v0[:, 1] + self.mul0[:, 1],
+                                self.v0[:, 0] + self.mul0[:, 0], h, 1, 0)
+        self._modular_reduction(self.v1[:, 3] + self.mul1[:, 3],
+                                self.v1[:, 2] + self.mul1[:, 2],
+                                self.v0[:, 3] + self.mul0[:, 3],
+                                self.v0[:, 2] + self.mul0[:, 2], h, 3, 2)
+        return h.astype("<u8").view(np.uint8).reshape(self.streams, 32)
+
+    @staticmethod
+    def _modular_reduction(a3u, a2, a1, a0, out, i1, i0):
+        a3 = a3u & _U64(0x3FFFFFFFFFFFFFFF)
+        out[:, i1] = a1 ^ ((a3 << _U64(1)) | (a2 >> _U64(63))) \
+            ^ ((a3 << _U64(2)) | (a2 >> _U64(62)))
+        out[:, i0] = a0 ^ (a2 << _U64(1)) ^ (a2 << _U64(2))
+
+
+def highwayhash256(key: bytes, data: bytes | np.ndarray) -> bytes:
+    """One-shot single-stream HighwayHash-256."""
+    buf = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray, memoryview)) \
+        else np.asarray(data, dtype=np.uint8)
+    st = HighwayState(key, streams=1)
+    n = buf.size
+    full = n // 32
+    if full:
+        st.update_packets(buf[:full * 32].reshape(1, full, 32))
+    if n % 32:
+        st.update_remainder(buf[full * 32:][None, :], n % 32)
+    return st.finalize256()[0].tobytes()
+
+
+def highwayhash256_many(key: bytes, blocks: np.ndarray) -> np.ndarray:
+    """Hash S equal-length blocks in lockstep: uint8 [S, L] -> uint8 [S, 32].
+
+    This is the bitrot hot path: the S streams are the shard blocks of a
+    stripe batch, hashed with one vectorized recurrence instead of S
+    sequential hashes.
+    """
+    blocks = np.ascontiguousarray(blocks, dtype=np.uint8)
+    s, n = blocks.shape
+    st = HighwayState(key, streams=s)
+    full = n // 32
+    if full:
+        st.update_packets(blocks[:, :full * 32].reshape(s, full, 32))
+    if n % 32:
+        st.update_remainder(blocks[:, full * 32:], n % 32)
+    return st.finalize256()

@@ -1,0 +1,52 @@
+"""Bitrot hashing: golden self-test, magic-key oracle, vectorized lockstep."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from minio_tpu.storage import bitrot
+from minio_tpu.utils.highwayhash import (MAGIC_KEY, highwayhash256,
+                                         highwayhash256_many)
+
+
+def test_reference_golden_selftest():
+    # Byte-identical to cmd/bitrot.go:224-255 or we'd corrupt data.
+    bitrot.bitrot_self_test()
+
+
+def test_magic_key_is_hh256_of_pi_decimals():
+    # The reference derives its bitrot key as HH-256 of the first 100
+    # decimals of pi under a zero key (cmd/bitrot.go:36-37). This exercises
+    # the remainder (non-multiple-of-32) path: 100 = 3 packets + 4 bytes.
+    pi100 = ("14159265358979323846264338327950288419716939937510"
+             "58209749445923078164062862089986280348253421170679")
+    assert highwayhash256(b"\x00" * 32, pi100.encode()) == MAGIC_KEY
+
+
+@pytest.mark.parametrize("length", [0, 1, 3, 4, 15, 16, 17, 31, 32, 33,
+                                    63, 64, 100, 1000, 4097])
+def test_many_matches_single(length):
+    rng = np.random.default_rng(length)
+    blocks = rng.integers(0, 256, size=(5, length), dtype=np.uint8)
+    got = highwayhash256_many(MAGIC_KEY, blocks)
+    for i in range(5):
+        assert got[i].tobytes() == highwayhash256(MAGIC_KEY, blocks[i].tobytes())
+
+
+@pytest.mark.parametrize("algo", [bitrot.SHA256, bitrot.BLAKE2B512,
+                                  bitrot.HIGHWAYHASH256, bitrot.HIGHWAYHASH256S])
+def test_hash_blocks_many_all_algorithms(algo):
+    rng = np.random.default_rng(9)
+    blocks = rng.integers(0, 256, size=(3, 333), dtype=np.uint8)
+    got = bitrot.hash_blocks_many(algo, blocks)
+    assert got.shape == (3, bitrot.digest_size(algo))
+    for i in range(3):
+        assert got[i].tobytes() == bitrot.hash_block(algo, blocks[i].tobytes())
+
+
+def test_non_highway_algorithms_are_stdlib():
+    data = b"minio-tpu bitrot"
+    assert bitrot.hash_block(bitrot.SHA256, data) == hashlib.sha256(data).digest()
+    assert bitrot.hash_block(bitrot.BLAKE2B512, data) == \
+        hashlib.blake2b(data, digest_size=64).digest()
