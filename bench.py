@@ -1,0 +1,89 @@
+"""Headline benchmark: EC:4 (8+4) Reed-Solomon encode of 1 MiB stripe
+blocks on one TPU chip — the hot loop of PutObject (reference:
+cmd/erasure-encode.go:69, BASELINE.json configs[1]).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: 25 GiB/s — the AVX512 throughput class of the reference's
+klauspost/reedsolomon backend for EC 8+4 on a modern server core-complex
+(the reference publishes no absolute numbers, BASELINE.md; klauspost's
+own amd64 AVX512 benchmarks land in the 14-30 GiB/s range for these
+shapes). vs_baseline > 1 means the TPU path beats AVX512.
+
+Methodology note: the axon tunnel acks dispatches asynchronously and a
+host readback costs ~150 ms, so per-call wall timing is useless. We
+chain ITERS kernel applications inside one jit (each iteration's input
+depends on the previous output) and difference a 1-iteration run from a
+(1+ITERS)-iteration run to cancel both the readback latency and the
+jit/dispatch constant.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+BASELINE_GIBPS = 25.0
+K, M = 8, 4
+BLOCK = 1 << 20            # reference blockSizeV2 (cmd/object-api-common.go:37)
+BATCH = 64                 # stripes per device step
+ITERS = 200
+
+
+def _median_time(fn, reps=5):
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from minio_tpu.ops import gf256, rs_device
+
+    shard_len = BLOCK // K
+    encode = rs_device.make_encoder(gf256.parity_matrix(K, M))
+
+    def chained(n):
+        @jax.jit
+        def f(x_):
+            def body(_, x):
+                par = encode(x)
+                # Dependency chain: fold one parity byte back into the data
+                # so iterations cannot be elided or overlapped.
+                return x ^ par[:, :1, :1]
+            x_ = jax.lax.fori_loop(0, n, body, x_)
+            return x_[0, 0, 0]
+        return f
+
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(
+        rng.integers(0, 256, size=(BATCH, K, shard_len), dtype=np.uint8))
+
+    f1, fn = chained(1), chained(1 + ITERS)
+    _ = int(f1(data))      # compile + warm
+    _ = int(fn(data))
+    t1 = _median_time(lambda: int(f1(data)))
+    tn = _median_time(lambda: int(fn(data)))
+    per_iter = max((tn - t1) / ITERS, 1e-9)
+
+    data_bytes = BATCH * K * shard_len
+    gibps = data_bytes / per_iter / (1 << 30)
+    print(json.dumps({
+        "metric": "ec_encode_8p4_1mib_gibps_per_chip",
+        "value": round(gibps, 2),
+        "unit": "GiB/s",
+        "vs_baseline": round(gibps / BASELINE_GIBPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

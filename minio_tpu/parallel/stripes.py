@@ -1,0 +1,105 @@
+"""Multi-chip stripe parallelism: the TPU analogue of erasure striping.
+
+The reference parallelises one erasure stripe across n drives
+(multiWriter fan-out, reference: cmd/erasure-encode.go:27-110) and
+scales out by hashing objects across independent erasure sets
+(cmd/erasure-sets.go:663). On a TPU pod the same two axes become a
+`jax.sharding.Mesh`:
+
+  * ``stripe`` — data parallelism over independent stripe batches
+    (the analogue of set-level scale-out: stripes never talk to each
+    other, so this axis needs no collectives for encode);
+  * ``shard``  — the k+m shard axis (the analogue of the drive fan-out:
+    decode/heal gathers k surviving shards, which becomes an
+    ``all_gather`` riding ICI instead of n NVMe/network reads).
+
+Everything here is pure-jit SPMD: the same program runs on every chip,
+XLA inserts the collectives implied by the sharding annotations.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from minio_tpu.ops import gf256
+from minio_tpu.ops import rs_device
+
+
+def make_mesh(devices=None, stripe_parallel: int | None = None) -> Mesh:
+    """A ("stripe", "shard") mesh over the given devices.
+
+    The shard axis gets the largest power-of-two factor <= 4 of the device
+    count (shard fan-out is latency-bound, keep it on adjacent chips);
+    the rest goes to the embarrassingly-parallel stripe axis.
+    """
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    n = devices.size
+    if stripe_parallel is None:
+        shard_par = 4 if n % 4 == 0 else (2 if n % 2 == 0 else 1)
+        stripe_parallel = n // shard_par
+    shard_par = n // stripe_parallel
+    return Mesh(devices.reshape(stripe_parallel, shard_par),
+                axis_names=("stripe", "shard"))
+
+
+def encode_step(mesh: Mesh, k: int, m: int):
+    """Build the jitted full encode step for one (k, m) config.
+
+    Input  : data uint8 [B, k, L], sharded over stripes.
+    Output : shards uint8 [B, k+m, L] sharded over (stripe, shard) — the
+             device-side layout from which per-drive writers DMA their
+             shard column out — plus a parity self-check scalar psum'd
+             over the whole mesh (the device-side analogue of the write
+             path verifying parity consistency before commit).
+    """
+    encode = rs_device.make_encoder(gf256.parity_matrix(k, m), mode="xla")
+    # Independent verification path: decode the first min(m, k) data rows
+    # back from (the remaining data rows + parity). A DIFFERENT GF matrix
+    # (a Vandermonde-submatrix inverse) computes it, so XLA cannot CSE it
+    # against the encode — a wrong bit-matrix or flaky chip shows up as a
+    # nonzero check, unlike a re-encode of identical inputs.
+    n = k + m
+    nchk = min(m, k)
+    survivors = tuple(range(nchk, n))[:k]
+    dec_rows = gf256.decode_matrix(k, m, survivors)[:nchk, :]
+    verify = rs_device.make_encoder(dec_rows, mode="xla")
+
+    data_sharding = NamedSharding(mesh, P("stripe", None, None))
+    out_sharding = NamedSharding(mesh, P("stripe", "shard", None))
+
+    @jax.jit
+    def step(data: jax.Array) -> tuple[jax.Array, jax.Array]:
+        parity = encode(data)
+        shards = jnp.concatenate([data, parity], axis=1)  # [B, k+m, L]
+        shards = jax.lax.with_sharding_constraint(shards, out_sharding)
+        redecoded = verify(shards[:, nchk:, :][:, :k, :])
+        check = jnp.sum((redecoded ^ shards[:, :nchk, :]).astype(jnp.int32))
+        return shards, check
+
+    return step, data_sharding
+
+
+def decode_gather_step(mesh: Mesh, k: int, m: int, missing: tuple[int, ...]):
+    """Jitted reconstruct of missing DATA shards from k survivors.
+
+    `missing` lists lost shard indices (data or parity); only the data
+    rows (< k) are produced, like the reference's DecodeDataBlocks —
+    parity re-derives from data on the heal path. Input: survivors uint8
+    [B, k, L] (the first k available shard rows, like the reference's
+    ReconstructData), sharded over (stripe, shard) — the gather of
+    survivor rows onto each chip is XLA's all_gather over the shard
+    axis, the ICI replacement for the reference's k parallel drive reads
+    (cmd/erasure-decode.go:127-221).
+    """
+    n = k + m
+    available = tuple(i for i in range(n) if i not in missing)[:k]
+    dec = gf256.decode_matrix(k, m, available)
+    missing_data = [i for i in missing if i < k]
+    reconstruct = rs_device.make_encoder(dec[missing_data, :], mode="xla")
+
+    in_sharding = NamedSharding(mesh, P("stripe", "shard", None))
+    step = jax.jit(reconstruct)
+    return step, in_sharding
