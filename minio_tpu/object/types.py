@@ -1,0 +1,128 @@
+"""Object-layer types and errors (ObjectLayer interface vocabulary).
+
+Mirrors the reference's object-API types (cmd/object-api-datatypes.go,
+cmd/object-api-errors.go) at the granularity the S3 front-end needs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+class ObjectError(Exception):
+    """Base class; carries bucket/object for S3 error rendering."""
+
+    def __init__(self, bucket: str = "", object_: str = "", msg: str = ""):
+        self.bucket = bucket
+        self.object = object_
+        super().__init__(msg or f"{type(self).__name__}: {bucket}/{object_}")
+
+
+class BucketNotFound(ObjectError):
+    pass
+
+
+class BucketExists(ObjectError):
+    pass
+
+
+class BucketNotEmpty(ObjectError):
+    pass
+
+
+class ObjectNotFound(ObjectError):
+    pass
+
+
+class VersionNotFound(ObjectError):
+    pass
+
+
+class MethodNotAllowed(ObjectError):
+    """e.g. GET on a delete marker."""
+
+
+class InvalidRange(ObjectError):
+    pass
+
+
+class ReadQuorumError(ObjectError):
+    """errErasureReadQuorum: not enough consistent metadata/shards."""
+
+
+class WriteQuorumError(ObjectError):
+    """errErasureWriteQuorum: too few successful writes."""
+
+
+class InvalidArgument(ObjectError):
+    pass
+
+
+class PreconditionFailed(ObjectError):
+    pass
+
+
+@dataclasses.dataclass
+class BucketInfo:
+    name: str
+    created: int = 0  # ns epoch
+    versioning: bool = False
+
+
+@dataclasses.dataclass
+class ObjectInfo:
+    bucket: str = ""
+    name: str = ""
+    mod_time: int = 0
+    size: int = 0
+    etag: str = ""
+    content_type: str = ""
+    version_id: str = ""
+    is_latest: bool = True
+    delete_marker: bool = False
+    user_metadata: dict = dataclasses.field(default_factory=dict)
+    parts: list = dataclasses.field(default_factory=list)
+    is_dir: bool = False
+    actual_size: int = 0
+    storage_class: str = "STANDARD"
+
+
+@dataclasses.dataclass
+class ListObjectsInfo:
+    is_truncated: bool = False
+    next_marker: str = ""
+    next_continuation_token: str = ""
+    objects: list[ObjectInfo] = dataclasses.field(default_factory=list)
+    prefixes: list[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class PutOptions:
+    version_id: str = ""
+    versioned: bool = False
+    user_metadata: dict = dataclasses.field(default_factory=dict)
+    content_type: str = ""
+    storage_class: str = "STANDARD"
+    mod_time: int = 0
+
+
+@dataclasses.dataclass
+class GetOptions:
+    version_id: str = ""
+    offset: int = 0
+    length: int = -1   # -1 = to end
+
+
+@dataclasses.dataclass
+class DeleteOptions:
+    version_id: str = ""
+    versioned: bool = False
+
+
+@dataclasses.dataclass
+class DeletedObject:
+    object_name: str = ""
+    version_id: str = ""
+    delete_marker: bool = False
+    delete_marker_version_id: str = ""

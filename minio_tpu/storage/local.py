@@ -1,0 +1,484 @@
+"""Local drive backend: the per-drive POSIX storage engine.
+
+The analogue of the reference's xlStorage (cmd/xl-storage.go): one
+instance manages one drive (a directory tree), storing each object as
+
+    <root>/<volume>/<object>/xl.meta          version journal (meta.py)
+    <root>/<volume>/<object>/<dataDir>/part.N shard files (bitrot-framed)
+    <root>/.mtpu.sys/tmp/<uuid>               staging for crash-safe commits
+
+Writes land in tmp and are atomically renamed into place with fsync
+(reference: CreateFile cmd/xl-storage.go:2092, RenameData :2557) so a
+crash never exposes a partial object. Small shards inline into xl.meta
+instead of separate files (reference threshold semantics,
+internal/config/storageclass/storage-class.go:278).
+
+This layer is deliberately synchronous & thread-safe per path; the
+erasure object layer above fans out across drives with a thread pool the
+way the reference fans out goroutines.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import os
+import shutil
+import threading
+import uuid as uuid_mod
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+from minio_tpu.storage import meta as metafmt
+from minio_tpu.storage.meta import (FileInfo, FileNotFoundErr, MetaError,
+                                    VersionNotFoundErr, XLMeta)
+
+SYS_VOL = ".mtpu.sys"
+META_FILE = "xl.meta"
+TMP_DIR = "tmp"
+FORMAT_FILE = "format.json"
+
+
+class StorageError(Exception):
+    pass
+
+
+class VolumeNotFound(StorageError):
+    pass
+
+
+class VolumeExists(StorageError):
+    pass
+
+
+class VolumeNotEmpty(StorageError):
+    pass
+
+
+class DiskAccessDenied(StorageError):
+    pass
+
+
+class FaultyDisk(StorageError):
+    pass
+
+
+@dataclass
+class DiskInfo:
+    total: int = 0
+    free: int = 0
+    used: int = 0
+    root_disk: bool = False
+    healing: bool = False
+    endpoint: str = ""
+    disk_id: str = ""
+    error: str = ""
+
+
+@dataclass
+class VolInfo:
+    name: str
+    created: int = 0
+
+
+def _is_valid_volname(vol: str) -> bool:
+    return bool(vol) and vol not in (".", "..") and "/" not in vol and "\\" not in vol
+
+
+class LocalStorage:
+    """One local drive. All paths are (volume, object-path) pairs."""
+
+    def __init__(self, root: str, endpoint: str = ""):
+        self.root = os.path.abspath(root)
+        self.endpoint = endpoint or self.root
+        self._disk_id: Optional[str] = None
+        self._lock = threading.Lock()          # guards _path_locks
+        self._path_locks: dict[str, threading.Lock] = {}
+        os.makedirs(os.path.join(self.root, SYS_VOL, TMP_DIR), exist_ok=True)
+
+    def _path_lock(self, volume: str, path: str) -> threading.Lock:
+        """Per-object lock serializing xl.meta read-modify-write cycles.
+
+        Bounded: the map is pruned opportunistically (uncontended locks
+        are dropped once the map grows past a soft cap)."""
+        key = f"{volume}/{path}"
+        with self._lock:
+            lk = self._path_locks.get(key)
+            if lk is None:
+                if len(self._path_locks) > 4096:
+                    for k in [k for k, v in self._path_locks.items()
+                              if not v.locked()][:2048]:
+                        del self._path_locks[k]
+                lk = self._path_locks[key] = threading.Lock()
+            return lk
+
+    # ------------------------------------------------------------------
+    # identity (format.json, reference: cmd/format-erasure.go)
+    # ------------------------------------------------------------------
+
+    def read_format(self) -> Optional[dict]:
+        try:
+            with open(os.path.join(self.root, SYS_VOL, FORMAT_FILE), "rb") as f:
+                return json.loads(f.read())
+        except FileNotFoundError:
+            return None
+
+    def write_format(self, fmt: dict) -> None:
+        blob = json.dumps(fmt, indent=2).encode()
+        self._atomic_write(os.path.join(self.root, SYS_VOL, FORMAT_FILE), blob)
+        self._disk_id = fmt.get("xl", {}).get("this")
+
+    def disk_id(self) -> str:
+        if self._disk_id is None:
+            fmt = self.read_format()
+            self._disk_id = fmt.get("xl", {}).get("this", "") if fmt else ""
+        return self._disk_id or ""
+
+    def is_online(self) -> bool:
+        return os.path.isdir(os.path.join(self.root, SYS_VOL))
+
+    # ------------------------------------------------------------------
+    # path helpers
+    # ------------------------------------------------------------------
+
+    def _vol_dir(self, volume: str) -> str:
+        if not _is_valid_volname(volume):
+            raise StorageError(f"invalid volume name {volume!r}")
+        return os.path.join(self.root, volume)
+
+    def _obj_dir(self, volume: str, path: str) -> str:
+        base = self._vol_dir(volume)
+        full = os.path.normpath(os.path.join(base, path))
+        if not full.startswith(base + os.sep) and full != base:
+            raise DiskAccessDenied(path)  # path escape
+        return full
+
+    def _tmp_path(self) -> str:
+        return os.path.join(self.root, SYS_VOL, TMP_DIR, str(uuid_mod.uuid4()))
+
+    @staticmethod
+    def _fsync_dir(path: str) -> None:
+        try:
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:
+            pass
+
+    def _atomic_write(self, dest: str, data: bytes) -> None:
+        """tmp + fsync + rename: the crash-consistency primitive."""
+        tmp = self._tmp_path()
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, dest)
+        self._fsync_dir(os.path.dirname(dest))
+
+    # ------------------------------------------------------------------
+    # volumes
+    # ------------------------------------------------------------------
+
+    def make_vol(self, volume: str) -> None:
+        d = self._vol_dir(volume)
+        if os.path.isdir(d):
+            raise VolumeExists(volume)
+        os.makedirs(d)
+
+    def make_vol_if_missing(self, volume: str) -> None:
+        os.makedirs(self._vol_dir(volume), exist_ok=True)
+
+    def list_vols(self) -> list[VolInfo]:
+        out = []
+        for name in sorted(os.listdir(self.root)):
+            if name == SYS_VOL or not _is_valid_volname(name):
+                continue
+            st = os.stat(os.path.join(self.root, name))
+            if os.path.isdir(os.path.join(self.root, name)):
+                out.append(VolInfo(name=name, created=int(st.st_ctime_ns)))
+        return out
+
+    def stat_vol(self, volume: str) -> VolInfo:
+        d = self._vol_dir(volume)
+        if not os.path.isdir(d):
+            raise VolumeNotFound(volume)
+        return VolInfo(name=volume, created=int(os.stat(d).st_ctime_ns))
+
+    def delete_vol(self, volume: str, force: bool = False) -> None:
+        d = self._vol_dir(volume)
+        if not os.path.isdir(d):
+            raise VolumeNotFound(volume)
+        if force:
+            shutil.rmtree(d)
+            return
+        try:
+            os.rmdir(d)
+        except OSError as e:
+            if e.errno in (errno.ENOTEMPTY, errno.EEXIST):
+                raise VolumeNotEmpty(volume) from e
+            raise
+
+    # ------------------------------------------------------------------
+    # raw file ops
+    # ------------------------------------------------------------------
+
+    def write_all(self, volume: str, path: str, data: bytes) -> None:
+        self._atomic_write(self._obj_dir(volume, path), data)
+
+    def read_all(self, volume: str, path: str) -> bytes:
+        try:
+            with open(self._obj_dir(volume, path), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise FileNotFoundErr(f"{volume}/{path}") from None
+        except IsADirectoryError:
+            raise FileNotFoundErr(f"{volume}/{path}") from None
+
+    def delete(self, volume: str, path: str, recursive: bool = False) -> None:
+        full = self._obj_dir(volume, path)
+        try:
+            if recursive:
+                shutil.rmtree(full)
+            elif os.path.isdir(full):
+                os.rmdir(full)
+            else:
+                os.remove(full)
+        except FileNotFoundError:
+            raise FileNotFoundErr(f"{volume}/{path}") from None
+        self._rm_empty_parents(os.path.dirname(full), self._vol_dir(volume))
+
+    def _rm_empty_parents(self, d: str, stop: str) -> None:
+        while d.startswith(stop + os.sep):
+            try:
+                os.rmdir(d)
+            except OSError:
+                return
+            d = os.path.dirname(d)
+
+    # ------------------------------------------------------------------
+    # shard files (streaming writes land in tmp, commit via rename_data)
+    # ------------------------------------------------------------------
+
+    def create_file(self, volume: str, path: str, data: bytes | Iterator[bytes]) -> None:
+        """Write a shard file with fsync (callers pass bitrot-framed bytes)."""
+        dest = self._obj_dir(volume, path)
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        with open(dest, "wb") as f:
+            if isinstance(data, (bytes, bytearray, memoryview)):
+                f.write(data)
+            else:
+                for chunk in data:
+                    f.write(chunk)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def read_file(self, volume: str, path: str, offset: int = 0,
+                  length: int = -1) -> bytes:
+        try:
+            with open(self._obj_dir(volume, path), "rb") as f:
+                f.seek(offset)
+                return f.read() if length < 0 else f.read(length)
+        except FileNotFoundError:
+            raise FileNotFoundErr(f"{volume}/{path}") from None
+
+    def stat_info_file(self, volume: str, path: str) -> os.stat_result:
+        try:
+            return os.stat(self._obj_dir(volume, path))
+        except FileNotFoundError:
+            raise FileNotFoundErr(f"{volume}/{path}") from None
+
+    # ------------------------------------------------------------------
+    # versioned object metadata
+    # ------------------------------------------------------------------
+
+    def _meta_path(self, volume: str, path: str) -> str:
+        return os.path.join(self._obj_dir(volume, path), META_FILE)
+
+    def _read_meta(self, volume: str, path: str) -> XLMeta:
+        try:
+            with open(self._meta_path(volume, path), "rb") as f:
+                return XLMeta.load(f.read())
+        except FileNotFoundError:
+            raise FileNotFoundErr(f"{volume}/{path}") from None
+
+    def _reclaim_data_dir(self, volume: str, path: str, data_dir: str) -> None:
+        if data_dir:
+            shutil.rmtree(os.path.join(self._obj_dir(volume, path), data_dir),
+                          ignore_errors=True)
+
+    def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
+        """Append/replace one version in the journal (creates it fresh)."""
+        with self._path_lock(volume, path):
+            try:
+                xl = self._read_meta(volume, path)
+            except FileNotFoundErr:
+                xl = XLMeta()
+            old_ddir = xl.add_version(fi)
+            self._atomic_write(self._meta_path(volume, path), xl.dump())
+            self._reclaim_data_dir(volume, path, old_ddir)
+
+    def update_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
+        with self._path_lock(volume, path):
+            xl = self._read_meta(volume, path)
+            if xl._find(fi.storage_version_id()) is None:
+                raise VersionNotFoundErr(fi.version_id)
+            old_ddir = xl.add_version(fi)
+            self._atomic_write(self._meta_path(volume, path), xl.dump())
+            self._reclaim_data_dir(volume, path, old_ddir)
+
+    def read_version(self, volume: str, path: str, version_id: str = "",
+                     read_data: bool = False) -> FileInfo:
+        xl = self._read_meta(volume, path)
+        return xl.to_fileinfo(volume, path, version_id, read_data=read_data)
+
+    def read_xl(self, volume: str, path: str) -> bytes:
+        return self.read_all(volume, os.path.join(path, META_FILE))
+
+    def list_versions(self, volume: str, path: str) -> list[FileInfo]:
+        xl = self._read_meta(volume, path)
+        return xl.list_versions(volume, path)
+
+    def delete_version(self, volume: str, path: str, version_id: str = "",
+                       force_del_marker: bool = False) -> None:
+        """Remove one version; drops shard data when unreferenced; removes
+        the whole object dir when the journal empties (reference:
+        DeleteVersion, cmd/xl-storage.go)."""
+        with self._path_lock(volume, path):
+            xl = self._read_meta(volume, path)
+            vid = version_id or metafmt.NULL_VERSION_ID
+            v = xl._find(vid)
+            if v is None:
+                raise VersionNotFoundErr(version_id)
+            data_dir = xl.delete_version(version_id)
+            if data_dir and xl.shared_data_dir_count(vid, data_dir) == 0:
+                self._reclaim_data_dir(volume, path, data_dir)
+            if not xl.versions:
+                self.delete(volume, path, recursive=True)
+                return
+            self._atomic_write(self._meta_path(volume, path), xl.dump())
+
+    # ------------------------------------------------------------------
+    # the commit protocol
+    # ------------------------------------------------------------------
+
+    def rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
+                    dst_volume: str, dst_path: str) -> None:
+        """Atomically commit staged shard data + a new version.
+
+        Staged layout (written by the erasure layer):
+            <src>/<src_path>/<data_dir>/part.N
+        Commit = move data dir into the object dir, then write the merged
+        xl.meta (reference: RenameData, cmd/xl-storage.go:2557 — data
+        moves first, metadata write is the commit point).
+        """
+        dst_dir = self._obj_dir(dst_volume, dst_path)
+        with self._path_lock(dst_volume, dst_path):
+            try:
+                xl = self._read_meta(dst_volume, dst_path)
+            except FileNotFoundErr:
+                xl = XLMeta()
+                fi.fresh = True
+            if fi.data_dir:
+                src_data = os.path.join(self._obj_dir(src_volume, src_path),
+                                        fi.data_dir)
+                dst_data = os.path.join(dst_dir, fi.data_dir)
+                os.makedirs(dst_dir, exist_ok=True)
+                if os.path.isdir(dst_data):
+                    shutil.rmtree(dst_data)
+                os.replace(src_data, dst_data)
+            old_ddir = xl.add_version(fi)
+            self._atomic_write(os.path.join(dst_dir, META_FILE), xl.dump())
+            self._reclaim_data_dir(dst_volume, dst_path, old_ddir)
+        # Clean the now-empty staging dir.
+        shutil.rmtree(self._obj_dir(src_volume, src_path), ignore_errors=True)
+
+    # ------------------------------------------------------------------
+    # listing / walking
+    # ------------------------------------------------------------------
+
+    def list_dir(self, volume: str, dir_path: str, count: int = -1) -> list[str]:
+        """Entries of one directory level: files as-is, dirs with '/'."""
+        base = self._obj_dir(volume, dir_path) if dir_path else self._vol_dir(volume)
+        try:
+            names = sorted(os.listdir(base))
+        except FileNotFoundError:
+            raise FileNotFoundErr(f"{volume}/{dir_path}") from None
+        out = []
+        for n in names:
+            if os.path.isdir(os.path.join(base, n)):
+                out.append(n + "/")
+            else:
+                out.append(n)
+            if 0 < count <= len(out):
+                break
+        return out
+
+    def walk_dir(self, volume: str, base_dir: str = "",
+                 recursive: bool = True,
+                 forward_from: str = "") -> Iterator[tuple[str, bytes]]:
+        """Yield (object_path, raw xl.meta) sorted, streaming.
+
+        The per-drive listing primitive (reference: WalkDir,
+        cmd/metacache-walk.go:73): depth-first sorted recursion; a
+        directory containing xl.meta IS an object and is yielded instead
+        of being descended into (objects can nest under object names).
+        """
+        vol = self._vol_dir(volume)
+        if not os.path.isdir(vol):
+            raise VolumeNotFound(volume)
+
+        def emit(rel: str) -> Optional[tuple[str, bytes]]:
+            try:
+                with open(os.path.join(vol, rel, META_FILE), "rb") as f:
+                    return rel, f.read()
+            except (FileNotFoundError, NotADirectoryError):
+                return None
+
+        def is_uuid(n: str) -> bool:
+            try:
+                uuid_mod.UUID(n)
+                return True
+            except ValueError:
+                return False
+
+        def walk(rel: str, parent_is_obj: bool) -> Iterator[tuple[str, bytes]]:
+            full = os.path.join(vol, rel) if rel else vol
+            try:
+                names = sorted(os.listdir(full))
+            except (FileNotFoundError, NotADirectoryError):
+                return
+            for n in names:
+                if n == META_FILE:
+                    continue
+                if parent_is_obj and is_uuid(n):
+                    continue  # version data dir, not a key prefix
+                child = f"{rel}/{n}" if rel else n
+                if child < forward_from[:len(child)]:
+                    continue
+                if os.path.isdir(os.path.join(full, n)):
+                    got = emit(child)
+                    if got is not None:
+                        yield got
+                        # Objects can nest under an object name (key "a"
+                        # and "a/b" coexist) — keep descending.
+                        if recursive:
+                            yield from walk(child, True)
+                    elif recursive:
+                        yield from walk(child, False)
+                    else:
+                        yield child + "/", b""
+        yield from walk(base_dir, False)
+
+    # ------------------------------------------------------------------
+    # health / usage
+    # ------------------------------------------------------------------
+
+    def disk_info(self) -> DiskInfo:
+        st = os.statvfs(self.root)
+        total = st.f_blocks * st.f_frsize
+        free = st.f_bavail * st.f_frsize
+        return DiskInfo(total=total, free=free, used=total - free,
+                        endpoint=self.endpoint, disk_id=self.disk_id())

@@ -1,0 +1,276 @@
+"""Object metadata: the per-object version journal ("xl.meta" analogue).
+
+The reference keeps one small metadata file next to each object's shard
+data holding a journal of versions (objects, delete markers), erasure
+layout, part list, and — for small objects — the shard bytes inline
+(reference: cmd/xl-storage-format-v2.go:42-88, cmd/storage-datatypes.go:191,
+cmd/xl-storage-meta-inline.go). We keep those semantics — version
+journal, latest-first ordering, delete markers, inline data, per-version
+data dirs — with our own msgpack layout (no byte-level format
+compatibility is needed; quorum comparison happens on parsed values).
+
+File layout: 4-byte magic ``XTP1`` + msgpack map:
+  {"versions": [version-map, ...], "inline": {version_id: bytes}}
+Versions are stored sorted by (mod_time, version_id) descending, so
+index 0 is the latest — same invariant the reference maintains.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import uuid as uuid_mod
+from typing import Optional
+
+import msgpack
+
+MAGIC = b"XTP1"
+
+# Version kinds (reference: object / delete-marker / legacy journal entries,
+# cmd/xl-storage-format-v2.go:73-88).
+KIND_OBJECT = 1
+KIND_DELETE_MARKER = 2
+
+NULL_VERSION_ID = "null"
+
+
+def new_uuid() -> str:
+    return str(uuid_mod.uuid4())
+
+
+def now_ns() -> int:
+    return time.time_ns()
+
+
+@dataclasses.dataclass
+class ErasureInfo:
+    """Per-disk erasure layout of one version (reference: ErasureInfo,
+    cmd/storage-datatypes.go; checksums cover the bitrot algorithm per part)."""
+    algorithm: str = "rs-vandermonde"
+    data_blocks: int = 0
+    parity_blocks: int = 0
+    block_size: int = 0
+    index: int = 0               # 1-based shard index held by this disk
+    distribution: tuple[int, ...] = ()
+    checksums: list[dict] = dataclasses.field(default_factory=list)
+
+    def to_map(self) -> dict:
+        return {
+            "alg": self.algorithm, "k": self.data_blocks,
+            "m": self.parity_blocks, "bs": self.block_size,
+            "idx": self.index, "dist": list(self.distribution),
+            "cks": self.checksums,
+        }
+
+    @classmethod
+    def from_map(cls, m: dict) -> "ErasureInfo":
+        return cls(algorithm=m.get("alg", ""), data_blocks=m.get("k", 0),
+                   parity_blocks=m.get("m", 0), block_size=m.get("bs", 0),
+                   index=m.get("idx", 0),
+                   distribution=tuple(m.get("dist", ())),
+                   checksums=list(m.get("cks", ())))
+
+    def shard_size(self) -> int:
+        from minio_tpu.erasure.codec import ceil_frac
+        return ceil_frac(self.block_size, self.data_blocks)
+
+    def shard_file_size(self, total: int) -> int:
+        from minio_tpu.erasure.codec import Erasure
+        return Erasure(self.data_blocks, self.parity_blocks,
+                       self.block_size).shard_file_size(total)
+
+
+@dataclasses.dataclass
+class ObjectPartInfo:
+    number: int
+    size: int                    # on-wire (possibly compressed/encrypted) size
+    actual_size: int             # original client payload size
+    mod_time: int = 0
+    etag: str = ""
+
+    def to_map(self) -> dict:
+        return {"n": self.number, "s": self.size, "as": self.actual_size,
+                "mt": self.mod_time, "etag": self.etag}
+
+    @classmethod
+    def from_map(cls, m: dict) -> "ObjectPartInfo":
+        return cls(number=m["n"], size=m["s"], actual_size=m.get("as", m["s"]),
+                   mod_time=m.get("mt", 0), etag=m.get("etag", ""))
+
+
+@dataclasses.dataclass
+class FileInfo:
+    """One version of one object as seen by one disk (reference: FileInfo,
+    cmd/storage-datatypes.go:191). This is the unit quorum logic compares."""
+    volume: str = ""
+    name: str = ""
+    version_id: str = ""         # "" == null version
+    is_latest: bool = True
+    deleted: bool = False        # delete marker
+    data_dir: str = ""
+    mod_time: int = 0            # ns since epoch
+    size: int = 0
+    metadata: dict = dataclasses.field(default_factory=dict)
+    parts: list[ObjectPartInfo] = dataclasses.field(default_factory=list)
+    erasure: ErasureInfo = dataclasses.field(default_factory=ErasureInfo)
+    inline_data: Optional[bytes] = None
+    fresh: bool = False          # first write of this object path
+    successor_mod_time: int = 0
+
+    def storage_version_id(self) -> str:
+        return self.version_id or NULL_VERSION_ID
+
+    def to_version_map(self) -> dict:
+        v = {
+            "kind": KIND_DELETE_MARKER if self.deleted else KIND_OBJECT,
+            "vid": self.storage_version_id(),
+            "mt": self.mod_time,
+        }
+        if not self.deleted:
+            v.update({
+                "ddir": self.data_dir, "size": self.size,
+                "meta": dict(self.metadata),
+                "parts": [p.to_map() for p in self.parts],
+                "ec": self.erasure.to_map(),
+                "inline": self.inline_data is not None,
+            })
+        else:
+            v["meta"] = dict(self.metadata)
+        return v
+
+
+class MetaError(Exception):
+    pass
+
+
+class FileNotFoundErr(MetaError):
+    pass
+
+
+class VersionNotFoundErr(MetaError):
+    pass
+
+
+class MethodNotAllowedErr(MetaError):
+    """Read of a delete marker (maps to S3 MethodNotAllowed)."""
+
+
+class XLMeta:
+    """The parsed version journal of one object path on one disk."""
+
+    def __init__(self) -> None:
+        self.versions: list[dict] = []        # sorted latest-first
+        self.inline: dict[str, bytes] = {}    # version_id -> shard bytes
+
+    # -- serialization ------------------------------------------------------
+
+    def dump(self) -> bytes:
+        return MAGIC + msgpack.packb(
+            {"versions": self.versions, "inline": self.inline},
+            use_bin_type=True)
+
+    @classmethod
+    def load(cls, blob: bytes) -> "XLMeta":
+        if len(blob) < 4 or blob[:4] != MAGIC:
+            raise MetaError("bad object metadata magic")
+        m = msgpack.unpackb(blob[4:], raw=False, strict_map_key=False)
+        x = cls()
+        x.versions = list(m.get("versions", ()))
+        x.inline = {k: v for k, v in m.get("inline", {}).items()}
+        return x
+
+    # -- journal ops --------------------------------------------------------
+
+    def _sort(self) -> None:
+        self.versions.sort(key=lambda v: (v["mt"], v["vid"]), reverse=True)
+
+    def add_version(self, fi: FileInfo) -> str:
+        """Insert/replace a version. Returns the replaced entry's data_dir
+        ("" if none) so callers can reclaim its shard files — overwriting
+        the null version must not leak the old data dir."""
+        vid = fi.storage_version_id()
+        old = self._find(vid)
+        old_ddir = ""
+        if old is not None:
+            self.versions.remove(old)
+            self.inline.pop(vid, None)
+            old_ddir = old.get("ddir", "") or ""
+        self.versions.append(fi.to_version_map())
+        if fi.inline_data is not None:
+            self.inline[vid] = bytes(fi.inline_data)
+        self._sort()
+        if old_ddir and old_ddir != fi.data_dir and \
+                self.shared_data_dir_count(vid, old_ddir) == 0:
+            return old_ddir
+        return ""
+
+    def delete_version(self, version_id: str) -> str:
+        """Remove a version; returns its data_dir ("" if none/inline)."""
+        vid = version_id or NULL_VERSION_ID
+        v = self._find(vid)
+        if v is None:
+            raise VersionNotFoundErr(vid)
+        self.versions.remove(v)
+        self.inline.pop(vid, None)
+        return v.get("ddir", "") if not v.get("inline") else ""
+
+    def _find(self, vid: str) -> Optional[dict]:
+        for v in self.versions:
+            if v["vid"] == vid:
+                return v
+        return None
+
+    def latest(self) -> Optional[dict]:
+        return self.versions[0] if self.versions else None
+
+    def to_fileinfo(self, volume: str, name: str, version_id: str = "",
+                    read_data: bool = False) -> FileInfo:
+        """Resolve a version (default: latest) into a FileInfo.
+
+        Mirrors the reference's ToFileInfo: requesting the latest version
+        of an object whose latest is a delete marker yields deleted=True;
+        requesting a specific missing version raises VersionNotFound.
+        """
+        if not self.versions:
+            raise FileNotFoundErr(f"{volume}/{name}")
+        if version_id:
+            v = self._find(version_id)
+            if v is None:
+                raise VersionNotFoundErr(version_id)
+        else:
+            v = self.versions[0]
+        return self._map_to_fileinfo(v, volume, name, read_data)
+
+    def _map_to_fileinfo(self, v: dict, volume: str, name: str,
+                         read_data: bool) -> FileInfo:
+        vid = v["vid"]
+        fi = FileInfo(
+            volume=volume, name=name,
+            version_id="" if vid == NULL_VERSION_ID else vid,
+            is_latest=(self.versions and self.versions[0] is v),
+            deleted=v["kind"] == KIND_DELETE_MARKER,
+            mod_time=v["mt"],
+        )
+        if fi.deleted:
+            fi.metadata = dict(v.get("meta", {}))
+            return fi
+        fi.data_dir = v.get("ddir", "")
+        fi.size = v.get("size", 0)
+        fi.metadata = dict(v.get("meta", {}))
+        fi.parts = [ObjectPartInfo.from_map(p) for p in v.get("parts", ())]
+        fi.erasure = ErasureInfo.from_map(v.get("ec", {}))
+        if v.get("inline") and read_data:
+            fi.inline_data = self.inline.get(vid)
+        elif v.get("inline"):
+            fi.inline_data = b""  # marker: data is inline, not loaded
+        return fi
+
+    def list_versions(self, volume: str, name: str) -> list[FileInfo]:
+        return [self._map_to_fileinfo(v, volume, name, read_data=False)
+                for v in self.versions]
+
+    def shared_data_dir_count(self, vid: str, data_dir: str) -> int:
+        """How many OTHER versions reference data_dir (reference keeps a
+        refcount so remaps/copies can share a data dir)."""
+        return sum(1 for v in self.versions
+                   if v.get("ddir") == data_dir and v["vid"] != vid)

@@ -1,0 +1,240 @@
+"""ErasureSet object CRUD: round-trips, quorum, bitrot recovery, versioning.
+
+The in-process harness mirrors the reference's ObjectLayer test pattern
+(cmd/test-utils_test.go prepareErasure): a real erasure set over N
+tempdir drives in one process.
+"""
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from minio_tpu.object.erasure_object import (BLOCK_SIZE, ErasureSet,
+                                             hash_order)
+from minio_tpu.object.types import (BucketExists, BucketNotFound,
+                                    DeleteOptions, GetOptions,
+                                    MethodNotAllowed, ObjectNotFound,
+                                    PutOptions, ReadQuorumError,
+                                    VersionNotFound, WriteQuorumError)
+from minio_tpu.storage.local import LocalStorage
+
+
+def make_set(tmp_path, n=4, parity=None):
+    disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(n)]
+    return ErasureSet(disks, parity=parity)
+
+
+@pytest.fixture
+def es(tmp_path):
+    s = make_set(tmp_path, 4)
+    s.make_bucket("bkt")
+    return s
+
+
+def test_bucket_lifecycle(tmp_path):
+    es = make_set(tmp_path, 4)
+    es.make_bucket("b1")
+    with pytest.raises(BucketExists):
+        es.make_bucket("b1")
+    assert [b.name for b in es.list_buckets()] == ["b1"]
+    es.delete_bucket("b1")
+    with pytest.raises(BucketNotFound):
+        es.get_bucket_info("b1")
+
+
+@pytest.mark.parametrize("size", [0, 1, 100, 128 << 10, 1 << 20,
+                                  (1 << 20) + 1, 3 * (1 << 20) + 12345])
+def test_put_get_roundtrip(es, size):
+    rng = np.random.default_rng(size + 1)
+    data = rng.integers(0, 256, size=size, dtype=np.uint8).tobytes()
+    info = es.put_object("bkt", "obj", data, PutOptions(content_type="x/y"))
+    assert info.size == size
+    got_info, payload = es.get_object("bkt", "obj")
+    assert payload == data
+    assert got_info.etag == info.etag
+    assert got_info.content_type == "x/y"
+
+
+def test_range_get(es):
+    data = bytes(range(256)) * 8192  # 2 MiB
+    es.put_object("bkt", "obj", data)
+    _, part = es.get_object("bkt", "obj", GetOptions(offset=100, length=1000))
+    assert part == data[100:1100]
+    _, tail = es.get_object("bkt", "obj",
+                            GetOptions(offset=len(data) - 5, length=5))
+    assert tail == data[-5:]
+
+
+def test_get_missing_raises(es):
+    with pytest.raises(ObjectNotFound):
+        es.get_object("bkt", "nope")
+    with pytest.raises(BucketNotFound):
+        es.get_object("nobkt", "x")
+
+
+def test_overwrite_null_version(es):
+    es.put_object("bkt", "o", b"first")
+    es.put_object("bkt", "o", b"second")
+    _, payload = es.get_object("bkt", "o")
+    assert payload == b"second"
+    assert len(es.list_versions_all("bkt", "o")) == 1
+
+
+def test_delete_object(es):
+    es.put_object("bkt", "o", b"x")
+    es.delete_object("bkt", "o")
+    with pytest.raises(ObjectNotFound):
+        es.get_object_info("bkt", "o")
+    # idempotent-ish: deleting a missing object does not raise quorum errors
+    es.delete_object("bkt", "o")
+
+
+def test_versioned_put_and_delete_marker(es):
+    i1 = es.put_object("bkt", "o", b"v1", PutOptions(versioned=True))
+    i2 = es.put_object("bkt", "o", b"v2", PutOptions(versioned=True))
+    assert i1.version_id and i2.version_id and i1.version_id != i2.version_id
+    _, latest = es.get_object("bkt", "o")
+    assert latest == b"v2"
+    _, old = es.get_object("bkt", "o", GetOptions(version_id=i1.version_id))
+    assert old == b"v1"
+
+    deleted = es.delete_object("bkt", "o", DeleteOptions(versioned=True))
+    assert deleted.delete_marker
+    with pytest.raises(MethodNotAllowed):
+        es.get_object("bkt", "o")
+    # specific versions still readable
+    _, old = es.get_object("bkt", "o", GetOptions(version_id=i2.version_id))
+    assert old == b"v2"
+    # delete the marker -> object visible again
+    es.delete_object("bkt", "o",
+                     DeleteOptions(version_id=deleted.delete_marker_version_id))
+    _, latest = es.get_object("bkt", "o")
+    assert latest == b"v2"
+    with pytest.raises(VersionNotFound):
+        es.get_object("bkt", "o", GetOptions(version_id="00000000-0000-0000-0000-000000000000"))
+
+
+def test_bitrot_corruption_recovered(es, tmp_path):
+    data = np.random.default_rng(7).integers(
+        0, 256, size=2 * (1 << 20), dtype=np.uint8).tobytes()
+    es.put_object("bkt", "obj", data)
+    # Corrupt the shard file on one drive.
+    corrupted = 0
+    root = tmp_path / "d1" / "bkt" / "obj"
+    for dirpath, _, files in os.walk(root):
+        for f in files:
+            if f.startswith("part.") and not corrupted:
+                p = os.path.join(dirpath, f)
+                blob = bytearray(open(p, "rb").read())
+                blob[100] ^= 0xFF
+                open(p, "wb").write(bytes(blob))
+                corrupted += 1
+    assert corrupted == 1
+    _, payload = es.get_object("bkt", "obj")
+    assert payload == data
+
+
+def test_indivisible_block_k3(tmp_path):
+    # k=3 does not divide the 1 MiB block: per-block zero padding path.
+    es6 = make_set(tmp_path, 6)  # EC 3+3
+    es6.make_bucket("b")
+    data = os.urandom(2 * (1 << 20) + 777)
+    es6.put_object("b", "o", data)
+    _, got = es6.get_object("b", "o")
+    assert got == data
+    _, part = es6.get_object("b", "o", GetOptions(offset=(1 << 20) - 3, length=10))
+    assert part == data[(1 << 20) - 3:(1 << 20) + 7]
+
+
+def test_one_disk_lost_still_reads(es, tmp_path):
+    data = b"hello erasure world" * 100000
+    es.put_object("bkt", "obj", data)
+    shutil.rmtree(tmp_path / "d2")
+    os.makedirs(tmp_path / "d2")
+    _, payload = es.get_object("bkt", "obj")
+    assert payload == data
+
+
+def test_too_many_disks_lost_read_quorum(es, tmp_path):
+    data = os.urandom(1 << 20)
+    es.put_object("bkt", "obj", data)  # EC 2+2 on 4 drives
+    for i in (1, 2, 3):
+        shutil.rmtree(tmp_path / f"d{i}")
+        os.makedirs(tmp_path / f"d{i}")
+    with pytest.raises((ReadQuorumError, ObjectNotFound)):
+        es.get_object("bkt", "obj")
+
+
+def test_write_quorum_failure(tmp_path):
+    es = make_set(tmp_path, 4)
+    es.make_bucket("bkt")
+    # Make 3 of 4 drives unwritable by replacing them with a broken stub.
+    class Broken:
+        def __getattr__(self, name):
+            def fail(*a, **k):
+                raise OSError("dead drive")
+            return fail
+    es.disks[1] = es.disks[2] = es.disks[3] = Broken()
+    with pytest.raises(WriteQuorumError):
+        es.put_object("bkt", "obj", b"payload")
+
+
+def test_hash_order_deterministic_permutation():
+    d = hash_order("bkt/obj", 12)
+    assert sorted(d) == list(range(1, 13))
+    assert d == hash_order("bkt/obj", 12)
+    assert d != hash_order("bkt/obj2", 12) or True  # may collide; shape matters
+
+
+def test_inline_small_objects_have_no_part_files(es, tmp_path):
+    es.put_object("bkt", "small", b"tiny payload")
+    for i in range(4):
+        objdir = tmp_path / f"d{i}" / "bkt" / "small"
+        assert (objdir / "xl.meta").exists()
+        entries = [e for e in os.listdir(objdir) if e != "xl.meta"]
+        assert entries == []
+
+
+def test_large_object_has_part_files(es, tmp_path):
+    es.put_object("bkt", "big", os.urandom(2 << 20))
+    found = 0
+    for i in range(4):
+        objdir = tmp_path / f"d{i}" / "bkt" / "big"
+        for dirpath, _, files in os.walk(objdir):
+            found += sum(1 for f in files if f.startswith("part."))
+    assert found == 4
+
+
+def test_overwrite_reclaims_old_data_dir(es, tmp_path):
+    es.put_object("bkt", "o", os.urandom(1 << 20))
+    es.put_object("bkt", "o", os.urandom(1 << 20))
+    # exactly one data dir (uuid) per drive after overwrite
+    for i in range(4):
+        objdir = tmp_path / f"d{i}" / "bkt" / "o"
+        dirs = [e for e in os.listdir(objdir) if (objdir / e).is_dir()]
+        assert len(dirs) == 1
+
+
+def test_failed_put_cleans_staging(tmp_path):
+    es = make_set(tmp_path, 4)
+    es.make_bucket("bkt")
+    # rename_data fails on 3 drives after staging succeeded.
+    class RenameFails:
+        def __init__(self, inner):
+            self._inner = inner
+        def __getattr__(self, name):
+            if name == "rename_data":
+                def boom(*a, **k):
+                    raise OSError("commit failed")
+                return boom
+            return getattr(self._inner, name)
+    for i in (1, 2, 3):
+        es.disks[i] = RenameFails(es.disks[i])
+    with pytest.raises(WriteQuorumError):
+        es.put_object("bkt", "o", os.urandom(1 << 20))
+    for i in range(4):
+        staging = tmp_path / f"d{i}" / ".mtpu.sys" / "staging"
+        leftovers = list(staging.glob("*")) if staging.exists() else []
+        assert leftovers == []

@@ -1,0 +1,163 @@
+"""Local storage engine: version journal, atomic commits, walk, bitrot framing."""
+
+import os
+
+import numpy as np
+import pytest
+
+from minio_tpu.storage import bitrot
+from minio_tpu.storage.local import (LocalStorage, StorageError, VolumeExists,
+                                     VolumeNotFound)
+from minio_tpu.storage.meta import (ErasureInfo, FileInfo, FileNotFoundErr,
+                                    ObjectPartInfo, VersionNotFoundErr,
+                                    XLMeta, new_uuid, now_ns)
+
+
+@pytest.fixture
+def disk(tmp_path):
+    return LocalStorage(str(tmp_path / "drive0"))
+
+
+def _fi(name="obj", vid="", data_dir="", size=0, deleted=False, mod_time=None):
+    return FileInfo(volume="bkt", name=name, version_id=vid,
+                    data_dir=data_dir, size=size, deleted=deleted,
+                    mod_time=mod_time if mod_time is not None else now_ns(),
+                    erasure=ErasureInfo(data_blocks=2, parity_blocks=2,
+                                        block_size=1 << 20, index=1,
+                                        distribution=(1, 2, 3, 4)))
+
+
+class TestVolumes:
+    def test_make_list_stat_delete(self, disk):
+        disk.make_vol("bkt")
+        with pytest.raises(VolumeExists):
+            disk.make_vol("bkt")
+        assert [v.name for v in disk.list_vols()] == ["bkt"]
+        assert disk.stat_vol("bkt").name == "bkt"
+        disk.delete_vol("bkt")
+        with pytest.raises(VolumeNotFound):
+            disk.stat_vol("bkt")
+
+    def test_sys_volume_hidden(self, disk):
+        assert disk.list_vols() == []
+
+    def test_invalid_names(self, disk):
+        for bad in ("", ".", "..", "a/b"):
+            with pytest.raises(StorageError):
+                disk.make_vol(bad)
+
+
+class TestMetaJournal:
+    def test_roundtrip(self):
+        xl = XLMeta()
+        fi = _fi(vid=new_uuid(), data_dir=new_uuid(), size=123)
+        fi.parts = [ObjectPartInfo(number=1, size=123, actual_size=123)]
+        xl.add_version(fi)
+        xl2 = XLMeta.load(xl.dump())
+        got = xl2.to_fileinfo("bkt", "obj", fi.version_id)
+        assert got.size == 123
+        assert got.erasure.data_blocks == 2
+        assert got.parts[0].number == 1
+        assert got.is_latest
+
+    def test_latest_ordering_and_delete_marker(self):
+        xl = XLMeta()
+        v1, v2 = new_uuid(), new_uuid()
+        xl.add_version(_fi(vid=v1, mod_time=100))
+        xl.add_version(_fi(vid=v2, mod_time=200))
+        xl.add_version(_fi(vid="", deleted=True, mod_time=300))
+        latest = xl.to_fileinfo("bkt", "obj")
+        assert latest.deleted and latest.is_latest
+        old = xl.to_fileinfo("bkt", "obj", v1)
+        assert not old.deleted and not old.is_latest
+
+    def test_inline_data(self):
+        xl = XLMeta()
+        fi = _fi(vid=new_uuid())
+        fi.inline_data = b"shardbytes"
+        xl.add_version(fi)
+        xl2 = XLMeta.load(xl.dump())
+        assert xl2.to_fileinfo("b", "o", fi.version_id, read_data=True).inline_data == b"shardbytes"
+        # Without read_data the marker is an empty-bytes sentinel.
+        assert xl2.to_fileinfo("b", "o", fi.version_id).inline_data == b""
+
+
+class TestVersionedStorage:
+    def test_write_read_delete_version(self, disk):
+        disk.make_vol("bkt")
+        vid = new_uuid()
+        disk.write_metadata("bkt", "a/b/obj", _fi(vid=vid, size=7))
+        got = disk.read_version("bkt", "a/b/obj")
+        assert got.version_id == vid and got.size == 7
+        disk.delete_version("bkt", "a/b/obj", vid)
+        with pytest.raises(FileNotFoundErr):
+            disk.read_version("bkt", "a/b/obj")
+        # empty parents cleaned up
+        assert not os.path.exists(os.path.join(disk.root, "bkt", "a"))
+
+    def test_rename_data_commit(self, disk):
+        disk.make_vol("bkt")
+        ddir = new_uuid()
+        staging = f"staging-{new_uuid()}"
+        disk.create_file(".mtpu.sys", f"{staging}/{ddir}/part.1", b"SHARD")
+        fi = _fi(vid=new_uuid(), data_dir=ddir, size=5)
+        disk.rename_data(".mtpu.sys", staging, fi, "bkt", "obj")
+        got = disk.read_version("bkt", "obj")
+        assert got.data_dir == ddir
+        assert disk.read_file("bkt", f"obj/{ddir}/part.1") == b"SHARD"
+        # staging dir gone
+        assert not os.path.exists(os.path.join(disk.root, ".mtpu.sys", staging))
+
+    def test_nested_objects_coexist(self, disk):
+        disk.make_vol("bkt")
+        disk.write_metadata("bkt", "a", _fi(name="a", vid=new_uuid()))
+        disk.write_metadata("bkt", "a/b", _fi(name="a/b", vid=new_uuid()))
+        assert disk.read_version("bkt", "a").name == "a"
+        assert disk.read_version("bkt", "a/b").name == "a/b"
+
+    def test_walk_dir(self, disk):
+        disk.make_vol("bkt")
+        names = ["z", "a/1", "a/2", "m/x/deep"]
+        for n in names:
+            disk.write_metadata("bkt", n, _fi(name=n, vid=new_uuid()))
+        # staged uuid data dir inside an object must not appear
+        ddir = new_uuid()
+        disk.create_file("bkt", f"a/1/{ddir}/part.1", b"x")
+        walked = [p for p, _ in disk.walk_dir("bkt")]
+        assert walked == sorted(names)
+
+    def test_update_metadata_missing_version(self, disk):
+        disk.make_vol("bkt")
+        disk.write_metadata("bkt", "o", _fi(vid=new_uuid()))
+        with pytest.raises(VersionNotFoundErr):
+            disk.update_metadata("bkt", "o", _fi(vid=new_uuid()))
+
+
+class TestBitrotFraming:
+    def test_frame_and_read_roundtrip(self):
+        rng = np.random.default_rng(3)
+        shard = rng.integers(0, 256, size=10_000, dtype=np.uint8)
+        blob = bitrot.frame_shard(shard, shard_size=4096)
+        assert len(blob) == bitrot.shard_file_size(10_000, 4096)
+        r = bitrot.FramedShardReader(blob, 4096, 10_000)
+        got = np.concatenate([r.block(i) for i in range(3)])
+        assert np.array_equal(got, shard)
+
+    def test_batch_framing_matches_single(self):
+        rng = np.random.default_rng(4)
+        shards = rng.integers(0, 256, size=(6, 5000), dtype=np.uint8)
+        batch = bitrot.frame_shards_batch(shards, shard_size=2048)
+        for i in range(6):
+            assert batch[i] == bitrot.frame_shard(shards[i], 2048)
+
+    def test_corruption_detected(self):
+        shard = np.arange(5000, dtype=np.int32).astype(np.uint8)
+        blob = bytearray(bitrot.frame_shard(shard, shard_size=2048))
+        blob[40] ^= 0xFF  # flip a data byte in block 0
+        r = bitrot.FramedShardReader(bytes(blob), 2048, 5000)
+        with pytest.raises(bitrot.BitrotError):
+            r.block(0)
+        r.block(1)  # other blocks still verify
+
+    def test_whole_file_algorithms_unframed(self):
+        assert bitrot.shard_file_size(100, 10, bitrot.SHA256) == 100
