@@ -43,7 +43,22 @@ class ECBackend(Protocol):
 
 
 class HostBackend:
+    """Host GF path: native C++ nibble-split kernel when built (the
+    analogue of the reference's assembly Galois kernels), numpy tables
+    otherwise. Both byte-identical."""
+
     def apply_matrix(self, matrix: np.ndarray, shards: np.ndarray) -> np.ndarray:
+        from minio_tpu import native
+        lib = native.load()
+        matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+        shards = np.ascontiguousarray(shards, dtype=np.uint8)
+        if lib is not None and shards.size:
+            r, k = matrix.shape
+            length = shards.shape[1]
+            out = np.empty((r, length), dtype=np.uint8)
+            lib.mtpu_gf_apply(native._u8(matrix), r, k, native._u8(shards),
+                              length, length, native._u8(out), length)
+            return out
         return gf256.gf_matvec_bytes(matrix, shards)
 
 

@@ -1,0 +1,81 @@
+"""Build-on-first-import loader for the native kernel library.
+
+Compiles native.cc with g++ -O3 -march=native into _native.so next to
+this file (rebuilt when the source is newer) and exposes it via ctypes.
+Falls back to None if no compiler is available — pure-Python/numpy paths
+take over, slower but byte-identical.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "native.cc")
+_SO = os.path.join(_DIR, "_native.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    # Per-process tmp name: concurrent builders must not interleave into
+    # one tmp file (a corrupt .so with a fresh mtime would permanently
+    # disable the native path).
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-o", tmp, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        return True
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def load():
+    """The ctypes library handle, or None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            stale = not os.path.exists(_SO) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SO) < os.path.getmtime(_SRC))
+            if stale and not _build():
+                return None
+            lib = ctypes.CDLL(_SO)
+        except Exception:
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.mtpu_hh256.argtypes = [u8p, u8p, ctypes.c_size_t, u8p]
+        lib.mtpu_hh256.restype = None
+        lib.mtpu_hh256_many.argtypes = [u8p, u8p, ctypes.c_size_t,
+                                        ctypes.c_size_t, ctypes.c_size_t, u8p]
+        lib.mtpu_hh256_many.restype = None
+        lib.mtpu_xxh64.argtypes = [u8p, ctypes.c_size_t, ctypes.c_uint64]
+        lib.mtpu_xxh64.restype = ctypes.c_uint64
+        lib.mtpu_gf_apply.argtypes = [u8p, ctypes.c_size_t, ctypes.c_size_t,
+                                      u8p, ctypes.c_size_t, ctypes.c_size_t,
+                                      u8p, ctypes.c_size_t]
+        lib.mtpu_gf_apply.restype = None
+        _lib = lib
+        return _lib
+
+
+def _u8(arr) -> "ctypes.POINTER(ctypes.c_uint8)":
+    import numpy as np
+    a = arr if isinstance(arr, (bytes, bytearray)) else np.ascontiguousarray(arr)
+    if isinstance(a, (bytes, bytearray)):
+        return (ctypes.c_uint8 * len(a)).from_buffer_copy(a)
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))

@@ -1,0 +1,279 @@
+// Native data-path kernels for minio_tpu (host side).
+//
+// The reference gets its host performance from Go-assembly dependencies
+// (AVX2/AVX512 HighwayHash in github.com/minio/highwayhash, GFNI/AVX2
+// Galois kernels in klauspost/reedsolomon, assembly xxhash — SURVEY.md
+// §2.7). This module is our native equivalent, compiled with -O3
+// -march=native so the compiler vectorizes the hot loops; the TPU path
+// (ops/rs_device.py) handles bulk stripes, this handles the host-side
+// cases: bitrot hashing, small-block GF math, digests for self-tests.
+//
+// Exposed via a plain C ABI for ctypes (no pybind11 in the image).
+//
+// Implementations are from-scratch from the public algorithm specs
+// (HighwayHash: github.com/google/highwayhash paper/spec; xxHash spec),
+// byte-validated in tests against the reference's golden digests.
+
+#include <cstdint>
+#include <cstddef>
+#include <cstring>
+#include <mutex>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// HighwayHash-256
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct HHState {
+  uint64_t v0[4], v1[4], mul0[4], mul1[4];
+};
+
+const uint64_t kInit0[4] = {0xdbe6d5d5fe4cce2fULL, 0xa4093822299f31d0ULL,
+                            0x13198a2e03707344ULL, 0x243f6a8885a308d3ULL};
+const uint64_t kInit1[4] = {0x3bd39e10cb0ef593ULL, 0xc0acf169b5f18a8cULL,
+                            0xbe5466cf34e90c6cULL, 0x452821e638d01377ULL};
+
+inline uint64_t Rot32(uint64_t x) { return (x >> 32) | (x << 32); }
+
+inline uint64_t Le64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;  // little-endian hosts only (x86/arm64)
+}
+
+inline void Reset(const uint64_t key[4], HHState* s) {
+  for (int i = 0; i < 4; ++i) {
+    s->v0[i] = kInit0[i] ^ key[i];
+    s->v1[i] = kInit1[i] ^ Rot32(key[i]);
+    s->mul0[i] = kInit0[i];
+    s->mul1[i] = kInit1[i];
+  }
+}
+
+inline void ZipperMergeAndAdd(uint64_t v1, uint64_t v0, uint64_t* add1,
+                              uint64_t* add0) {
+  *add0 += (((v0 & 0xff000000ULL) | (v1 & 0xff00000000ULL)) >> 24) |
+           (((v0 & 0xff0000000000ULL) | (v1 & 0xff000000000000ULL)) >> 16) |
+           (v0 & 0xff0000ULL) | ((v0 & 0xff00ULL) << 32) |
+           ((v1 & 0xff00000000000000ULL) >> 8) | (v0 << 56);
+  *add1 += (((v1 & 0xff000000ULL) | (v0 & 0xff00000000ULL)) >> 24) |
+           (v1 & 0xff0000ULL) | ((v1 & 0xff0000000000ULL) >> 16) |
+           ((v1 & 0xff00ULL) << 24) | ((v0 & 0xff000000000000ULL) >> 8) |
+           ((v1 & 0xffULL) << 48) | (v0 & 0xff00000000000000ULL);
+}
+
+inline void Update(const uint64_t lanes[4], HHState* s) {
+  for (int i = 0; i < 4; ++i) {
+    s->v1[i] += s->mul0[i] + lanes[i];
+    s->mul0[i] ^= (s->v1[i] & 0xffffffffULL) * (s->v0[i] >> 32);
+    s->v0[i] += s->mul1[i];
+    s->mul1[i] ^= (s->v0[i] & 0xffffffffULL) * (s->v1[i] >> 32);
+  }
+  ZipperMergeAndAdd(s->v1[1], s->v1[0], &s->v0[1], &s->v0[0]);
+  ZipperMergeAndAdd(s->v1[3], s->v1[2], &s->v0[3], &s->v0[2]);
+  ZipperMergeAndAdd(s->v0[1], s->v0[0], &s->v1[1], &s->v1[0]);
+  ZipperMergeAndAdd(s->v0[3], s->v0[2], &s->v1[3], &s->v1[2]);
+}
+
+inline void UpdatePacket(const uint8_t* p, HHState* s) {
+  uint64_t lanes[4] = {Le64(p), Le64(p + 8), Le64(p + 16), Le64(p + 24)};
+  Update(lanes, s);
+}
+
+inline uint32_t Rol32(uint32_t x, unsigned c) {
+  return c ? (x << c) | (x >> (32 - c)) : x;
+}
+
+inline void UpdateRemainder(const uint8_t* bytes, size_t size_mod32,
+                            HHState* s) {
+  const size_t size_mod4 = size_mod32 & 3;
+  const uint8_t* remainder = bytes + (size_mod32 & ~size_t(3));
+  uint8_t packet[32] = {0};
+  for (int i = 0; i < 4; ++i)
+    s->v0[i] += (uint64_t(size_mod32) << 32) + size_mod32;
+  for (int i = 0; i < 4; ++i) {
+    uint32_t lo = uint32_t(s->v1[i]), hi = uint32_t(s->v1[i] >> 32);
+    s->v1[i] = (uint64_t(Rol32(hi, size_mod32)) << 32) | Rol32(lo, size_mod32);
+  }
+  std::memcpy(packet, bytes, size_mod32 & ~size_t(3));
+  if (size_mod32 & 16) {
+    for (int i = 0; i < 4; ++i)
+      packet[28 + i] = remainder[i + size_mod4 - 4];
+  } else if (size_mod4) {
+    packet[16] = remainder[0];
+    packet[17] = remainder[size_mod4 >> 1];
+    packet[18] = remainder[size_mod4 - 1];
+  }
+  UpdatePacket(packet, s);
+}
+
+inline void Finalize256(HHState* s, uint64_t hash[4]) {
+  for (int r = 0; r < 10; ++r) {
+    uint64_t permuted[4] = {Rot32(s->v0[2]), Rot32(s->v0[3]),
+                            Rot32(s->v0[0]), Rot32(s->v0[1])};
+    Update(permuted, s);
+  }
+  auto mod = [](uint64_t a3u, uint64_t a2, uint64_t a1, uint64_t a0,
+                uint64_t* m1, uint64_t* m0) {
+    const uint64_t a3 = a3u & 0x3fffffffffffffffULL;
+    *m1 = a1 ^ ((a3 << 1) | (a2 >> 63)) ^ ((a3 << 2) | (a2 >> 62));
+    *m0 = a0 ^ (a2 << 1) ^ (a2 << 2);
+  };
+  mod(s->v1[1] + s->mul1[1], s->v1[0] + s->mul1[0], s->v0[1] + s->mul0[1],
+      s->v0[0] + s->mul0[0], &hash[1], &hash[0]);
+  mod(s->v1[3] + s->mul1[3], s->v1[2] + s->mul1[2], s->v0[3] + s->mul0[3],
+      s->v0[2] + s->mul0[2], &hash[3], &hash[2]);
+}
+
+}  // namespace
+
+void mtpu_hh256(const uint8_t* key32, const uint8_t* data, size_t len,
+                uint8_t* out32) {
+  uint64_t key[4] = {Le64(key32), Le64(key32 + 8), Le64(key32 + 16),
+                     Le64(key32 + 24)};
+  HHState s;
+  Reset(key, &s);
+  size_t full = len / 32;
+  for (size_t i = 0; i < full; ++i) UpdatePacket(data + 32 * i, &s);
+  if (len % 32) UpdateRemainder(data + 32 * full, len % 32, &s);
+  uint64_t hash[4];
+  Finalize256(&s, hash);
+  std::memcpy(out32, hash, 32);
+}
+
+// Hash `nstreams` blocks, each `len` bytes, laid out contiguously with
+// byte stride `stride` (stride >= len). Out: nstreams x 32 bytes.
+void mtpu_hh256_many(const uint8_t* key32, const uint8_t* data,
+                     size_t nstreams, size_t stride, size_t len,
+                     uint8_t* out) {
+  for (size_t i = 0; i < nstreams; ++i)
+    mtpu_hh256(key32, data + i * stride, len, out + 32 * i);
+}
+
+// ---------------------------------------------------------------------------
+// xxHash64 (spec: cyan4973.github.io/xxHash)
+// ---------------------------------------------------------------------------
+
+namespace {
+const uint64_t P1 = 0x9E3779B185EBCA87ULL, P2 = 0xC2B2AE3D27D4EB4FULL,
+               P3 = 0x165667B19E3779F9ULL, P4 = 0x85EBCA77C2B2AE63ULL,
+               P5 = 0x27D4EB2F165667C5ULL;
+inline uint64_t Rotl64(uint64_t x, int r) { return (x << r) | (x >> (64 - r)); }
+inline uint64_t XxhRound(uint64_t acc, uint64_t input) {
+  acc += input * P2;
+  acc = Rotl64(acc, 31);
+  return acc * P1;
+}
+inline uint64_t XxhMerge(uint64_t acc, uint64_t val) {
+  acc ^= XxhRound(0, val);
+  return acc * P1 + P4;
+}
+}  // namespace
+
+uint64_t mtpu_xxh64(const uint8_t* p, size_t len, uint64_t seed) {
+  const uint8_t* end = p + len;
+  uint64_t h;
+  if (len >= 32) {
+    uint64_t v1 = seed + P1 + P2, v2 = seed + P2, v3 = seed, v4 = seed - P1;
+    do {
+      v1 = XxhRound(v1, Le64(p)); p += 8;
+      v2 = XxhRound(v2, Le64(p)); p += 8;
+      v3 = XxhRound(v3, Le64(p)); p += 8;
+      v4 = XxhRound(v4, Le64(p)); p += 8;
+    } while (p + 32 <= end);
+    h = Rotl64(v1, 1) + Rotl64(v2, 7) + Rotl64(v3, 12) + Rotl64(v4, 18);
+    h = XxhMerge(h, v1); h = XxhMerge(h, v2);
+    h = XxhMerge(h, v3); h = XxhMerge(h, v4);
+  } else {
+    h = seed + P5;
+  }
+  h += uint64_t(len);
+  while (p + 8 <= end) {
+    h ^= XxhRound(0, Le64(p));
+    h = Rotl64(h, 27) * P1 + P4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    uint32_t v;
+    std::memcpy(&v, p, 4);
+    h ^= uint64_t(v) * P1;
+    h = Rotl64(h, 23) * P2 + P3;
+    p += 4;
+  }
+  while (p < end) {
+    h ^= uint64_t(*p) * P5;
+    h = Rotl64(h, 11) * P1;
+    ++p;
+  }
+  h ^= h >> 33;
+  h *= P2;
+  h ^= h >> 29;
+  h *= P3;
+  h ^= h >> 32;
+  return h;
+}
+
+// ---------------------------------------------------------------------------
+// GF(2^8) shard transform (host fallback for small blocks)
+// ---------------------------------------------------------------------------
+//
+// out[r][:] = XOR_j mul(matrix[r][j], shards[j][:]) using 4-bit split
+// tables (the classic PSHUFB decomposition: one 16-entry table for each
+// nibble), which compilers auto-vectorize well with -O3 -march=native.
+
+namespace {
+uint8_t kGfMul[256][256];
+std::once_flag kGfOnce;
+
+// ctypes releases the GIL, so concurrent first calls are real races —
+// call_once publishes the fully-built table before anyone reads it.
+void GfInit() {
+  std::call_once(kGfOnce, [] {
+    // GF(2^8) with poly 0x11d (same field as the codec).
+    for (int a = 1; a < 256; ++a) {
+      for (int b = 1; b < 256; ++b) {
+        int x = a, y = b, acc = 0;
+        while (y) {
+          if (y & 1) acc ^= x;
+          x <<= 1;
+          if (x & 0x100) x ^= 0x11d;
+          y >>= 1;
+        }
+        kGfMul[a][b] = uint8_t(acc);
+      }
+    }
+  });
+}
+}  // namespace
+
+void mtpu_gf_apply(const uint8_t* matrix, size_t r, size_t k,
+                   const uint8_t* shards, size_t stride, size_t len,
+                   uint8_t* out, size_t out_stride) {
+  GfInit();
+  for (size_t i = 0; i < r; ++i) {
+    uint8_t* dst = out + i * out_stride;
+    std::memset(dst, 0, len);
+    for (size_t j = 0; j < k; ++j) {
+      const uint8_t c = matrix[i * k + j];
+      if (c == 0) continue;
+      const uint8_t* src = shards + j * stride;
+      if (c == 1) {
+        for (size_t t = 0; t < len; ++t) dst[t] ^= src[t];
+      } else {
+        // Nibble-split tables: mul(c, x) = lo[x & 15] ^ hi[x >> 4].
+        uint8_t lo[16], hi[16];
+        for (int v = 0; v < 16; ++v) {
+          lo[v] = kGfMul[c][v];
+          hi[v] = kGfMul[c][v << 4];
+        }
+        for (size_t t = 0; t < len; ++t)
+          dst[t] ^= lo[src[t] & 15] ^ hi[src[t] >> 4];
+      }
+    }
+  }
+}
+
+}  // extern "C"

@@ -38,7 +38,7 @@ from minio_tpu.object.types import (BucketExists, BucketInfo, BucketNotEmpty,
                                     ReadQuorumError, VersionNotFound,
                                     WriteQuorumError)
 from minio_tpu.storage import bitrot
-from minio_tpu.storage.local import (StorageError, VolumeExists,
+from minio_tpu.storage.local import (SYS_VOL, StorageError, VolumeExists,
                                      VolumeNotEmpty, VolumeNotFound)
 from minio_tpu.storage.meta import (ErasureInfo, FileInfo, FileNotFoundErr,
                                     MetaError, ObjectPartInfo,
@@ -46,7 +46,6 @@ from minio_tpu.storage.meta import (ErasureInfo, FileInfo, FileNotFoundErr,
 
 BLOCK_SIZE = 1 << 20          # reference blockSizeV2 (cmd/object-api-common.go:37)
 SMALL_FILE_THRESHOLD = 128 << 10  # inline threshold (storage-class.go:278)
-SYS_VOL = ".mtpu.sys"
 STAGING_PREFIX = "staging"
 
 _RESERVED_BUCKETS = {SYS_VOL}

@@ -157,10 +157,7 @@ class HighwayState:
         out[:, i0] = a0 ^ (a2 << _U64(1)) ^ (a2 << _U64(2))
 
 
-def highwayhash256(key: bytes, data: bytes | np.ndarray) -> bytes:
-    """One-shot single-stream HighwayHash-256."""
-    buf = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray, memoryview)) \
-        else np.asarray(data, dtype=np.uint8)
+def _hh256_python(key: bytes, buf: np.ndarray) -> bytes:
     st = HighwayState(key, streams=1)
     n = buf.size
     full = n // 32
@@ -171,15 +168,39 @@ def highwayhash256(key: bytes, data: bytes | np.ndarray) -> bytes:
     return st.finalize256()[0].tobytes()
 
 
-def highwayhash256_many(key: bytes, blocks: np.ndarray) -> np.ndarray:
-    """Hash S equal-length blocks in lockstep: uint8 [S, L] -> uint8 [S, 32].
+def highwayhash256(key: bytes, data: bytes | np.ndarray) -> bytes:
+    """One-shot single-stream HighwayHash-256 (native C++ when built)."""
+    if len(key) != 32:
+        raise ValueError("HighwayHash-256 requires a 32-byte key")
+    from minio_tpu import native
+    lib = native.load()
+    buf = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray, memoryview)) \
+        else np.ascontiguousarray(data, dtype=np.uint8)
+    if lib is not None:
+        out = np.empty(32, dtype=np.uint8)
+        lib.mtpu_hh256(native._u8(np.frombuffer(key, dtype=np.uint8)),
+                       native._u8(buf), buf.size, native._u8(out))
+        return out.tobytes()
+    return _hh256_python(key, buf)
 
-    This is the bitrot hot path: the S streams are the shard blocks of a
-    stripe batch, hashed with one vectorized recurrence instead of S
-    sequential hashes.
+
+def highwayhash256_many(key: bytes, blocks: np.ndarray) -> np.ndarray:
+    """Hash S equal-length blocks: uint8 [S, L] -> uint8 [S, 32].
+
+    This is the bitrot hot path — native C++ per stream when built, else
+    the vectorized lockstep numpy recurrence across streams.
     """
+    if len(key) != 32:
+        raise ValueError("HighwayHash-256 requires a 32-byte key")
     blocks = np.ascontiguousarray(blocks, dtype=np.uint8)
     s, n = blocks.shape
+    from minio_tpu import native
+    lib = native.load()
+    if lib is not None:
+        out = np.empty((s, 32), dtype=np.uint8)
+        lib.mtpu_hh256_many(native._u8(np.frombuffer(key, dtype=np.uint8)),
+                            native._u8(blocks), s, n, n, native._u8(out))
+        return out
     st = HighwayState(key, streams=s)
     full = n // 32
     if full:

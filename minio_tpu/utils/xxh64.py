@@ -34,6 +34,19 @@ def _merge_round(acc: int, val: int) -> int:
 
 
 def xxh64(data: bytes, seed: int = 0) -> int:
+    try:
+        from minio_tpu import native
+        lib = native.load()
+        if lib is not None:
+            import numpy as np
+            buf = np.frombuffer(data, dtype=np.uint8)
+            return int(lib.mtpu_xxh64(native._u8(buf), buf.size, seed))
+    except Exception:
+        pass
+    return _xxh64_py(data, seed)
+
+
+def _xxh64_py(data: bytes, seed: int = 0) -> int:
     n = len(data)
     p = 0
     if n >= 32:
