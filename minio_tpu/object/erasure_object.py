@@ -156,6 +156,52 @@ class ErasureSet:
         ok = sum(e is None or isinstance(e, VolumeNotFound) for e in errors)
         if ok < len(self.disks) // 2 + 1:
             raise WriteQuorumError(bucket)
+        # Drop bucket metadata so a recreated bucket starts fresh
+        # (versioning state must not survive deletion).
+        self._fanout([lambda d=d: _swallow(
+            lambda: d.delete(SYS_VOL, f"buckets/{bucket}", recursive=True))
+            for d in self.disks])
+
+    # -- bucket metadata (versioning etc.; full subsystem arrives with
+    #    IAM/policies — stored as quorum-replicated JSON under SYS_VOL,
+    #    the shape of the reference's .minio.sys bucket metadata) --------
+
+    def _bucket_meta_path(self, bucket: str) -> str:
+        return f"buckets/{bucket}/bucket-meta.json"
+
+    def get_bucket_meta(self, bucket: str) -> dict:
+        import json
+        results, _ = self._fanout(
+            [lambda d=d: d.read_all(SYS_VOL, self._bucket_meta_path(bucket))
+             for d in self.disks])
+        votes: dict[bytes, int] = {}
+        for r in results:
+            if r is not None:
+                votes[r] = votes.get(r, 0) + 1
+        if not votes:
+            return {}
+        blob = max(votes, key=lambda b: votes[b])
+        try:
+            return json.loads(blob)
+        except ValueError:
+            return {}
+
+    def set_bucket_meta(self, bucket: str, meta: dict) -> None:
+        import json
+        blob = json.dumps(meta, sort_keys=True).encode()
+        _, errors = self._fanout(
+            [lambda d=d: d.write_all(SYS_VOL, self._bucket_meta_path(bucket),
+                                     blob) for d in self.disks])
+        if sum(e is None for e in errors) < len(self.disks) // 2 + 1:
+            raise WriteQuorumError(bucket)
+
+    def bucket_versioning(self, bucket: str) -> bool:
+        return bool(self.get_bucket_meta(bucket).get("versioning"))
+
+    def set_bucket_versioning(self, bucket: str, enabled: bool) -> None:
+        meta = self.get_bucket_meta(bucket)
+        meta["versioning"] = bool(enabled)
+        self.set_bucket_meta(bucket, meta)
 
     def _check_bucket(self, bucket: str) -> None:
         if bucket in _RESERVED_BUCKETS:
@@ -373,14 +419,24 @@ class ErasureSet:
         fi, fis, errors = self._get_object_fileinfo(
             bucket, object_, opts.version_id, read_data=True)
         if fi.deleted:
-            raise MethodNotAllowed(bucket, object_)
+            # Latest-is-delete-marker reads 404 (NoSuchKey); naming the
+            # marker's version explicitly is 405 (MethodNotAllowed) —
+            # AWS semantics, as in the reference's toAPIError mapping.
+            if opts.version_id:
+                raise MethodNotAllowed(bucket, object_)
+            raise ObjectNotFound(bucket, object_)
         info = self._to_object_info(bucket, object_, fi)
 
         total = fi.size
-        offset = opts.offset
-        length = total - offset if opts.length < 0 else opts.length
-        if offset < 0 or length < 0 or offset + length > total:
-            raise InvalidRange(bucket, object_)
+        if opts.range_spec is not None:
+            offset, length = _resolve_range(opts.range_spec, total,
+                                            bucket, object_)
+        else:
+            offset = opts.offset
+            length = total - offset if opts.length < 0 else opts.length
+            if offset < 0 or length < 0 or offset + length > total:
+                raise InvalidRange(bucket, object_)
+        info.range_start, info.range_length = offset, length
         if total == 0 or length == 0:
             return info, b""
 
@@ -486,7 +542,11 @@ class ErasureSet:
                         opts: Optional[GetOptions] = None) -> ObjectInfo:
         opts = opts or GetOptions()
         fi, _, _ = self._get_object_fileinfo(bucket, object_, opts.version_id)
-        if fi.deleted and not opts.version_id:
+        if fi.deleted:
+            # Same AWS mapping as get_object: 404 for latest-is-marker,
+            # 405 when the marker's version is named explicitly.
+            if opts.version_id:
+                raise MethodNotAllowed(bucket, object_)
             raise ObjectNotFound(bucket, object_)
         return self._to_object_info(bucket, object_, fi)
 
@@ -531,6 +591,88 @@ class ErasureSet:
             raise WriteQuorumError(bucket, object_)
         return DeletedObject(object_name=object_, version_id=opts.version_id)
 
+    def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
+                     delimiter: str = "", max_keys: int = 1000,
+                     include_versions: bool = False):
+        """Sorted listing with prefix/marker/delimiter semantics.
+
+        Per-drive sorted walks (reference: WalkDir, cmd/metacache-walk.go)
+        merged across up to 3 drives for resilience (reference default
+        askDisks), resolved per object from its journal. Early-exits once
+        max_keys+1 entries are found past the marker.
+        """
+        from minio_tpu.object.types import ListObjectsInfo
+        import heapq
+
+        self._check_bucket(bucket)
+        max_keys = max(1, min(max_keys, 1000))
+        base_dir = ""
+        if "/" in prefix:
+            base_dir = prefix.rsplit("/", 1)[0]
+
+        def disk_iter(d):
+            try:
+                yield from d.walk_dir(bucket, base_dir=base_dir,
+                                      forward_from=marker or prefix)
+            except Exception:  # noqa: BLE001 - drive loss tolerated
+                return
+
+        # Walk a majority of drives: any write quorum (>= n/2) must
+        # intersect the walked set, so committed objects are never
+        # invisible to listings even when some drives missed the write.
+        walk_disks = self.disks[:len(self.disks) // 2 + 1]
+        iters = [disk_iter(d) for d in walk_disks if d is not None]
+        merged = heapq.merge(*iters, key=lambda kv: kv[0])
+
+        info = ListObjectsInfo()
+        seen_prefixes: set[str] = set()
+        last = None
+        last_added = ""   # last key/prefix actually returned; resume point
+        from minio_tpu.storage.meta import XLMeta
+        for path, blob in merged:
+            if path == last:
+                continue
+            last = path
+            if not path.startswith(prefix):
+                if path > prefix and not prefix.startswith(path):
+                    break  # sorted walk has passed the prefix range
+                continue
+            if marker and path <= marker:
+                continue
+            if delimiter:
+                rest = path[len(prefix):]
+                di = rest.find(delimiter)
+                if di >= 0:
+                    cp = prefix + rest[:di + len(delimiter)]
+                    if cp in seen_prefixes or (marker and cp <= marker):
+                        continue
+                    if len(info.objects) + len(seen_prefixes) >= max_keys:
+                        info.is_truncated = True
+                        info.next_marker = last_added
+                        break
+                    seen_prefixes.add(cp)
+                    last_added = cp
+                    continue
+            try:
+                xl = XLMeta.load(blob)
+                fi = xl.to_fileinfo(bucket, path)
+            except Exception:  # noqa: BLE001 - unreadable journal copy
+                continue
+            if fi.deleted and not include_versions:
+                continue
+            if len(info.objects) + len(seen_prefixes) >= max_keys:
+                info.is_truncated = True
+                info.next_marker = last_added
+                break
+            if include_versions:
+                for v in xl.list_versions(bucket, path):
+                    info.objects.append(self._to_object_info(bucket, path, v))
+            else:
+                info.objects.append(self._to_object_info(bucket, path, fi))
+            last_added = path
+        info.prefixes = sorted(seen_prefixes)
+        return info
+
     def list_versions_all(self, bucket: str, object_: str) -> list[FileInfo]:
         results, _ = self._fanout(
             [lambda d=d: d.list_versions(bucket, object_) for d in self.disks])
@@ -538,6 +680,23 @@ class ErasureSet:
             if r:
                 return r
         raise ObjectNotFound(bucket, object_)
+
+
+def _resolve_range(spec: tuple, size: int, bucket: str, object_: str):
+    """(start|None, end|None) -> (offset, length), HTTP Range semantics."""
+    lo, hi = spec
+    if lo is None:                       # suffix: last `hi` bytes
+        if hi is None or hi <= 0:
+            raise InvalidRange(bucket, object_)
+        start = max(0, size - hi)
+        return start, size - start
+    if lo >= size:
+        raise InvalidRange(bucket, object_)
+    if hi is None:
+        return lo, size - lo
+    if lo > hi:
+        raise InvalidRange(bucket, object_)
+    return lo, min(hi, size - 1) - lo + 1
 
 
 def _parity_matrix(k: int, m: int) -> np.ndarray:

@@ -86,6 +86,9 @@ class ObjectInfo:
     is_dir: bool = False
     actual_size: int = 0
     storage_class: str = "STANDARD"
+    # Resolved byte range of the payload returned by get_object.
+    range_start: int = 0
+    range_length: int = 0
 
 
 @dataclasses.dataclass
@@ -112,6 +115,10 @@ class GetOptions:
     version_id: str = ""
     offset: int = 0
     length: int = -1   # -1 = to end
+    # Parsed HTTP Range header (start|None, end|None); resolved against
+    # the object size inside get_object so range requests cost a single
+    # metadata fan-out. Overrides offset/length when set.
+    range_spec: Optional[tuple] = None
 
 
 @dataclasses.dataclass

@@ -1,0 +1,87 @@
+"""S3 API error catalogue and exception mapping (reference: cmd/api-errors.go)."""
+
+from __future__ import annotations
+
+from minio_tpu.object import types as ot
+from minio_tpu.s3.sigv4 import SigError
+
+# code -> (http status, default message)
+_CATALOG = {
+    "AccessDenied": (403, "Access Denied."),
+    "InvalidAccessKeyId": (403, "The Access Key Id you provided does not exist in our records."),
+    "SignatureDoesNotMatch": (403, "The request signature we calculated does not match the signature you provided."),
+    "AuthorizationHeaderMalformed": (400, "The authorization header is malformed."),
+    "AuthorizationQueryParametersError": (400, "Query-string authorization parameters are malformed."),
+    "XAmzContentSHA256Mismatch": (400, "The provided 'x-amz-content-sha256' header does not match what was computed."),
+    "IncompleteBody": (400, "You did not provide the number of bytes specified by the Content-Length HTTP header."),
+    "InvalidChunkSizeError": (400, "Invalid chunk size."),
+    "NoSuchBucket": (404, "The specified bucket does not exist."),
+    "BucketAlreadyOwnedByYou": (409, "Your previous request to create the named bucket succeeded and you already own it."),
+    "BucketNotEmpty": (409, "The bucket you tried to delete is not empty."),
+    "NoSuchKey": (404, "The specified key does not exist."),
+    "NoSuchVersion": (404, "The specified version does not exist."),
+    "MethodNotAllowed": (405, "The specified method is not allowed against this resource."),
+    "InvalidRange": (416, "The requested range is not satisfiable."),
+    "InvalidArgument": (400, "Invalid argument."),
+    "InvalidBucketName": (400, "The specified bucket is not valid."),
+    "InvalidObjectName": (400, "Object name contains unsupported characters."),
+    "EntityTooLarge": (400, "Your proposed upload exceeds the maximum allowed object size."),
+    "MissingContentLength": (411, "You must provide the Content-Length HTTP header."),
+    "InternalError": (500, "We encountered an internal error, please try again."),
+    "SlowDownRead": (503, "Resource requested is unreadable, please reduce your request rate"),
+    "SlowDownWrite": (503, "Resource requested is unwritable, please reduce your request rate"),
+    "MalformedXML": (400, "The XML you provided was not well-formed or did not validate against our published schema."),
+    "NoSuchUpload": (404, "The specified multipart upload does not exist."),
+    "InvalidPart": (400, "One or more of the specified parts could not be found."),
+    "InvalidPartOrder": (400, "The list of parts was not in ascending order."),
+    "PreconditionFailed": (412, "At least one of the pre-conditions you specified did not hold."),
+    "NotModified": (304, "Not Modified"),
+    "NoSuchBucketPolicy": (404, "The bucket policy does not exist."),
+    "NoSuchLifecycleConfiguration": (404, "The lifecycle configuration does not exist."),
+    "NoSuchTagSet": (404, "The TagSet does not exist."),
+    "ReplicationConfigurationNotFoundError": (404, "The replication configuration was not found."),
+    "ServerSideEncryptionConfigurationNotFoundError": (404, "The server side encryption configuration was not found."),
+    "ObjectLockConfigurationNotFoundError": (404, "Object Lock configuration does not exist for this bucket."),
+    "NoSuchCORSConfiguration": (404, "The CORS configuration does not exist."),
+    "NotImplemented": (501, "A header you provided implies functionality that is not implemented."),
+}
+
+
+class S3Error(Exception):
+    def __init__(self, code: str, message: str = "", bucket: str = "",
+                 key: str = ""):
+        status, default = _CATALOG.get(code, (500, code))
+        self.code = code
+        self.status = status
+        self.message = message or default
+        self.bucket = bucket
+        self.key = key
+        super().__init__(f"{code}: {self.message}")
+
+
+def from_exception(e: Exception) -> S3Error:
+    """Translate object-layer / auth exceptions into S3 errors."""
+    if isinstance(e, S3Error):
+        return e
+    if isinstance(e, SigError):
+        return S3Error(e.code if e.code in _CATALOG else "AccessDenied",
+                       str(e))
+    b = getattr(e, "bucket", "")
+    k = getattr(e, "object", "")
+    mapping = {
+        ot.BucketNotFound: "NoSuchBucket",
+        ot.BucketExists: "BucketAlreadyOwnedByYou",
+        ot.BucketNotEmpty: "BucketNotEmpty",
+        ot.ObjectNotFound: "NoSuchKey",
+        ot.VersionNotFound: "NoSuchVersion",
+        ot.MethodNotAllowed: "MethodNotAllowed",
+        ot.InvalidRange: "InvalidRange",
+        ot.InvalidArgument: "InvalidArgument",
+        ot.PreconditionFailed: "PreconditionFailed",
+        ot.ReadQuorumError: "SlowDownRead",
+        ot.WriteQuorumError: "SlowDownWrite",
+    }
+    for cls, code in mapping.items():
+        if isinstance(e, cls):
+            return S3Error(code, bucket=b, key=k)
+    return S3Error("InternalError", str(e), bucket=b, key=k)

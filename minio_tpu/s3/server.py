@@ -1,0 +1,507 @@
+"""S3 HTTP front-end: router + handlers over the object layer.
+
+The analogue of the reference's api-router + object/bucket handlers
+(cmd/api-router.go:253, cmd/object-handlers.go, cmd/bucket-handlers.go):
+SigV4-authenticated REST mapping onto the ObjectLayer-equivalent
+(ErasureSet / server pools). Stdlib threading HTTP server — one OS
+thread per request, the Python shape of the reference's
+goroutine-per-request model.
+"""
+
+from __future__ import annotations
+
+import datetime
+import email.utils
+import hashlib
+import os
+import threading
+import urllib.parse
+import xml.etree.ElementTree as ET
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from minio_tpu.object.types import (DeleteOptions, GetOptions, InvalidArgument,
+                                    ObjectNotFound, PutOptions)
+from minio_tpu.s3 import sigv4
+from minio_tpu.s3.errors import S3Error, from_exception
+
+XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
+MAX_OBJECT_SIZE = 5 * (1 << 40)
+
+
+def _rfc1123(ns: int) -> str:
+    return email.utils.formatdate(ns / 1e9, usegmt=True)
+
+
+def _iso8601(ns: int) -> str:
+    return datetime.datetime.fromtimestamp(
+        ns / 1e9, tz=datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
+
+
+def _xml(root: ET.Element) -> bytes:
+    return b'<?xml version="1.0" encoding="UTF-8"?>\n' + ET.tostring(root)
+
+
+def _el(parent, tag, text=None):
+    e = ET.SubElement(parent, tag)
+    if text is not None:
+        e.text = str(text)
+    return e
+
+
+class Credentials:
+    """Static credential provider (IAM subsystem replaces this)."""
+
+    def __init__(self, access_key: str = "", secret_key: str = ""):
+        self.access_key = access_key or os.environ.get(
+            "MTPU_ROOT_USER", "minioadmin")
+        self.secret_key = secret_key or os.environ.get(
+            "MTPU_ROOT_PASSWORD", "minioadmin")
+
+    def secret_for(self, access_key: str):
+        if access_key == self.access_key:
+            return self.secret_key
+        return None
+
+
+class S3Server:
+    def __init__(self, object_layer, address: str = "127.0.0.1:9000",
+                 credentials: Credentials | None = None):
+        self.object_layer = object_layer
+        self.credentials = credentials or Credentials()
+        host, _, port = address.rpartition(":")
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)),
+                                         handler)
+        self.httpd.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> str:
+        h, p = self.httpd.server_address[:2]
+        return f"{h}:{p}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _make_handler(server: S3Server):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        server_version = "MinIO-TPU"
+
+        # -- plumbing ---------------------------------------------------
+
+        def log_message(self, fmt, *args):  # quiet; tracing subsystem logs
+            pass
+
+        def _headers_lower(self) -> dict[str, str]:
+            return {k.lower(): v for k, v in self.headers.items()}
+
+        def _parse(self):
+            parsed = urllib.parse.urlsplit(self.path)
+            path = urllib.parse.unquote(parsed.path)
+            query = urllib.parse.parse_qs(parsed.query,
+                                          keep_blank_values=True)
+            parts = path.lstrip("/").split("/", 1)
+            bucket = parts[0] if parts[0] else ""
+            key = parts[1] if len(parts) > 1 else ""
+            return path, query, bucket, key
+
+        def _read_body(self) -> bytes:
+            te = self._headers_lower().get("transfer-encoding", "")
+            if "chunked" in te.lower():
+                out = bytearray()
+                while True:
+                    line = self.rfile.readline().strip()
+                    size = int(line.split(b";")[0], 16)
+                    if size == 0:
+                        self.rfile.readline()
+                        break
+                    out += self.rfile.read(size)
+                    self.rfile.readline()
+                return bytes(out)
+            length = int(self.headers.get("Content-Length") or 0)
+            if length > MAX_OBJECT_SIZE:
+                raise S3Error("EntityTooLarge")
+            return self.rfile.read(length) if length else b""
+
+        def _auth(self, method, path, query, body_hash=None) -> sigv4.ParsedAuth:
+            return sigv4.verify_request(
+                method, path, query, self._headers_lower(),
+                server.credentials.secret_for, body_hash=body_hash)
+
+        def _send(self, status: int, body: bytes = b"",
+                  headers: dict | None = None, content_type="application/xml"):
+            self.send_response(status)
+            self.send_header("x-amz-request-id", "0")
+            if body or status not in (204, 304):
+                self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            if body and self.command != "HEAD":
+                self.wfile.write(body)
+
+        def _send_error(self, e: Exception, bucket="", key=""):
+            err = from_exception(e)
+            root = ET.Element("Error")
+            _el(root, "Code", err.code)
+            _el(root, "Message", err.message)
+            _el(root, "BucketName", err.bucket or bucket)
+            _el(root, "Key", err.key or key)
+            _el(root, "Resource", self.path)
+            _el(root, "RequestId", "0")
+            self._send(err.status, _xml(root))
+
+        # -- dispatch ---------------------------------------------------
+
+        def _route(self, method: str):
+            path, query, bucket, key = self._parse()
+            try:
+                body = b""
+                if method in ("PUT", "POST"):
+                    body = self._read_body()
+                body_hash = hashlib.sha256(body).hexdigest() \
+                    if method in ("PUT", "POST") else None
+                auth = self._auth(method, path, query, body_hash=body_hash)
+                # aws-chunked payload: unwrap per-chunk framing.
+                if method in ("PUT", "POST") and auth.payload_hash in (
+                        sigv4.STREAMING_PAYLOAD,
+                        sigv4.STREAMING_PAYLOAD_TRAILER,
+                        sigv4.STREAMING_UNSIGNED_TRAILER):
+                    secret = server.credentials.secret_for(
+                        auth.credential.access_key)
+                    body = sigv4.decode_chunked_payload(body, auth, secret)
+
+                if not bucket:
+                    if method == "GET":
+                        return self._list_buckets()
+                    raise S3Error("MethodNotAllowed")
+                if not key:
+                    return self._bucket_op(method, bucket, query, body)
+                return self._object_op(method, bucket, key, query, body)
+            except Exception as e:  # noqa: BLE001 - rendered as S3 error XML
+                self._send_error(e, bucket, key)
+
+        def do_GET(self):
+            self._route("GET")
+
+        def do_PUT(self):
+            self._route("PUT")
+
+        def do_POST(self):
+            self._route("POST")
+
+        def do_DELETE(self):
+            self._route("DELETE")
+
+        def do_HEAD(self):
+            self._route("HEAD")
+
+        # -- service / bucket ops --------------------------------------
+
+        def _list_buckets(self):
+            buckets = server.object_layer.list_buckets()
+            root = ET.Element("ListAllMyBucketsResult", xmlns=XMLNS)
+            owner = _el(root, "Owner")
+            _el(owner, "ID", "minio-tpu")
+            _el(owner, "DisplayName", "minio-tpu")
+            bl = _el(root, "Buckets")
+            for b in buckets:
+                be = _el(bl, "Bucket")
+                _el(be, "Name", b.name)
+                _el(be, "CreationDate", _iso8601(b.created))
+            self._send(200, _xml(root))
+
+        def _bucket_op(self, method, bucket, query, body):
+            ol = server.object_layer
+            if method == "PUT":
+                if "versioning" in query:
+                    return self._put_versioning(bucket, body)
+                _validate_bucket_name(bucket)
+                ol.make_bucket(bucket)
+                return self._send(200, headers={"Location": f"/{bucket}"})
+            if method == "HEAD":
+                ol.get_bucket_info(bucket)
+                return self._send(200)
+            if method == "DELETE":
+                ol.delete_bucket(bucket)
+                return self._send(204)
+            if method == "POST" and "delete" in query:
+                return self._delete_objects(bucket, body)
+            if method == "GET":
+                if "location" in query:
+                    root = ET.Element("LocationConstraint", xmlns=XMLNS)
+                    return self._send(200, _xml(root))
+                if "versioning" in query:
+                    return self._get_versioning(bucket)
+                if "object-lock" in query:
+                    raise S3Error("ObjectLockConfigurationNotFoundError",
+                                  bucket=bucket)
+                if "policy" in query:
+                    raise S3Error("NoSuchBucketPolicy", bucket=bucket)
+                if "lifecycle" in query:
+                    raise S3Error("NoSuchLifecycleConfiguration", bucket=bucket)
+                if "tagging" in query:
+                    raise S3Error("NoSuchTagSet", bucket=bucket)
+                if "encryption" in query:
+                    raise S3Error(
+                        "ServerSideEncryptionConfigurationNotFoundError",
+                        bucket=bucket)
+                if "replication" in query:
+                    raise S3Error("ReplicationConfigurationNotFoundError",
+                                  bucket=bucket)
+                if "cors" in query:
+                    raise S3Error("NoSuchCORSConfiguration", bucket=bucket)
+                return self._list_objects(bucket, query)
+            raise S3Error("MethodNotAllowed")
+
+        def _get_versioning(self, bucket):
+            ol = server.object_layer
+            ol.get_bucket_info(bucket)
+            enabled = getattr(ol, "bucket_versioning", lambda b: False)(bucket)
+            root = ET.Element("VersioningConfiguration", xmlns=XMLNS)
+            if enabled:
+                _el(root, "Status", "Enabled")
+            self._send(200, _xml(root))
+
+        def _put_versioning(self, bucket, body):
+            ol = server.object_layer
+            ol.get_bucket_info(bucket)
+            try:
+                status = ET.fromstring(body).findtext(
+                    f"{{{XMLNS}}}Status") or ET.fromstring(body).findtext("Status")
+            except ET.ParseError:
+                raise S3Error("MalformedXML") from None
+            setter = getattr(ol, "set_bucket_versioning", None)
+            if setter is None:
+                raise S3Error("NotImplemented")
+            setter(bucket, status == "Enabled")
+            self._send(200)
+
+        def _list_objects(self, bucket, query):
+            def q(name, default=""):
+                return query.get(name, [default])[0]
+            v2 = q("list-type") == "2"
+            prefix = q("prefix")
+            delimiter = q("delimiter")
+            max_keys = int(q("max-keys", "1000") or 1000)
+            if v2:
+                marker = q("start-after")
+                token = q("continuation-token")
+                if token:
+                    marker = _b64d(token)
+            else:
+                marker = q("marker")
+            info = server.object_layer.list_objects(
+                bucket, prefix=prefix, marker=marker, delimiter=delimiter,
+                max_keys=max_keys)
+            root = ET.Element("ListBucketResult", xmlns=XMLNS)
+            _el(root, "Name", bucket)
+            _el(root, "Prefix", prefix)
+            if delimiter:
+                _el(root, "Delimiter", delimiter)
+            _el(root, "MaxKeys", max_keys)
+            _el(root, "IsTruncated", "true" if info.is_truncated else "false")
+            if v2:
+                _el(root, "KeyCount", len(info.objects) + len(info.prefixes))
+                if info.is_truncated:
+                    _el(root, "NextContinuationToken", _b64e(info.next_marker))
+            else:
+                _el(root, "Marker", marker)
+                if info.is_truncated:
+                    _el(root, "NextMarker", info.next_marker)
+            for o in info.objects:
+                c = _el(root, "Contents")
+                _el(c, "Key", o.name)
+                _el(c, "LastModified", _iso8601(o.mod_time))
+                _el(c, "ETag", f'"{o.etag}"')
+                _el(c, "Size", o.size)
+                _el(c, "StorageClass", o.storage_class)
+            for p in info.prefixes:
+                cp = _el(root, "CommonPrefixes")
+                _el(cp, "Prefix", p)
+            self._send(200, _xml(root))
+
+        def _delete_objects(self, bucket, body):
+            try:
+                tree = ET.fromstring(body)
+            except ET.ParseError:
+                raise S3Error("MalformedXML") from None
+            ns = f"{{{XMLNS}}}"
+            objs = tree.findall(f"{ns}Object") or tree.findall("Object")
+            quiet = (tree.findtext(f"{ns}Quiet") or
+                     tree.findtext("Quiet") or "") == "true"
+            root = ET.Element("DeleteResult", xmlns=XMLNS)
+            versioned = _versioned(server.object_layer, bucket)
+            for obj in objs[:1000]:
+                key = obj.findtext(f"{ns}Key") or obj.findtext("Key") or ""
+                vid = obj.findtext(f"{ns}VersionId") or obj.findtext("VersionId") or ""
+                try:
+                    deleted = server.object_layer.delete_object(
+                        bucket, key,
+                        DeleteOptions(version_id=vid, versioned=versioned))
+                    if not quiet:
+                        de = _el(root, "Deleted")
+                        _el(de, "Key", key)
+                        if vid:
+                            _el(de, "VersionId", vid)
+                        if deleted.delete_marker:
+                            _el(de, "DeleteMarker", "true")
+                            _el(de, "DeleteMarkerVersionId",
+                                deleted.delete_marker_version_id)
+                except Exception as e:  # noqa: BLE001 - per-key result
+                    err = from_exception(e)
+                    ee = _el(root, "Error")
+                    _el(ee, "Key", key)
+                    _el(ee, "Code", err.code)
+                    _el(ee, "Message", err.message)
+            self._send(200, _xml(root))
+
+        # -- object ops -------------------------------------------------
+
+        def _object_op(self, method, bucket, key, query, body):
+            _validate_object_name(key)
+            if method == "PUT":
+                return self._put_object(bucket, key, query, body)
+            if method in ("GET", "HEAD"):
+                return self._get_object(method, bucket, key, query)
+            if method == "DELETE":
+                return self._delete_object(bucket, key, query)
+            raise S3Error("MethodNotAllowed")
+
+        def _put_object(self, bucket, key, query, body):
+            h = self._headers_lower()
+            if "x-amz-copy-source" in h:
+                raise S3Error("NotImplemented")  # CopyObject: next slice
+            meta = {k[len("x-amz-meta-"):]: v for k, v in h.items()
+                    if k.startswith("x-amz-meta-")}
+            opts = PutOptions(
+                versioned=_versioned(server.object_layer, bucket),
+                user_metadata=meta,
+                content_type=h.get("content-type", ""),
+                storage_class=h.get("x-amz-storage-class", "STANDARD"))
+            info = server.object_layer.put_object(bucket, key, body, opts)
+            headers = {"ETag": f'"{info.etag}"'}
+            if info.version_id:
+                headers["x-amz-version-id"] = info.version_id
+            self._send(200, headers=headers)
+
+        def _get_object(self, method, bucket, key, query):
+            h = self._headers_lower()
+            vid = query.get("versionId", [""])[0]
+            rng = h.get("range", "")
+            spec = _range_spec(rng)
+            payload = b""
+            if method == "HEAD":
+                # HEAD: metadata fan-out only, no shard reads.
+                info = server.object_layer.get_object_info(
+                    bucket, key, GetOptions(version_id=vid))
+                start, length = (_resolve_head_range(spec, info.size)
+                                 if spec else (0, info.size))
+            else:
+                info, payload = server.object_layer.get_object(
+                    bucket, key, GetOptions(version_id=vid, range_spec=spec))
+                start, length = info.range_start, info.range_length
+            headers = {
+                "ETag": f'"{info.etag}"',
+                "Last-Modified": _rfc1123(info.mod_time),
+                "Accept-Ranges": "bytes",
+            }
+            if info.version_id:
+                headers["x-amz-version-id"] = info.version_id
+            for mk, mv in info.user_metadata.items():
+                headers[f"x-amz-meta-{mk}"] = mv
+            ctype = info.content_type or "application/octet-stream"
+            status = 206 if spec else 200
+            if spec:
+                headers["Content-Range"] = \
+                    f"bytes {start}-{start + length - 1}/{info.size}"
+            if method == "HEAD":
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(length))
+                for k2, v2 in headers.items():
+                    self.send_header(k2, v2)
+                self.end_headers()
+                return
+            self._send(status, payload, headers=headers, content_type=ctype)
+
+        def _delete_object(self, bucket, key, query):
+            vid = query.get("versionId", [""])[0]
+            deleted = server.object_layer.delete_object(
+                bucket, key, DeleteOptions(
+                    version_id=vid,
+                    versioned=_versioned(server.object_layer, bucket)))
+            headers = {}
+            if deleted.delete_marker:
+                headers["x-amz-delete-marker"] = "true"
+                headers["x-amz-version-id"] = deleted.delete_marker_version_id
+            elif vid:
+                headers["x-amz-version-id"] = vid
+            self._send(204, headers=headers)
+
+    return Handler
+
+
+def _b64e(s: str) -> str:
+    import base64
+    return base64.urlsafe_b64encode(s.encode()).decode()
+
+
+def _b64d(s: str) -> str:
+    import base64
+    try:
+        return base64.urlsafe_b64decode(s.encode()).decode()
+    except Exception:
+        raise S3Error("InvalidArgument", "bad continuation token") from None
+
+
+def _versioned(ol, bucket: str) -> bool:
+    fn = getattr(ol, "bucket_versioning", None)
+    return bool(fn(bucket)) if fn else False
+
+
+def _range_spec(rng: str):
+    """Range header -> (start|None, end|None) spec, or None if absent."""
+    if not rng:
+        return None
+    if not rng.startswith("bytes="):
+        raise S3Error("InvalidArgument")
+    spec = rng[len("bytes="):]
+    if "," in spec:
+        raise S3Error("NotImplemented", "multiple ranges")
+    lo, _, hi = spec.partition("-")
+    try:
+        if lo == "":
+            return (None, int(hi))
+        return (int(lo), int(hi) if hi else None)
+    except ValueError:
+        raise S3Error("InvalidArgument") from None
+
+
+def _resolve_head_range(spec, size: int):
+    from minio_tpu.object.erasure_object import _resolve_range
+    return _resolve_range(spec, size, "", "")
+
+
+def _validate_bucket_name(name: str) -> None:
+    import re
+    if not (3 <= len(name) <= 63) or \
+            not re.fullmatch(r"[a-z0-9][a-z0-9.-]*[a-z0-9]", name):
+        raise S3Error("InvalidBucketName", bucket=name)
+
+
+def _validate_object_name(key: str) -> None:
+    if not key or len(key.encode()) > 1024 or "\x00" in key:
+        raise S3Error("InvalidObjectName", key=key)
+    for seg in key.split("/"):
+        if seg in (".", ".."):
+            raise S3Error("InvalidObjectName", key=key)

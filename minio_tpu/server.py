@@ -1,0 +1,72 @@
+"""`python -m minio_tpu.server` — boot a single-node S3 server.
+
+The analogue of the reference's serverMain (cmd/server-main.go:746):
+run the boot self-tests (hard-fail on wrong math, like the reference's
+erasure/bitrot self-tests at :799-803), build the erasure set over the
+drive paths, and serve the S3 API.
+
+Usage:
+    python -m minio_tpu.server --address 127.0.0.1:9000 /data/d1 /data/d2 ...
+
+Credentials come from MTPU_ROOT_USER / MTPU_ROOT_PASSWORD
+(default minioadmin/minioadmin).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="minio_tpu.server")
+    ap.add_argument("--address", default="0.0.0.0:9000")
+    ap.add_argument("--parity", type=int, default=None,
+                    help="EC parity shards (default: by drive count)")
+    ap.add_argument("--ec-backend", choices=["auto", "host", "tpu"],
+                    default="auto",
+                    help="where the GF(2^8) math runs (tpu = JAX device)")
+    ap.add_argument("drives", nargs="+", help="local drive directories")
+    args = ap.parse_args(argv)
+
+    # Boot self-tests: identical math to the reference or refuse to serve.
+    from minio_tpu.erasure.selftest import erasure_self_test
+    from minio_tpu.storage.bitrot import bitrot_self_test
+    erasure_self_test()
+    bitrot_self_test()
+
+    backend = None
+    if args.ec_backend == "tpu":
+        from minio_tpu.ops.rs_device import DeviceBackend
+        backend = DeviceBackend()
+    elif args.ec_backend == "auto":
+        try:
+            import jax
+            if jax.default_backend() == "tpu":
+                from minio_tpu.ops.rs_device import DeviceBackend
+                backend = DeviceBackend()
+        except Exception:  # noqa: BLE001 - no JAX device -> host math
+            backend = None
+
+    from minio_tpu.object.erasure_object import ErasureSet
+    from minio_tpu.s3.server import S3Server
+    from minio_tpu.storage.local import LocalStorage
+
+    disks = [LocalStorage(p) for p in args.drives]
+    layer = ErasureSet(disks, parity=args.parity, backend=backend)
+    srv = S3Server(layer, address=args.address)
+    print(f"minio-tpu serving S3 on {srv.address} "
+          f"({len(disks)} drives, parity={layer.default_parity}, "
+          f"ec-backend={'tpu' if backend else 'host'})", flush=True)
+    srv.start()
+    try:
+        import time
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

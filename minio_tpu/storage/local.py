@@ -445,31 +445,45 @@ class LocalStorage:
                 return False
 
         def walk(rel: str, parent_is_obj: bool) -> Iterator[tuple[str, bytes]]:
+            """Yields in GLOBAL lexicographic key order. A directory `d`
+            produces two ordered events: the object key "d" (sorts before
+            siblings like "d-x") and the subtree "d/" (sorts after them) —
+            interleaving siblings between an object and its nested keys,
+            exactly as S3 key order requires."""
             full = os.path.join(vol, rel) if rel else vol
             try:
-                names = sorted(os.listdir(full))
+                names = os.listdir(full)
             except (FileNotFoundError, NotADirectoryError):
                 return
+            events = []  # (sort_key, name, kind)
             for n in names:
                 if n == META_FILE:
                     continue
                 if parent_is_obj and is_uuid(n):
                     continue  # version data dir, not a key prefix
-                child = f"{rel}/{n}" if rel else n
-                if child < forward_from[:len(child)]:
-                    continue
                 if os.path.isdir(os.path.join(full, n)):
-                    got = emit(child)
-                    if got is not None:
-                        yield got
-                        # Objects can nest under an object name (key "a"
-                        # and "a/b" coexist) — keep descending.
-                        if recursive:
-                            yield from walk(child, True)
-                    elif recursive:
-                        yield from walk(child, False)
+                    events.append((n, n, "obj"))
+                    events.append((n + "/", n, "descend"))
+            events.sort()
+            for sort_key, n, kind in events:
+                child = f"{rel}/{n}" if rel else n
+                if kind == "obj":
+                    if child >= forward_from or forward_from.startswith(child):
+                        got = emit(child)
+                        if got is not None:
+                            yield got
+                else:
+                    subtree = child + "/"
+                    # Prune subtrees wholly before the resume point.
+                    if subtree < forward_from and \
+                            not forward_from.startswith(subtree):
+                        continue
+                    if recursive:
+                        is_obj = os.path.exists(
+                            os.path.join(vol, child, META_FILE))
+                        yield from walk(child, is_obj)
                     else:
-                        yield child + "/", b""
+                        yield subtree, b""
         yield from walk(base_dir, False)
 
     # ------------------------------------------------------------------

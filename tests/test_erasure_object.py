@@ -102,8 +102,11 @@ def test_versioned_put_and_delete_marker(es):
 
     deleted = es.delete_object("bkt", "o", DeleteOptions(versioned=True))
     assert deleted.delete_marker
+    with pytest.raises(ObjectNotFound):
+        es.get_object("bkt", "o")  # latest is a marker -> NoSuchKey
     with pytest.raises(MethodNotAllowed):
-        es.get_object("bkt", "o")
+        es.get_object("bkt", "o", GetOptions(
+            version_id=deleted.delete_marker_version_id))
     # specific versions still readable
     _, old = es.get_object("bkt", "o", GetOptions(version_id=i2.version_id))
     assert old == b"v2"
