@@ -87,6 +87,27 @@ class ErasureSet:
         self.default_parity = default_parity(n) if parity is None else parity
         self.backend = backend
         self.pool = pool or ThreadPoolExecutor(max_workers=max(8, 2 * n))
+        self._mrf = None
+
+    @property
+    def mrf(self):
+        """Lazy MRF heal queue (background worker starts on first use)."""
+        if self._mrf is None:
+            from minio_tpu.object.healing import MRFQueue
+            self._mrf = MRFQueue(self)
+        return self._mrf
+
+    # -- healing entry points ------------------------------------------
+
+    def heal_object(self, bucket: str, object_: str, version_id: str = "",
+                    deep: bool = False):
+        from minio_tpu.object import healing
+        return healing.heal_object(self, bucket, object_, version_id,
+                                   deep=deep)
+
+    def heal_bucket(self, bucket: str):
+        from minio_tpu.object import healing
+        return healing.heal_bucket(self, bucket)
 
     # ------------------------------------------------------------------
     # fan-out helper
@@ -158,6 +179,7 @@ class ErasureSet:
             raise WriteQuorumError(bucket)
         # Drop bucket metadata so a recreated bucket starts fresh
         # (versioning state must not survive deletion).
+        getattr(self, "_bmeta_cache", {}).pop(bucket, None)
         self._fanout([lambda d=d: _swallow(
             lambda: d.delete(SYS_VOL, f"buckets/{bucket}", recursive=True))
             for d in self.disks])
@@ -169,7 +191,24 @@ class ErasureSet:
     def _bucket_meta_path(self, bucket: str) -> str:
         return f"buckets/{bucket}/bucket-meta.json"
 
+    _BUCKET_META_TTL = 2.0
+
     def get_bucket_meta(self, bucket: str) -> dict:
+        """Quorum-voted bucket metadata with a short in-memory TTL cache
+        (the reference caches bucket metadata cluster-wide; without a
+        cache every object write pays an n-drive metadata fan-out)."""
+        import time as _time
+        cache = getattr(self, "_bmeta_cache", None)
+        if cache is None:
+            cache = self._bmeta_cache = {}
+        hit = cache.get(bucket)
+        if hit is not None and _time.monotonic() - hit[0] < self._BUCKET_META_TTL:
+            return hit[1]
+        meta = self._get_bucket_meta_uncached(bucket)
+        cache[bucket] = (_time.monotonic(), meta)
+        return meta
+
+    def _get_bucket_meta_uncached(self, bucket: str) -> dict:
         import json
         results, _ = self._fanout(
             [lambda d=d: d.read_all(SYS_VOL, self._bucket_meta_path(bucket))
@@ -192,6 +231,7 @@ class ErasureSet:
         _, errors = self._fanout(
             [lambda d=d: d.write_all(SYS_VOL, self._bucket_meta_path(bucket),
                                      blob) for d in self.disks])
+        getattr(self, "_bmeta_cache", {}).pop(bucket, None)
         if sum(e is None for e in errors) < len(self.disks) // 2 + 1:
             raise WriteQuorumError(bucket)
 
@@ -402,6 +442,11 @@ class ErasureSet:
                     for d in self.disks])
             raise WriteQuorumError(bucket, object_,
                                    f"wrote {ok}/{n}, need {write_quorum}")
+        if ok < n:
+            # Partial success: queue immediate background repair of the
+            # drives that missed the write (reference MRF hook,
+            # cmd/erasure-object.go:1556-1594).
+            self.mrf.enqueue(bucket, object_, version_id)
         return ObjectInfo(bucket=bucket, name=object_, mod_time=mod_time,
                           size=len(data), etag=etag,
                           content_type=opts.content_type,
@@ -418,6 +463,12 @@ class ErasureSet:
         opts = opts or GetOptions()
         fi, fis, errors = self._get_object_fileinfo(
             bucket, object_, opts.version_id, read_data=True)
+        if any(e is not None for e in errors):
+            # Some drive is missing this version's metadata: schedule a
+            # background heal even if the read itself succeeds from the
+            # healthy k (reference: heal-on-missing-metadata in
+            # getObjectFileInfo's MRF hook).
+            self.mrf.enqueue(bucket, object_, fi.version_id)
         if fi.deleted:
             # Latest-is-delete-marker reads 404 (NoSuchKey); naming the
             # marker's version explicitly is 405 (MethodNotAllowed) —
@@ -520,6 +571,10 @@ class ErasureSet:
                 raise ReadQuorumError(bucket, object_,
                                       f"{available}/{n} shards readable")
             e.decode_data_blocks(shards)
+            # Bytes were served from reconstruction: heal in background
+            # (reference: MRF enqueue on degraded reads,
+            # cmd/erasure-object.go:399-417).
+            self.mrf.enqueue(bucket, object_, fi.version_id)
 
         # Blocks interleave across shards: reassemble block-major, trimming
         # each block's zero padding (k*shard_size may exceed BLOCK_SIZE).
@@ -589,6 +644,10 @@ class ErasureSet:
                       for e in errors)
         if ok + missing < write_quorum:
             raise WriteQuorumError(bucket, object_)
+        if ok + missing < n and ok > 0:
+            # A drive missed the delete: repair so listings/reads cannot
+            # resurrect the version from the stale copy.
+            self.mrf.enqueue(bucket, object_, opts.version_id)
         return DeletedObject(object_name=object_, version_id=opts.version_id)
 
     def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
@@ -624,15 +683,45 @@ class ErasureSet:
         iters = [disk_iter(d) for d in walk_disks if d is not None]
         merged = heapq.merge(*iters, key=lambda kv: kv[0])
 
+        def resolve_latest(path, entries, total_walked):
+            """Resolve one key from its walked journal copies.
+
+            When every walked drive has the key and they agree, the parsed
+            copy is authoritative (no extra I/O — the hot path). Otherwise
+            the entry is ambiguous (a drive missed a delete/overwrite, or
+            the object never reached all walked drives) and resolution
+            falls back to a full quorum metadata read, exactly how the
+            reference's metacache resolver escalates disagreements —
+            a lone stale copy must not resurrect deleted objects, and a
+            quorum-thin write must still be listed."""
+            parsed = []
+            for blob in entries:
+                try:
+                    xl = XLMeta.load(blob)
+                    fi = xl.to_fileinfo(bucket, path)
+                    parsed.append((xl, fi))
+                except Exception:  # noqa: BLE001 - unreadable copy
+                    continue
+            agree = (len(parsed) == total_walked and len({
+                (fi.mod_time, fi.version_id, fi.deleted, fi.data_dir)
+                for _, fi in parsed}) == 1)
+            if agree:
+                return parsed[0]
+            try:
+                fi, _, _ = self._get_object_fileinfo(bucket, path)
+            except Exception:  # noqa: BLE001 - dangling / below quorum
+                return None
+            xl = parsed[0][0] if parsed else None
+            return (xl, fi)
+
         info = ListObjectsInfo()
         seen_prefixes: set[str] = set()
-        last = None
         last_added = ""   # last key/prefix actually returned; resume point
         from minio_tpu.storage.meta import XLMeta
-        for path, blob in merged:
-            if path == last:
-                continue
-            last = path
+        from itertools import groupby
+        grouped = ((path, [b for _, b in grp]) for path, grp in
+                   groupby(merged, key=lambda kv: kv[0]))
+        for path, blobs in grouped:
             if not path.startswith(prefix):
                 if path > prefix and not prefix.startswith(path):
                     break  # sorted walk has passed the prefix range
@@ -644,7 +733,12 @@ class ErasureSet:
                 di = rest.find(delimiter)
                 if di >= 0:
                     cp = prefix + rest[:di + len(delimiter)]
-                    if cp in seen_prefixes or (marker and cp <= marker):
+                    # Skip a prefix only when the whole page before it was
+                    # already returned; a marker INSIDE the prefix (e.g.
+                    # start-after=a/1 with cp=a/) must still surface it.
+                    if cp in seen_prefixes or (
+                            marker and cp <= marker
+                            and not (marker.startswith(cp) and marker != cp)):
                         continue
                     if len(info.objects) + len(seen_prefixes) >= max_keys:
                         info.is_truncated = True
@@ -653,18 +747,17 @@ class ErasureSet:
                     seen_prefixes.add(cp)
                     last_added = cp
                     continue
-            try:
-                xl = XLMeta.load(blob)
-                fi = xl.to_fileinfo(bucket, path)
-            except Exception:  # noqa: BLE001 - unreadable journal copy
+            best = resolve_latest(path, blobs, len(iters))
+            if best is None:
                 continue
+            xl, fi = best
             if fi.deleted and not include_versions:
                 continue
             if len(info.objects) + len(seen_prefixes) >= max_keys:
                 info.is_truncated = True
                 info.next_marker = last_added
                 break
-            if include_versions:
+            if include_versions and xl is not None:
                 for v in xl.list_versions(bucket, path):
                     info.objects.append(self._to_object_info(bucket, path, v))
             else:

@@ -1,0 +1,266 @@
+"""Healing: reconstruct missing/corrupt shards onto bad drives, plus the
+MRF ("most recently failed") retry queue.
+
+The analogue of the reference's healing stack (cmd/erasure-healing.go:296
+healObject; cmd/mrf.go MRF queue): classify per-drive state for the
+quorum version, rebuild ALL n shards from any k readable ones
+(reference: Erasure.Heal reconstructs data+parity,
+cmd/erasure-decode.go:317), and commit the rebuilt shards to the bad
+drives through the same staged rename path writes use. Partial writes
+enqueue onto the MRF queue for immediate background repair, exactly the
+reference's write-path MRF hook (cmd/erasure-object.go:1556-1594).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from minio_tpu.erasure.codec import ceil_frac
+from minio_tpu.object.types import ObjectNotFound, ReadQuorumError
+from minio_tpu.storage import bitrot
+from minio_tpu.storage.meta import FileInfo, FileNotFoundErr, VersionNotFoundErr
+
+DRIVE_STATE_OK = "ok"
+DRIVE_STATE_OFFLINE = "offline"
+DRIVE_STATE_MISSING = "missing"
+DRIVE_STATE_OUTDATED = "outdated"
+DRIVE_STATE_CORRUPT = "corrupt"
+
+
+@dataclasses.dataclass
+class HealResult:
+    bucket: str
+    object: str
+    version_id: str = ""
+    before: list = dataclasses.field(default_factory=list)
+    after: list = dataclasses.field(default_factory=list)
+    healed: int = 0
+    data_blocks: int = 0
+    parity_blocks: int = 0
+
+
+class HealError(Exception):
+    pass
+
+
+def heal_object(es, bucket: str, object_: str, version_id: str = "",
+                deep: bool = False) -> HealResult:
+    """Heal one version of one object across the set's drives."""
+    from minio_tpu.object import erasure_object as eo
+
+    fis, errors = es._read_version_all(bucket, object_, version_id,
+                                       read_data=True)
+    n = len(es.disks)
+    any_fi = next((f for f in fis if f is not None), None)
+    if any_fi is None:
+        raise ObjectNotFound(bucket, object_)
+    quorum = max(any_fi.erasure.data_blocks, n // 2) \
+        if any_fi.erasure.data_blocks else n // 2 + 1
+    fi, _ = es._quorum_fileinfo(fis, quorum)
+    if fi is None:
+        raise ReadQuorumError(bucket, object_)
+    if fi.deleted:
+        # Delete markers heal by metadata replication only.
+        return _heal_metadata_only(es, bucket, object_, fi, fis, errors)
+
+    k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
+    e = es._erasure(k, m)
+    shard_size = e.shard_size()
+    shard_file_len = e.shard_file_size(fi.size)
+    inline = fi.inline_data is not None
+    dist = fi.erasure.distribution
+
+    # Classify drives + load verified shards where possible.
+    states: list[str] = [DRIVE_STATE_OFFLINE] * n
+    shards: list[Optional[np.ndarray]] = [None] * (k + m)
+    nblocks = ceil_frac(shard_file_len, shard_size) if shard_file_len else 0
+
+    def load_shard(disk_idx: int) -> Optional[np.ndarray]:
+        d = es.disks[disk_idx]
+        dfi = fis[disk_idx]
+        shard_idx = dist[disk_idx] - 1
+        try:
+            if inline:
+                blob = dfi.inline_data or b""
+            else:
+                blob = d.read_file(bucket, f"{object_}/{fi.data_dir}/part.1")
+            reader = bitrot.FramedShardReader(blob, shard_size, shard_file_len)
+            parts = [reader.block(b) for b in range(nblocks)]
+            return np.concatenate(parts) if parts else np.zeros(0, np.uint8)
+        except Exception:  # noqa: BLE001 - treat as corrupt
+            return None
+
+    for i in range(n):
+        dfi = fis[i]
+        if isinstance(errors[i], (FileNotFoundErr, VersionNotFoundErr)):
+            states[i] = DRIVE_STATE_MISSING
+            continue
+        if dfi is None:
+            states[i] = DRIVE_STATE_OFFLINE
+            continue
+        if (dfi.mod_time, dfi.data_dir) != (fi.mod_time, fi.data_dir) \
+                or dfi.deleted != fi.deleted:
+            states[i] = DRIVE_STATE_OUTDATED
+            continue
+        if fi.size == 0:
+            states[i] = DRIVE_STATE_OK
+            shards[dist[i] - 1] = np.zeros(0, np.uint8)
+            continue
+        loaded = load_shard(i)
+        if loaded is None:
+            states[i] = DRIVE_STATE_CORRUPT
+        else:
+            states[i] = DRIVE_STATE_OK
+            shards[dist[i] - 1] = loaded
+
+    result = HealResult(bucket=bucket, object=object_,
+                        version_id=fi.version_id, before=list(states),
+                        data_blocks=k, parity_blocks=m)
+    bad = [i for i in range(n) if states[i] in
+           (DRIVE_STATE_MISSING, DRIVE_STATE_OUTDATED, DRIVE_STATE_CORRUPT)]
+    if not bad:
+        result.after = list(states)
+        return result
+
+    if fi.size > 0:
+        if sum(1 for s in shards if s is not None) < k:
+            raise ReadQuorumError(bucket, object_,
+                                  "not enough shards to heal")
+        # Rebuild ALL shards (data + parity), batched through the backend.
+        e.decode_data_and_parity_blocks(shards)
+
+    # Write rebuilt shards to the bad drives via the staged commit path.
+    def heal_one(disk_idx: int):
+        d = es.disks[disk_idx]
+        shard_idx = dist[disk_idx] - 1
+        hfi = dataclasses.replace(
+            fi, metadata=dict(fi.metadata), parts=list(fi.parts),
+            erasure=dataclasses.replace(fi.erasure, index=shard_idx + 1),
+            inline_data=None)
+        if fi.size == 0:
+            hfi.inline_data = b"" if inline else None
+            d.write_metadata(bucket, object_, hfi)
+            return
+        framed = bitrot.frame_shard(shards[shard_idx], shard_size)
+        if inline:
+            hfi.inline_data = framed
+            d.write_metadata(bucket, object_, hfi)
+        else:
+            staging = f"{eo.STAGING_PREFIX}/{eo.new_uuid()}"
+            d.create_file(eo.SYS_VOL, f"{staging}/{fi.data_dir}/part.1",
+                          framed)
+            d.rename_data(eo.SYS_VOL, staging, hfi, bucket, object_)
+
+    _, herrs = es._fanout([
+        (lambda i=i: heal_one(i)) if i in bad else None
+        for i in range(n)])
+    after = list(states)
+    for i in bad:
+        if herrs[i] is None:
+            after[i] = DRIVE_STATE_OK
+            result.healed += 1
+    result.after = after
+    return result
+
+
+def _heal_metadata_only(es, bucket, object_, fi: FileInfo, fis, errors):
+    n = len(es.disks)
+    states = []
+    for i in range(n):
+        if fis[i] is not None and fis[i].mod_time == fi.mod_time \
+                and fis[i].deleted == fi.deleted:
+            states.append(DRIVE_STATE_OK)
+        elif isinstance(errors[i], (FileNotFoundErr, VersionNotFoundErr)):
+            states.append(DRIVE_STATE_MISSING)
+        else:
+            states.append(DRIVE_STATE_OUTDATED if fis[i] is not None
+                          else DRIVE_STATE_OFFLINE)
+    result = HealResult(bucket=bucket, object=object_,
+                        version_id=fi.version_id, before=list(states))
+    bad = [i for i in range(n) if states[i] in (DRIVE_STATE_MISSING,
+                                                DRIVE_STATE_OUTDATED)]
+    _, herrs = es._fanout([
+        (lambda i=i: es.disks[i].write_metadata(bucket, object_, fi))
+        if i in bad else None for i in range(n)])
+    after = list(states)
+    for i in bad:
+        if herrs[i] is None:
+            after[i] = DRIVE_STATE_OK
+            result.healed += 1
+    result.after = after
+    return result
+
+
+def heal_bucket(es, bucket: str) -> dict:
+    """Recreate the bucket volume on drives that miss it."""
+    results, errors = es._fanout(
+        [lambda d=d: d.stat_vol(bucket) for d in es.disks])
+    missing = [i for i, r in enumerate(results) if r is None]
+    if len(missing) == len(es.disks):
+        raise ObjectNotFound(bucket, "")
+    _, herrs = es._fanout([
+        (lambda i=i: es.disks[i].make_vol_if_missing(bucket))
+        if i in missing else None for i in range(len(es.disks))])
+    return {"bucket": bucket, "missing": len(missing),
+            "healed": sum(1 for i, e in enumerate(herrs)
+                          if i in missing and e is None)}
+
+
+class MRFQueue:
+    """Most-recently-failed heal queue: partial writes retry immediately
+    in the background (reference: cmd/mrf.go, bounded queue + worker)."""
+
+    def __init__(self, es, max_items: int = 100_000, retries: int = 3):
+        self.es = es
+        self.q: "queue.Queue[tuple]" = queue.Queue(maxsize=max_items)
+        self.retries = retries
+        self.healed = 0
+        self.dropped = 0
+        self._stop = threading.Event()
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def enqueue(self, bucket: str, object_: str, version_id: str = "") -> None:
+        try:
+            self.q.put_nowait((bucket, object_, version_id, 0))
+        except queue.Full:
+            self.dropped += 1
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                bucket, object_, vid, attempt = self.q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                heal_object(self.es, bucket, object_, vid)
+                self.healed += 1
+            except Exception:  # noqa: BLE001 - retry w/ backoff, then drop
+                if attempt + 1 < self.retries and not self._stop.is_set():
+                    time.sleep(min(2 ** attempt * 0.05, 1.0))
+                    try:
+                        self.q.put_nowait((bucket, object_, vid, attempt + 1))
+                    except queue.Full:
+                        self.dropped += 1
+                else:
+                    self.dropped += 1
+            finally:
+                self.q.task_done()
+
+    def drain(self, timeout: float = 10.0) -> None:
+        """Testing hook: wait until queued AND in-flight items finish."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.q.unfinished_tasks == 0:
+                return
+            time.sleep(0.02)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._worker.join(timeout=2)

@@ -119,10 +119,15 @@ def _make_handler(server: S3Server):
                 out = bytearray()
                 while True:
                     line = self.rfile.readline().strip()
-                    size = int(line.split(b";")[0], 16)
+                    try:
+                        size = int(line.split(b";")[0], 16)
+                    except ValueError:
+                        raise S3Error("IncompleteBody") from None
                     if size == 0:
                         self.rfile.readline()
                         break
+                    if len(out) + size > MAX_OBJECT_SIZE:
+                        raise S3Error("EntityTooLarge")
                     out += self.rfile.read(size)
                     self.rfile.readline()
                 return bytes(out)
@@ -131,10 +136,10 @@ def _make_handler(server: S3Server):
                 raise S3Error("EntityTooLarge")
             return self.rfile.read(length) if length else b""
 
-        def _auth(self, method, path, query, body_hash=None) -> sigv4.ParsedAuth:
+        def _auth(self, method, path, query) -> sigv4.ParsedAuth:
             return sigv4.verify_request(
                 method, path, query, self._headers_lower(),
-                server.credentials.secret_for, body_hash=body_hash)
+                server.credentials.secret_for)
 
         def _send(self, status: int, body: bytes = b"",
                   headers: dict | None = None, content_type="application/xml"):
@@ -165,20 +170,25 @@ def _make_handler(server: S3Server):
         def _route(self, method: str):
             path, query, bucket, key = self._parse()
             try:
+                # Verify the signature from headers first; the declared
+                # payload hash is part of the signed canonical request, so
+                # the body is only hashed afterwards when the mode calls
+                # for it (streaming modes verify per chunk instead).
+                auth = self._auth(method, path, query)
                 body = b""
                 if method in ("PUT", "POST"):
                     body = self._read_body()
-                body_hash = hashlib.sha256(body).hexdigest() \
-                    if method in ("PUT", "POST") else None
-                auth = self._auth(method, path, query, body_hash=body_hash)
-                # aws-chunked payload: unwrap per-chunk framing.
-                if method in ("PUT", "POST") and auth.payload_hash in (
-                        sigv4.STREAMING_PAYLOAD,
-                        sigv4.STREAMING_PAYLOAD_TRAILER,
-                        sigv4.STREAMING_UNSIGNED_TRAILER):
-                    secret = server.credentials.secret_for(
-                        auth.credential.access_key)
-                    body = sigv4.decode_chunked_payload(body, auth, secret)
+                    if auth.payload_hash in (
+                            sigv4.STREAMING_PAYLOAD,
+                            sigv4.STREAMING_PAYLOAD_TRAILER,
+                            sigv4.STREAMING_UNSIGNED_TRAILER):
+                        secret = server.credentials.secret_for(
+                            auth.credential.access_key)
+                        body = sigv4.decode_chunked_payload(body, auth, secret)
+                    elif auth.payload_hash != sigv4.UNSIGNED_PAYLOAD \
+                            and not auth.presigned:
+                        if hashlib.sha256(body).hexdigest() != auth.payload_hash:
+                            raise S3Error("XAmzContentSHA256Mismatch")
 
                 if not bucket:
                     if method == "GET":
@@ -410,6 +420,8 @@ def _make_handler(server: S3Server):
                 info, payload = server.object_layer.get_object(
                     bucket, key, GetOptions(version_id=vid, range_spec=spec))
                 start, length = info.range_start, info.range_length
+            if spec and info.size == 0 and spec[0] is None:
+                spec = None  # suffix range on empty object: plain 200 (AWS)
             headers = {
                 "ETag": f'"{info.etag}"',
                 "Last-Modified": _rfc1123(info.mod_time),
@@ -503,5 +515,7 @@ def _validate_object_name(key: str) -> None:
     if not key or len(key.encode()) > 1024 or "\x00" in key:
         raise S3Error("InvalidObjectName", key=key)
     for seg in key.split("/"):
-        if seg in (".", ".."):
+        # Empty segments ("a//b", trailing "/") would alias to a different
+        # key after path normalization on disk — reject them.
+        if seg in ("", ".", ".."):
             raise S3Error("InvalidObjectName", key=key)

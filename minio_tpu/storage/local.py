@@ -170,6 +170,9 @@ class LocalStorage:
     def _atomic_write(self, dest: str, data: bytes) -> None:
         """tmp + fsync + rename: the crash-consistency primitive."""
         tmp = self._tmp_path()
+        # A hot-replaced drive may lack the staging tree; recreate it
+        # rather than failing heal/writes on the fresh drive.
+        os.makedirs(os.path.dirname(tmp), exist_ok=True)
         os.makedirs(os.path.dirname(dest), exist_ok=True)
         with open(tmp, "wb") as f:
             f.write(data)

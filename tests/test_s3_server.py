@@ -281,3 +281,58 @@ def test_bucket_recreate_resets_versioning(cli):
     _mk(cli, "vreset")
     _, _, body = cli.request("GET", "/vreset", query={"versioning": ""})
     assert b"Enabled" not in body
+
+
+def test_trailing_slash_and_empty_segment_rejected(cli):
+    _mk(cli, "slashes")
+    cli.request("PUT", "/slashes/x", body=b"1")
+    status, _, _ = cli.request("PUT", "/slashes/x/", body=b"2")
+    assert status == 400
+    status, _, _ = cli.request("PUT", "/slashes/a//b", body=b"2")
+    assert status == 400
+    _, _, body = cli.request("GET", "/slashes/x")
+    assert body == b"1"
+
+
+def test_suffix_range_empty_object(cli):
+    _mk(cli, "emptyrng")
+    cli.request("PUT", "/emptyrng/e", body=b"")
+    status, h, body = cli.request("GET", "/emptyrng/e",
+                                  headers={"Range": "bytes=-100"})
+    assert status == 200 and body == b"" and "Content-Range" not in h
+
+
+def test_delimiter_prefix_visible_past_marker(cli):
+    _mk(cli, "markerin")
+    for k in ("a/1", "a/2", "b"):
+        cli.request("PUT", f"/markerin/{k}", body=b"x")
+    _, _, body = cli.request("GET", "/markerin",
+                             query={"list-type": "2", "delimiter": "/",
+                                    "start-after": "a/1"})
+    root = ET.fromstring(body)
+    prefixes = [e.findtext(f"{NS}Prefix") for e in root.iter(f"{NS}CommonPrefixes")]
+    keys = [e.text for e in root.iter(f"{NS}Key")]
+    assert prefixes == ["a/"] and keys == ["b"]
+
+
+def test_listing_does_not_resurrect_deleted(cli, srv):
+    _mk(cli, "resur")
+    cli.request("PUT", "/resur/gone", body=b"x")
+    # Simulate a drive missing the delete: delete only via quorum subset.
+    ol = srv.object_layer
+    real = ol.disks[0]
+
+    class DeleteFails:
+        def __getattr__(self, name):
+            if name == "delete_version":
+                def boom(*a, **k):
+                    raise OSError("drive hiccup")
+                return boom
+            return getattr(real, name)
+    ol.disks[0] = DeleteFails()
+    status, _, _ = cli.request("DELETE", "/resur/gone")
+    assert status == 204
+    ol.disks[0] = real
+    _, _, body = cli.request("GET", "/resur", query={"list-type": "2"})
+    keys = [e.text for e in ET.fromstring(body).iter(f"{NS}Key")]
+    assert "gone" not in keys
