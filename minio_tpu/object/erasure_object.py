@@ -88,13 +88,16 @@ class ErasureSet:
         self.backend = backend
         self.pool = pool or ThreadPoolExecutor(max_workers=max(8, 2 * n))
         self._mrf = None
+        self._mrf_lock = __import__("threading").Lock()
 
     @property
     def mrf(self):
         """Lazy MRF heal queue (background worker starts on first use)."""
         if self._mrf is None:
-            from minio_tpu.object.healing import MRFQueue
-            self._mrf = MRFQueue(self)
+            with self._mrf_lock:
+                if self._mrf is None:
+                    from minio_tpu.object.healing import MRFQueue
+                    self._mrf = MRFQueue(self)
         return self._mrf
 
     # -- healing entry points ------------------------------------------
@@ -108,6 +111,37 @@ class ErasureSet:
     def heal_bucket(self, bucket: str):
         from minio_tpu.object import healing
         return healing.heal_bucket(self, bucket)
+
+    # -- multipart (object/multipart.py) -------------------------------
+
+    def new_multipart_upload(self, bucket, object_, opts=None):
+        from minio_tpu.object import multipart
+        return multipart.new_multipart_upload(self, bucket, object_, opts)
+
+    def put_object_part(self, bucket, object_, upload_id, part_number, data):
+        from minio_tpu.object import multipart
+        return multipart.put_object_part(self, bucket, object_, upload_id,
+                                         part_number, data)
+
+    def complete_multipart_upload(self, bucket, object_, upload_id, parts):
+        from minio_tpu.object import multipart
+        return multipart.complete_multipart_upload(self, bucket, object_,
+                                                   upload_id, parts)
+
+    def abort_multipart_upload(self, bucket, object_, upload_id):
+        from minio_tpu.object import multipart
+        return multipart.abort_multipart_upload(self, bucket, object_,
+                                                upload_id)
+
+    def list_parts(self, bucket, object_, upload_id, part_marker=0,
+                   max_parts=1000):
+        from minio_tpu.object import multipart
+        return multipart.list_parts(self, bucket, object_, upload_id,
+                                    part_marker, max_parts)
+
+    def list_multipart_uploads(self, bucket, prefix=""):
+        from minio_tpu.object import multipart
+        return multipart.list_multipart_uploads(self, bucket, prefix)
 
     # ------------------------------------------------------------------
     # fan-out helper
@@ -496,18 +530,44 @@ class ErasureSet:
 
     def _read_payload(self, bucket: str, object_: str, fi: FileInfo,
                       fis: list, offset: int, length: int) -> bytes:
-        """Gather only the erasure blocks covering [offset, offset+length):
-        verified shard-block slices (k preferred, hedge to all), batched
-        reconstruct of missing shards, block-major reassembly. I/O, hashing
-        and memory are O(range), not O(object) — the reference's
-        ShardFileOffset range math (cmd/erasure-coding.go:135)."""
+        """Read [offset, offset+length) across the object's parts.
+
+        Each part is an independent erasure encode stored as part.N shard
+        files (reference: multipart parts keep their own erasure framing,
+        cmd/erasure-object.go per-part loop at :368-387); single-put
+        objects are the one-part special case."""
+        parts = fi.parts or [ObjectPartInfo(number=1, size=fi.size,
+                                            actual_size=fi.size)]
+        out = bytearray()
+        cum = 0
+        for p in parts:
+            p_lo = max(offset, cum)
+            p_hi = min(offset + length, cum + p.size)
+            if p_hi > p_lo:
+                out += self._read_part_window(
+                    bucket, object_, fi, fis, p.number, p.size,
+                    p_lo - cum, p_hi - p_lo)
+            cum += p.size
+            if cum >= offset + length:
+                break
+        return bytes(out)
+
+    def _read_part_window(self, bucket: str, object_: str, fi: FileInfo,
+                          fis: list, part_number: int, part_size: int,
+                          offset: int, length: int) -> bytes:
+        """Gather only the erasure blocks covering the window inside one
+        part: verified shard-block slices (k preferred, hedge to all),
+        batched reconstruct of missing shards, block-major reassembly.
+        I/O, hashing and memory are O(range), not O(object) — the
+        reference's ShardFileOffset range math (cmd/erasure-coding.go:135)."""
         k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
         n = k + m
         e = self._erasure(k, m)
         shard_size = e.shard_size()
-        shard_file_len = e.shard_file_size(fi.size)
+        shard_file_len = e.shard_file_size(part_size)
         hsize = bitrot.digest_size(bitrot.DEFAULT_ALGORITHM)
         frame = hsize + shard_size
+        part_file = f"part.{part_number}"
 
         start_b = offset // BLOCK_SIZE
         end_b = (offset + length - 1) // BLOCK_SIZE
@@ -545,7 +605,7 @@ class ErasureSet:
                     blob = blob[framed_lo:framed_hi]
                 else:
                     blob = d.read_file(
-                        bucket, f"{object_}/{fi.data_dir}/part.1",
+                        bucket, f"{object_}/{fi.data_dir}/{part_file}",
                         offset=framed_lo, length=framed_hi - framed_lo)
                 reader = bitrot.FramedShardReader(blob, shard_size, win_len)
                 blocks = [reader.block(b)
@@ -583,7 +643,7 @@ class ErasureSet:
             lo = (b - start_b) * shard_size
             hi = min((b - start_b + 1) * shard_size, win_len)
             chunk = b"".join(shards[s][lo:hi].tobytes() for s in range(k))
-            take = min(BLOCK_SIZE, fi.size - b * BLOCK_SIZE)
+            take = min(BLOCK_SIZE, part_size - b * BLOCK_SIZE)
             out += chunk[:take]
         # `out` holds object bytes [start_b*BLOCK_SIZE, ...); cut the range.
         skip = offset - start_b * BLOCK_SIZE
@@ -711,8 +771,9 @@ class ErasureSet:
                 fi, _, _ = self._get_object_fileinfo(bucket, path)
             except Exception:  # noqa: BLE001 - dangling / below quorum
                 return None
-            xl = parsed[0][0] if parsed else None
-            return (xl, fi)
+            # Walked copies disagreed — none of their journals can be
+            # trusted for a versions expansion, only the quorum fi.
+            return (None, fi)
 
         info = ListObjectsInfo()
         seen_prefixes: set[str] = set()

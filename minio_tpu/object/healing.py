@@ -57,6 +57,26 @@ def heal_object(es, bucket: str, object_: str, version_id: str = "",
     fis, errors = es._read_version_all(bucket, object_, version_id,
                                        read_data=True)
     n = len(es.disks)
+    not_found = sum(isinstance(e, (FileNotFoundErr, VersionNotFoundErr))
+                    for e in errors)
+    if not_found > n // 2:
+        # Quorum verdict: this version does not exist. Purge stale copies
+        # from any drive still holding it (a drive that missed a delete
+        # must not keep resurrectable state — the reference's dangling
+        # object GC, cmd/erasure-object.go:484 deleteIfDangling).
+        stale = [i for i in range(n) if fis[i] is not None]
+        if stale:
+            es._fanout([
+                (lambda i=i: _purge_version(es.disks[i], bucket, object_,
+                                            fis[i].version_id))
+                if i in stale else None for i in range(n)])
+        result = HealResult(bucket=bucket, object=object_,
+                            version_id=version_id)
+        result.before = [DRIVE_STATE_OUTDATED if i in stale
+                         else DRIVE_STATE_MISSING for i in range(n)]
+        result.after = [DRIVE_STATE_MISSING] * n
+        result.healed = len(stale)
+        return result
     any_fi = next((f for f in fis if f is not None), None)
     if any_fi is None:
         raise ObjectNotFound(bucket, object_)
@@ -69,30 +89,40 @@ def heal_object(es, bucket: str, object_: str, version_id: str = "",
         # Delete markers heal by metadata replication only.
         return _heal_metadata_only(es, bucket, object_, fi, fis, errors)
 
+    from minio_tpu.storage.meta import ObjectPartInfo
     k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
     e = es._erasure(k, m)
     shard_size = e.shard_size()
-    shard_file_len = e.shard_file_size(fi.size)
     inline = fi.inline_data is not None
     dist = fi.erasure.distribution
+    parts = fi.parts or [ObjectPartInfo(number=1, size=fi.size,
+                                        actual_size=fi.size)]
 
-    # Classify drives + load verified shards where possible.
+    # Classify drives + load verified shards PER PART (multipart objects
+    # store one independently-encoded shard file per part).
     states: list[str] = [DRIVE_STATE_OFFLINE] * n
-    shards: list[Optional[np.ndarray]] = [None] * (k + m)
-    nblocks = ceil_frac(shard_file_len, shard_size) if shard_file_len else 0
+    # part_shards[part_idx][shard_idx] -> bytes or None
+    part_shards: list[list[Optional[np.ndarray]]] = \
+        [[None] * (k + m) for _ in parts]
 
-    def load_shard(disk_idx: int) -> Optional[np.ndarray]:
+    def load_all_parts(disk_idx: int) -> Optional[list[np.ndarray]]:
         d = es.disks[disk_idx]
         dfi = fis[disk_idx]
-        shard_idx = dist[disk_idx] - 1
+        out = []
         try:
-            if inline:
-                blob = dfi.inline_data or b""
-            else:
-                blob = d.read_file(bucket, f"{object_}/{fi.data_dir}/part.1")
-            reader = bitrot.FramedShardReader(blob, shard_size, shard_file_len)
-            parts = [reader.block(b) for b in range(nblocks)]
-            return np.concatenate(parts) if parts else np.zeros(0, np.uint8)
+            for p in parts:
+                plen = e.shard_file_size(p.size)
+                nblocks = ceil_frac(plen, shard_size) if plen else 0
+                if inline:
+                    blob = dfi.inline_data or b""
+                else:
+                    blob = d.read_file(
+                        bucket, f"{object_}/{fi.data_dir}/part.{p.number}")
+                reader = bitrot.FramedShardReader(blob, shard_size, plen)
+                chunks = [reader.block(b) for b in range(nblocks)]
+                out.append(np.concatenate(chunks) if chunks
+                           else np.zeros(0, np.uint8))
+            return out
         except Exception:  # noqa: BLE001 - treat as corrupt
             return None
 
@@ -110,14 +140,16 @@ def heal_object(es, bucket: str, object_: str, version_id: str = "",
             continue
         if fi.size == 0:
             states[i] = DRIVE_STATE_OK
-            shards[dist[i] - 1] = np.zeros(0, np.uint8)
+            for ps in part_shards:
+                ps[dist[i] - 1] = np.zeros(0, np.uint8)
             continue
-        loaded = load_shard(i)
+        loaded = load_all_parts(i)
         if loaded is None:
             states[i] = DRIVE_STATE_CORRUPT
         else:
             states[i] = DRIVE_STATE_OK
-            shards[dist[i] - 1] = loaded
+            for pi, arr in enumerate(loaded):
+                part_shards[pi][dist[i] - 1] = arr
 
     result = HealResult(bucket=bucket, object=object_,
                         version_id=fi.version_id, before=list(states),
@@ -129,11 +161,12 @@ def heal_object(es, bucket: str, object_: str, version_id: str = "",
         return result
 
     if fi.size > 0:
-        if sum(1 for s in shards if s is not None) < k:
-            raise ReadQuorumError(bucket, object_,
-                                  "not enough shards to heal")
-        # Rebuild ALL shards (data + parity), batched through the backend.
-        e.decode_data_and_parity_blocks(shards)
+        for ps in part_shards:
+            if sum(1 for s in ps if s is not None) < k:
+                raise ReadQuorumError(bucket, object_,
+                                      "not enough shards to heal")
+            # Rebuild ALL shards (data + parity) of this part.
+            e.decode_data_and_parity_blocks(ps)
 
     # Write rebuilt shards to the bad drives via the staged commit path.
     def heal_one(disk_idx: int):
@@ -147,14 +180,18 @@ def heal_object(es, bucket: str, object_: str, version_id: str = "",
             hfi.inline_data = b"" if inline else None
             d.write_metadata(bucket, object_, hfi)
             return
-        framed = bitrot.frame_shard(shards[shard_idx], shard_size)
         if inline:
-            hfi.inline_data = framed
+            hfi.inline_data = bitrot.frame_shard(
+                part_shards[0][shard_idx], shard_size)
             d.write_metadata(bucket, object_, hfi)
         else:
             staging = f"{eo.STAGING_PREFIX}/{eo.new_uuid()}"
-            d.create_file(eo.SYS_VOL, f"{staging}/{fi.data_dir}/part.1",
-                          framed)
+            for pi, p in enumerate(parts):
+                framed = bitrot.frame_shard(part_shards[pi][shard_idx],
+                                            shard_size)
+                d.create_file(eo.SYS_VOL,
+                              f"{staging}/{fi.data_dir}/part.{p.number}",
+                              framed)
             d.rename_data(eo.SYS_VOL, staging, hfi, bucket, object_)
 
     _, herrs = es._fanout([
@@ -167,6 +204,13 @@ def heal_object(es, bucket: str, object_: str, version_id: str = "",
             result.healed += 1
     result.after = after
     return result
+
+
+def _purge_version(disk, bucket: str, object_: str, version_id: str) -> None:
+    try:
+        disk.delete_version(bucket, object_, version_id)
+    except Exception:  # noqa: BLE001 - best effort purge
+        pass
 
 
 def _heal_metadata_only(es, bucket, object_, fi: FileInfo, fis, errors):

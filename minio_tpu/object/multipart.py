@@ -1,0 +1,304 @@
+"""Multipart uploads over one erasure set.
+
+The analogue of the reference's erasure multipart lifecycle
+(cmd/erasure-multipart.go:521 NewMultipartUpload, :570 PutObjectPart,
+:1093 CompleteMultipartUpload): uploads live under a system volume
+staging area until complete, each part is an INDEPENDENT erasure encode
+(so parts stream/retry/parallelise freely and the final object's read
+path walks parts), and completion validates the client's part list
+against stored part metadata before atomically assembling the final
+object through the same rename-commit used by plain puts.
+
+Part encoding is the same batched device pass as put_object — a 16x5MiB
+concurrent multipart upload turns into 16 independent stripe-batch
+encodes (BASELINE.json configs[4])."""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Optional
+
+from minio_tpu.object.types import (InvalidArgument, ObjectInfo, PutOptions,
+                                    WriteQuorumError)
+from minio_tpu.storage import bitrot
+from minio_tpu.storage.meta import (ErasureInfo, FileInfo, FileNotFoundErr,
+                                    ObjectPartInfo, new_uuid, now_ns)
+
+MIN_PART_SIZE = 5 * (1 << 20)   # all but the last part (AWS rule)
+MAX_PARTS = 10_000
+
+
+class UploadNotFound(Exception):
+    pass
+
+
+class InvalidPart(Exception):
+    pass
+
+
+class InvalidPartOrder(Exception):
+    pass
+
+
+class EntityTooSmall(Exception):
+    pass
+
+
+def _upload_root(bucket: str, object_: str) -> str:
+    digest = hashlib.sha256(f"{bucket}/{object_}".encode()).hexdigest()[:32]
+    return f"multipart/{bucket}/{digest}"
+
+
+def _upload_dir(bucket: str, object_: str, upload_id: str) -> str:
+    return f"{_upload_root(bucket, object_)}/{upload_id}"
+
+
+def new_multipart_upload(es, bucket: str, object_: str,
+                         opts: Optional[PutOptions] = None) -> str:
+    from minio_tpu.object import erasure_object as eo
+    opts = opts or PutOptions()
+    es._check_bucket(bucket)
+    n = len(es.disks)
+    m = es.default_parity
+    if opts.storage_class == "REDUCED_REDUNDANCY" and n > 1:
+        m = max(1, min(m, 2))
+    k = n - m
+    upload_id = new_uuid()
+    record = {
+        "bucket": bucket, "object": object_, "upload_id": upload_id,
+        "k": k, "m": m,
+        "distribution": eo.hash_order(f"{bucket}/{object_}", n),
+        "user_metadata": dict(opts.user_metadata),
+        "content_type": opts.content_type,
+        "versioned": bool(opts.versioned),
+        "initiated": now_ns(),
+    }
+    blob = json.dumps(record).encode()
+    path = f"{_upload_dir(bucket, object_, upload_id)}/upload.json"
+    _, errors = es._fanout(
+        [lambda d=d: d.write_all(eo.SYS_VOL, path, blob) for d in es.disks])
+    if sum(e is None for e in errors) < n // 2 + 1:
+        raise WriteQuorumError(bucket, object_)
+    return upload_id
+
+
+def _read_upload(es, bucket: str, object_: str, upload_id: str) -> dict:
+    from minio_tpu.object import erasure_object as eo
+    path = f"{_upload_dir(bucket, object_, upload_id)}/upload.json"
+    results, _ = es._fanout(
+        [lambda d=d: d.read_all(eo.SYS_VOL, path) for d in es.disks])
+    for r in results:
+        if r is not None:
+            try:
+                return json.loads(r)
+            except ValueError:
+                continue
+    raise UploadNotFound(upload_id)
+
+
+def put_object_part(es, bucket: str, object_: str, upload_id: str,
+                    part_number: int, data: bytes) -> ObjectPartInfo:
+    from minio_tpu.object import erasure_object as eo
+    if not (1 <= part_number <= MAX_PARTS):
+        raise InvalidArgument(bucket, object_, "part number out of range")
+    rec = _read_upload(es, bucket, object_, upload_id)
+    k, m, dist = rec["k"], rec["m"], rec["distribution"]
+    n = k + m
+    e = es._erasure(k, m)
+    shards = es._encode_object(data, k, m)
+    framed = bitrot.frame_shards_batch(shards, e.shard_size()) \
+        if shards.shape[1] else [b""] * n
+    etag = hashlib.md5(data).hexdigest()
+    meta = {"number": part_number, "size": len(data),
+            "actual_size": len(data), "etag": etag, "mod_time": now_ns()}
+    updir = _upload_dir(bucket, object_, upload_id)
+
+    def write_one(disk_idx: int):
+        d = es.disks[disk_idx]
+        shard_idx = dist[disk_idx] - 1
+        d.create_file(eo.SYS_VOL, f"{updir}/part.{part_number}",
+                      framed[shard_idx])
+        d.write_all(eo.SYS_VOL, f"{updir}/part.{part_number}.meta",
+                    json.dumps(meta).encode())
+
+    _, errors = es._fanout(
+        [lambda i=i: write_one(i) for i in range(n)])
+    write_quorum = k + (1 if k == m else 0)
+    if sum(e2 is None for e2 in errors) < write_quorum:
+        raise WriteQuorumError(bucket, object_)
+    return ObjectPartInfo(number=part_number, size=len(data),
+                          actual_size=len(data), etag=etag,
+                          mod_time=meta["mod_time"])
+
+
+def _read_part_meta(es, updir: str, part_number: int) -> Optional[dict]:
+    from minio_tpu.object import erasure_object as eo
+    results, _ = es._fanout(
+        [lambda d=d: d.read_all(eo.SYS_VOL, f"{updir}/part.{part_number}.meta")
+         for d in es.disks])
+    votes: dict[bytes, int] = {}
+    for r in results:
+        if r is not None:
+            votes[r] = votes.get(r, 0) + 1
+    if not votes:
+        return None
+    try:
+        return json.loads(max(votes, key=lambda b: votes[b]))
+    except ValueError:
+        return None
+
+
+def list_parts(es, bucket: str, object_: str, upload_id: str,
+               part_marker: int = 0, max_parts: int = 1000) -> list[dict]:
+    from minio_tpu.object import erasure_object as eo
+    _read_upload(es, bucket, object_, upload_id)  # existence check
+    updir = _upload_dir(bucket, object_, upload_id)
+    found: dict[int, dict] = {}
+    results, _ = es._fanout(
+        [lambda d=d: d.list_dir(eo.SYS_VOL, updir) for d in es.disks])
+    numbers = set()
+    for entries in results:
+        for name in entries or ():
+            if name.startswith("part.") and name.endswith(".meta"):
+                try:
+                    numbers.add(int(name[len("part."):-len(".meta")]))
+                except ValueError:
+                    pass
+    for num in sorted(numbers):
+        if num <= part_marker:
+            continue
+        meta = _read_part_meta(es, updir, num)
+        if meta:
+            found[num] = meta
+        if len(found) >= max_parts:
+            break
+    return [found[n2] for n2 in sorted(found)]
+
+
+def list_multipart_uploads(es, bucket: str, prefix: str = "") -> list[dict]:
+    from minio_tpu.object import erasure_object as eo
+    es._check_bucket(bucket)
+    out = []
+    seen = set()
+    for d in es.disks[:len(es.disks) // 2 + 1]:
+        try:
+            hashes = d.list_dir(eo.SYS_VOL, f"multipart/{bucket}")
+        except Exception:  # noqa: BLE001
+            continue
+        for hdir in hashes:
+            hdir = hdir.rstrip("/")
+            try:
+                uploads = d.list_dir(eo.SYS_VOL, f"multipart/{bucket}/{hdir}")
+            except Exception:  # noqa: BLE001
+                continue
+            for uid in uploads:
+                uid = uid.rstrip("/")
+                if uid in seen:
+                    continue
+                try:
+                    rec = json.loads(d.read_all(
+                        eo.SYS_VOL,
+                        f"multipart/{bucket}/{hdir}/{uid}/upload.json"))
+                except Exception:  # noqa: BLE001
+                    continue
+                if rec.get("object", "").startswith(prefix):
+                    seen.add(uid)
+                    out.append(rec)
+    out.sort(key=lambda r: (r.get("object", ""), r.get("initiated", 0)))
+    return out
+
+
+def abort_multipart_upload(es, bucket: str, object_: str,
+                           upload_id: str) -> None:
+    from minio_tpu.object import erasure_object as eo
+    _read_upload(es, bucket, object_, upload_id)
+    updir = _upload_dir(bucket, object_, upload_id)
+    es._fanout([lambda d=d: _try(lambda: d.delete(eo.SYS_VOL, updir,
+                                                  recursive=True))
+                for d in es.disks])
+
+
+def complete_multipart_upload(es, bucket: str, object_: str, upload_id: str,
+                              parts: list[tuple[int, str]]) -> ObjectInfo:
+    """parts: [(part_number, etag), ...] in the client's declared order."""
+    from minio_tpu.object import erasure_object as eo
+    rec = _read_upload(es, bucket, object_, upload_id)
+    k, m, dist = rec["k"], rec["m"], rec["distribution"]
+    n = k + m
+    updir = _upload_dir(bucket, object_, upload_id)
+    if not parts:
+        raise InvalidPart("empty part list")
+    if any(parts[i][0] >= parts[i + 1][0] for i in range(len(parts) - 1)):
+        raise InvalidPartOrder()
+
+    fi_parts: list[ObjectPartInfo] = []
+    md5_concat = b""
+    total = 0
+    for idx, (num, etag) in enumerate(parts):
+        meta = _read_part_meta(es, updir, num)
+        clean = etag.strip('"')
+        if meta is None or meta["etag"] != clean:
+            raise InvalidPart(f"part {num}")
+        if meta["size"] < MIN_PART_SIZE and idx != len(parts) - 1:
+            raise EntityTooSmall(f"part {num}")
+        fi_parts.append(ObjectPartInfo(
+            number=num, size=meta["size"], actual_size=meta["actual_size"],
+            etag=clean, mod_time=meta["mod_time"]))
+        md5_concat += bytes.fromhex(clean)
+        total += meta["size"]
+
+    etag = hashlib.md5(md5_concat).hexdigest() + f"-{len(parts)}"
+    version_id = new_uuid() if rec.get("versioned") else ""
+    mod_time = now_ns()
+    data_dir = new_uuid()
+    metadata = dict(rec.get("user_metadata") or {})
+    metadata["etag"] = etag
+    if rec.get("content_type"):
+        metadata["content-type"] = rec["content_type"]
+
+    def commit_one(disk_idx: int):
+        d = es.disks[disk_idx]
+        shard_idx = dist[disk_idx] - 1
+        staging = f"{eo.STAGING_PREFIX}/{new_uuid()}"
+        for num, _ in parts:
+            d.rename_file(eo.SYS_VOL, f"{updir}/part.{num}",
+                          eo.SYS_VOL, f"{staging}/{data_dir}/part.{num}")
+        fi = FileInfo(
+            volume=bucket, name=object_, version_id=version_id,
+            deleted=False, data_dir=data_dir, mod_time=mod_time,
+            size=total, metadata=metadata, parts=list(fi_parts),
+            erasure=ErasureInfo(
+                data_blocks=k, parity_blocks=m,
+                block_size=eo.BLOCK_SIZE, index=shard_idx + 1,
+                distribution=tuple(dist)))
+        d.rename_data(eo.SYS_VOL, staging, fi, bucket, object_)
+
+    _, errors = es._fanout(
+        [lambda i=i: commit_one(i) for i in range(n)])
+    ok = sum(e2 is None for e2 in errors)
+    write_quorum = k + (1 if k == m else 0)
+    if ok < write_quorum:
+        raise WriteQuorumError(bucket, object_,
+                               f"committed {ok}/{n}")
+    if ok < n:
+        es.mrf.enqueue(bucket, object_, version_id)
+    # Drop the upload dir (part files already moved on the disks that
+    # committed; stale copies elsewhere go with the dir).
+    es._fanout([lambda d=d: _try(lambda: d.delete(eo.SYS_VOL, updir,
+                                                  recursive=True))
+                for d in es.disks])
+    return ObjectInfo(bucket=bucket, name=object_, mod_time=mod_time,
+                      size=total, etag=etag,
+                      content_type=rec.get("content_type", ""),
+                      version_id=version_id,
+                      user_metadata=dict(rec.get("user_metadata") or {}),
+                      parts=fi_parts, actual_size=total)
+
+
+def _try(fn):
+    try:
+        fn()
+    except Exception:  # noqa: BLE001
+        pass

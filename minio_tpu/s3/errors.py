@@ -34,6 +34,7 @@ _CATALOG = {
     "NoSuchUpload": (404, "The specified multipart upload does not exist."),
     "InvalidPart": (400, "One or more of the specified parts could not be found."),
     "InvalidPartOrder": (400, "The list of parts was not in ascending order."),
+    "EntityTooSmall": (400, "Your proposed upload is smaller than the minimum allowed object size."),
     "PreconditionFailed": (412, "At least one of the pre-conditions you specified did not hold."),
     "NotModified": (304, "Not Modified"),
     "NoSuchBucketPolicy": (404, "The bucket policy does not exist."),
@@ -66,6 +67,13 @@ def from_exception(e: Exception) -> S3Error:
     if isinstance(e, SigError):
         return S3Error(e.code if e.code in _CATALOG else "AccessDenied",
                        str(e))
+    from minio_tpu.object import multipart as mp
+    mp_map = {mp.UploadNotFound: "NoSuchUpload", mp.InvalidPart: "InvalidPart",
+              mp.InvalidPartOrder: "InvalidPartOrder",
+              mp.EntityTooSmall: "EntityTooSmall"}
+    for cls, code in mp_map.items():
+        if isinstance(e, cls):
+            return S3Error(code, str(e))
     b = getattr(e, "bucket", "")
     k = getattr(e, "object", "")
     mapping = {

@@ -155,6 +155,10 @@ def _make_handler(server: S3Server):
                 self.wfile.write(body)
 
         def _send_error(self, e: Exception, bucket="", key=""):
+            # The request body may be partially or fully unread (auth runs
+            # before body consumption): close the connection rather than
+            # letting keep-alive parse leftover body bytes as a request.
+            self.close_connection = True
             err = from_exception(e)
             root = ET.Element("Error")
             _el(root, "Code", err.code)
@@ -185,8 +189,7 @@ def _make_handler(server: S3Server):
                         secret = server.credentials.secret_for(
                             auth.credential.access_key)
                         body = sigv4.decode_chunked_payload(body, auth, secret)
-                    elif auth.payload_hash != sigv4.UNSIGNED_PAYLOAD \
-                            and not auth.presigned:
+                    elif auth.payload_hash != sigv4.UNSIGNED_PAYLOAD:
                         if hashlib.sha256(body).hexdigest() != auth.payload_hash:
                             raise S3Error("XAmzContentSHA256Mismatch")
 
@@ -246,6 +249,8 @@ def _make_handler(server: S3Server):
                 return self._send(204)
             if method == "POST" and "delete" in query:
                 return self._delete_objects(bucket, body)
+            if method == "GET" and "uploads" in query:
+                return self._list_uploads(bucket, query)
             if method == "GET":
                 if "location" in query:
                     root = ET.Element("LocationConstraint", xmlns=XMLNS)
@@ -340,6 +345,22 @@ def _make_handler(server: S3Server):
                 _el(cp, "Prefix", p)
             self._send(200, _xml(root))
 
+        def _list_uploads(self, bucket, query):
+            prefix = query.get("prefix", [""])[0]
+            uploads = server.object_layer.list_multipart_uploads(bucket,
+                                                                 prefix)
+            root = ET.Element("ListMultipartUploadsResult", xmlns=XMLNS)
+            _el(root, "Bucket", bucket)
+            _el(root, "Prefix", prefix)
+            _el(root, "IsTruncated", "false")
+            for rec in uploads:
+                ue = _el(root, "Upload")
+                _el(ue, "Key", rec.get("object", ""))
+                _el(ue, "UploadId", rec.get("upload_id", ""))
+                _el(ue, "Initiated", _iso8601(rec.get("initiated", 0)))
+                _el(ue, "StorageClass", "STANDARD")
+            self._send(200, _xml(root))
+
         def _delete_objects(self, bucket, body):
             try:
                 tree = ET.fromstring(body)
@@ -379,6 +400,19 @@ def _make_handler(server: S3Server):
 
         def _object_op(self, method, bucket, key, query, body):
             _validate_object_name(key)
+            if method == "POST" and "uploads" in query:
+                return self._initiate_multipart(bucket, key)
+            if method == "POST" and "uploadId" in query:
+                return self._complete_multipart(bucket, key, query, body)
+            if method == "PUT" and "partNumber" in query:
+                return self._put_part(bucket, key, query, body,
+                                      self._headers_lower())
+            if method == "DELETE" and "uploadId" in query:
+                server.object_layer.abort_multipart_upload(
+                    bucket, key, query["uploadId"][0])
+                return self._send(204)
+            if method == "GET" and "uploadId" in query:
+                return self._list_parts(bucket, key, query)
             if method == "PUT":
                 return self._put_object(bucket, key, query, body)
             if method in ("GET", "HEAD"):
@@ -387,10 +421,141 @@ def _make_handler(server: S3Server):
                 return self._delete_object(bucket, key, query)
             raise S3Error("MethodNotAllowed")
 
+        # -- multipart --------------------------------------------------
+
+        def _initiate_multipart(self, bucket, key):
+            h = self._headers_lower()
+            meta = {k[len("x-amz-meta-"):]: v for k, v in h.items()
+                    if k.startswith("x-amz-meta-")}
+            opts = PutOptions(
+                versioned=_versioned(server.object_layer, bucket),
+                user_metadata=meta,
+                content_type=h.get("content-type", ""),
+                storage_class=h.get("x-amz-storage-class", "STANDARD"))
+            uid = server.object_layer.new_multipart_upload(bucket, key, opts)
+            root = ET.Element("InitiateMultipartUploadResult", xmlns=XMLNS)
+            _el(root, "Bucket", bucket)
+            _el(root, "Key", key)
+            _el(root, "UploadId", uid)
+            self._send(200, _xml(root))
+
+        def _put_part(self, bucket, key, query, body, h):
+            try:
+                part_num = int(query["partNumber"][0])
+            except (ValueError, KeyError):
+                raise S3Error("InvalidArgument") from None
+            uid = query.get("uploadId", [""])[0]
+            if "x-amz-copy-source" in h:
+                # UploadPartCopy: source bytes become the part payload.
+                src = urllib.parse.unquote(h["x-amz-copy-source"]).lstrip("/")
+                src_vid = ""
+                if "?versionId=" in src:
+                    src, _, src_vid = src.partition("?versionId=")
+                if "/" not in src:
+                    raise S3Error("InvalidArgument", "bad copy source")
+                sbucket, skey = src.split("/", 1)
+                spec = _range_spec(h.get("x-amz-copy-source-range", "")
+                                   .replace("bytes=", "bytes=")
+                                   ) if h.get("x-amz-copy-source-range") else None
+                _, body = server.object_layer.get_object(
+                    sbucket, skey, GetOptions(version_id=src_vid,
+                                              range_spec=spec))
+                part = server.object_layer.put_object_part(
+                    bucket, key, uid, part_num, body)
+                root = ET.Element("CopyPartResult", xmlns=XMLNS)
+                _el(root, "ETag", f'"{part.etag}"')
+                _el(root, "LastModified", _iso8601(part.mod_time))
+                return self._send(200, _xml(root))
+            part = server.object_layer.put_object_part(
+                bucket, key, uid, part_num, body)
+            self._send(200, headers={"ETag": f'"{part.etag}"'})
+
+        def _complete_multipart(self, bucket, key, query, body):
+            uid = query["uploadId"][0]
+            try:
+                tree = ET.fromstring(body)
+            except ET.ParseError:
+                raise S3Error("MalformedXML") from None
+            ns = f"{{{XMLNS}}}"
+            parts = []
+            for pe in tree.findall(f"{ns}Part") or tree.findall("Part"):
+                num = pe.findtext(f"{ns}PartNumber") or pe.findtext("PartNumber")
+                etag = pe.findtext(f"{ns}ETag") or pe.findtext("ETag") or ""
+                try:
+                    parts.append((int(num), etag))
+                except (TypeError, ValueError):
+                    raise S3Error("MalformedXML") from None
+            info = server.object_layer.complete_multipart_upload(
+                bucket, key, uid, parts)
+            root = ET.Element("CompleteMultipartUploadResult", xmlns=XMLNS)
+            _el(root, "Location", f"/{bucket}/{key}")
+            _el(root, "Bucket", bucket)
+            _el(root, "Key", key)
+            _el(root, "ETag", f'"{info.etag}"')
+            headers = {}
+            if info.version_id:
+                headers["x-amz-version-id"] = info.version_id
+            self._send(200, _xml(root), headers=headers)
+
+        def _list_parts(self, bucket, key, query):
+            uid = query["uploadId"][0]
+            try:
+                marker = int(query.get("part-number-marker", ["0"])[0] or 0)
+                max_parts = int(query.get("max-parts", ["1000"])[0] or 1000)
+            except ValueError:
+                raise S3Error("InvalidArgument") from None
+            parts = server.object_layer.list_parts(bucket, key, uid,
+                                                   marker, max_parts)
+            root = ET.Element("ListPartsResult", xmlns=XMLNS)
+            _el(root, "Bucket", bucket)
+            _el(root, "Key", key)
+            _el(root, "UploadId", uid)
+            _el(root, "PartNumberMarker", marker)
+            _el(root, "MaxParts", max_parts)
+            _el(root, "IsTruncated", "false")
+            for p in parts:
+                pe = _el(root, "Part")
+                _el(pe, "PartNumber", p["number"])
+                _el(pe, "ETag", f'"{p["etag"]}"')
+                _el(pe, "Size", p["size"])
+                _el(pe, "LastModified", _iso8601(p["mod_time"]))
+            self._send(200, _xml(root))
+
+        def _copy_object(self, bucket, key, h):
+            src = urllib.parse.unquote(h["x-amz-copy-source"])
+            src_vid = ""
+            if "?versionId=" in src:
+                src, _, src_vid = src.partition("?versionId=")
+            src = src.lstrip("/")
+            if "/" not in src:
+                raise S3Error("InvalidArgument", "bad copy source")
+            sbucket, skey = src.split("/", 1)
+            sinfo, payload = server.object_layer.get_object(
+                sbucket, skey, GetOptions(version_id=src_vid))
+            directive = h.get("x-amz-metadata-directive", "COPY").upper()
+            if directive == "REPLACE":
+                meta = {k2[len("x-amz-meta-"):]: v for k2, v in h.items()
+                        if k2.startswith("x-amz-meta-")}
+                ctype = h.get("content-type", sinfo.content_type)
+            else:
+                meta = dict(sinfo.user_metadata)
+                ctype = sinfo.content_type
+            info = server.object_layer.put_object(
+                bucket, key, payload, PutOptions(
+                    versioned=_versioned(server.object_layer, bucket),
+                    user_metadata=meta, content_type=ctype))
+            root = ET.Element("CopyObjectResult", xmlns=XMLNS)
+            _el(root, "ETag", f'"{info.etag}"')
+            _el(root, "LastModified", _iso8601(info.mod_time))
+            headers = {}
+            if info.version_id:
+                headers["x-amz-version-id"] = info.version_id
+            self._send(200, _xml(root), headers=headers)
+
         def _put_object(self, bucket, key, query, body):
             h = self._headers_lower()
             if "x-amz-copy-source" in h:
-                raise S3Error("NotImplemented")  # CopyObject: next slice
+                return self._copy_object(bucket, key, h)
             meta = {k[len("x-amz-meta-"):]: v for k, v in h.items()
                     if k.startswith("x-amz-meta-")}
             opts = PutOptions(

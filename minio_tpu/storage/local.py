@@ -398,6 +398,17 @@ class LocalStorage:
         # Clean the now-empty staging dir.
         shutil.rmtree(self._obj_dir(src_volume, src_path), ignore_errors=True)
 
+    def rename_file(self, src_volume: str, src_path: str,
+                    dst_volume: str, dst_path: str) -> None:
+        """Atomic same-drive move (multipart assembly, commit plumbing)."""
+        src = self._obj_dir(src_volume, src_path)
+        dst = self._obj_dir(dst_volume, dst_path)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        try:
+            os.replace(src, dst)
+        except FileNotFoundError:
+            raise FileNotFoundErr(f"{src_volume}/{src_path}") from None
+
     # ------------------------------------------------------------------
     # listing / walking
     # ------------------------------------------------------------------

@@ -96,13 +96,34 @@ def test_heal_bucket(tmp_path):
     assert es.disks[0].stat_vol("bkt").name == "bkt"
 
 
-def test_heal_insufficient_shards_raises(tmp_path):
+def test_heal_insufficient_shards_offline_raises(tmp_path):
+    # OFFLINE drives (transient errors, not ENOENT) must raise, never
+    # purge: the data may come back when the drives do.
+    es = make_set(tmp_path)
+    es.put_object("bkt", "obj", os.urandom(1 << 20))
+
+    class Offline:
+        def __getattr__(self, name):
+            def fail(*a, **k):
+                raise OSError("drive offline")
+            return fail
+    for i in (0, 1, 2):
+        es.disks[i] = Offline()
+    with pytest.raises(ReadQuorumError):
+        es.heal_object("bkt", "obj")
+
+
+def test_heal_unrecoverable_purges_dangling(tmp_path):
+    # Genuinely-vanished shards beyond parity: the surviving below-quorum
+    # copy is dangling and gets purged (reference: deleteIfDangling).
     es = make_set(tmp_path)
     es.put_object("bkt", "obj", os.urandom(1 << 20))
     for i in (0, 1, 2):
         _wipe_drive(tmp_path, i)
-    with pytest.raises(ReadQuorumError):
-        es.heal_object("bkt", "obj")
+    res = es.heal_object("bkt", "obj")
+    assert res.healed == 1  # the stale survivor purged
+    with pytest.raises(Exception):
+        es.disks[3].read_version("bkt", "obj")
 
 
 def test_degraded_read_triggers_mrf_heal(tmp_path):
@@ -140,3 +161,45 @@ def test_partial_write_triggers_mrf_heal(tmp_path):
     es.mrf.drain()
     fi = real.read_version("bkt", "obj")
     assert fi.size == len(data)
+
+
+def test_heal_multipart_object(tmp_path):
+    from minio_tpu.object import multipart as mp
+    es = make_set(tmp_path)
+    uid = es.new_multipart_upload("bkt", "multi")
+    p1 = os.urandom(mp.MIN_PART_SIZE)
+    p2 = os.urandom(123_456)
+    e1 = es.put_object_part("bkt", "multi", uid, 1, p1)
+    e2 = es.put_object_part("bkt", "multi", uid, 2, p2)
+    es.complete_multipart_upload("bkt", "multi", uid,
+                                 [(1, e1.etag), (2, e2.etag)])
+    _wipe_drive(tmp_path, 2)
+    res = es.heal_object("bkt", "multi")
+    assert res.healed == 1 and res.after[2] == DRIVE_STATE_OK
+    _wipe_drive(tmp_path, 0)
+    _wipe_drive(tmp_path, 3)
+    _, got = es.get_object("bkt", "multi")
+    assert got == p1 + p2
+
+
+def test_heal_purges_stale_version_after_missed_delete(tmp_path):
+    es = make_set(tmp_path)
+    es.put_object("bkt", "zombie", b"old data")
+    real = es.disks[0]
+
+    class DeleteFails:
+        def __getattr__(self, name):
+            if name == "delete_version":
+                def boom(*a, **k):
+                    raise OSError("hiccup")
+                return boom
+            return getattr(real, name)
+
+    es.disks[0] = DeleteFails()
+    es.delete_object("bkt", "zombie")
+    es.disks[0] = real
+    # Drive 0 still holds the stale copy; heal must purge it.
+    res = es.heal_object("bkt", "zombie")
+    assert res.healed == 1
+    with pytest.raises(Exception):
+        real.read_version("bkt", "zombie")

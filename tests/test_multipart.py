@@ -1,0 +1,162 @@
+"""Multipart upload lifecycle + CopyObject, over HTTP and the object layer
+(reference patterns: cmd/erasure-multipart.go, multipart-quorum-test.sh)."""
+
+import os
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from minio_tpu.object.erasure_object import ErasureSet
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.local import LocalStorage
+from tests.s3client import S3Client
+
+NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+PART = 5 * (1 << 20)
+
+
+@pytest.fixture(scope="module")
+def srv(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("drives")
+    disks = [LocalStorage(str(tmp / f"d{i}")) for i in range(4)]
+    server = S3Server(ErasureSet(disks), address="127.0.0.1:0")
+    server.start()
+    yield server
+    server.stop()
+
+
+@pytest.fixture(scope="module")
+def cli(srv):
+    c = S3Client(srv.address)
+    c.request("PUT", "/mpb")
+    return c
+
+
+def _initiate(cli, key, headers=None):
+    status, _, body = cli.request("POST", f"/mpb/{key}", query={"uploads": ""},
+                                  headers=headers or {})
+    assert status == 200, body
+    return ET.fromstring(body).findtext(f"{NS}UploadId")
+
+
+def test_full_multipart_flow(cli):
+    uid = _initiate(cli, "big", headers={"x-amz-meta-kind": "multi",
+                                         "content-type": "app/z"})
+    data = [os.urandom(PART), os.urandom(PART), os.urandom(1234)]
+    etags = []
+    for i, d in enumerate(data):
+        status, h, body = cli.request(
+            "PUT", "/mpb/big",
+            query={"partNumber": str(i + 1), "uploadId": uid}, body=d)
+        assert status == 200, body
+        etags.append(h["ETag"])
+
+    # list parts
+    status, _, body = cli.request("GET", "/mpb/big", query={"uploadId": uid})
+    root = ET.fromstring(body)
+    nums = [int(e.text) for e in root.iter(f"{NS}PartNumber")]
+    assert nums == [1, 2, 3]
+
+    # complete
+    xml = "<CompleteMultipartUpload>" + "".join(
+        f"<Part><PartNumber>{i+1}</PartNumber><ETag>{etags[i]}</ETag></Part>"
+        for i in range(3)) + "</CompleteMultipartUpload>"
+    status, _, body = cli.request("POST", "/mpb/big", query={"uploadId": uid},
+                                  body=xml.encode())
+    assert status == 200, body
+    etag = ET.fromstring(body).findtext(f"{NS}ETag").strip('"')
+    assert etag.endswith("-3")
+
+    full = b"".join(data)
+    status, h, got = cli.request("GET", "/mpb/big")
+    assert got == full
+    assert h["ETag"] == f'"{etag}"'
+    assert h.get("x-amz-meta-kind") == "multi"
+    assert h["Content-Type"] == "app/z"
+
+    # ranged read across the part-2/part-3 boundary
+    off = 2 * PART - 100
+    status, _, got = cli.request(
+        "GET", "/mpb/big", headers={"Range": f"bytes={off}-{off + 199}"})
+    assert got == full[off:off + 200]
+
+
+def test_complete_validations(cli):
+    uid = _initiate(cli, "val")
+    d = os.urandom(1000)
+    _, h, _ = cli.request("PUT", "/mpb/val",
+                          query={"partNumber": "1", "uploadId": uid}, body=d)
+    etag = h["ETag"]
+    # wrong etag
+    xml = (f"<CompleteMultipartUpload><Part><PartNumber>1</PartNumber>"
+           f"<ETag>\"{'0'*32}\"</ETag></Part></CompleteMultipartUpload>")
+    status, _, body = cli.request("POST", "/mpb/val", query={"uploadId": uid},
+                                  body=xml.encode())
+    assert status == 400 and b"InvalidPart" in body
+    # out-of-order
+    _, h2, _ = cli.request("PUT", "/mpb/val",
+                           query={"partNumber": "2", "uploadId": uid}, body=d)
+    xml = ("<CompleteMultipartUpload>"
+           f"<Part><PartNumber>2</PartNumber><ETag>{h2['ETag']}</ETag></Part>"
+           f"<Part><PartNumber>1</PartNumber><ETag>{etag}</ETag></Part>"
+           "</CompleteMultipartUpload>")
+    status, _, body = cli.request("POST", "/mpb/val", query={"uploadId": uid},
+                                  body=xml.encode())
+    assert status == 400 and b"InvalidPartOrder" in body
+    # too-small non-last part
+    xml = ("<CompleteMultipartUpload>"
+           f"<Part><PartNumber>1</PartNumber><ETag>{etag}</ETag></Part>"
+           f"<Part><PartNumber>2</PartNumber><ETag>{h2['ETag']}</ETag></Part>"
+           "</CompleteMultipartUpload>")
+    status, _, body = cli.request("POST", "/mpb/val", query={"uploadId": uid},
+                                  body=xml.encode())
+    assert status == 400 and b"EntityTooSmall" in body
+
+
+def test_abort_and_list_uploads(cli):
+    uid = _initiate(cli, "gone")
+    status, _, body = cli.request("GET", "/mpb", query={"uploads": ""})
+    assert uid in body.decode()
+    status, _, _ = cli.request("DELETE", "/mpb/gone", query={"uploadId": uid})
+    assert status == 204
+    status, _, body = cli.request("GET", "/mpb", query={"uploads": ""})
+    assert uid not in body.decode()
+    # operations on the aborted upload 404
+    status, _, body = cli.request("GET", "/mpb/gone", query={"uploadId": uid})
+    assert status == 404 and b"NoSuchUpload" in body
+
+
+def test_part_overwrite_last_wins(cli):
+    uid = _initiate(cli, "ow")
+    cli.request("PUT", "/mpb/ow", query={"partNumber": "1", "uploadId": uid},
+                body=b"A" * 1000)
+    _, h, _ = cli.request("PUT", "/mpb/ow",
+                          query={"partNumber": "1", "uploadId": uid},
+                          body=b"B" * 1000)
+    xml = (f"<CompleteMultipartUpload><Part><PartNumber>1</PartNumber>"
+           f"<ETag>{h['ETag']}</ETag></Part></CompleteMultipartUpload>")
+    status, _, body = cli.request("POST", "/mpb/ow", query={"uploadId": uid},
+                                  body=xml.encode())
+    assert status == 200, body
+    _, _, got = cli.request("GET", "/mpb/ow")
+    assert got == b"B" * 1000
+
+
+def test_copy_object(cli):
+    payload = os.urandom(600_000)
+    cli.request("PUT", "/mpb/src", body=payload,
+                headers={"x-amz-meta-tag": "orig", "content-type": "a/b"})
+    status, _, body = cli.request(
+        "PUT", "/mpb/dst", headers={"x-amz-copy-source": "/mpb/src"})
+    assert status == 200 and b"CopyObjectResult" in body
+    status, h, got = cli.request("GET", "/mpb/dst")
+    assert got == payload and h.get("x-amz-meta-tag") == "orig" \
+        and h["Content-Type"] == "a/b"
+    # REPLACE directive
+    status, _, _ = cli.request(
+        "PUT", "/mpb/dst2",
+        headers={"x-amz-copy-source": "/mpb/src",
+                 "x-amz-metadata-directive": "REPLACE",
+                 "x-amz-meta-tag": "new"})
+    _, h, got = cli.request("GET", "/mpb/dst2")
+    assert got == payload and h.get("x-amz-meta-tag") == "new"
