@@ -13,8 +13,12 @@ scales out by hashing objects across independent erasure sets
     decode/heal gathers k surviving shards, which becomes an
     ``all_gather`` riding ICI instead of n NVMe/network reads).
 
-Everything here is pure-jit SPMD: the same program runs on every chip,
-XLA inserts the collectives implied by the sharding annotations.
+The compute body runs under ``shard_map`` so each chip executes the
+fused Pallas kernel (rs_device mode="auto": Pallas on TPU, XLA einsum
+elsewhere) on its local block; the collectives between blocks are
+explicit (`all_gather` on the shard axis, `psum` for the parity check),
+mirroring the reference's k-parallel drive reads and write-quorum
+accounting.
 """
 
 from __future__ import annotations
@@ -23,6 +27,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
 
 from minio_tpu.ops import gf256
 from minio_tpu.ops import rs_device
@@ -45,17 +50,21 @@ def make_mesh(devices=None, stripe_parallel: int | None = None) -> Mesh:
                 axis_names=("stripe", "shard"))
 
 
-def encode_step(mesh: Mesh, k: int, m: int):
+def encode_step(mesh: Mesh, k: int, m: int, mode: str = "auto"):
     """Build the jitted full encode step for one (k, m) config.
 
-    Input  : data uint8 [B, k, L], sharded over stripes.
+    Input  : data uint8 [B, k, L], sharded (stripe, -, shard) — the lane
+             (byte-offset) axis is split over the shard devices, since the
+             GF transform is independent per byte column. B must divide by
+             the stripe axis and L by the shard axis (callers pad stripe
+             batches to whole tiles anyway).
     Output : shards uint8 [B, k+m, L] sharded over (stripe, shard) — the
              device-side layout from which per-drive writers DMA their
              shard column out — plus a parity self-check scalar psum'd
              over the whole mesh (the device-side analogue of the write
              path verifying parity consistency before commit).
     """
-    encode = rs_device.make_encoder(gf256.parity_matrix(k, m), mode="xla")
+    encode = rs_device.make_encoder(gf256.parity_matrix(k, m), mode=mode)
     # Independent verification path: decode the first min(m, k) data rows
     # back from (the remaining data rows + parity). A DIFFERENT GF matrix
     # (a Vandermonde-submatrix inverse) computes it, so XLA cannot CSE it
@@ -65,41 +74,83 @@ def encode_step(mesh: Mesh, k: int, m: int):
     nchk = min(m, k)
     survivors = tuple(range(nchk, n))[:k]
     dec_rows = gf256.decode_matrix(k, m, survivors)[:nchk, :]
-    verify = rs_device.make_encoder(dec_rows, mode="xla")
+    verify = rs_device.make_encoder(dec_rows, mode=mode)
 
-    data_sharding = NamedSharding(mesh, P("stripe", None, None))
+    data_sharding = NamedSharding(mesh, P("stripe", None, "shard"))
     out_sharding = NamedSharding(mesh, P("stripe", "shard", None))
+
+    def local_step(data: jax.Array) -> tuple[jax.Array, jax.Array]:
+        # Local block [B/sp, k, L/shp]: every chip runs the fused kernel
+        # on its lane slice; no cross-chip traffic inside the hot loop.
+        parity = encode(data)
+        shards = jnp.concatenate([data, parity], axis=1)  # [b, k+m, l]
+        redecoded = verify(shards[:, nchk:, :][:, :k, :])
+        check = jnp.sum((redecoded ^ shards[:, :nchk, :]).astype(jnp.int32))
+        check = jax.lax.psum(check, ("stripe", "shard"))
+        return shards, check
+
+    # check_vma=False: pallas_call's out_shape carries no varying-mesh-axes
+    # annotation, which the static VMA checker requires under shard_map.
+    sharded = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P("stripe", None, "shard"),),
+        out_specs=(P("stripe", None, "shard"), P()), check_vma=False)
+
+    stripe_par, shard_par = mesh.devices.shape
 
     @jax.jit
     def step(data: jax.Array) -> tuple[jax.Array, jax.Array]:
-        parity = encode(data)
-        shards = jnp.concatenate([data, parity], axis=1)  # [B, k+m, L]
+        assert data.shape[0] % stripe_par == 0, \
+            f"batch {data.shape[0]} not divisible by stripe axis {stripe_par}"
+        assert data.shape[2] % shard_par == 0, \
+            f"lanes {data.shape[2]} not divisible by shard axis {shard_par}"
+        shards, check = sharded(data)
+        # Redistribute lanes→shard-rows so each shard-axis device holds
+        # whole shard rows for its drives (an all-to-all over ICI).
         shards = jax.lax.with_sharding_constraint(shards, out_sharding)
-        redecoded = verify(shards[:, nchk:, :][:, :k, :])
-        check = jnp.sum((redecoded ^ shards[:, :nchk, :]).astype(jnp.int32))
         return shards, check
 
     return step, data_sharding
 
 
-def decode_gather_step(mesh: Mesh, k: int, m: int, missing: tuple[int, ...]):
+def decode_gather_step(mesh: Mesh, k: int, m: int, missing: tuple[int, ...],
+                       mode: str = "auto"):
     """Jitted reconstruct of missing DATA shards from k survivors.
 
     `missing` lists lost shard indices (data or parity); only the data
     rows (< k) are produced, like the reference's DecodeDataBlocks —
     parity re-derives from data on the heal path. Input: survivors uint8
     [B, k, L] (the first k available shard rows, like the reference's
-    ReconstructData), sharded over (stripe, shard) — the gather of
-    survivor rows onto each chip is XLA's all_gather over the shard
-    axis, the ICI replacement for the reference's k parallel drive reads
-    (cmd/erasure-decode.go:127-221).
+    ReconstructData), sharded over (stripe, shard): each shard-axis
+    device holds k/shard_par survivor rows, and the explicit
+    ``all_gather`` over the shard axis is the ICI replacement for the
+    reference's k parallel drive reads (cmd/erasure-decode.go:127-221).
     """
     n = k + m
     available = tuple(i for i in range(n) if i not in missing)[:k]
     dec = gf256.decode_matrix(k, m, available)
     missing_data = [i for i in missing if i < k]
-    reconstruct = rs_device.make_encoder(dec[missing_data, :], mode="xla")
+    reconstruct = rs_device.make_encoder(dec[missing_data, :], mode=mode)
 
     in_sharding = NamedSharding(mesh, P("stripe", "shard", None))
-    step = jax.jit(reconstruct)
+
+    shard_par = mesh.devices.shape[1]
+
+    def local_step(survivors: jax.Array) -> jax.Array:
+        # survivors local block [B/sp, k/shp, L]: gather the full k rows
+        # onto every shard-axis device (ICI all_gather), then reconstruct
+        # only this device's lane slice — each chip does 1/shard_par of
+        # the GF transform instead of replicating the whole matmul.
+        rows = jax.lax.all_gather(survivors, "shard", axis=1, tiled=True)
+        lanes = rows.shape[2] // shard_par
+        idx = jax.lax.axis_index("shard")
+        mine = jax.lax.dynamic_slice_in_dim(rows, idx * lanes, lanes, axis=2)
+        return reconstruct(mine)
+
+    # check_vma=False: pallas_call's out_shape carries no varying-mesh-axes
+    # annotation, which the static VMA checker requires under shard_map.
+    step = jax.jit(shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P("stripe", "shard", None),),
+        out_specs=P("stripe", None, "shard"), check_vma=False))
     return step, in_sharding
