@@ -84,9 +84,20 @@ class ErasureSet:
                  backend=None, pool: Optional[ThreadPoolExecutor] = None):
         self.disks = list(disks)
         n = len(self.disks)
+        if parity is not None and not 0 <= parity <= n // 2:
+            # Parity above n/2 makes write quorum (k) smaller than read
+            # quorum (n/2): acknowledged writes could be unreadable and
+            # then purged as dangling. The reference rejects it in
+            # storage-class config validation
+            # (internal/config/storageclass/storage-class.go).
+            raise ValueError(
+                f"parity {parity} out of range for {n} drives "
+                f"(need 0 <= parity <= {n // 2})")
         self.default_parity = default_parity(n) if parity is None else parity
         self.backend = backend
         self.pool = pool or ThreadPoolExecutor(max_workers=max(8, 2 * n))
+        from minio_tpu.object.nslock import NSLockMap
+        self.ns = NSLockMap()
         self._mrf = None
         self._mrf_lock = __import__("threading").Lock()
 
@@ -415,6 +426,8 @@ class ErasureSet:
         write_quorum = k + (1 if k == m else 0)
 
         distribution = hash_order(f"{bucket}/{object_}", n)
+        # Encode outside the namespace lock (pure compute); only the
+        # commit fan-out below serializes against other ops on this key.
         shards = self._encode_object(data, k, m)
         e = self._erasure(k, m)
         shard_size = e.shard_size()
@@ -460,8 +473,9 @@ class ErasureSet:
                               framed[shard_idx])
                 d.rename_data(SYS_VOL, staging, fi, bucket, object_)
 
-        _, errors = self._fanout(
-            [lambda i=i: write_one(i) for i in range(n)])
+        with self.ns.write(bucket, object_):
+            _, errors = self._fanout(
+                [lambda i=i: write_one(i) for i in range(n)])
         ok = sum(e is None for e in errors)
         if ok < write_quorum:
             # Best-effort cleanup: committed versions on the disks that
@@ -495,6 +509,13 @@ class ErasureSet:
     def get_object(self, bucket: str, object_: str,
                    opts: Optional[GetOptions] = None) -> tuple[ObjectInfo, bytes]:
         opts = opts or GetOptions()
+        # Namespace read lock: shares with other readers, excludes
+        # put/delete/heal on this key (reference: GetObjectNInfo's NSLock).
+        with self.ns.read(bucket, object_):
+            return self._get_object_locked(bucket, object_, opts)
+
+    def _get_object_locked(self, bucket: str, object_: str,
+                           opts: GetOptions) -> tuple[ObjectInfo, bytes]:
         fi, fis, errors = self._get_object_fileinfo(
             bucket, object_, opts.version_id, read_data=True)
         if any(e is not None for e in errors):
@@ -680,6 +701,11 @@ class ErasureSet:
                       opts: Optional[DeleteOptions] = None) -> DeletedObject:
         opts = opts or DeleteOptions()
         self._check_bucket(bucket)
+        with self.ns.write(bucket, object_):
+            return self._delete_object_locked(bucket, object_, opts)
+
+    def _delete_object_locked(self, bucket: str, object_: str,
+                              opts: DeleteOptions) -> DeletedObject:
         n = len(self.disks)
         write_quorum = n // 2 + 1
 

@@ -51,7 +51,24 @@ class HealError(Exception):
 
 def heal_object(es, bucket: str, object_: str, version_id: str = "",
                 deep: bool = False) -> HealResult:
-    """Heal one version of one object across the set's drives."""
+    """Heal one version of one object across the set's drives.
+
+    Serialized against put/delete/get via the namespace write lock
+    (reference: healObject's NSLock, cmd/erasure-healing.go:323) so a
+    background heal cannot race an in-flight write into purging freshly
+    committed shards.
+
+    `deep=False` (the scanner's normal mode) classifies shard files by
+    stat (existence + exact framed size) without reading them;
+    `deep=True` reads and bitrot-verifies every block (reference scanMode
+    normal vs deep, cmd/erasure-healing.go:296).
+    """
+    with es.ns.write(bucket, object_):
+        return _heal_object_locked(es, bucket, object_, version_id, deep)
+
+
+def _heal_object_locked(es, bucket: str, object_: str, version_id: str,
+                        deep: bool) -> HealResult:
     from minio_tpu.object import erasure_object as eo
 
     fis, errors = es._read_version_all(bucket, object_, version_id,
@@ -60,12 +77,28 @@ def heal_object(es, bucket: str, object_: str, version_id: str = "",
     not_found = sum(isinstance(e, (FileNotFoundErr, VersionNotFoundErr))
                     for e in errors)
     if not_found > n // 2:
-        # Quorum verdict: this version does not exist. Purge stale copies
-        # from any drive still holding it (a drive that missed a delete
-        # must not keep resurrectable state — the reference's dangling
-        # object GC, cmd/erasure-object.go:484 deleteIfDangling).
+        # Majority verdict: this version does not exist. Purge stale
+        # copies only when they can NEVER satisfy read quorum again —
+        # not-found must exceed the version's parity count, not just a
+        # majority (reference deleteIfDangling's stricter criteria,
+        # cmd/erasure-object.go:484: a quorum-thin but valid write must
+        # heal, not vanish).
         stale = [i for i in range(n) if fis[i] is not None]
+        purge = False
         if stale:
+            # Parity bound from the most redundant DATA version held by
+            # any stale drive (a delete marker has no erasure info and
+            # must not collapse the bound to a bare majority).
+            ks = [fis[i].erasure.data_blocks for i in stale
+                  if not fis[i].deleted and fis[i].erasure.data_blocks]
+            if ks:
+                m = n - min(ks)
+                purge = not_found > max(n // 2, m)
+            else:
+                # Only delete markers / metadata-only versions: majority
+                # not-found is already decisive.
+                purge = True
+        if stale and purge:
             es._fanout([
                 (lambda i=i: _purge_version(es.disks[i], bucket, object_,
                                             fis[i].version_id))
@@ -74,8 +107,11 @@ def heal_object(es, bucket: str, object_: str, version_id: str = "",
                             version_id=version_id)
         result.before = [DRIVE_STATE_OUTDATED if i in stale
                          else DRIVE_STATE_MISSING for i in range(n)]
-        result.after = [DRIVE_STATE_MISSING] * n
-        result.healed = len(stale)
+        if purge:
+            result.after = [DRIVE_STATE_MISSING] * n
+            result.healed = len(stale)
+        else:
+            result.after = list(result.before)
         return result
     any_fi = next((f for f in fis if f is not None), None)
     if any_fi is None:
@@ -126,6 +162,27 @@ def heal_object(es, bucket: str, object_: str, version_id: str = "",
         except Exception:  # noqa: BLE001 - treat as corrupt
             return None
 
+    def stat_all_parts(disk_idx: int) -> bool:
+        """Non-deep check: every shard file exists with the exact
+        bitrot-framed size (no data read, no hash verify)."""
+        d = es.disks[disk_idx]
+        dfi = fis[disk_idx]
+        try:
+            for p in parts:
+                plen = e.shard_file_size(p.size)
+                want = bitrot.shard_file_size(plen, shard_size)
+                if inline:
+                    if len(dfi.inline_data or b"") != want:
+                        return False
+                else:
+                    st = d.stat_info_file(
+                        bucket, f"{object_}/{fi.data_dir}/part.{p.number}")
+                    if st.st_size != want:
+                        return False
+            return True
+        except Exception:  # noqa: BLE001 - unstattable == corrupt
+            return False
+
     for i in range(n):
         dfi = fis[i]
         if isinstance(errors[i], (FileNotFoundErr, VersionNotFoundErr)):
@@ -143,13 +200,17 @@ def heal_object(es, bucket: str, object_: str, version_id: str = "",
             for ps in part_shards:
                 ps[dist[i] - 1] = np.zeros(0, np.uint8)
             continue
-        loaded = load_all_parts(i)
-        if loaded is None:
-            states[i] = DRIVE_STATE_CORRUPT
+        if deep:
+            loaded = load_all_parts(i)
+            if loaded is None:
+                states[i] = DRIVE_STATE_CORRUPT
+            else:
+                states[i] = DRIVE_STATE_OK
+                for pi, arr in enumerate(loaded):
+                    part_shards[pi][dist[i] - 1] = arr
         else:
-            states[i] = DRIVE_STATE_OK
-            for pi, arr in enumerate(loaded):
-                part_shards[pi][dist[i] - 1] = arr
+            states[i] = DRIVE_STATE_OK if stat_all_parts(i) \
+                else DRIVE_STATE_CORRUPT
 
     result = HealResult(bucket=bucket, object=object_,
                         version_id=fi.version_id, before=list(states),
@@ -159,6 +220,24 @@ def heal_object(es, bucket: str, object_: str, version_id: str = "",
     if not bad:
         result.after = list(states)
         return result
+
+    if fi.size > 0 and not deep:
+        # Non-deep mode deferred the reads; pull verified shards from the
+        # stat-OK drives now that a rebuild is actually needed. A drive
+        # that passed stat but fails bitrot on read demotes to corrupt.
+        ok_idxs = [i for i in range(n) if states[i] == DRIVE_STATE_OK]
+        loads, _ = es._fanout([
+            (lambda i=i: load_all_parts(i)) if i in ok_idxs else None
+            for i in range(n)])
+        for i in ok_idxs:
+            loaded = loads[i]
+            if loaded is None:
+                states[i] = DRIVE_STATE_CORRUPT
+                result.before[i] = DRIVE_STATE_CORRUPT
+                bad.append(i)
+            else:
+                for pi, arr in enumerate(loaded):
+                    part_shards[pi][dist[i] - 1] = arr
 
     if fi.size > 0:
         for ps in part_shards:
@@ -283,7 +362,9 @@ class MRFQueue:
             except queue.Empty:
                 continue
             try:
-                heal_object(self.es, bucket, object_, vid)
+                # MRF entries come from observed failures (degraded reads,
+                # bitrot hits, partial writes), so verify deeply.
+                heal_object(self.es, bucket, object_, vid, deep=True)
                 self.healed += 1
             except Exception:  # noqa: BLE001 - retry w/ backoff, then drop
                 if attempt + 1 < self.retries and not self._stop.is_set():

@@ -111,15 +111,22 @@ def put_object_part(es, bucket: str, object_: str, upload_id: str,
     framed = bitrot.frame_shards_batch(shards, e.shard_size()) \
         if shards.shape[1] else [b""] * n
     etag = hashlib.md5(data).hexdigest()
+    # Each upload attempt gets its own data file; the atomic .meta replace
+    # referencing it is the commit point, so a crash or concurrent
+    # re-upload of the same part can never pair a torn data file with a
+    # .meta that validates (the reference stages parts through tmp +
+    # rename, cmd/erasure-multipart.go:570).
+    attempt = new_uuid()
+    data_file = f"part.{part_number}.{attempt}"
     meta = {"number": part_number, "size": len(data),
-            "actual_size": len(data), "etag": etag, "mod_time": now_ns()}
+            "actual_size": len(data), "etag": etag, "mod_time": now_ns(),
+            "file": data_file}
     updir = _upload_dir(bucket, object_, upload_id)
 
     def write_one(disk_idx: int):
         d = es.disks[disk_idx]
         shard_idx = dist[disk_idx] - 1
-        d.create_file(eo.SYS_VOL, f"{updir}/part.{part_number}",
-                      framed[shard_idx])
+        d.create_file(eo.SYS_VOL, f"{updir}/{data_file}", framed[shard_idx])
         d.write_all(eo.SYS_VOL, f"{updir}/part.{part_number}.meta",
                     json.dumps(meta).encode())
 
@@ -234,6 +241,7 @@ def complete_multipart_upload(es, bucket: str, object_: str, upload_id: str,
         raise InvalidPartOrder()
 
     fi_parts: list[ObjectPartInfo] = []
+    part_files: dict[int, str] = {}
     md5_concat = b""
     total = 0
     for idx, (num, etag) in enumerate(parts):
@@ -246,6 +254,7 @@ def complete_multipart_upload(es, bucket: str, object_: str, upload_id: str,
         fi_parts.append(ObjectPartInfo(
             number=num, size=meta["size"], actual_size=meta["actual_size"],
             etag=clean, mod_time=meta["mod_time"]))
+        part_files[num] = meta.get("file", f"part.{num}")
         md5_concat += bytes.fromhex(clean)
         total += meta["size"]
 
@@ -263,7 +272,7 @@ def complete_multipart_upload(es, bucket: str, object_: str, upload_id: str,
         shard_idx = dist[disk_idx] - 1
         staging = f"{eo.STAGING_PREFIX}/{new_uuid()}"
         for num, _ in parts:
-            d.rename_file(eo.SYS_VOL, f"{updir}/part.{num}",
+            d.rename_file(eo.SYS_VOL, f"{updir}/{part_files[num]}",
                           eo.SYS_VOL, f"{staging}/{data_dir}/part.{num}")
         fi = FileInfo(
             volume=bucket, name=object_, version_id=version_id,
@@ -275,8 +284,11 @@ def complete_multipart_upload(es, bucket: str, object_: str, upload_id: str,
                 distribution=tuple(dist)))
         d.rename_data(eo.SYS_VOL, staging, fi, bucket, object_)
 
-    _, errors = es._fanout(
-        [lambda i=i: commit_one(i) for i in range(n)])
+    # Namespace write lock: the final assembly is an object commit and
+    # must serialize with puts/deletes/heals of the same key.
+    with es.ns.write(bucket, object_):
+        _, errors = es._fanout(
+            [lambda i=i: commit_one(i) for i in range(n)])
     ok = sum(e2 is None for e2 in errors)
     write_quorum = k + (1 if k == m else 0)
     if ok < write_quorum:

@@ -51,8 +51,10 @@ _LANES = 128
 # below ~32k lanes (5.6 GB/s at 1k-lane tiles vs 120 GB/s at 128k-lane
 # tiles with two batch rows per cell for EC 8+4 on 1 MiB blocks).
 _TILE_L_MAX = 131072
-# v5e VMEM is large enough for ~28 MiB working sets per cell (measured:
-# EC 8+4 at 128k-lane tiles compiles and is the fastest config).
+# Starting budget for the _choose_tile guess (v5e scoped VMEM caps cells
+# at 16 MiB, but Mosaic's padding/double-buffering makes real usage
+# opaque — the compile-retry loop in apply_matrix_device is the actual
+# enforcement; this just sets where the probe starts).
 _VMEM_BUDGET = 32 * 1024 * 1024
 
 
@@ -64,12 +66,32 @@ def _choose_tile(k: int, r: int, l: int, b: int) -> tuple[int, int]:
     re-deriving the tile from the padded l is a fixed point — the wrapper
     and the jitted body always agree.
     """
-    per_lane = k * 8 + r * 8 * 4 + 2 * (k + r)  # bytes per lane of tile
+    # bits int8 [k8,T] + unpack temps + acc int32 [r8,T] + data/out tiles.
+    # This is only the STARTING guess: the scoped-VMEM ceiling on v5e is
+    # 16 MiB and Mosaic's real allocation (padding of small sublane dims,
+    # double-buffered grid cells) is opaque, so apply_matrix_device
+    # halves the tile and retries whenever the compile overflows VMEM,
+    # caching what worked (see _working_tile).
+    per_lane = k * 8 + r * 8 * 4 + 2 * (k + r)
     tile = _LANES
     while tile < _TILE_L_MAX and tile * 2 * per_lane <= _VMEM_BUDGET and tile < l:
         tile *= 2
     bb = 2 if b % 2 == 0 else 1
     return tile, bb
+
+
+# (k, r, bb) -> lane-tile cap learned from VMEM compile failures.
+_tile_cap: dict[tuple[int, int, int], int] = {}
+# (k, r, bb, tile) combos that compiled successfully (skip the probe sync).
+_tile_ok: set[tuple[int, int, int, int]] = set()
+
+
+def _is_vmem_error(e: Exception) -> bool:
+    # Only the actual scoped-VMEM overflow signature — a transient
+    # compile-service error or unrelated Mosaic failure must surface
+    # immediately, not trigger halve-and-retry (which would poison
+    # _tile_cap at the minimum tile).
+    return "vmem" in str(e).lower()
 
 
 def _on_tpu() -> bool:
@@ -132,7 +154,8 @@ def _rs_kernel(bmat_ref, data_ref, out_ref):
     for i in range(data_ref.shape[0]):
         x = data_ref[i].astype(jnp.int32)  # [k, TL]
         # Plane-major unpack: row b*k+i holds bit b of shard i. Static
-        # concat — no sublane interleaving needed.
+        # concat — no sublane interleaving needed. (Shifts must be int32:
+        # Mosaic cannot legalize arith.shrui on 8-bit vectors.)
         bits = jnp.concatenate(
             [((x >> b) & 1).astype(jnp.int8) for b in range(8)], axis=0)
         acc = jax.lax.dot_general(
@@ -146,14 +169,13 @@ def _rs_kernel(bmat_ref, data_ref, out_ref):
         out_ref[i] = out.astype(jnp.uint8)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _pallas_apply(bmat_plane: jax.Array, data: jax.Array,
-                  interpret: bool = False) -> jax.Array:
+@functools.partial(jax.jit, static_argnames=("tile", "bb", "interpret"))
+def _pallas_apply(bmat_plane: jax.Array, data: jax.Array, tile: int,
+                  bb: int, interpret: bool = False) -> jax.Array:
     """bmat_plane int8 [r8, k8] (plane-major), data uint8 [B, k, L_padded]."""
     b, k, l = data.shape
     r8 = bmat_plane.shape[0]
     r = r8 // 8
-    tile, bb = _choose_tile(k, r, l, b)
     # Loud failure beats silently-unwritten output tails: callers must pad
     # (DeviceBackend.apply_matrix_device / make_encoder do).
     assert l % tile == 0, f"lane dim {l} not a multiple of tile {tile}"
@@ -197,20 +219,51 @@ class DeviceBackend:
     # -- device-array API (stays on device; used by batched/jit callers) ----
 
     def apply_matrix_device(self, matrix: np.ndarray, data: jax.Array) -> jax.Array:
-        """data uint8 [B, k, L] on device -> [B, r, L] on device."""
+        """data uint8 [B, k, L] on device -> [B, r, L] on device.
+
+        Pads lanes to a whole number of tiles (zero bytes are a fixed
+        point of the linear transform so the tail slices back out
+        exactly). If the Pallas compile overflows the chip's scoped VMEM
+        at the heuristic tile size, halves the tile and retries; the
+        working size is cached per (k, r, bb) so the probe cost is paid
+        once per config.
+        """
         bm_byte, bm_plane = _prep(matrix)
         if self.mode == "xla":
             return _xla_apply(jnp.asarray(bm_byte), data)
         b, k, l = data.shape
-        # Pad lanes to a whole number of tiles; zero bytes are a fixed point
-        # of the linear transform so the tail slices back out exactly.
-        tile, _ = _choose_tile(k, matrix.shape[0], l, b)
-        pad = (-l) % tile
-        if pad:
-            data = jnp.pad(data, ((0, 0), (0, 0), (0, pad)))
-        out = _pallas_apply(jnp.asarray(bm_plane), data,
-                            interpret=self._interpret)
-        return out[..., :l] if pad else out
+        r = matrix.shape[0]
+        tile, bb = _choose_tile(k, r, l, b)
+        key = (k, r, bb)
+        tile = min(tile, _tile_cap.get(key, tile))
+        bmat = jnp.asarray(bm_plane)
+        if isinstance(data, jax.core.Tracer):
+            # Under an outer jit/shard_map trace there is no way to probe
+            # (no concrete values, failures surface at the caller's
+            # compile); use the capped heuristic directly.
+            pad = (-l) % tile
+            padded = jnp.pad(data, ((0, 0), (0, 0), (0, pad))) if pad else data
+            out = _pallas_apply(bmat, padded, tile=tile, bb=bb,
+                                interpret=self._interpret)
+            return out[..., :l] if pad else out
+        while True:
+            pad = (-l) % tile
+            padded = jnp.pad(data, ((0, 0), (0, 0), (0, pad))) if pad else data
+            try:
+                out = _pallas_apply(bmat, padded, tile=tile, bb=bb,
+                                    interpret=self._interpret)
+                if key + (tile,) not in _tile_ok:
+                    # Force the (possibly async) compile to surface VMEM
+                    # overflows now, while we can still retry smaller.
+                    out.block_until_ready()
+                    _tile_ok.add(key + (tile,))
+                return out[..., :l] if pad else out
+            except Exception as e:  # noqa: BLE001 - inspect & retry
+                if tile > _LANES and _is_vmem_error(e):
+                    tile //= 2
+                    _tile_cap[key] = min(_tile_cap.get(key, tile), tile)
+                    continue
+                raise
 
     # -- ECBackend protocol (numpy in / numpy out) --------------------------
 

@@ -149,8 +149,21 @@ def decode_gather_step(mesh: Mesh, k: int, m: int, missing: tuple[int, ...],
 
     # check_vma=False: pallas_call's out_shape carries no varying-mesh-axes
     # annotation, which the static VMA checker requires under shard_map.
-    step = jax.jit(shard_map(
+    sharded = shard_map(
         local_step, mesh=mesh,
         in_specs=(P("stripe", "shard", None),),
-        out_specs=P("stripe", None, "shard"), check_vma=False))
+        out_specs=P("stripe", None, "shard"), check_vma=False)
+
+    stripe_par = mesh.devices.shape[0]
+
+    @jax.jit
+    def step(survivors: jax.Array) -> jax.Array:
+        assert survivors.shape[0] % stripe_par == 0, \
+            f"batch {survivors.shape[0]} not divisible by stripe axis"
+        assert survivors.shape[1] % shard_par == 0, \
+            f"k={survivors.shape[1]} not divisible by shard axis {shard_par}"
+        assert survivors.shape[2] % shard_par == 0, \
+            f"lanes {survivors.shape[2]} not divisible by shard axis"
+        return sharded(survivors)
+
     return step, in_sharding

@@ -105,13 +105,14 @@ def _make_handler(server: S3Server):
 
         def _parse(self):
             parsed = urllib.parse.urlsplit(self.path)
-            path = urllib.parse.unquote(parsed.path)
+            raw_path = parsed.path          # still percent-encoded: signed
+            path = urllib.parse.unquote(raw_path)
             query = urllib.parse.parse_qs(parsed.query,
                                           keep_blank_values=True)
             parts = path.lstrip("/").split("/", 1)
             bucket = parts[0] if parts[0] else ""
             key = parts[1] if len(parts) > 1 else ""
-            return path, query, bucket, key
+            return raw_path, query, bucket, key
 
         def _read_body(self) -> bytes:
             te = self._headers_lower().get("transfer-encoding", "")
@@ -172,13 +173,14 @@ def _make_handler(server: S3Server):
         # -- dispatch ---------------------------------------------------
 
         def _route(self, method: str):
-            path, query, bucket, key = self._parse()
+            raw_path, query, bucket, key = self._parse()
             try:
                 # Verify the signature from headers first; the declared
                 # payload hash is part of the signed canonical request, so
                 # the body is only hashed afterwards when the mode calls
-                # for it (streaming modes verify per chunk instead).
-                auth = self._auth(method, path, query)
+                # for it (streaming modes verify per chunk instead). The
+                # RAW request path is signed — never a re-encoding of it.
+                auth = self._auth(method, raw_path, query)
                 body = b""
                 if method in ("PUT", "POST"):
                     body = self._read_body()

@@ -82,12 +82,20 @@ def canonical_query(query: dict[str, list[str]],
 def canonical_request(method: str, path: str, query: dict[str, list[str]],
                       headers: dict[str, str], signed_headers: list[str],
                       payload_hash: str,
-                      drop_query: tuple[str, ...] = ()) -> str:
+                      drop_query: tuple[str, ...] = (),
+                      raw_path: Optional[str] = None) -> str:
+    """`path` is percent-encoded by this function (signing-side use);
+    verifiers pass `raw_path` — the exact still-encoded URI from the wire
+    — because S3 signs the raw request path without re-encoding (clients
+    whose percent-encoding differs from urllib's safe set, or keys with
+    non-UTF-8 bytes, would otherwise mismatch)."""
     canon_headers = "".join(
         f"{h}:{' '.join(headers.get(h, '').split())}\n" for h in signed_headers)
+    uri = raw_path if raw_path is not None \
+        else uri_encode(path, encode_slash=False)
     return "\n".join([
         method.upper(),
-        uri_encode(path, encode_slash=False) or "/",
+        uri or "/",
         canonical_query(query, drop=drop_query),
         canon_headers,
         ";".join(signed_headers),
@@ -174,6 +182,8 @@ def verify_request(method: str, path: str, query: dict[str, list[str]],
                    ) -> ParsedAuth:
     """Verify a header-signed or presigned request.
 
+    `path` must be the RAW (still percent-encoded) request path from the
+    wire — it is signed verbatim, never re-encoded.
     `secret_for(access_key) -> secret | None`. Raises SigError on any
     mismatch; returns the parsed auth (callers use the access key for
     policy checks and the payload-hash mode for body handling).
@@ -184,15 +194,27 @@ def verify_request(method: str, path: str, query: dict[str, list[str]],
     if secret is None:
         raise SigError("InvalidAccessKeyId", auth.credential.access_key)
 
+    sts_date = auth.amz_date
     if not presigned:
         # Replay window: signed requests are valid for +/-15 minutes
         # (the reference enforces the same max skew on header auth).
+        # Clients may sign with only a Date header (RFC1123 format); the
+        # SigV4 spec then puts the ISO8601 rendering of that instant in
+        # the string-to-sign, so normalize for verification too.
         try:
             t0 = datetime.datetime.strptime(
                 auth.amz_date, "%Y%m%dT%H%M%SZ").replace(
                     tzinfo=datetime.timezone.utc)
         except ValueError:
-            raise SigError("AccessDenied", "bad x-amz-date") from None
+            import email.utils
+            try:
+                t0 = email.utils.parsedate_to_datetime(auth.amz_date)
+            except (TypeError, ValueError):
+                raise SigError("AccessDenied", "bad x-amz-date") from None
+            if t0.tzinfo is None:
+                t0 = t0.replace(tzinfo=datetime.timezone.utc)
+            sts_date = t0.astimezone(datetime.timezone.utc) \
+                .strftime("%Y%m%dT%H%M%SZ")
         now = datetime.datetime.now(datetime.timezone.utc)
         if abs((now - t0).total_seconds()) > 15 * 60:
             raise SigError("AccessDenied",
@@ -210,10 +232,10 @@ def verify_request(method: str, path: str, query: dict[str, list[str]],
                 raise SigError("XAmzContentSHA256Mismatch", "payload mismatch")
         drop = ()
 
-    canon = canonical_request(method, path, query, headers,
+    canon = canonical_request(method, "", query, headers,
                               auth.signed_headers, payload_hash,
-                              drop_query=drop)
-    sts = string_to_sign(auth.amz_date, auth.credential.scope(), canon)
+                              drop_query=drop, raw_path=path)
+    sts = string_to_sign(sts_date, auth.credential.scope(), canon)
     key = signing_key(secret, auth.credential.date, auth.credential.region)
     want = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
     if not hmac.compare_digest(want, auth.signature):

@@ -29,6 +29,10 @@ def main(argv=None) -> int:
     ap.add_argument("drives", nargs="+", help="local drive directories")
     args = ap.parse_args(argv)
 
+    if args.parity is not None and not 0 <= args.parity <= len(args.drives) // 2:
+        ap.error(f"--parity must be in [0, {len(args.drives) // 2}] "
+                 f"for {len(args.drives)} drives")
+
     # Boot self-tests: identical math to the reference or refuse to serve.
     from minio_tpu.erasure.selftest import erasure_self_test
     from minio_tpu.storage.bitrot import bitrot_self_test

@@ -444,10 +444,16 @@ class LocalStorage:
         if not os.path.isdir(vol):
             raise VolumeNotFound(volume)
 
+        # emit() caches each journal blob so the descend event for the
+        # same directory can derive data dirs without a second read+parse.
+        blob_cache: dict[str, bytes] = {}
+
         def emit(rel: str) -> Optional[tuple[str, bytes]]:
             try:
                 with open(os.path.join(vol, rel, META_FILE), "rb") as f:
-                    return rel, f.read()
+                    blob = f.read()
+                blob_cache[rel] = blob
+                return rel, blob
             except (FileNotFoundError, NotADirectoryError):
                 return None
 
@@ -458,23 +464,47 @@ class LocalStorage:
             except ValueError:
                 return False
 
-        def walk(rel: str, parent_is_obj: bool) -> Iterator[tuple[str, bytes]]:
+        def data_dirs_of(rel: str) -> frozenset[str]:
+            """Data-dir names referenced by rel's journal — ONLY those are
+            version data, any other UUID-named child is a legitimate user
+            key prefix and must be walked."""
+            try:
+                blob = blob_cache.pop(rel, None)
+                if blob is None:
+                    with open(os.path.join(vol, rel, META_FILE), "rb") as f:
+                        blob = f.read()
+                xl = XLMeta.load(blob)
+                return frozenset(v.get("ddir", "") for v in xl.versions
+                                 if v.get("ddir"))
+            except (OSError, MetaError):
+                # Unreadable journal: no children get classified as data
+                # dirs, so every UUID child is walked as a possible key
+                # (harmless — dirs without xl.meta yield nothing).
+                return frozenset()
+
+        def walk(rel: str, rel_is_obj: bool) -> Iterator[tuple[str, bytes]]:
             """Yields in GLOBAL lexicographic key order. A directory `d`
             produces two ordered events: the object key "d" (sorts before
             siblings like "d-x") and the subtree "d/" (sorts after them) —
             interleaving siblings between an object and its nested keys,
-            exactly as S3 key order requires."""
+            exactly as S3 key order requires. When rel is itself an
+            object, children matching its journal's data dirs are shard
+            storage, not keys (any other UUID-named child IS a key)."""
             full = os.path.join(vol, rel) if rel else vol
             try:
                 names = os.listdir(full)
             except (FileNotFoundError, NotADirectoryError):
                 return
+            ddirs: Optional[frozenset] = None  # lazily parsed journal
             events = []  # (sort_key, name, kind)
             for n in names:
                 if n == META_FILE:
                     continue
-                if parent_is_obj and is_uuid(n):
-                    continue  # version data dir, not a key prefix
+                if rel_is_obj and is_uuid(n):
+                    if ddirs is None:
+                        ddirs = data_dirs_of(rel)
+                    if n in ddirs:
+                        continue  # version data dir, not a key prefix
                 if os.path.isdir(os.path.join(full, n)):
                     events.append((n, n, "obj"))
                     events.append((n + "/", n, "descend"))
@@ -498,7 +528,10 @@ class LocalStorage:
                         yield from walk(child, is_obj)
                     else:
                         yield subtree, b""
-        yield from walk(base_dir, False)
+
+        base_is_obj = bool(base_dir) and os.path.exists(
+            os.path.join(vol, base_dir, META_FILE))
+        yield from walk(base_dir, base_is_obj)
 
     # ------------------------------------------------------------------
     # health / usage

@@ -58,7 +58,8 @@ class S3Client:
 
         qs = urllib.parse.urlencode(
             [(k, v) for k, vs in query.items() for v in vs])
-        url = urllib.parse.quote(path) + ("?" + qs if qs else "")
+        # Send exactly the URI that was signed (raw-path verification).
+        url = sigv4.uri_encode(path, encode_slash=False) + ("?" + qs if qs else "")
         conn = http.client.HTTPConnection(self.address, timeout=30)
         try:
             conn.request(method, url, body=body, headers=send_headers)
@@ -106,4 +107,4 @@ class S3Client:
         query["X-Amz-Signature"] = [sig]
         qs = urllib.parse.urlencode(
             [(k, v) for k, vs in query.items() for v in vs])
-        return urllib.parse.quote(path) + "?" + qs
+        return sigv4.uri_encode(path, encode_slash=False) + "?" + qs

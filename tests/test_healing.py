@@ -56,10 +56,15 @@ def test_heal_corrupt_shard(tmp_path):
                 blob = bytearray(open(p, "rb").read())
                 blob[50] ^= 1
                 open(p, "wb").write(bytes(blob))
-    res = es.heal_object("bkt", "obj")
+    # Non-deep (stat-only) classification cannot see an in-place bit
+    # flip: the file exists at the right size.
+    res_shallow = es.heal_object("bkt", "obj")
+    assert res_shallow.before[2] == DRIVE_STATE_OK
+    # Deep mode reads and bitrot-verifies every block and repairs it.
+    res = es.heal_object("bkt", "obj", deep=True)
     assert res.before[2] == DRIVE_STATE_CORRUPT
     assert res.after[2] == DRIVE_STATE_OK
-    res2 = es.heal_object("bkt", "obj")
+    res2 = es.heal_object("bkt", "obj", deep=True)
     assert res2.before == [DRIVE_STATE_OK] * 4 and res2.healed == 0
 
 
