@@ -1,0 +1,238 @@
+"""Server pools: federation of independent sets layers.
+
+The analogue of the reference's erasureServerPools
+(cmd/erasure-server-pool.go:52): each pool is an ErasureSets instance
+(its own drives and set layout — the cluster expansion unit). New
+objects land in the pool with the most free space unless a version of
+the key already exists in some pool (cmd/erasure-server-pool.go:1084
+PutObject / :1095 getPoolIdx); reads/deletes search pools in order;
+listings merge across pools.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from minio_tpu.object.multipart import UploadNotFound
+from minio_tpu.object.sets import merge_list_pages
+from minio_tpu.object.types import (BucketNotFound, ListObjectsInfo,
+                                    MethodNotAllowed, ObjectNotFound,
+                                    VersionNotFound)
+
+_MISSES = (ObjectNotFound, VersionNotFound)
+
+
+class ServerPools:
+    """Top-level ObjectLayer over one or more pools."""
+
+    def __init__(self, pools: Sequence):
+        if not pools:
+            raise ValueError("at least one pool required")
+        self.pools = list(pools)
+
+    # -- placement -----------------------------------------------------
+
+    def _pool_of_existing(self, bucket: str, object_: str) -> Optional[int]:
+        """Pool already holding any version of the key, else None.
+        (MethodNotAllowed means the latest is a delete marker — the key
+        still lives in that pool.)"""
+        if len(self.pools) == 1:
+            return 0
+        for i, p in enumerate(self.pools):
+            try:
+                p.get_object_info(bucket, object_)
+                return i
+            except MethodNotAllowed:
+                return i
+            except _MISSES + (BucketNotFound,):
+                continue
+            # Transient errors (quorum loss, drive faults) propagate:
+            # treating them as "not here" would write a NEW copy of the
+            # key into another pool and split-brain the namespace.
+        return None
+
+    def _pool_for_new(self) -> int:
+        if len(self.pools) == 1:
+            return 0
+        frees = [p.free_space() for p in self.pools]
+        return max(range(len(frees)), key=lambda i: frees[i])
+
+    def _put_pool(self, bucket: str, object_: str) -> int:
+        idx = self._pool_of_existing(bucket, object_)
+        return self._pool_for_new() if idx is None else idx
+
+    # -- buckets -------------------------------------------------------
+
+    def make_bucket(self, bucket: str) -> None:
+        # A bucket already present in one pool (e.g. after cluster
+        # expansion) must still be created in the others; BucketExists
+        # only when every pool reports it.
+        from minio_tpu.object.types import BucketExists
+        exists = 0
+        for p in self.pools:
+            try:
+                p.make_bucket(bucket)
+            except BucketExists:
+                exists += 1
+        if exists == len(self.pools):
+            raise BucketExists(bucket)
+
+    def get_bucket_info(self, bucket: str):
+        last: Exception = BucketNotFound(bucket)
+        for p in self.pools:
+            try:
+                return p.get_bucket_info(bucket)
+            except BucketNotFound as e:
+                last = e
+        raise last
+
+    def list_buckets(self):
+        seen: dict[str, object] = {}
+        for p in self.pools:
+            for b in p.list_buckets():
+                if b.name not in seen or b.created < seen[b.name].created:
+                    seen[b.name] = b
+        return [seen[k] for k in sorted(seen)]
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        not_found = 0
+        for p in self.pools:
+            try:
+                p.delete_bucket(bucket, force=force)
+            except BucketNotFound:
+                not_found += 1
+        if not_found == len(self.pools):
+            raise BucketNotFound(bucket)
+
+    # -- bucket metadata ----------------------------------------------
+
+    def get_bucket_meta(self, bucket: str) -> dict:
+        for p in self.pools:
+            meta = p.get_bucket_meta(bucket)
+            if meta:
+                return meta
+        return {}
+
+    def set_bucket_meta(self, bucket: str, meta: dict) -> None:
+        for p in self.pools:
+            p.set_bucket_meta(bucket, meta)
+
+    def bucket_versioning(self, bucket: str) -> bool:
+        return bool(self.get_bucket_meta(bucket).get("versioning"))
+
+    def set_bucket_versioning(self, bucket: str, enabled: bool) -> None:
+        meta = self.get_bucket_meta(bucket)
+        meta["versioning"] = bool(enabled)
+        self.set_bucket_meta(bucket, meta)
+
+    # -- objects -------------------------------------------------------
+
+    def put_object(self, bucket, object_, data, opts=None):
+        return self.pools[self._put_pool(bucket, object_)].put_object(
+            bucket, object_, data, opts)
+
+    def _search(self, fn_name: str, bucket, object_, *args, **kw):
+        last: Exception = ObjectNotFound(bucket, object_)
+        for p in self.pools:
+            try:
+                return getattr(p, fn_name)(bucket, object_, *args, **kw)
+            except _MISSES as e:
+                last = e
+        raise last
+
+    def get_object(self, bucket, object_, opts=None):
+        return self._search("get_object", bucket, object_, opts)
+
+    def get_object_info(self, bucket, object_, opts=None):
+        return self._search("get_object_info", bucket, object_, opts)
+
+    def list_versions_all(self, bucket, object_):
+        return self._search("list_versions_all", bucket, object_)
+
+    def delete_object(self, bucket, object_, opts=None):
+        # Delete markers must land in the pool that holds the key
+        # (reference DeleteObject pool lookup); a plain missing key
+        # surfaces from the first pool's semantics.
+        idx = self._pool_of_existing(bucket, object_)
+        if idx is None:
+            idx = 0
+        return self.pools[idx].delete_object(bucket, object_, opts)
+
+    # -- multipart -----------------------------------------------------
+
+    def new_multipart_upload(self, bucket, object_, opts=None):
+        return self.pools[self._put_pool(bucket, object_)] \
+            .new_multipart_upload(bucket, object_, opts)
+
+    def _upload_pool(self, bucket, object_, upload_id):
+        from minio_tpu.object import multipart as mp
+        for p in self.pools:
+            try:
+                mp._read_upload(p.set_for(object_) if hasattr(p, "set_for")
+                                else p, bucket, object_, upload_id)
+                return p
+            except UploadNotFound:
+                continue
+        raise UploadNotFound(upload_id)
+
+    def put_object_part(self, bucket, object_, upload_id, part_number, data):
+        return self._upload_pool(bucket, object_, upload_id).put_object_part(
+            bucket, object_, upload_id, part_number, data)
+
+    def complete_multipart_upload(self, bucket, object_, upload_id, parts):
+        return self._upload_pool(bucket, object_, upload_id) \
+            .complete_multipart_upload(bucket, object_, upload_id, parts)
+
+    def abort_multipart_upload(self, bucket, object_, upload_id):
+        return self._upload_pool(bucket, object_, upload_id) \
+            .abort_multipart_upload(bucket, object_, upload_id)
+
+    def list_parts(self, bucket, object_, upload_id, part_marker=0,
+                   max_parts=1000):
+        return self._upload_pool(bucket, object_, upload_id).list_parts(
+            bucket, object_, upload_id, part_marker, max_parts)
+
+    def list_multipart_uploads(self, bucket, prefix=""):
+        out = []
+        for p in self.pools:
+            out.extend(p.list_multipart_uploads(bucket, prefix))
+        out.sort(key=lambda r: (r.get("object", ""), r.get("initiated", 0)))
+        return out
+
+    # -- listing -------------------------------------------------------
+
+    def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
+                     delimiter: str = "", max_keys: int = 1000,
+                     include_versions: bool = False) -> ListObjectsInfo:
+        pages = []
+        found = False
+        for p in self.pools:
+            try:
+                pages.append(p.list_objects(
+                    bucket, prefix=prefix, marker=marker, delimiter=delimiter,
+                    max_keys=max_keys, include_versions=include_versions))
+                found = True
+            except BucketNotFound:
+                continue
+        if not found:
+            raise BucketNotFound(bucket)
+        return merge_list_pages(pages, max_keys)
+
+    # -- healing -------------------------------------------------------
+
+    def heal_object(self, bucket, object_, version_id="", deep=False):
+        return self._search("heal_object", bucket, object_, version_id,
+                            deep=deep)
+
+    def heal_bucket(self, bucket):
+        out = {"bucket": bucket, "missing": 0, "healed": 0}
+        for p in self.pools:
+            r = p.heal_bucket(bucket)
+            out["missing"] += r.get("missing", 0)
+            out["healed"] += r.get("healed", 0)
+        return out
+
+    def drain_mrf(self, timeout: float = 10.0) -> None:
+        for p in self.pools:
+            if hasattr(p, "drain_mrf"):
+                p.drain_mrf(timeout)

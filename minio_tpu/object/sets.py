@@ -1,0 +1,252 @@
+"""Multi-set object layer: objects hashed across independent erasure sets.
+
+The analogue of the reference's erasureSets (cmd/erasure-sets.go:51):
+a fixed collection of equal-width erasure sets; each object key routes
+to exactly one set via SipHash-mod under the deployment id
+(cmd/erasure-sets.go:663-701 sipHashMod/getHashedSet), making sets the
+embarrassingly-parallel scale-out axis (SURVEY §2.8.3). Bucket
+operations fan out to every set; listings merge the per-set sorted
+pages.
+"""
+
+from __future__ import annotations
+
+import uuid as uuid_mod
+from typing import Optional, Sequence
+
+from minio_tpu.object.types import (BucketExists, BucketNotEmpty,
+                                    BucketNotFound, ListObjectsInfo)
+from minio_tpu.utils.siphash import sip_hash_mod
+
+
+def merge_list_pages(pages: Sequence[ListObjectsInfo],
+                     max_keys: int) -> ListObjectsInfo:
+    """Merge per-set/per-pool listing pages into one page.
+
+    Each input page is sorted and complete up to its own max_keys, so
+    the first max_keys of the merged key order are fully represented.
+    """
+    items: list[tuple[str, str, object]] = []
+    seen_prefixes: set[str] = set()
+    for page in pages:
+        for o in page.objects:
+            items.append((o.name, "o", o))
+        for p in page.prefixes:
+            if p not in seen_prefixes:
+                seen_prefixes.add(p)
+                items.append((p, "p", p))
+    items.sort(key=lambda it: it[0])
+    out = ListObjectsInfo()
+    truncated_src = any(p.is_truncated for p in pages)
+    count = 0
+    last = ""
+    for name, kind, val in items:
+        if count >= max_keys:
+            out.is_truncated = True
+            break
+        if kind == "o":
+            out.objects.append(val)
+            # Versioned listings carry several entries per key; they
+            # count once per entry, matching S3 max-keys semantics.
+        else:
+            out.prefixes.append(val)
+        count += 1
+        last = name
+    if truncated_src and not out.is_truncated:
+        # A source had more keys beyond its page even though the merged
+        # page fit: stay truncated so the client keeps paginating.
+        out.is_truncated = True
+    out.next_marker = last if out.is_truncated else ""
+    return out
+
+
+class ErasureSets:
+    """Object layer over N erasure sets of one pool."""
+
+    def __init__(self, sets: Sequence, deployment_id: str = ""):
+        self.sets = list(sets)
+        self.deployment_id = deployment_id or str(uuid_mod.uuid4())
+        self._id_bytes = uuid_mod.UUID(self.deployment_id).bytes
+
+    # -- routing -------------------------------------------------------
+
+    def set_index(self, object_: str) -> int:
+        return sip_hash_mod(object_, len(self.sets), self._id_bytes)
+
+    def set_for(self, object_: str):
+        return self.sets[self.set_index(object_)]
+
+    @property
+    def disks(self) -> list:
+        return [d for s in self.sets for d in s.disks]
+
+    def free_space(self) -> int:
+        total = 0
+        for s in self.sets:
+            for d in s.disks:
+                try:
+                    total += d.disk_info().free
+                except Exception:  # noqa: BLE001 - offline drive
+                    pass
+        return total
+
+    # -- buckets (fan out to every set) --------------------------------
+
+    def make_bucket(self, bucket: str) -> None:
+        errs = []
+        for s in self.sets:
+            try:
+                s.make_bucket(bucket)
+            except BucketExists as e:
+                errs.append(e)
+            # quorum failures propagate: partially-created buckets heal
+        if len(errs) == len(self.sets):
+            raise BucketExists(bucket)
+
+    def get_bucket_info(self, bucket: str):
+        last: Exception = BucketNotFound(bucket)
+        for s in self.sets:
+            try:
+                return s.get_bucket_info(bucket)
+            except BucketNotFound as e:
+                last = e
+        raise last
+
+    def list_buckets(self):
+        seen: dict[str, object] = {}
+        for s in self.sets:
+            try:
+                for b in s.list_buckets():
+                    if b.name not in seen or b.created < seen[b.name].created:
+                        seen[b.name] = b
+            except Exception:  # noqa: BLE001 - degraded set tolerated
+                continue
+        return [seen[k] for k in sorted(seen)]
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        # Refuse unless every set's share is empty (unless forced).
+        if not force:
+            for s in self.sets:
+                try:
+                    if s.list_objects(bucket, max_keys=1,
+                                      include_versions=True).objects:
+                        raise BucketNotEmpty(bucket)
+                except BucketNotFound:
+                    continue
+        not_found = 0
+        for s in self.sets:
+            try:
+                s.delete_bucket(bucket, force=force)
+            except BucketNotFound:
+                not_found += 1
+        if not_found == len(self.sets):
+            raise BucketNotFound(bucket)
+
+    # -- bucket metadata (replicated to every set) ---------------------
+
+    def get_bucket_meta(self, bucket: str) -> dict:
+        for s in self.sets:
+            meta = s.get_bucket_meta(bucket)
+            if meta:
+                return meta
+        return {}
+
+    def set_bucket_meta(self, bucket: str, meta: dict) -> None:
+        for s in self.sets:
+            s.set_bucket_meta(bucket, meta)
+
+    def bucket_versioning(self, bucket: str) -> bool:
+        return bool(self.get_bucket_meta(bucket).get("versioning"))
+
+    def set_bucket_versioning(self, bucket: str, enabled: bool) -> None:
+        meta = self.get_bucket_meta(bucket)
+        meta["versioning"] = bool(enabled)
+        self.set_bucket_meta(bucket, meta)
+
+    # -- objects (route by key) ----------------------------------------
+
+    def put_object(self, bucket, object_, data, opts=None):
+        return self.set_for(object_).put_object(bucket, object_, data, opts)
+
+    def get_object(self, bucket, object_, opts=None):
+        return self.set_for(object_).get_object(bucket, object_, opts)
+
+    def get_object_info(self, bucket, object_, opts=None):
+        return self.set_for(object_).get_object_info(bucket, object_, opts)
+
+    def delete_object(self, bucket, object_, opts=None):
+        return self.set_for(object_).delete_object(bucket, object_, opts)
+
+    def list_versions_all(self, bucket, object_):
+        return self.set_for(object_).list_versions_all(bucket, object_)
+
+    # -- multipart (route by key) --------------------------------------
+
+    def new_multipart_upload(self, bucket, object_, opts=None):
+        return self.set_for(object_).new_multipart_upload(bucket, object_,
+                                                          opts)
+
+    def put_object_part(self, bucket, object_, upload_id, part_number, data):
+        return self.set_for(object_).put_object_part(
+            bucket, object_, upload_id, part_number, data)
+
+    def complete_multipart_upload(self, bucket, object_, upload_id, parts):
+        return self.set_for(object_).complete_multipart_upload(
+            bucket, object_, upload_id, parts)
+
+    def abort_multipart_upload(self, bucket, object_, upload_id):
+        return self.set_for(object_).abort_multipart_upload(
+            bucket, object_, upload_id)
+
+    def list_parts(self, bucket, object_, upload_id, part_marker=0,
+                   max_parts=1000):
+        return self.set_for(object_).list_parts(
+            bucket, object_, upload_id, part_marker, max_parts)
+
+    def list_multipart_uploads(self, bucket, prefix=""):
+        out = []
+        for s in self.sets:
+            out.extend(s.list_multipart_uploads(bucket, prefix))
+        out.sort(key=lambda r: (r.get("object", ""), r.get("initiated", 0)))
+        return out
+
+    # -- listing (merge per-set pages) ---------------------------------
+
+    def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
+                     delimiter: str = "", max_keys: int = 1000,
+                     include_versions: bool = False) -> ListObjectsInfo:
+        pages = []
+        found = False
+        for s in self.sets:
+            try:
+                pages.append(s.list_objects(
+                    bucket, prefix=prefix, marker=marker, delimiter=delimiter,
+                    max_keys=max_keys, include_versions=include_versions))
+                found = True
+            except BucketNotFound:
+                continue
+        if not found:
+            raise BucketNotFound(bucket)
+        return merge_list_pages(pages, max_keys)
+
+    # -- healing -------------------------------------------------------
+
+    def heal_object(self, bucket, object_, version_id="", deep=False):
+        return self.set_for(object_).heal_object(bucket, object_,
+                                                 version_id, deep=deep)
+
+    def heal_bucket(self, bucket):
+        out = {"bucket": bucket, "missing": 0, "healed": 0}
+        for s in self.sets:
+            try:
+                r = s.heal_bucket(bucket)
+                out["missing"] += r.get("missing", 0)
+                out["healed"] += r.get("healed", 0)
+            except Exception:  # noqa: BLE001 - set without the bucket
+                continue
+        return out
+
+    def drain_mrf(self, timeout: float = 10.0) -> None:
+        for s in self.sets:
+            if s._mrf is not None:
+                s.mrf.drain(timeout)

@@ -26,12 +26,12 @@ def main(argv=None) -> int:
     ap.add_argument("--ec-backend", choices=["auto", "host", "tpu"],
                     default="auto",
                     help="where the GF(2^8) math runs (tpu = JAX device)")
-    ap.add_argument("drives", nargs="+", help="local drive directories")
+    ap.add_argument("--set-size", type=int, default=None,
+                    help="drives per erasure set (default: auto 2-16)")
+    ap.add_argument("drives", nargs="+",
+                    help="drive dirs; `{1...N}` ellipses expand, and each "
+                         "ellipses argument forms its own server pool")
     args = ap.parse_args(argv)
-
-    if args.parity is not None and not 0 <= args.parity <= len(args.drives) // 2:
-        ap.error(f"--parity must be in [0, {len(args.drives) // 2}] "
-                 f"for {len(args.drives)} drives")
 
     # Boot self-tests: identical math to the reference or refuse to serve.
     from minio_tpu.erasure.selftest import erasure_self_test
@@ -53,14 +53,56 @@ def main(argv=None) -> int:
             backend = None
 
     from minio_tpu.object.erasure_object import ErasureSet
+    from minio_tpu.object.pools import ServerPools
+    from minio_tpu.object.sets import ErasureSets
     from minio_tpu.s3.server import S3Server
-    from minio_tpu.storage.local import LocalStorage
+    from minio_tpu.storage.local import LocalStorage, OfflineDisk
+    from minio_tpu.topology import ellipses, format as fmt_mod
 
-    disks = [LocalStorage(p) for p in args.drives]
-    layer = ErasureSet(disks, parity=args.parity, backend=backend)
+    try:
+        pool_specs = ellipses.parse_pools(args.drives)
+    except ValueError as e:
+        ap.error(str(e))
+    pools = []
+    deployment_id = None
+    n_sets = n_drives = 0
+    for spec in pool_specs:
+        disks = [LocalStorage(p) for p in spec]
+        try:
+            set_size = args.set_size or ellipses.choose_set_size(len(disks))
+        except ValueError as e:
+            ap.error(str(e))
+        if len(disks) % set_size:
+            ap.error(f"{len(disks)} drives not divisible into sets "
+                     f"of {set_size}")
+        if args.parity is not None and not 0 <= args.parity <= set_size // 2:
+            ap.error(f"--parity must be in [0, {set_size // 2}] for "
+                     f"{set_size}-drive sets")
+        try:
+            ordered, fmt = fmt_mod.boot(disks, set_size, deployment_id)
+        except fmt_mod.FormatError as e:
+            print(f"FATAL: format verification failed: {e}", file=sys.stderr)
+            return 1
+        if deployment_id is not None and fmt.deployment_id != deployment_id:
+            # Two unrelated deployments must never be federated
+            # (reference: mixed deployment ids are a fatal boot error).
+            print(f"FATAL: pool {len(pools)} belongs to deployment "
+                  f"{fmt.deployment_id}, expected {deployment_id}",
+                  file=sys.stderr)
+            return 1
+        deployment_id = deployment_id or fmt.deployment_id
+        ordered = [d if d is not None else OfflineDisk(f"pos-{i}")
+                   for i, d in enumerate(ordered)]
+        sets = [ErasureSet(ordered[i:i + set_size], parity=args.parity,
+                           backend=backend)
+                for i in range(0, len(ordered), set_size)]
+        pools.append(ErasureSets(sets, fmt.deployment_id))
+        n_sets += len(sets)
+        n_drives += len(ordered)
+    layer = ServerPools(pools)
     srv = S3Server(layer, address=args.address)
     print(f"minio-tpu serving S3 on {srv.address} "
-          f"({len(disks)} drives, parity={layer.default_parity}, "
+          f"({len(pools)} pools, {n_sets} sets, {n_drives} drives, "
           f"ec-backend={'tpu' if backend else 'host'})", flush=True)
     srv.start()
     try:

@@ -85,6 +85,31 @@ def _is_valid_volname(vol: str) -> bool:
     return bool(vol) and vol not in (".", "..") and "/" not in vol and "\\" not in vol
 
 
+class OfflineDisk:
+    """Placeholder for a format position whose drive is missing/refused.
+
+    Every operation fails with StorageError, which the erasure layer
+    already tolerates up to parity (the reference models this as a nil
+    StorageAPI slot in the set)."""
+
+    def __init__(self, endpoint: str = "offline"):
+        self.endpoint = endpoint
+
+    def is_online(self) -> bool:
+        return False
+
+    def disk_id(self) -> str:
+        return ""
+
+    def read_format(self):
+        return None
+
+    def __getattr__(self, name: str):
+        def fail(*a, **kw):
+            raise StorageError(f"drive offline: {self.endpoint}")
+        return fail
+
+
 class LocalStorage:
     """One local drive. All paths are (volume, object-path) pairs."""
 

@@ -1,0 +1,1 @@
+"""Cluster topology: ellipses expansion, set sizing, format.json identity."""

@@ -1,0 +1,77 @@
+"""Ellipses drive-spec expansion and erasure-set sizing.
+
+The analogue of the reference's endpoint ellipses parsing
+(cmd/endpoint-ellipses.go:48 and internal/config/... `{1...64}` syntax):
+`/data/d{1...16}` expands to 16 drive paths, and the total drive count
+is split into equal erasure sets of 2-16 drives (GCD-style sizing,
+reference setSizes). Each CLI argument group forms one server pool.
+"""
+
+from __future__ import annotations
+
+import re
+
+_ELLIPSES = re.compile(r"\{(\d+)\.\.\.(\d+)\}")
+
+SET_SIZES = tuple(range(2, 17))   # valid erasure set sizes (reference)
+
+
+def has_ellipses(spec: str) -> bool:
+    return bool(_ELLIPSES.search(spec))
+
+
+def expand(spec: str) -> list[str]:
+    """Expand every `{a...b}` range in the spec (cartesian, left-first).
+
+    Numbers keep their zero-padding: `d{01...04}` -> d01..d04.
+    """
+    m = _ELLIPSES.search(spec)
+    if not m:
+        return [spec]
+    lo_s, hi_s = m.group(1), m.group(2)
+    lo, hi = int(lo_s), int(hi_s)
+    if hi < lo:
+        raise ValueError(f"bad ellipses range {m.group(0)} in {spec!r}")
+    width = len(lo_s) if lo_s.startswith("0") else 0
+    out = []
+    for i in range(lo, hi + 1):
+        num = str(i).zfill(width) if width else str(i)
+        out.extend(expand(spec[:m.start()] + num + spec[m.end():]))
+    return out
+
+
+def choose_set_size(count: int) -> int:
+    """Largest valid set size (2-16) that divides the drive count
+    (reference possibleSetCounts/commonSetDriveCount shape). A single
+    drive is the degenerate 1-drive single set."""
+    if count == 1:
+        return 1
+    for size in sorted(SET_SIZES, reverse=True):
+        if count % size == 0:
+            return size
+    raise ValueError(
+        f"cannot split {count} drives into sets of 2-16; "
+        f"use a drive count divisible by a number in 2..16")
+
+
+def split_sets(drives: list[str], set_size: int | None = None) -> list[list[str]]:
+    size = set_size or choose_set_size(len(drives))
+    return [drives[i:i + size] for i in range(0, len(drives), size)]
+
+
+def parse_pools(args: list[str]) -> list[list[str]]:
+    """CLI drive args -> pools of drive paths.
+
+    Mirrors the reference server CLI: every ellipses argument is its own
+    pool; all plain (non-ellipses) arguments together form one pool.
+    """
+    pools: list[list[str]] = []
+    plain: list[str] = []
+    for a in args:
+        if has_ellipses(a):
+            pools.append(expand(a))
+        else:
+            plain.append(a)
+    if plain:
+        pools.append(plain)
+    return pools

@@ -1,0 +1,163 @@
+"""format.json: drive identity and set layout, quorum-verified at boot.
+
+The analogue of the reference's formatErasureV3
+(cmd/format-erasure.go:112-126, cmd/prepare-storage.go): every drive
+stores the deployment id, the full sets layout (a matrix of drive
+UUIDs), and its own UUID ("this"). At boot the layouts are
+quorum-compared, drives are re-ordered into their format positions (so
+shuffled CLI arguments or fstab reordering cannot scramble shard
+placement), fresh drives are initialized in place of their missing
+UUIDs, and a drive carrying a foreign identity is refused.
+"""
+
+from __future__ import annotations
+
+import uuid as uuid_mod
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+FORMAT_VERSION = "1"
+FORMAT_BACKEND = "xl"
+XL_VERSION = "3"
+DIST_ALGO = "SIPMOD+PARITY"
+
+
+class FormatError(Exception):
+    pass
+
+
+@dataclass
+class FormatInfo:
+    deployment_id: str
+    sets: list[list[str]]      # sets x drives-per-set of drive UUIDs
+    this: str                  # this drive's UUID
+
+    def to_json(self) -> dict:
+        return {
+            "version": FORMAT_VERSION,
+            "format": FORMAT_BACKEND,
+            "id": self.deployment_id,
+            "xl": {
+                "version": XL_VERSION,
+                "this": self.this,
+                "sets": self.sets,
+                "distributionAlgo": DIST_ALGO,
+            },
+        }
+
+    @classmethod
+    def from_json(cls, m: dict) -> "FormatInfo":
+        try:
+            if m["format"] != FORMAT_BACKEND or m["version"] != FORMAT_VERSION:
+                raise FormatError(f"unsupported format {m.get('format')!r}")
+            xl = m["xl"]
+            return cls(deployment_id=m["id"], sets=[list(s) for s in xl["sets"]],
+                       this=xl["this"])
+        except (KeyError, TypeError) as e:
+            raise FormatError(f"malformed format.json: {e}") from None
+
+
+def init_formats(disks: Sequence, set_size: int,
+                 deployment_id: Optional[str] = None) -> list[FormatInfo]:
+    """First boot: assign fresh UUIDs and write format.json everywhere."""
+    n = len(disks)
+    if n % set_size:
+        raise FormatError(f"{n} drives not divisible into sets of {set_size}")
+    deployment_id = deployment_id or str(uuid_mod.uuid4())
+    uuids = [str(uuid_mod.uuid4()) for _ in range(n)]
+    sets = [uuids[i:i + set_size] for i in range(0, n, set_size)]
+    fmts = []
+    for i, d in enumerate(disks):
+        fmt = FormatInfo(deployment_id=deployment_id, sets=sets, this=uuids[i])
+        d.write_format(fmt.to_json())
+        fmts.append(fmt)
+    return fmts
+
+
+def load_and_order(disks: Sequence, set_size: int) -> tuple[list, FormatInfo]:
+    """Boot an existing/partial layout: quorum-verify and order drives.
+
+    Returns (ordered_disks, reference_format) where ordered_disks[i] is
+    the drive whose UUID occupies position i of the flattened sets
+    layout (None for positions whose drive is missing/offline). Fresh
+    (formatless) drives are healed into missing positions with a new
+    format.json carrying the expected UUID (reference: formatErasureFixV3
+    / initFormatErasure healing). Drives whose format disagrees with the
+    quorum layout are refused (left out as None).
+
+    Raises FormatError when no quorum layout exists AND some drive has a
+    format (a half-wiped cluster must not be silently re-initialized) —
+    callers fall back to init_formats only when every drive is fresh.
+    """
+    read: list[Optional[FormatInfo]] = []
+    for d in disks:
+        try:
+            raw = d.read_format()
+            read.append(FormatInfo.from_json(raw) if raw else None)
+        except (FormatError, OSError, ValueError):
+            # Corrupt/unreadable format: the drive is treated as absent
+            # for quorum purposes, never crashes the whole boot.
+            read.append(None)
+
+    if all(f is None for f in read):
+        raise FormatError("all drives are fresh (no format.json)")
+
+    # Quorum on (deployment id, layout).
+    votes: dict[tuple, int] = {}
+    for f in read:
+        if f is not None:
+            key = (f.deployment_id, tuple(tuple(s) for s in f.sets))
+            votes[key] = votes.get(key, 0) + 1
+    (dep_id, layout), count = max(votes.items(), key=lambda kv: kv[1])
+    if count < len(disks) // 2 + 1:
+        raise FormatError(
+            f"no format quorum: best layout has {count}/{len(disks)} votes")
+    flat = [u for s in layout for u in s]
+    if len(flat) != len(disks):
+        raise FormatError(
+            f"layout describes {len(flat)} drives, {len(disks)} given")
+    if any(len(s) != set_size for s in layout):
+        raise FormatError("layout set size disagrees with requested topology")
+
+    ref = FormatInfo(deployment_id=dep_id,
+                     sets=[list(s) for s in layout], this="")
+    by_uuid = {}
+    fresh = []
+    for d, f in zip(disks, read):
+        if f is None:
+            fresh.append(d)
+        elif (f.deployment_id, tuple(tuple(s) for s in f.sets)) == (dep_id, layout):
+            by_uuid[f.this] = d
+        # else: foreign/odd-format drive — refused, never written to.
+
+    ordered: list = []
+    for pos, u in enumerate(flat):
+        d = by_uuid.get(u)
+        if d is None and fresh:
+            # Heal a fresh drive into this missing position.
+            d = fresh.pop(0)
+            fmt = FormatInfo(deployment_id=dep_id,
+                             sets=[list(s) for s in layout], this=u)
+            try:
+                d.write_format(fmt.to_json())
+            except OSError:
+                d = None
+        ordered.append(d)
+    return ordered, ref
+
+
+def _safe_read(d) -> Optional[dict]:
+    try:
+        return d.read_format()
+    except (OSError, ValueError):
+        return None
+
+
+def boot(disks: Sequence, set_size: int,
+         deployment_id: Optional[str] = None) -> tuple[list, FormatInfo]:
+    """init_formats on a fully-fresh layout, load_and_order otherwise."""
+    if all(_safe_read(d) is None for d in disks):
+        fmts = init_formats(disks, set_size, deployment_id)
+        return list(disks), FormatInfo(
+            deployment_id=fmts[0].deployment_id, sets=fmts[0].sets, this="")
+    return load_and_order(disks, set_size)
