@@ -1,0 +1,14 @@
+"""grid: the node-to-node RPC mesh (distributed runtime backbone).
+
+The analogue of the reference's internal/grid (one muxed websocket per
+server pair carrying typed RPC + streams, internal/grid/README.md):
+here one TCP connection per node pair carries length-prefixed msgpack
+frames, multiplexing unary calls and streaming responses, with
+auto-reconnect. Small hot calls (metadata, locks) and bulk shard bytes
+share the connection; frames are bounded so bulk transfers cannot
+starve lock traffic.
+"""
+
+from minio_tpu.grid.wire import GridError, RemoteCallError  # noqa: F401
+from minio_tpu.grid.client import GridClient, client_for  # noqa: F401
+from minio_tpu.grid.server import GridServer  # noqa: F401

@@ -1,0 +1,183 @@
+"""Grid client: one muxed connection per remote node.
+
+The analogue of the reference's grid.Connection / muxClient
+(internal/grid/connection.go, muxclient.go): all calls from this
+process to one peer share a single TCP connection; a background reader
+demultiplexes responses to per-call queues. Connection loss fails all
+in-flight calls (the storage layer treats that as a per-drive fault and
+its quorum logic absorbs it) and the next call reconnects.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import socket
+import threading
+from typing import Iterator, Optional
+
+from minio_tpu.grid import wire
+from minio_tpu.grid.wire import GridError, RemoteCallError
+
+_SENTINEL_ERR = "__conn_lost__"
+
+
+class GridClient:
+    def __init__(self, host: str, port: int, connect_timeout: float = 5.0,
+                 call_timeout: float = 60.0):
+        self.host = host
+        self.port = port
+        self.connect_timeout = connect_timeout
+        self.call_timeout = call_timeout
+        self._sock: Optional[socket.socket] = None
+        self._mu = threading.Lock()          # guards connect + write + maps
+        self._mux = itertools.count(1)
+        self._pending: dict[int, "queue.Queue[dict]"] = {}
+        self._reader: Optional[threading.Thread] = None
+
+    # -- connection management -----------------------------------------
+
+    def _connect_locked(self) -> None:
+        if self._sock is not None:
+            return
+        try:
+            s = socket.create_connection((self.host, self.port),
+                                         timeout=self.connect_timeout)
+        except OSError as e:
+            raise GridError(f"connect {self.host}:{self.port}: {e}") from None
+        s.settimeout(None)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = s
+        self._reader = threading.Thread(target=self._read_loop, args=(s,),
+                                        daemon=True)
+        self._reader.start()
+
+    def _drop_conn(self, s) -> None:
+        with self._mu:
+            if self._sock is s:
+                self._sock = None
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for q in pending:
+            q.put({"t": wire.T_ERR, "e": _SENTINEL_ERR, "msg": "conn lost"})
+        try:
+            s.close()
+        except OSError:
+            pass
+
+    def _read_loop(self, s) -> None:
+        try:
+            while True:
+                msg = wire.read_frame(s)
+                t = msg.get("t")
+                if t == wire.T_PING:
+                    with self._mu:
+                        if self._sock is s:
+                            s.sendall(wire.pack_frame({"t": wire.T_PONG}))
+                    continue
+                if t == wire.T_PONG:
+                    continue
+                q = self._pending.get(msg.get("m"))
+                if q is not None:
+                    q.put(msg)
+        except (GridError, OSError):
+            self._drop_conn(s)
+
+    def close(self) -> None:
+        with self._mu:
+            s, self._sock = self._sock, None
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    # -- calls ---------------------------------------------------------
+
+    def _send(self, msg: dict, mux: int, q) -> None:
+        with self._mu:
+            self._connect_locked()
+            self._pending[mux] = q
+            s = self._sock
+            try:
+                s.sendall(wire.pack_frame(msg))
+            except OSError as e:
+                self._pending.pop(mux, None)
+                self._sock = None
+                raise GridError(f"send to {self.host}:{self.port}: {e}") \
+                    from None
+
+    def _finish(self, mux: int) -> None:
+        with self._mu:
+            self._pending.pop(mux, None)
+
+    def call(self, handler: str, payload=None,
+             timeout: Optional[float] = None):
+        """Unary call; raises RemoteCallError with the remote's code."""
+        mux = next(self._mux)
+        q: "queue.Queue[dict]" = queue.Queue()
+        self._send({"t": wire.T_REQ, "m": mux, "h": handler, "p": payload},
+                   mux, q)
+        try:
+            try:
+                msg = q.get(timeout=timeout or self.call_timeout)
+            except queue.Empty:
+                raise GridError(
+                    f"call {handler} to {self.host}:{self.port} timed out") \
+                    from None
+            if msg["t"] == wire.T_RESP:
+                return msg.get("p")
+            code = msg.get("e", "Internal")
+            if code == _SENTINEL_ERR:
+                raise GridError("connection lost mid-call")
+            raise RemoteCallError(code, msg.get("msg", ""))
+        finally:
+            self._finish(mux)
+
+    def stream(self, handler: str, payload=None,
+               timeout: Optional[float] = None) -> Iterator:
+        """Streaming call: yields items until EOF. Raises on error."""
+        mux = next(self._mux)
+        q: "queue.Queue[dict]" = queue.Queue()
+        self._send({"t": wire.T_SREQ, "m": mux, "h": handler, "p": payload},
+                   mux, q)
+        try:
+            while True:
+                try:
+                    msg = q.get(timeout=timeout or self.call_timeout)
+                except queue.Empty:
+                    raise GridError(f"stream {handler} timed out") from None
+                t = msg["t"]
+                if t == wire.T_CHUNK:
+                    yield msg.get("p")
+                elif t == wire.T_EOF:
+                    return
+                else:
+                    code = msg.get("e", "Internal")
+                    if code == _SENTINEL_ERR:
+                        raise GridError("connection lost mid-stream")
+                    raise RemoteCallError(code, msg.get("msg", ""))
+        finally:
+            self._finish(mux)
+
+    def ping(self, timeout: float = 2.0) -> bool:
+        try:
+            self.call("grid.ping", None, timeout=timeout)
+            return True
+        except GridError:
+            return False
+
+
+# One client per peer address, shared process-wide (the reference's
+# "single connection per node pair").
+_clients: dict[tuple[str, int], GridClient] = {}
+_clients_mu = threading.Lock()
+
+
+def client_for(host: str, port: int) -> GridClient:
+    key = (host, port)
+    with _clients_mu:
+        c = _clients.get(key)
+        if c is None:
+            c = _clients[key] = GridClient(host, port)
+        return c

@@ -1,0 +1,260 @@
+"""dsync: distributed reader/writer quorum locks.
+
+The analogue of the reference's internal/dsync: a DRWMutex acquires the
+lock on every node's lock server and succeeds iff a quorum granted it
+(write quorum n//2+1, read quorum max(1, n//2) —
+internal/dsync/drwmutex.go:218-234); held locks refresh continuously
+and a refresh-quorum loss invokes the loss callback
+(drwmutex.go:256-300). Each node runs a LockServer (the reference's
+localLocker, cmd/local-locker.go:63) with TTL-expiring entries so locks
+held by a crashed node free themselves.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+import uuid as uuid_mod
+from contextlib import contextmanager
+from typing import Callable, Optional, Sequence
+
+from minio_tpu.grid.client import GridClient
+from minio_tpu.grid.wire import GridError
+from minio_tpu.object.nslock import LockTimeout
+
+LOCK_TTL = 30.0
+REFRESH_INTERVAL = 8.0
+
+
+class LockServer:
+    """Per-node lock table with TTL expiry."""
+
+    def __init__(self, ttl: float = LOCK_TTL):
+        self.ttl = ttl
+        self._mu = threading.Lock()
+        # resource -> {"writer": uid|None, "wexp": ts,
+        #              "readers": {uid: expiry}}
+        self._res: dict[str, dict] = {}
+
+    def _entry(self, resource: str) -> dict:
+        e = self._res.get(resource)
+        if e is None:
+            e = self._res[resource] = {"writer": None, "wexp": 0.0,
+                                       "readers": {}}
+        return e
+
+    def _expire(self, e: dict, now: float) -> None:
+        if e["writer"] is not None and e["wexp"] < now:
+            e["writer"] = None
+        e["readers"] = {u: x for u, x in e["readers"].items() if x >= now}
+
+    def try_lock(self, resource: str, uid: str, write: bool) -> bool:
+        now = time.monotonic()
+        with self._mu:
+            e = self._entry(resource)
+            self._expire(e, now)
+            if write:
+                if (e["writer"] in (None, uid)) and not e["readers"]:
+                    e["writer"] = uid
+                    e["wexp"] = now + self.ttl
+                    return True
+                return False
+            if e["writer"] is None:
+                e["readers"][uid] = now + self.ttl
+                return True
+            return False
+
+    def unlock(self, resource: str, uid: str, write: bool) -> bool:
+        with self._mu:
+            e = self._res.get(resource)
+            if e is None:
+                return False
+            if write and e["writer"] == uid:
+                e["writer"] = None
+            else:
+                e["readers"].pop(uid, None)
+            if e["writer"] is None and not e["readers"]:
+                self._res.pop(resource, None)
+            return True
+
+    def refresh(self, resource: str, uid: str, write: bool) -> bool:
+        now = time.monotonic()
+        with self._mu:
+            e = self._res.get(resource)
+            if e is None:
+                return False
+            self._expire(e, now)
+            if write:
+                if e["writer"] != uid:
+                    return False
+                e["wexp"] = now + self.ttl
+                return True
+            if uid not in e["readers"]:
+                return False
+            e["readers"][uid] = now + self.ttl
+            return True
+
+    # expose over the grid ---------------------------------------------
+
+    def register_into(self, srv) -> None:
+        srv.register("lock.try", lambda p: self.try_lock(p["r"], p["u"],
+                                                         p["w"]))
+        srv.register("lock.unlock", lambda p: self.unlock(p["r"], p["u"],
+                                                          p["w"]))
+        srv.register("lock.refresh", lambda p: self.refresh(p["r"], p["u"],
+                                                            p["w"]))
+
+
+class LocalLocker:
+    """In-process locker for this node's own LockServer (the reference's
+    local fast path, cmd/namespace-lock.go localLockInstance)."""
+
+    def __init__(self, server: LockServer):
+        self.server = server
+
+    def try_lock(self, resource, uid, write) -> bool:
+        return self.server.try_lock(resource, uid, write)
+
+    def unlock(self, resource, uid, write) -> bool:
+        return self.server.unlock(resource, uid, write)
+
+    def refresh(self, resource, uid, write) -> bool:
+        return self.server.refresh(resource, uid, write)
+
+
+class RemoteLocker:
+    """Locker on a peer node, reached over the grid."""
+
+    def __init__(self, client: GridClient):
+        self.client = client
+
+    def _call(self, op: str, resource: str, uid: str, write: bool) -> bool:
+        try:
+            return bool(self.client.call(
+                f"lock.{op}", {"r": resource, "u": uid, "w": write},
+                timeout=5.0))
+        except GridError:
+            return False
+
+    def try_lock(self, resource, uid, write) -> bool:
+        return self._call("try", resource, uid, write)
+
+    def unlock(self, resource, uid, write) -> bool:
+        return self._call("unlock", resource, uid, write)
+
+    def refresh(self, resource, uid, write) -> bool:
+        return self._call("refresh", resource, uid, write)
+
+
+class DRWMutex:
+    """Quorum RW lock over a set of lockers."""
+
+    def __init__(self, lockers: Sequence, resource: str,
+                 on_lost: Optional[Callable[[], None]] = None):
+        self.lockers = list(lockers)
+        self.resource = resource
+        self.on_lost = on_lost
+        self.uid = str(uuid_mod.uuid4())
+        self._write = False
+        self._held = False
+        self._stop_refresh = threading.Event()
+        self._refresher: Optional[threading.Thread] = None
+
+    def _quorum(self, write: bool) -> int:
+        n = len(self.lockers)
+        return n // 2 + 1 if write else max(1, n // 2)
+
+    def _fanout(self, op: str, write: bool) -> int:
+        ok = 0
+        threads = []
+        results = [False] * len(self.lockers)
+
+        def run(i, lk):
+            try:
+                results[i] = getattr(lk, op)(self.resource, self.uid, write)
+            except Exception:  # noqa: BLE001 - dead locker == vote lost
+                results[i] = False
+        for i, lk in enumerate(self.lockers):
+            t = threading.Thread(target=run, args=(i, lk), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=6.0)
+        return sum(results)
+
+    def lock(self, write: bool = True, timeout: float = 60.0) -> bool:
+        deadline = time.monotonic() + timeout
+        quorum = self._quorum(write)
+        while True:
+            got = self._fanout("try_lock", write)
+            if got >= quorum:
+                self._write = write
+                self._held = True
+                self._start_refresh()
+                return True
+            # Failed round: release any partial grants, back off, retry
+            # (reference: releaseAll + retry loop, drwmutex.go:218).
+            self._fanout("unlock", write)
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(random.uniform(0.02, 0.1))
+
+    def unlock(self) -> None:
+        if not self._held:
+            return
+        self._held = False
+        self._stop_refresh.set()
+        if self._refresher is not None:
+            self._refresher.join(timeout=1.0)
+        self._fanout("unlock", self._write)
+
+    def _start_refresh(self) -> None:
+        self._stop_refresh.clear()
+        self._refresher = threading.Thread(target=self._refresh_loop,
+                                           daemon=True)
+        self._refresher.start()
+
+    def _refresh_loop(self) -> None:
+        quorum = self._quorum(self._write)
+        while not self._stop_refresh.wait(REFRESH_INTERVAL):
+            if self._fanout("refresh", self._write) < quorum:
+                # Quorum lost (network partition, peer restarts): the
+                # holder must stop trusting its lock (reference loss
+                # callback cancels the op's context).
+                self._held = False
+                if self.on_lost is not None:
+                    try:
+                        self.on_lost()
+                    except Exception:  # noqa: BLE001
+                        pass
+                return
+
+
+class DistNSLock:
+    """Namespace-lock interface (see object/nslock.NSLockMap) backed by
+    dsync quorum locks — drop-in for ErasureSet.ns in distributed mode
+    (reference: distLockInstance, cmd/namespace-lock.go:157)."""
+
+    def __init__(self, lockers: Sequence):
+        self.lockers = list(lockers)
+
+    @contextmanager
+    def write(self, volume: str, path: str, timeout: float = 60.0):
+        m = DRWMutex(self.lockers, f"{volume}/{path}")
+        if not m.lock(write=True, timeout=timeout):
+            raise LockTimeout(f"dist write lock {volume}/{path}")
+        try:
+            yield
+        finally:
+            m.unlock()
+
+    @contextmanager
+    def read(self, volume: str, path: str, timeout: float = 60.0):
+        m = DRWMutex(self.lockers, f"{volume}/{path}")
+        if not m.lock(write=False, timeout=timeout):
+            raise LockTimeout(f"dist read lock {volume}/{path}")
+        try:
+            yield
+        finally:
+            m.unlock()

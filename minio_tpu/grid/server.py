@@ -1,0 +1,164 @@
+"""Grid server: accepts peer connections, dispatches registered handlers.
+
+The analogue of the reference's grid handler registry + muxServer
+(internal/grid/handlers.go:42-101, muxserver.go). Unary handlers return
+a msgpack-able payload; stream handlers are generators whose items are
+sent as chunk frames. Handler exceptions map to wire error codes via
+the registered exception table, so the remote client re-raises the
+same storage exception types the local path would see.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional
+
+from minio_tpu.grid import wire
+
+# exception class -> wire code (extended by storage/remote.py, dsync).
+ERROR_CODES: dict[type, str] = {}
+
+
+def register_error(exc_type: type, code: str) -> None:
+    ERROR_CODES[exc_type] = code
+
+
+def _code_for(e: Exception) -> str:
+    for t in type(e).__mro__:
+        if t in ERROR_CODES:
+            return ERROR_CODES[t]
+    return "Internal"
+
+
+class GridServer:
+    def __init__(self, port: int, host: str = "0.0.0.0",
+                 max_workers: int = 32):
+        self.host = host
+        self.port = port
+        self._handlers: dict[str, Callable] = {}
+        self._streams: dict[str, Callable] = {}
+        self._pool = ThreadPoolExecutor(max_workers=max_workers)
+        self._sock: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._stopping = threading.Event()
+        self._conns: set = set()
+        self.register("grid.ping", lambda p: "pong")
+
+    def register(self, name: str, fn: Callable) -> None:
+        self._handlers[name] = fn
+
+    def register_stream(self, name: str, fn: Callable) -> None:
+        self._streams[name] = fn
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((self.host, self.port))
+        s.listen(64)
+        self._sock = s
+        if self.port == 0:
+            self.port = s.getsockname()[1]
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    def stop(self) -> None:
+        self._stopping.set()
+        if self._sock is not None:
+            try:
+                # shutdown() wakes the thread blocked in accept(); a bare
+                # close() would leave the fd (and the LISTEN socket) alive
+                # until accept returned.
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        for conn in list(self._conns):
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._pool.shutdown(wait=False)
+
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns.add(conn)
+            threading.Thread(target=self._conn_loop, args=(conn,),
+                             daemon=True).start()
+
+    # -- per-connection ------------------------------------------------
+
+    def _conn_loop(self, conn: socket.socket) -> None:
+        wlock = threading.Lock()
+
+        def send(msg: dict) -> None:
+            blob = wire.pack_frame(msg)
+            with wlock:
+                conn.sendall(blob)
+
+        try:
+            while True:
+                msg = wire.read_frame(conn)
+                t = msg.get("t")
+                if t == wire.T_PING:
+                    send({"t": wire.T_PONG})
+                elif t == wire.T_REQ:
+                    self._pool.submit(self._run_unary, send, msg)
+                elif t == wire.T_SREQ:
+                    self._pool.submit(self._run_stream, send, msg)
+        except (wire.GridError, OSError, RuntimeError):
+            # RuntimeError: pool shut down mid-frame during server stop.
+            pass
+        finally:
+            self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _run_unary(self, send, msg: dict) -> None:
+        mux = msg.get("m")
+        fn = self._handlers.get(msg.get("h", ""))
+        try:
+            if fn is None:
+                send({"t": wire.T_ERR, "m": mux, "e": "NoSuchHandler",
+                      "msg": str(msg.get("h"))})
+                return
+            out = fn(msg.get("p"))
+            send({"t": wire.T_RESP, "m": mux, "p": out})
+        except Exception as e:  # noqa: BLE001 - mapped onto the wire
+            try:
+                send({"t": wire.T_ERR, "m": mux, "e": _code_for(e),
+                      "msg": str(e)[:512]})
+            except OSError:
+                pass
+
+    def _run_stream(self, send, msg: dict) -> None:
+        mux = msg.get("m")
+        fn = self._streams.get(msg.get("h", ""))
+        try:
+            if fn is None:
+                send({"t": wire.T_ERR, "m": mux, "e": "NoSuchHandler",
+                      "msg": str(msg.get("h"))})
+                return
+            for item in fn(msg.get("p")):
+                send({"t": wire.T_CHUNK, "m": mux, "p": item})
+            send({"t": wire.T_EOF, "m": mux})
+        except Exception as e:  # noqa: BLE001 - mapped onto the wire
+            try:
+                send({"t": wire.T_ERR, "m": mux, "e": _code_for(e),
+                      "msg": str(e)[:512]})
+            except OSError:
+                pass

@@ -1,0 +1,77 @@
+"""Wire framing for the grid mesh.
+
+Frame = 4-byte big-endian length + one msgpack map:
+
+    {"t": TYPE, "m": mux_id, ...}
+
+      T_REQ    {"h": handler, "p": payload}      unary call
+      T_RESP   {"p": payload}                    unary result
+      T_ERR    {"e": code, "msg": str}           call failed
+      T_SREQ   {"h": handler, "p": payload}      open a response stream
+      T_CHUNK  {"p": item}                       one stream item
+      T_EOF    {}                                stream end
+      T_PING / T_PONG                            keepalive
+
+Payloads are anything msgpack can carry (maps/lists/bytes/str/ints).
+The reference's split between grid RPC (small hot calls) and HTTP
+streams (bulk bytes) maps onto T_REQ vs T_SREQ/T_CHUNK on the same
+multiplexed connection (internal/grid/README.md; the frame cap keeps
+bulk chunks from head-of-line-blocking lock traffic).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+import msgpack
+
+T_REQ = 0
+T_RESP = 1
+T_ERR = 2
+T_SREQ = 3
+T_CHUNK = 4
+T_EOF = 5
+T_PING = 6
+T_PONG = 7
+
+# A single frame never exceeds this; callers chunk larger payloads.
+MAX_FRAME = 32 << 20
+_LEN = struct.Struct(">I")
+
+
+class GridError(Exception):
+    """Transport-level failure (connect, frame, timeout)."""
+
+
+class RemoteCallError(GridError):
+    """The remote handler raised; `code` maps back to a local exception."""
+
+    def __init__(self, code: str, msg: str = ""):
+        self.code = code
+        super().__init__(f"{code}: {msg}" if msg else code)
+
+
+def pack_frame(msg: dict) -> bytes:
+    blob = msgpack.packb(msg, use_bin_type=True)
+    if len(blob) > MAX_FRAME:
+        raise GridError(f"frame too large: {len(blob)} bytes")
+    return _LEN.pack(len(blob)) + blob
+
+
+def read_exact(sock, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        got = sock.recv(n - len(buf))
+        if not got:
+            raise GridError("connection closed")
+        buf += got
+    return bytes(buf)
+
+
+def read_frame(sock) -> dict:
+    (length,) = _LEN.unpack(read_exact(sock, 4))
+    if length > MAX_FRAME:
+        raise GridError(f"oversized frame: {length}")
+    return msgpack.unpackb(read_exact(sock, length), raw=False,
+                           strict_map_key=False)

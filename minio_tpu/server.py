@@ -1,12 +1,20 @@
-"""`python -m minio_tpu.server` — boot a single-node S3 server.
+"""`python -m minio_tpu.server` — boot a (possibly distributed) S3 server.
 
 The analogue of the reference's serverMain (cmd/server-main.go:746):
 run the boot self-tests (hard-fail on wrong math, like the reference's
-erasure/bitrot self-tests at :799-803), build the erasure set over the
-drive paths, and serve the S3 API.
+erasure/bitrot self-tests at :799-803), bring up the grid mesh when the
+topology spans nodes (initGlobalGrid, :882-889), quorum-verify
+format.json, build pools/sets over local + remote drives, and serve the
+S3 API.
 
-Usage:
-    python -m minio_tpu.server --address 127.0.0.1:9000 /data/d1 /data/d2 ...
+Usage (single node):
+    python -m minio_tpu.server --address 127.0.0.1:9000 /data/d{1...4}
+
+Distributed (run the SAME command on every node; endpoints owned by
+other nodes are reached over the grid on port+1000):
+    python -m minio_tpu.server --address 127.0.0.1:9001 \\
+        http://127.0.0.1:9001/data/n1/d{1...2} \\
+        http://127.0.0.1:9002/data/n2/d{1...2}
 
 Credentials come from MTPU_ROOT_USER / MTPU_ROOT_PASSWORD
 (default minioadmin/minioadmin).
@@ -15,7 +23,11 @@ Credentials come from MTPU_ROOT_USER / MTPU_ROOT_PASSWORD
 from __future__ import annotations
 
 import argparse
+import socket as socket_mod
 import sys
+import time
+
+GRID_PORT_OFFSET = 1000
 
 
 def main(argv=None) -> int:
@@ -28,9 +40,12 @@ def main(argv=None) -> int:
                     help="where the GF(2^8) math runs (tpu = JAX device)")
     ap.add_argument("--set-size", type=int, default=None,
                     help="drives per erasure set (default: auto 2-16)")
+    ap.add_argument("--boot-timeout", type=float, default=120.0,
+                    help="seconds to wait for peer nodes at boot")
     ap.add_argument("drives", nargs="+",
-                    help="drive dirs; `{1...N}` ellipses expand, and each "
-                         "ellipses argument forms its own server pool")
+                    help="drive dirs or http://host:port/path endpoints; "
+                         "`{1...N}` ellipses expand, and each ellipses "
+                         "argument forms its own server pool")
     args = ap.parse_args(argv)
 
     # Boot self-tests: identical math to the reference or refuse to serve.
@@ -57,17 +72,82 @@ def main(argv=None) -> int:
     from minio_tpu.object.sets import ErasureSets
     from minio_tpu.s3.server import S3Server
     from minio_tpu.storage.local import LocalStorage, OfflineDisk
+    from minio_tpu.storage.remote import RemoteStorage, StorageRPCService
     from minio_tpu.topology import ellipses, format as fmt_mod
+
+    my_host, _, my_port_s = args.address.rpartition(":")
+    my_host = my_host or "0.0.0.0"
+    my_port = int(my_port_s)
+    local_hosts = {"127.0.0.1", "localhost", "0.0.0.0", my_host,
+                   socket_mod.gethostname()}
+
+    def is_local(ep: ellipses.Endpoint) -> bool:
+        return ep.host is None or (ep.port == my_port
+                                   and ep.host in local_hosts)
 
     try:
         pool_specs = ellipses.parse_pools(args.drives)
+        pool_eps = [[ellipses.parse_endpoint(s) for s in spec]
+                    for spec in pool_specs]
     except ValueError as e:
         ap.error(str(e))
+
+    all_eps = [ep for spec in pool_eps for ep in spec]
+    remote_nodes = sorted({(ep.host, ep.port) for ep in all_eps
+                           if not is_local(ep)})
+    distributed = bool(remote_nodes)
+
+    # -- grid mesh up BEFORE the object layer (reference: initGlobalGrid
+    #    precedes newObjectLayer, cmd/server-main.go:882-942) ----------
+    local_disks: dict[str, LocalStorage] = {}
+    for ep in all_eps:
+        if is_local(ep):
+            local_disks[ep.path] = LocalStorage(
+                ep.path, endpoint=str(ep) if ep.is_url else "")
+
+    grid_srv = None
+    lockers = []
+    if distributed:
+        from minio_tpu.grid import GridServer, client_for
+        from minio_tpu.grid.dsync import (DistNSLock, LocalLocker,
+                                          LockServer, RemoteLocker)
+        grid_srv = GridServer(my_port + GRID_PORT_OFFSET)
+        StorageRPCService(local_disks).register_into(grid_srv)
+        lock_server = LockServer()
+        lock_server.register_into(grid_srv)
+        node_info = {"deployment_id": ""}
+        grid_srv.register("node.info", lambda p: dict(node_info))
+        grid_srv.start()
+        print(f"grid mesh on :{grid_srv.port} "
+              f"({len(local_disks)} local drives)", flush=True)
+
+        # Wait for every peer's grid before touching formats (the
+        # reference's bootstrap handshake, cmd/bootstrap-peer-server.go).
+        deadline = time.monotonic() + args.boot_timeout
+        for host, port in remote_nodes:
+            c = client_for(host, port + GRID_PORT_OFFSET)
+            while not c.ping(timeout=2.0):
+                if time.monotonic() > deadline:
+                    print(f"WARN: peer {host}:{port} unreachable; its "
+                          f"drives boot offline", file=sys.stderr)
+                    break
+                time.sleep(0.5)
+
+        lockers = [LocalLocker(lock_server)] + [
+            RemoteLocker(client_for(h, p + GRID_PORT_OFFSET))
+            for h, p in remote_nodes]
+
+    def make_disk(ep: ellipses.Endpoint):
+        if is_local(ep):
+            return local_disks[ep.path]
+        return RemoteStorage(ep.host, ep.port + GRID_PORT_OFFSET, ep.path)
+
+    # -- format boot + object layer ------------------------------------
     pools = []
     deployment_id = None
     n_sets = n_drives = 0
-    for spec in pool_specs:
-        disks = [LocalStorage(p) for p in spec]
+    for spec in pool_eps:
+        disks = [make_disk(ep) for ep in spec]
         try:
             set_size = args.set_size or ellipses.choose_set_size(len(disks))
         except ValueError as e:
@@ -78,11 +158,30 @@ def main(argv=None) -> int:
         if args.parity is not None and not 0 <= args.parity <= set_size // 2:
             ap.error(f"--parity must be in [0, {set_size // 2}] for "
                      f"{set_size}-drive sets")
-        try:
-            ordered, fmt = fmt_mod.boot(disks, set_size, deployment_id)
-        except fmt_mod.FormatError as e:
-            print(f"FATAL: format verification failed: {e}", file=sys.stderr)
-            return 1
+
+        # Only the node owning the pool's first endpoint initializes a
+        # fresh format; everyone else waits for it to appear (reference:
+        # prepare-storage leader init + waitForFormatErasure).
+        if distributed and not is_local(spec[0]):
+            deadline = time.monotonic() + args.boot_timeout
+            while all(fmt_mod._safe_read(d) is None for d in disks):
+                if time.monotonic() > deadline:
+                    break
+                time.sleep(0.5)
+        attempts = 5 if distributed else 1
+        ordered = fmt = None
+        for attempt in range(attempts):
+            try:
+                ordered, fmt = fmt_mod.boot(disks, set_size, deployment_id)
+                break
+            except fmt_mod.FormatError as e:
+                # Distributed boot race: the leader may still be writing
+                # formats; retry before declaring the layout broken.
+                if attempt == attempts - 1:
+                    print(f"FATAL: format verification failed: {e}",
+                          file=sys.stderr)
+                    return 1
+                time.sleep(2.0)
         if deployment_id is not None and fmt.deployment_id != deployment_id:
             # Two unrelated deployments must never be federated
             # (reference: mixed deployment ids are a fatal boot error).
@@ -96,21 +195,45 @@ def main(argv=None) -> int:
         sets = [ErasureSet(ordered[i:i + set_size], parity=args.parity,
                            backend=backend)
                 for i in range(0, len(ordered), set_size)]
+        if distributed:
+            from minio_tpu.grid.dsync import DistNSLock
+            for s in sets:
+                s.ns = DistNSLock(lockers)
         pools.append(ErasureSets(sets, fmt.deployment_id))
         n_sets += len(sets)
         n_drives += len(ordered)
+
+    if distributed:
+        node_info["deployment_id"] = deployment_id
+        # Cross-node config handshake: peers must agree on deployment
+        # (reference: verifyServerSystemConfig, cmd/server-main.go:928).
+        from minio_tpu.grid import client_for as _cf
+        for host, port in remote_nodes:
+            try:
+                info = _cf(host, port + GRID_PORT_OFFSET).call(
+                    "node.info", None, timeout=3.0)
+                peer_dep = info.get("deployment_id", "")
+                if peer_dep and peer_dep != deployment_id:
+                    print(f"FATAL: peer {host}:{port} deployment "
+                          f"{peer_dep} != {deployment_id}", file=sys.stderr)
+                    return 1
+            except Exception:  # noqa: BLE001 - peer still booting
+                pass
+
     layer = ServerPools(pools)
     srv = S3Server(layer, address=args.address)
     print(f"minio-tpu serving S3 on {srv.address} "
           f"({len(pools)} pools, {n_sets} sets, {n_drives} drives, "
+          f"{'distributed, ' if distributed else ''}"
           f"ec-backend={'tpu' if backend else 'host'})", flush=True)
     srv.start()
     try:
-        import time
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
         srv.stop()
+        if grid_srv is not None:
+            grid_srv.stop()
     return 0
 
 

@@ -139,6 +139,34 @@ class FileInfo:
         return v
 
 
+def fi_to_wire(fi: "FileInfo") -> dict:
+    """Full FileInfo <-> msgpack map for the grid RPC mesh (the analogue
+    of the reference's msgp-generated FileInfo codec,
+    cmd/storage-datatypes_gen.go)."""
+    return {
+        "vol": fi.volume, "name": fi.name, "vid": fi.version_id,
+        "lat": fi.is_latest, "del": fi.deleted, "ddir": fi.data_dir,
+        "mt": fi.mod_time, "size": fi.size, "meta": dict(fi.metadata),
+        "parts": [p.to_map() for p in fi.parts], "ec": fi.erasure.to_map(),
+        "inl": fi.inline_data, "fresh": fi.fresh,
+        "smt": fi.successor_mod_time,
+    }
+
+
+def fi_from_wire(d: dict) -> "FileInfo":
+    return FileInfo(
+        volume=d.get("vol", ""), name=d.get("name", ""),
+        version_id=d.get("vid", ""), is_latest=d.get("lat", True),
+        deleted=d.get("del", False), data_dir=d.get("ddir", ""),
+        mod_time=d.get("mt", 0), size=d.get("size", 0),
+        metadata=dict(d.get("meta", {})),
+        parts=[ObjectPartInfo.from_map(p) for p in d.get("parts", ())],
+        erasure=ErasureInfo.from_map(d.get("ec", {})),
+        inline_data=d.get("inl"), fresh=d.get("fresh", False),
+        successor_mod_time=d.get("smt", 0),
+    )
+
+
 class MetaError(Exception):
     pass
 

@@ -10,10 +10,40 @@ reference setSizes). Each CLI argument group forms one server pool.
 from __future__ import annotations
 
 import re
+import urllib.parse
+from dataclasses import dataclass
+from typing import Optional
 
 _ELLIPSES = re.compile(r"\{(\d+)\.\.\.(\d+)\}")
 
 SET_SIZES = tuple(range(2, 17))   # valid erasure set sizes (reference)
+
+
+@dataclass(frozen=True)
+class Endpoint:
+    """One drive endpoint: a plain local path, or http://host:port/path
+    naming the node that owns the drive (reference: cmd/endpoint.go)."""
+    host: Optional[str]       # None == plain local path
+    port: int
+    path: str
+
+    @property
+    def is_url(self) -> bool:
+        return self.host is not None
+
+    def __str__(self) -> str:
+        if self.host is None:
+            return self.path
+        return f"http://{self.host}:{self.port}{self.path}"
+
+
+def parse_endpoint(spec: str) -> Endpoint:
+    if spec.startswith("http://") or spec.startswith("https://"):
+        u = urllib.parse.urlsplit(spec)
+        if not u.hostname or not u.port or not u.path:
+            raise ValueError(f"endpoint {spec!r} needs host, port and path")
+        return Endpoint(host=u.hostname, port=u.port, path=u.path)
+    return Endpoint(host=None, port=0, path=spec)
 
 
 def has_ellipses(spec: str) -> bool:

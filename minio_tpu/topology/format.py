@@ -94,9 +94,9 @@ def load_and_order(disks: Sequence, set_size: int) -> tuple[list, FormatInfo]:
         try:
             raw = d.read_format()
             read.append(FormatInfo.from_json(raw) if raw else None)
-        except (FormatError, OSError, ValueError):
-            # Corrupt/unreadable format: the drive is treated as absent
-            # for quorum purposes, never crashes the whole boot.
+        except Exception:  # noqa: BLE001 - corrupt/unreachable drive
+            # (incl. remote StorageError): treated as absent for quorum
+            # purposes, never crashes the whole boot.
             read.append(None)
 
     if all(f is None for f in read):
@@ -140,7 +140,7 @@ def load_and_order(disks: Sequence, set_size: int) -> tuple[list, FormatInfo]:
                              sets=[list(s) for s in layout], this=u)
             try:
                 d.write_format(fmt.to_json())
-            except OSError:
+            except Exception:  # noqa: BLE001 - unreachable/readonly drive
                 d = None
         ordered.append(d)
     return ordered, ref
@@ -149,7 +149,7 @@ def load_and_order(disks: Sequence, set_size: int) -> tuple[list, FormatInfo]:
 def _safe_read(d) -> Optional[dict]:
     try:
         return d.read_format()
-    except (OSError, ValueError):
+    except Exception:  # noqa: BLE001 - corrupt/unreachable == absent
         return None
 
 
