@@ -32,7 +32,11 @@ class GridClient:
         self._sock: Optional[socket.socket] = None
         self._mu = threading.Lock()          # guards connect + write + maps
         self._mux = itertools.count(1)
-        self._pending: dict[int, "queue.Queue[dict]"] = {}
+        # mux -> (socket it was sent on, reply queue): a dying socket's
+        # reader must only fail calls sent on THAT socket, never calls
+        # already re-registered on a newer connection.
+        self._pending: dict[int, tuple[socket.socket, "queue.Queue[dict]"]] \
+            = {}
         self._reader: Optional[threading.Thread] = None
 
     # -- connection management -----------------------------------------
@@ -56,8 +60,8 @@ class GridClient:
         with self._mu:
             if self._sock is s:
                 self._sock = None
-            pending = list(self._pending.values())
-            self._pending.clear()
+            dead = [mux for mux, (sk, _) in self._pending.items() if sk is s]
+            pending = [self._pending.pop(mux)[1] for mux in dead]
         for q in pending:
             q.put({"t": wire.T_ERR, "e": _SENTINEL_ERR, "msg": "conn lost"})
         try:
@@ -77,9 +81,9 @@ class GridClient:
                     continue
                 if t == wire.T_PONG:
                     continue
-                q = self._pending.get(msg.get("m"))
-                if q is not None:
-                    q.put(msg)
+                ent = self._pending.get(msg.get("m"))
+                if ent is not None:
+                    ent[1].put(msg)
         except (GridError, OSError):
             self._drop_conn(s)
 
@@ -97,15 +101,19 @@ class GridClient:
     def _send(self, msg: dict, mux: int, q) -> None:
         with self._mu:
             self._connect_locked()
-            self._pending[mux] = q
             s = self._sock
+            self._pending[mux] = (s, q)
             try:
                 s.sendall(wire.pack_frame(msg))
             except OSError as e:
                 self._pending.pop(mux, None)
-                self._sock = None
-                raise GridError(f"send to {self.host}:{self.port}: {e}") \
-                    from None
+                err = e
+            else:
+                return
+        # Send failed: drop the connection fully (close the socket so the
+        # parked reader thread exits, fail other calls in flight on it).
+        self._drop_conn(s)
+        raise GridError(f"send to {self.host}:{self.port}: {err}") from None
 
     def _finish(self, mux: int) -> None:
         with self._mu:

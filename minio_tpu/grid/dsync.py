@@ -2,7 +2,7 @@
 
 The analogue of the reference's internal/dsync: a DRWMutex acquires the
 lock on every node's lock server and succeeds iff a quorum granted it
-(write quorum n//2+1, read quorum max(1, n//2) —
+(write quorum n//2+1, read quorum n - n//2 so the two always overlap —
 internal/dsync/drwmutex.go:218-234); held locks refresh continuously
 and a refresh-quorum loss invokes the loss callback
 (drwmutex.go:256-300). Each node runs a LockServer (the reference's
@@ -162,8 +162,11 @@ class DRWMutex:
         self._refresher: Optional[threading.Thread] = None
 
     def _quorum(self, write: bool) -> int:
+        # Read quorum must overlap every possible write quorum:
+        # write = n//2 + 1, read = n - n//2 (ceil), so read + write > n
+        # for all n (reference: internal/dsync/drwmutex.go:218-234).
         n = len(self.lockers)
-        return n // 2 + 1 if write else max(1, n // 2)
+        return n // 2 + 1 if write else n - n // 2
 
     def _fanout(self, op: str, write: bool) -> int:
         ok = 0

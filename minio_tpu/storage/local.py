@@ -469,15 +469,18 @@ class LocalStorage:
         if not os.path.isdir(vol):
             raise VolumeNotFound(volume)
 
-        # emit() caches each journal blob so the descend event for the
-        # same directory can derive data dirs without a second read+parse.
-        blob_cache: dict[str, bytes] = {}
+        # emit() keeps only the MOST RECENT journal blob so the descend
+        # event for the same directory can derive data dirs without a
+        # second read+parse — single slot by construction; an unbounded
+        # map would grow O(num_objects x journal_size) over a long walk.
+        # A miss in data_dirs_of simply re-reads the file.
+        last_blob: list = [None, b""]  # [rel, blob]
 
         def emit(rel: str) -> Optional[tuple[str, bytes]]:
             try:
                 with open(os.path.join(vol, rel, META_FILE), "rb") as f:
                     blob = f.read()
-                blob_cache[rel] = blob
+                last_blob[0], last_blob[1] = rel, blob
                 return rel, blob
             except (FileNotFoundError, NotADirectoryError):
                 return None
@@ -494,7 +497,7 @@ class LocalStorage:
             version data, any other UUID-named child is a legitimate user
             key prefix and must be walked."""
             try:
-                blob = blob_cache.pop(rel, None)
+                blob = last_blob[1] if last_blob[0] == rel else None
                 if blob is None:
                     with open(os.path.join(vol, rel, META_FILE), "rb") as f:
                         blob = f.read()

@@ -16,6 +16,7 @@ frames achieves the same isolation here).
 from __future__ import annotations
 
 import os
+import time
 from types import SimpleNamespace
 from typing import Iterator, Optional
 
@@ -225,11 +226,34 @@ class StorageRPCService:
         "list_dir"
     ).split()
 
-    def __init__(self, disks: dict[str, LocalStorage]):
+    # Chunked uploads whose client died between create_begin and
+    # create_commit would otherwise leak an open fd + tmp file forever.
+    XFER_IDLE_TTL = 300.0
+
+    def __init__(self, disks: dict[str, LocalStorage],
+                 xfer_idle_ttl: float = XFER_IDLE_TTL):
         self.disks = dict(disks)     # root path -> LocalStorage
         self._xfers: dict[str, dict] = {}
+        self.xfer_idle_ttl = xfer_idle_ttl
         import threading
         self._xfer_mu = threading.Lock()
+
+    def _sweep_stale_xfers(self) -> None:
+        now = time.monotonic()
+        stale = []
+        with self._xfer_mu:
+            for xfer, st in list(self._xfers.items()):
+                if now - st["touched"] > self.xfer_idle_ttl:
+                    stale.append(self._xfers.pop(xfer))
+        for st in stale:
+            try:
+                st["f"].close()
+            except OSError:
+                pass
+            try:
+                os.unlink(st["tmp"])
+            except OSError:
+                pass
 
     def _disk(self, payload: dict) -> LocalStorage:
         d = self.disks.get(payload.get("d", ""))
@@ -300,6 +324,7 @@ class StorageRPCService:
 
     def _create_begin(self, payload):
         from minio_tpu.storage.meta import new_uuid
+        self._sweep_stale_xfers()
         d = self._disk(payload)
         vol, path = payload["a"]
         xfer = new_uuid()
@@ -307,13 +332,16 @@ class StorageRPCService:
         os.makedirs(os.path.dirname(tmp), exist_ok=True)
         with self._xfer_mu:
             self._xfers[xfer] = {"disk": d, "vol": vol, "path": path,
-                                 "tmp": tmp, "f": open(tmp, "wb")}
+                                 "tmp": tmp, "f": open(tmp, "wb"),
+                                 "touched": time.monotonic()}
         return xfer
 
     def _create_chunk(self, payload):
         xfer, data = payload["a"]
         with self._xfer_mu:
             st = self._xfers.get(xfer)
+            if st is not None:
+                st["touched"] = time.monotonic()
         if st is None:
             raise StorageError(f"no such transfer {xfer}")
         st["f"].write(data)
