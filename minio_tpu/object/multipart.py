@@ -22,7 +22,6 @@ from typing import Optional
 
 from minio_tpu.object.types import (InvalidArgument, ObjectInfo, PutOptions,
                                     WriteQuorumError)
-from minio_tpu.storage import bitrot
 from minio_tpu.storage.meta import (ErasureInfo, FileInfo, FileNotFoundErr,
                                     ObjectPartInfo, new_uuid, now_ns)
 
@@ -106,10 +105,7 @@ def put_object_part(es, bucket: str, object_: str, upload_id: str,
     rec = _read_upload(es, bucket, object_, upload_id)
     k, m, dist = rec["k"], rec["m"], rec["distribution"]
     n = k + m
-    e = es._erasure(k, m)
-    shards = es._encode_object(data, k, m)
-    framed = bitrot.frame_shards_batch(shards, e.shard_size()) \
-        if shards.shape[1] else [b""] * n
+    framed = es._encode_and_frame(data, k, m)
     etag = hashlib.md5(data).hexdigest()
     # Each upload attempt gets its own data file; the atomic .meta replace
     # referencing it is the commit point, so a crash or concurrent
@@ -126,7 +122,8 @@ def put_object_part(es, bucket: str, object_: str, upload_id: str,
     def write_one(disk_idx: int):
         d = es.disks[disk_idx]
         shard_idx = dist[disk_idx] - 1
-        d.create_file(eo.SYS_VOL, f"{updir}/{data_file}", framed[shard_idx])
+        d.create_file(eo.SYS_VOL, f"{updir}/{data_file}",
+                      list(framed[shard_idx]))
         d.write_all(eo.SYS_VOL, f"{updir}/part.{part_number}.meta",
                     json.dumps(meta).encode())
 

@@ -198,6 +198,136 @@ def _pallas_apply(bmat_plane: jax.Array, data: jax.Array, tile: int,
 
 
 # ---------------------------------------------------------------------------
+# u32-lane variant (for the fused encode+bitrot pipeline)
+# ---------------------------------------------------------------------------
+# Byte-level device arrays pay a hidden tax: TPU tiles uint8 along
+# sublanes, so bitcasting u8 shards to the u32 words HighwayHash needs
+# is a ~35 GiB/s relayout — slower than the hash itself. This variant
+# keeps the WHOLE pipeline in u32 lanes: each lane holds 4 consecutive
+# shard bytes, the GF transform runs per byte-slot (same bit-matrix,
+# four slot dots share one MXU call), and the output is directly the
+# word layout the hash kernel consumes. Byte-identical to the u8 path.
+
+def _rs_kernel32(bmat_ref, data_ref, out_ref):
+    """One (batch, lane-tile) cell on u32 lanes.
+
+    bmat_ref: int8 [r8, k8] PLANE-major (same matrix as _rs_kernel).
+    data_ref: uint32 [bb, k, TL4]; out_ref: uint32 [bb, r, TL4].
+    """
+    k = data_ref.shape[1]
+    r = out_ref.shape[1]
+    tl4 = data_ref.shape[2]
+    for i in range(data_ref.shape[0]):
+        x = data_ref[i]                        # u32 [k, TL4]
+        # Per byte-slot bitplane unpack; slots concatenate along lanes
+        # so all four share one dot.
+        slots = []
+        for s in range(4):
+            xs = ((x >> (8 * s)) & 0xFF).astype(jnp.int32)
+            slots.append(jnp.concatenate(
+                [((xs >> b) & 1).astype(jnp.int8) for b in range(8)], axis=0))
+        bits = jnp.concatenate(slots, axis=1)  # int8 [k8, 4*TL4]
+        acc = jax.lax.dot_general(
+            bmat_ref[:], bits,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)  # [r8, 4*TL4]
+        out = jnp.zeros((r, tl4), dtype=jnp.uint32)
+        for s in range(4):
+            a = acc[:, s * tl4:(s + 1) * tl4]
+            packed = (a[0:r, :] & 1)
+            for c in range(1, 8):
+                packed = packed | ((a[c * r:(c + 1) * r, :] & 1) << c)
+            out = out | (packed.astype(jnp.uint32) << (8 * s))
+        out_ref[i] = out
+
+
+@functools.partial(jax.jit, static_argnames=("tile4", "bb", "interpret"))
+def _pallas_apply32(bmat_plane: jax.Array, data: jax.Array, tile4: int,
+                    bb: int, interpret: bool = False) -> jax.Array:
+    """bmat_plane int8 [r8, k8], data uint32 [B, k, L4_padded]."""
+    b, k, l4 = data.shape
+    r8 = bmat_plane.shape[0]
+    r = r8 // 8
+    assert l4 % tile4 == 0, f"lane dim {l4} not a multiple of tile {tile4}"
+    assert b % bb == 0, f"batch dim {b} not a multiple of {bb}"
+    grid = (b // bb, l4 // tile4)
+    return pl.pallas_call(
+        _rs_kernel32,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((r8, k * 8), lambda ib, il: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, k, tile4), lambda ib, il: (ib, 0, il),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bb, r, tile4), lambda ib, il: (ib, 0, il),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, r, l4), jnp.uint32),
+        interpret=interpret,
+    )(bmat_plane, data)
+
+
+def make_encoder32(matrix: np.ndarray, mode: str = "auto"):
+    """u32-lane encoder: fn(data uint32 [B, k, L4]) -> uint32 [B, r, L4].
+
+    Lane t of shard i holds bytes 4t..4t+3 (little-endian), i.e. the
+    same bytes as the u8 path's lanes 4t..4t+3 — outputs bitcast-equal.
+    Pads lanes to a tile multiple internally (zeros are a fixed point).
+    """
+    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+    r, k = matrix.shape
+    _, bm_plane = _prep(matrix)
+    backend = DeviceBackend(mode)
+    if backend.mode == "xla":
+        def run_xla(data):
+            # Portable fallback: via the byte path.
+            b, kk, l4 = data.shape
+            bytes_ = jax.lax.bitcast_convert_type(data, jnp.uint8) \
+                .reshape(b, kk, l4 * 4)
+            out = _xla_apply(jnp.asarray(_prep(matrix)[0]), bytes_)
+            return jax.lax.bitcast_convert_type(
+                out.reshape(b, r, l4, 4), jnp.uint32)
+        return run_xla
+    interpret = backend._interpret
+    bmat = jnp.asarray(bm_plane)
+
+    def run(data):
+        b, kk, l4 = data.shape
+        # VMEM per cell ~ bits i8 [k8, 4T] + acc i32 [r8, 4T] + io u32.
+        tile4 = 128
+        per_lane4 = k * 8 * 4 + r * 8 * 4 * 4 + (k + r) * 4
+        while tile4 < _TILE_L_MAX // 4 and tile4 * 2 * per_lane4 <= _VMEM_BUDGET \
+                and tile4 < l4:
+            tile4 *= 2
+        bb = 2 if b % 2 == 0 else 1
+        key = ("u32", k, r, bb)
+        tile4 = min(tile4, _tile_cap.get(key, tile4))
+        pad = (-l4) % tile4
+        padded = jnp.pad(data, ((0, 0), (0, 0), (0, pad))) if pad else data
+        if isinstance(data, jax.core.Tracer):
+            out = _pallas_apply32(bmat, padded, tile4=tile4, bb=bb,
+                                  interpret=interpret)
+            return out[..., :l4] if pad else out
+        while True:
+            try:
+                out = _pallas_apply32(bmat, padded, tile4=tile4, bb=bb,
+                                      interpret=interpret)
+                if key + (tile4,) not in _tile_ok:
+                    out.block_until_ready()
+                    _tile_ok.add(key + (tile4,))
+                return out[..., :l4] if pad else out
+            except Exception as e:  # noqa: BLE001 - inspect & retry
+                if tile4 > 128 and _is_vmem_error(e):
+                    tile4 //= 2
+                    _tile_cap[key] = min(_tile_cap.get(key, tile4), tile4)
+                    pad = (-l4) % tile4
+                    padded = jnp.pad(data, ((0, 0), (0, 0), (0, pad))) if pad else data
+                    continue
+                raise
+    return run
+
+
+# ---------------------------------------------------------------------------
 # Backend
 # ---------------------------------------------------------------------------
 

@@ -64,7 +64,9 @@ def main(argv=None) -> int:
             if jax.default_backend() == "tpu":
                 from minio_tpu.ops.rs_device import DeviceBackend
                 backend = DeviceBackend()
-        except Exception:  # noqa: BLE001 - no JAX device -> host math
+        except Exception as e:  # noqa: BLE001 - no JAX device -> host math
+            print(f"ec-backend auto-detect: no TPU ({type(e).__name__}: {e}); "
+                  "using host GF kernels", file=sys.stderr)
             backend = None
 
     from minio_tpu.object.erasure_object import ErasureSet

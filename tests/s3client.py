@@ -15,11 +15,12 @@ from minio_tpu.s3 import sigv4
 
 class S3Client:
     def __init__(self, address: str, access_key="minioadmin",
-                 secret_key="minioadmin", region="us-east-1"):
+                 secret_key="minioadmin", region="us-east-1", timeout=30):
         self.address = address
         self.access_key = access_key
         self.secret_key = secret_key
         self.region = region
+        self.timeout = timeout
 
     def request(self, method: str, path: str, query: dict | None = None,
                 body: bytes = b"", headers: dict | None = None,
@@ -60,7 +61,7 @@ class S3Client:
             [(k, v) for k, vs in query.items() for v in vs])
         # Send exactly the URI that was signed (raw-path verification).
         url = sigv4.uri_encode(path, encode_slash=False) + ("?" + qs if qs else "")
-        conn = http.client.HTTPConnection(self.address, timeout=30)
+        conn = http.client.HTTPConnection(self.address, timeout=self.timeout)
         try:
             conn.request(method, url, body=body, headers=send_headers)
             resp = conn.getresponse()

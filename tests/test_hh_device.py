@@ -1,0 +1,131 @@
+"""Device HighwayHash + fused encode/bitrot framing must be byte-identical
+to the host bitrot layer (and therefore to the reference's golden digests,
+cmd/bitrot.go:225-230).
+
+The Pallas kernels run in interpret mode off-TPU, so shapes here stay
+small; bench.py and the TPU-gated tests exercise the compiled kernels on
+real hardware.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from minio_tpu.erasure.codec import Erasure
+from minio_tpu.ops import gf256
+from minio_tpu.ops.hh_device import (_init_smem_np, _init_state_np,
+                                     _pallas_frame, _pick_pchunk,
+                                     hash_blocks_device, hash_blocks_pallas,
+                                     make_encode_framer)
+from minio_tpu.storage import bitrot
+from minio_tpu.utils.highwayhash import MAGIC_KEY, highwayhash256_many
+
+_ON_TPU = jax.default_backend() == "tpu"
+
+
+# ---------------------------------------------------------------------------
+# XLA (portable) path
+# ---------------------------------------------------------------------------
+
+_XLA_LENGTHS = [0, 1, 3, 17, 31, 32, 33, 63, 64, 100, 1024, 4096] if _ON_TPU \
+    else [0, 17, 31, 32, 100, 1024]   # each length = one ~3s CPU compile
+
+
+@pytest.mark.parametrize("length", _XLA_LENGTHS)
+def test_xla_hash_matches_host(length):
+    rng = np.random.default_rng(length)
+    blocks = rng.integers(0, 256, size=(5, length), dtype=np.uint8)
+    want = highwayhash256_many(MAGIC_KEY, blocks)
+    got = hash_blocks_device(MAGIC_KEY, blocks, mode="xla")
+    assert np.array_equal(want, got)
+
+
+def test_xla_hash_arbitrary_key():
+    key = bytes(range(32))
+    rng = np.random.default_rng(7)
+    blocks = rng.integers(0, 256, size=(3, 333), dtype=np.uint8)
+    want = highwayhash256_many(key, blocks)
+    got = hash_blocks_device(key, blocks, mode="xla")
+    assert np.array_equal(want, got)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel (interpret off-TPU, compiled on TPU)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("s,length", [(7, 256), (130, 512), (1024, 2048)])
+def test_pallas_hash_matches_host(s, length):
+    if not _ON_TPU and (s, length) != (7, 256):
+        pytest.skip("interpret mode: reduced sweep off-TPU")
+    rng = np.random.default_rng(s)
+    blocks = rng.integers(0, 256, size=(s, length), dtype=np.uint8)
+    want = highwayhash256_many(MAGIC_KEY, blocks)
+    got = np.asarray(hash_blocks_pallas(
+        blocks, jnp.asarray(_init_smem_np(MAGIC_KEY)), interpret=not _ON_TPU))
+    assert np.array_equal(want, got)
+
+
+def test_pallas_frame_layout():
+    """Framing kernel interleaves digest||block per drive correctly."""
+    b, x, l4 = 4, 3, 128
+    rng = np.random.default_rng(0)
+    shards = rng.integers(0, 2 ** 31, size=(b, x, l4), dtype=np.uint32)
+    digs = rng.integers(0, 2 ** 31, size=(b, x, 8), dtype=np.uint32)
+    out = np.asarray(_pallas_frame(jnp.asarray(shards), jnp.asarray(digs),
+                                   interpret=not _ON_TPU))
+    assert out.shape == (b, x, 8 + l4)
+    for bi in range(b):
+        for xi in range(x):
+            assert np.array_equal(out[bi, xi, :8], digs[bi, xi])
+            assert np.array_equal(out[bi, xi, 8:], shards[bi, xi])
+
+
+# ---------------------------------------------------------------------------
+# Fused framer vs the host bitrot layer
+# ---------------------------------------------------------------------------
+
+def _host_framed(data, k, m):
+    """Reference framing: host encode + frame_shards_batch per block."""
+    n = k + m
+    b, _, l = data.shape
+    e = Erasure(k, m, k * l)
+    files = [bytearray() for _ in range(n)]
+    for bi in range(b):
+        shards = e.encode_data(data[bi].reshape(-1).tobytes())
+        for i in range(n):
+            blk = np.asarray(shards[i])
+            files[i] += bitrot.hash_block(bitrot.DEFAULT_ALGORITHM, blk)
+            files[i] += blk.tobytes()
+    return [bytes(f) for f in files]
+
+
+_FRAMER_CONFIGS = [(4, 2, 3, 512), (8, 4, 2, 1024)] if _ON_TPU \
+    else [(4, 2, 3, 512)]
+
+
+@pytest.mark.parametrize("k,m,b,l", _FRAMER_CONFIGS)
+def test_framer_matches_host_bitrot(k, m, b, l):
+    rng = np.random.default_rng(k * m)
+    data = rng.integers(0, 256, size=(b, k, l), dtype=np.uint8)
+    framer = make_encode_framer(gf256.parity_matrix(k, m))
+    rows = framer(data)
+    want = _host_framed(data, k, m)
+    assert len(rows) == k + m
+    for i in range(k + m):
+        assert rows[i].tobytes() == want[i], f"drive {i} differs"
+
+
+@pytest.mark.skipif(not _ON_TPU, reason="compiled u32 pipeline needs TPU")
+def test_framer_u32_pipeline_on_tpu():
+    """The full u32 Pallas pipeline (encode32 + hash + frame) on real
+    hardware, eligible shape, including stream padding."""
+    k, m = 8, 4
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 256, size=(10, k, 4096), dtype=np.uint8)
+    framer = make_encode_framer(gf256.parity_matrix(k, m))
+    rows = framer(data)
+    want = _host_framed(data, k, m)
+    for i in range(k + m):
+        assert rows[i].tobytes() == want[i], f"drive {i} differs"
