@@ -44,10 +44,20 @@ from minio_tpu.storage.local import (SYS_VOL, StorageError, VolumeExists,
 from minio_tpu.storage.meta import (ErasureInfo, FileInfo, FileNotFoundErr,
                                     MetaError, ObjectPartInfo,
                                     VersionNotFoundErr, new_uuid, now_ns)
+from minio_tpu.utils.streams import Payload
 
 BLOCK_SIZE = 1 << 20          # reference blockSizeV2 (cmd/object-api-common.go:37)
 SMALL_FILE_THRESHOLD = 128 << 10  # inline threshold (storage-class.go:278)
 STAGING_PREFIX = "staging"
+# O(block) streaming: objects larger than one window stream through the
+# encoder in fixed 32-block (32 MiB) windows with double-buffered shard
+# writers — the analogue of the reference's 1 MiB-block readahead
+# pipeline (cmd/erasure-object.go:1415-1428), widened so each window is
+# one batched device encode. Peak memory is O(window), never O(object).
+STREAM_WINDOW_BLOCKS = 32
+STREAM_THRESHOLD = STREAM_WINDOW_BLOCKS * BLOCK_SIZE
+# Streamed GETs decode and yield this many plaintext bytes per step.
+GET_WINDOW_BYTES = 16 << 20
 
 _RESERVED_BUCKETS = {SYS_VOL}
 
@@ -429,7 +439,8 @@ class ErasureSet:
         return np.stack([be.apply_matrix(pm, stacked[b])
                          for b in range(stacked.shape[0])])
 
-    def _encode_and_frame(self, data: bytes, k: int, m: int) -> list[list]:
+    def _encode_and_frame(self, data: bytes, k: int, m: int,
+                          pad_blocks: int = 0) -> list[list]:
         """Encode + bitrot-frame the object: per-drive lists of framed
         byte chunks (shard index order), ready to write as shard files.
 
@@ -438,6 +449,11 @@ class ErasureSet:
         framing in one pass, ops/hh_device) and only the ragged tail
         block is framed on the host. Everywhere else this is the
         host/XLA batched path (byte-identical output).
+
+        pad_blocks: if set, the device batch is zero-padded up to this
+        many blocks (pad frames are sliced off) so the streaming window
+        loop keeps ONE compiled shape regardless of the last window's
+        block count.
         """
         e = self._erasure(k, m)
         n = k + m
@@ -458,9 +474,19 @@ class ErasureSet:
             return [[f] for f in bitrot.frame_shards_batch(shards, shard_size)]
         chunks: list[list] = [[] for _ in range(n)]
         buf = np.frombuffer(data, dtype=np.uint8, count=full * BLOCK_SIZE)
-        rows = _framer_for(k, m)(buf.reshape(full, k, shard_size))
+        stacked = buf.reshape(full, k, shard_size)
+        if pad_blocks and full < pad_blocks:
+            padded = np.zeros((pad_blocks, k, shard_size), dtype=np.uint8)
+            padded[:full] = stacked
+            stacked = padded
+        rows = _framer_for(k, m)(stacked)
+        frame_bytes = full * (bitrot.digest_size(bitrot.DEFAULT_ALGORITHM)
+                              + shard_size)
         for i in range(n):
-            chunks[i].append(memoryview(rows[i]))
+            row = rows[i]
+            chunks[i].append(memoryview(row)[:frame_bytes]
+                             if row.shape[0] != frame_bytes
+                             else memoryview(row))
         tail = total - full * BLOCK_SIZE
         if tail:
             tail_shards = e.split(data[full * BLOCK_SIZE:])
@@ -476,9 +502,20 @@ class ErasureSet:
     # PutObject
     # ------------------------------------------------------------------
 
-    def put_object(self, bucket: str, object_: str, data: bytes,
+    def put_object(self, bucket: str, object_: str, data,
                    opts: Optional[PutOptions] = None) -> ObjectInfo:
+        """data: bytes, or a utils.streams.Payload for O(window)-memory
+        streaming of large bodies (reference: PutObject streams 1 MiB
+        blocks, cmd/erasure-object.go:1415)."""
         opts = opts or PutOptions()
+        payload = Payload.wrap(data)
+        if payload.size > STREAM_THRESHOLD:
+            return self._put_object_streaming(bucket, object_, payload, opts)
+        return self._put_object_buffered(bucket, object_,
+                                         payload.read_all(), opts)
+
+    def _put_object_buffered(self, bucket: str, object_: str, data: bytes,
+                             opts: PutOptions) -> ObjectInfo:
         self._check_bucket(bucket)
         n = len(self.disks)
         m = self.default_parity
@@ -563,6 +600,173 @@ class ErasureSet:
                           actual_size=len(data))
 
     # ------------------------------------------------------------------
+    # Streaming PutObject (O(window) memory)
+    # ------------------------------------------------------------------
+
+    def _stream_framed_writes(self, payload: Payload, k: int, m: int,
+                              distribution: Sequence[int],
+                              path_for) -> tuple[str, list]:
+        """Windowed encode+frame with parallel streamed shard writers.
+
+        Reads `payload` in STREAM_WINDOW_BLOCKS windows, frames each
+        (device or host), and feeds per-drive bounded queues consumed by
+        one writer thread per drive (`path_for(i) -> (disk, vol, path)`,
+        written via create_file's iterator form). Memory is bounded by
+        the window size times the queue depth; a dead writer drains its
+        queue so the producer never blocks on it. Returns (md5 etag,
+        per-drive error list). The reference's shape: parallelWriter
+        goroutines fed block-by-block (cmd/erasure-encode.go:69).
+        """
+        import queue as queue_mod
+
+        n = len(self.disks)
+        window_bytes = STREAM_WINDOW_BLOCKS * BLOCK_SIZE
+        qs = [queue_mod.Queue(maxsize=2) for _ in range(n)]
+        errors: list = [None] * n
+        dead = [False] * n
+        sentinel_seen = [False] * n
+        _SENTINEL = object()
+
+        def writer(i: int):
+            try:
+                disk, vol, path = path_for(i)
+
+                def gen():
+                    while True:
+                        c = qs[i].get()
+                        if c is _SENTINEL:
+                            sentinel_seen[i] = True
+                            return
+                        yield from c
+                disk.create_file(vol, path, gen())
+            except Exception as exc:  # noqa: BLE001 - collected for quorum
+                errors[i] = exc
+                dead[i] = True
+                while not sentinel_seen[i]:
+                    if qs[i].get() is _SENTINEL:
+                        sentinel_seen[i] = True
+
+        import threading
+        threads = [threading.Thread(target=writer, args=(i,), daemon=True)
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        md5 = hashlib.md5()
+        write_quorum = k + (1 if k == m else 0)
+        stream_error: Optional[Exception] = None
+        try:
+            while True:
+                window = payload.read_exact(window_bytes)
+                if not window:
+                    break
+                md5.update(window)
+                framed = self._encode_and_frame(
+                    window, k, m, pad_blocks=STREAM_WINDOW_BLOCKS)
+                if n - sum(dead) < write_quorum:
+                    raise WriteQuorumError(
+                        "", "", f"{sum(dead)}/{n} writers failed mid-stream")
+                for i in range(n):
+                    if not dead[i]:
+                        qs[i].put(framed[distribution[i] - 1])
+        except Exception as exc:  # noqa: BLE001 - unwind writers first
+            stream_error = exc
+        finally:
+            for i in range(n):
+                qs[i].put(_SENTINEL)
+            for t in threads:
+                t.join()
+        if stream_error is not None:
+            raise stream_error
+        return md5.hexdigest(), errors
+
+    def _put_object_streaming(self, bucket: str, object_: str,
+                              payload: Payload,
+                              opts: PutOptions) -> ObjectInfo:
+        """Large-object PUT: stream windows to staged shard files, then
+        quorum-commit with atomic renames under the namespace lock —
+        encode and IO run unlocked, only the commit serializes (the
+        reference's tmp-write + renameData commit discipline)."""
+        self._check_bucket(bucket)
+        n = len(self.disks)
+        m = self.default_parity
+        if opts.storage_class == "REDUCED_REDUNDANCY" and n > 1:
+            m = max(1, min(m, 2))
+        k = n - m
+        write_quorum = k + (1 if k == m else 0)
+        size = payload.size
+        distribution = hash_order(f"{bucket}/{object_}", n)
+        version_id = opts.version_id or (new_uuid() if opts.versioned else "")
+        data_dir = new_uuid()
+        staging = f"{STAGING_PREFIX}/{new_uuid()}"
+
+        def path_for(i: int):
+            return self.disks[i], SYS_VOL, f"{staging}/{data_dir}/part.1"
+
+        def cleanup_staging(disks=None):
+            self._fanout([lambda d=d: _swallow(
+                lambda: d.delete(SYS_VOL, staging, recursive=True))
+                for d in (disks if disks is not None else self.disks)])
+
+        try:
+            etag, errors = self._stream_framed_writes(
+                payload, k, m, distribution, path_for)
+        except Exception:
+            cleanup_staging()
+            raise
+        ok = sum(err is None for err in errors)
+        if ok < write_quorum:
+            cleanup_staging()
+            raise WriteQuorumError(bucket, object_,
+                                   f"staged {ok}/{n}, need {write_quorum}")
+
+        mod_time = opts.mod_time or now_ns()
+        metadata = dict(opts.user_metadata)
+        metadata["etag"] = etag
+        if opts.content_type:
+            metadata["content-type"] = opts.content_type
+
+        def make_fi(shard_idx: int) -> FileInfo:
+            return FileInfo(
+                volume=bucket, name=object_, version_id=version_id,
+                deleted=False, data_dir=data_dir, mod_time=mod_time,
+                size=size, metadata=metadata,
+                parts=[ObjectPartInfo(number=1, size=size,
+                                      actual_size=size, etag=etag)],
+                erasure=ErasureInfo(
+                    data_blocks=k, parity_blocks=m, block_size=BLOCK_SIZE,
+                    index=shard_idx + 1, distribution=tuple(distribution)))
+
+        def commit_one(i: int):
+            if errors[i] is not None:
+                raise errors[i]
+            self.disks[i].rename_data(SYS_VOL, staging,
+                                      make_fi(distribution[i] - 1),
+                                      bucket, object_)
+
+        with self.ns.write(bucket, object_):
+            _, cerrors = self._fanout(
+                [lambda i=i: commit_one(i) for i in range(n)])
+        ok = sum(e2 is None for e2 in cerrors)
+        if ok < write_quorum:
+            self._fanout([lambda d=d: _swallow(
+                lambda: d.delete_version(bucket, object_, version_id))
+                for d, err in zip(self.disks, cerrors) if err is None])
+            cleanup_staging()
+            raise WriteQuorumError(bucket, object_,
+                                   f"committed {ok}/{n}, need {write_quorum}")
+        laggards = [d for d, err in zip(self.disks, cerrors)
+                    if err is not None]
+        if laggards:
+            cleanup_staging(laggards)
+            self.mrf.enqueue(bucket, object_, version_id)
+        return ObjectInfo(bucket=bucket, name=object_, mod_time=mod_time,
+                          size=size, etag=etag,
+                          content_type=opts.content_type,
+                          version_id=version_id,
+                          user_metadata=dict(opts.user_metadata),
+                          actual_size=size)
+
+    # ------------------------------------------------------------------
     # GetObject
     # ------------------------------------------------------------------
 
@@ -574,8 +778,9 @@ class ErasureSet:
         with self.ns.read(bucket, object_):
             return self._get_object_locked(bucket, object_, opts)
 
-    def _get_object_locked(self, bucket: str, object_: str,
-                           opts: GetOptions) -> tuple[ObjectInfo, bytes]:
+    def _prepare_get(self, bucket: str, object_: str, opts: GetOptions):
+        """Shared GET preamble: quorum fileinfo, delete-marker mapping,
+        range resolution. Returns (info, fi, fis, offset, length)."""
         fi, fis, errors = self._get_object_fileinfo(
             bucket, object_, opts.version_id, read_data=True)
         if any(e is not None for e in errors):
@@ -603,11 +808,75 @@ class ErasureSet:
             if offset < 0 or length < 0 or offset + length > total:
                 raise InvalidRange(bucket, object_)
         info.range_start, info.range_length = offset, length
-        if total == 0 or length == 0:
-            return info, b""
+        return info, fi, fis, offset, length
 
+    def _get_object_locked(self, bucket: str, object_: str,
+                           opts: GetOptions) -> tuple[ObjectInfo, bytes]:
+        info, fi, fis, offset, length = self._prepare_get(bucket, object_,
+                                                          opts)
+        if fi.size == 0 or length == 0:
+            return info, b""
         return info, self._read_payload(bucket, object_, fi, fis,
                                         offset, length)
+
+    def get_object_stream(self, bucket: str, object_: str,
+                          opts: Optional[GetOptions] = None):
+        """Streaming GET: (ObjectInfo, iterator of plaintext chunks).
+
+        Decodes GET_WINDOW_BYTES block windows at a time, so memory is
+        O(window) regardless of range size. The namespace read lock is
+        held until the iterator is exhausted or closed (the reference's
+        GetObjectNInfo reader-with-unlock-on-close)."""
+        opts = opts or GetOptions()
+        cm = self.ns.read(bucket, object_)
+        cm.__enter__()
+        try:
+            info, fi, fis, offset, length = self._prepare_get(
+                bucket, object_, opts)
+        except BaseException:
+            cm.__exit__(None, None, None)
+            raise
+
+        def gen():
+            try:
+                # Primer yield: the caller advances past it immediately
+                # (below), so the generator is always STARTED — close()
+                # on a never-started generator would skip this finally
+                # and leak the namespace lock.
+                yield b""
+                if fi.size and length:
+                    yield from self._iter_payload(bucket, object_, fi, fis,
+                                                  offset, length)
+            finally:
+                cm.__exit__(None, None, None)
+        g = gen()
+        next(g)
+        return info, g
+
+    def _iter_payload(self, bucket: str, object_: str, fi: FileInfo,
+                      fis: list, offset: int, length: int):
+        """Yield [offset, offset+length) as block-aligned windows."""
+        parts = fi.parts or [ObjectPartInfo(number=1, size=fi.size,
+                                            actual_size=fi.size)]
+        cum = 0
+        for p in parts:
+            p_lo = max(offset, cum)
+            p_hi = min(offset + length, cum + p.size)
+            pos = p_lo
+            while pos < p_hi:
+                # Snap window ends to erasure-block boundaries within the
+                # part so consecutive windows never re-read a block.
+                rel = pos - cum
+                end_rel = min(p.size,
+                              (rel // BLOCK_SIZE) * BLOCK_SIZE
+                              + GET_WINDOW_BYTES)
+                step = min(p_hi - pos, end_rel - rel)
+                yield self._read_part_window(bucket, object_, fi, fis,
+                                             p.number, p.size, rel, step)
+                pos += step
+            cum += p.size
+            if cum >= offset + length:
+                break
 
     def _read_payload(self, bucket: str, object_: str, fi: FileInfo,
                       fis: list, offset: int, length: int) -> bytes:

@@ -98,15 +98,17 @@ def _read_upload(es, bucket: str, object_: str, upload_id: str) -> dict:
 
 
 def put_object_part(es, bucket: str, object_: str, upload_id: str,
-                    part_number: int, data: bytes) -> ObjectPartInfo:
+                    part_number: int, data) -> ObjectPartInfo:
     from minio_tpu.object import erasure_object as eo
+    from minio_tpu.utils.streams import Payload
     if not (1 <= part_number <= MAX_PARTS):
         raise InvalidArgument(bucket, object_, "part number out of range")
     rec = _read_upload(es, bucket, object_, upload_id)
     k, m, dist = rec["k"], rec["m"], rec["distribution"]
     n = k + m
-    framed = es._encode_and_frame(data, k, m)
-    etag = hashlib.md5(data).hexdigest()
+    write_quorum = k + (1 if k == m else 0)
+    payload = Payload.wrap(data)
+    size = payload.size
     # Each upload attempt gets its own data file; the atomic .meta replace
     # referencing it is the commit point, so a crash or concurrent
     # re-upload of the same part can never pair a torn data file with a
@@ -114,10 +116,42 @@ def put_object_part(es, bucket: str, object_: str, upload_id: str,
     # rename, cmd/erasure-multipart.go:570).
     attempt = new_uuid()
     data_file = f"part.{part_number}.{attempt}"
-    meta = {"number": part_number, "size": len(data),
-            "actual_size": len(data), "etag": etag, "mod_time": now_ns(),
-            "file": data_file}
     updir = _upload_dir(bucket, object_, upload_id)
+
+    if size > eo.STREAM_THRESHOLD:
+        # O(window) streaming: shard files stream in windows, then the
+        # .meta commit fans out to the drives whose data write landed.
+        def path_for(i: int):
+            return es.disks[i], eo.SYS_VOL, f"{updir}/{data_file}"
+
+        etag, werrors = es._stream_framed_writes(payload, k, m, dist,
+                                                 path_for)
+        staged = [i for i in range(n) if werrors[i] is None]
+        if len(staged) < write_quorum:
+            es._fanout([lambda i=i: eo._swallow(
+                lambda: es.disks[i].delete(eo.SYS_VOL,
+                                           f"{updir}/{data_file}"))
+                for i in staged])
+            raise WriteQuorumError(bucket, object_)
+        meta = {"number": part_number, "size": size, "actual_size": size,
+                "etag": etag, "mod_time": now_ns(), "file": data_file}
+        blob = json.dumps(meta).encode()
+        _, merrors = es._fanout(
+            [lambda i=i: es.disks[i].write_all(
+                eo.SYS_VOL, f"{updir}/part.{part_number}.meta", blob)
+             for i in staged])
+        if sum(e2 is None for e2 in merrors) < write_quorum:
+            raise WriteQuorumError(bucket, object_)
+        return ObjectPartInfo(number=part_number, size=size,
+                              actual_size=size, etag=etag,
+                              mod_time=meta["mod_time"])
+
+    body = payload.read_all()
+    framed = es._encode_and_frame(body, k, m)
+    etag = hashlib.md5(body).hexdigest()
+    meta = {"number": part_number, "size": size,
+            "actual_size": size, "etag": etag, "mod_time": now_ns(),
+            "file": data_file}
 
     def write_one(disk_idx: int):
         d = es.disks[disk_idx]
@@ -129,11 +163,10 @@ def put_object_part(es, bucket: str, object_: str, upload_id: str,
 
     _, errors = es._fanout(
         [lambda i=i: write_one(i) for i in range(n)])
-    write_quorum = k + (1 if k == m else 0)
     if sum(e2 is None for e2 in errors) < write_quorum:
         raise WriteQuorumError(bucket, object_)
-    return ObjectPartInfo(number=part_number, size=len(data),
-                          actual_size=len(data), etag=etag,
+    return ObjectPartInfo(number=part_number, size=size,
+                          actual_size=size, etag=etag,
                           mod_time=meta["mod_time"])
 
 

@@ -143,6 +143,9 @@ class ServerPools:
     def get_object(self, bucket, object_, opts=None):
         return self._search("get_object", bucket, object_, opts)
 
+    def get_object_stream(self, bucket, object_, opts=None):
+        return self._search("get_object_stream", bucket, object_, opts)
+
     def get_object_info(self, bucket, object_, opts=None):
         return self._search("get_object_info", bucket, object_, opts)
 

@@ -171,6 +171,9 @@ class ErasureSets:
     def get_object(self, bucket, object_, opts=None):
         return self.set_for(object_).get_object(bucket, object_, opts)
 
+    def get_object_stream(self, bucket, object_, opts=None):
+        return self.set_for(object_).get_object_stream(bucket, object_, opts)
+
     def get_object_info(self, bucket, object_, opts=None):
         return self.set_for(object_).get_object_info(bucket, object_, opts)
 

@@ -423,36 +423,6 @@ def _hh_kernel(init_ref, w_ref, out_ref, st_ref, *, unroll: bool = True):
                                 even_lo[1], even_hi[1], odd_lo[1], odd_hi[1]])
 
 
-def _t_kernel(in_ref, out_ref):
-    out_ref[...] = in_ref[...].T
-
-
-def _pallas_transpose(x, pad_rows_to: int = 256, interpret: bool = False):
-    """u32 [R, C] -> [C, Rpad] via VPU tile transposes.
-
-    XLA's own transpose runs ~36 GiB/s on v5e for these shapes; this
-    kernel measures ~350 GiB/s — it is what makes the hash's
-    stream-minor word layout affordable. Requires C % 256 == 0. R is
-    padded up to a multiple of pad_rows_to; the pad columns of the
-    output are UNDEFINED (edge blocks read out of bounds) — callers
-    slice or ignore them.
-    """
-    r, c = x.shape
-    rpad = -(-r // pad_rows_to) * pad_rows_to
-    rt = 1024 if rpad % 1024 == 0 else 256
-    ct = 256
-    return pl.pallas_call(
-        _t_kernel,
-        grid=(rpad // rt, c // ct),
-        in_specs=[pl.BlockSpec((rt, ct), lambda i, j: (i, j),
-                               memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((ct, rt), lambda i, j: (j, i),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((c, rpad), x.dtype),
-        interpret=interpret,
-    )(x)
-
-
 def _t7_kernel(in_ref, out_ref):
     """Transpose one (1024-stream, ct-word) tile straight into the HH
     kernel's word layout: 8 sub-tile transposes, one per sublane group.

@@ -23,6 +23,8 @@ from minio_tpu.object.types import (DeleteOptions, GetOptions, InvalidArgument,
                                     ObjectNotFound, PutOptions)
 from minio_tpu.s3 import sigv4
 from minio_tpu.s3.errors import S3Error, from_exception
+from minio_tpu.utils.streams import (HashingReader, HttpChunkedReader,
+                                     LimitedReader, Payload)
 
 XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
 MAX_OBJECT_SIZE = 5 * (1 << 40)
@@ -142,6 +144,58 @@ def _make_handler(server: S3Server):
                 method, path, query, self._headers_lower(),
                 server.credentials.secret_for)
 
+        def _make_payload(self, auth) -> Payload:
+            """Sized streaming payload for object-data PUTs: the body is
+            never materialized; content verification (sha256 or chunk
+            signatures) runs incrementally and rejects before commit."""
+            h = self._headers_lower()
+            te = h.get("transfer-encoding", "")
+            if auth.payload_hash in (sigv4.STREAMING_PAYLOAD,
+                                     sigv4.STREAMING_PAYLOAD_TRAILER,
+                                     sigv4.STREAMING_UNSIGNED_TRAILER):
+                declared = h.get("x-amz-decoded-content-length")
+                if declared is None:
+                    raise S3Error("MissingContentLength")
+                declared = int(declared)
+                if declared > MAX_OBJECT_SIZE:
+                    raise S3Error("EntityTooLarge")
+                if "chunked" in te.lower():
+                    # aws-chunked inside HTTP TE-chunked (SDK pattern
+                    # for unknown-length streams): strip the transfer
+                    # framing incrementally first.
+                    raw = HttpChunkedReader(self.rfile)
+                else:
+                    encoded_len = int(h.get("content-length") or 0)
+                    raw = LimitedReader(self.rfile, encoded_len)
+                secret = server.credentials.secret_for(
+                    auth.credential.access_key)
+                reader = sigv4.ChunkedPayloadReader(
+                    raw, auth, secret,
+                    verify_signatures=auth.payload_hash
+                    != sigv4.STREAMING_UNSIGNED_TRAILER)
+                return Payload(reader, declared, finish=reader.finalize)
+            if "chunked" in te.lower():
+                # Plain HTTP chunked TE (no declared size): buffer it —
+                # rare for S3 clients; bounded by MAX_OBJECT_SIZE.
+                body = self._read_body()
+                if auth.payload_hash != sigv4.UNSIGNED_PAYLOAD and \
+                        hashlib.sha256(body).hexdigest() != auth.payload_hash:
+                    raise S3Error("XAmzContentSHA256Mismatch")
+                return Payload.wrap(body)
+            length = int(h.get("content-length") or 0)
+            if length > MAX_OBJECT_SIZE:
+                raise S3Error("EntityTooLarge")
+            raw = LimitedReader(self.rfile, length)
+            if auth.payload_hash == sigv4.UNSIGNED_PAYLOAD:
+                return Payload(raw, length)
+            hasher = HashingReader(raw)
+            want = auth.payload_hash
+
+            def fin():
+                if hasher.hexdigest() != want:
+                    raise S3Error("XAmzContentSHA256Mismatch")
+            return Payload(hasher, length, finish=fin)
+
         def _send(self, status: int, body: bytes = b"",
                   headers: dict | None = None, content_type="application/xml"):
             self.send_response(status)
@@ -182,7 +236,14 @@ def _make_handler(server: S3Server):
                 # RAW request path is signed — never a re-encoding of it.
                 auth = self._auth(method, raw_path, query)
                 body = b""
-                if method in ("PUT", "POST"):
+                payload = None
+                # Object-data PUTs stream O(window); every other body
+                # (bucket XML, multipart-complete XML, ...) is small and
+                # buffered with upfront content verification.
+                data_put = method == "PUT" and bool(key)
+                if data_put:
+                    payload = self._make_payload(auth)
+                elif method in ("PUT", "POST"):
                     body = self._read_body()
                     if auth.payload_hash in (
                             sigv4.STREAMING_PAYLOAD,
@@ -199,9 +260,17 @@ def _make_handler(server: S3Server):
                     if method == "GET":
                         return self._list_buckets()
                     raise S3Error("MethodNotAllowed")
-                if not key:
-                    return self._bucket_op(method, bucket, query, body)
-                return self._object_op(method, bucket, key, query, body)
+                try:
+                    if not key:
+                        return self._bucket_op(method, bucket, query, body)
+                    return self._object_op(method, bucket, key, query, body,
+                                           payload)
+                finally:
+                    # A handler that did not drain the request body (copy
+                    # object, errors) leaves bytes on the socket: close
+                    # rather than let keep-alive misparse them.
+                    if payload is not None and payload.remaining:
+                        self.close_connection = True
             except Exception as e:  # noqa: BLE001 - rendered as S3 error XML
                 self._send_error(e, bucket, key)
 
@@ -400,14 +469,14 @@ def _make_handler(server: S3Server):
 
         # -- object ops -------------------------------------------------
 
-        def _object_op(self, method, bucket, key, query, body):
+        def _object_op(self, method, bucket, key, query, body, payload=None):
             _validate_object_name(key)
             if method == "POST" and "uploads" in query:
                 return self._initiate_multipart(bucket, key)
             if method == "POST" and "uploadId" in query:
                 return self._complete_multipart(bucket, key, query, body)
             if method == "PUT" and "partNumber" in query:
-                return self._put_part(bucket, key, query, body,
+                return self._put_part(bucket, key, query, payload,
                                       self._headers_lower())
             if method == "DELETE" and "uploadId" in query:
                 server.object_layer.abort_multipart_upload(
@@ -416,7 +485,7 @@ def _make_handler(server: S3Server):
             if method == "GET" and "uploadId" in query:
                 return self._list_parts(bucket, key, query)
             if method == "PUT":
-                return self._put_object(bucket, key, query, body)
+                return self._put_object(bucket, key, query, payload)
             if method in ("GET", "HEAD"):
                 return self._get_object(method, bucket, key, query)
             if method == "DELETE":
@@ -441,7 +510,7 @@ def _make_handler(server: S3Server):
             _el(root, "UploadId", uid)
             self._send(200, _xml(root))
 
-        def _put_part(self, bucket, key, query, body, h):
+        def _put_part(self, bucket, key, query, payload, h):
             try:
                 part_num = int(query["partNumber"][0])
             except (ValueError, KeyError):
@@ -469,7 +538,7 @@ def _make_handler(server: S3Server):
                 _el(root, "LastModified", _iso8601(part.mod_time))
                 return self._send(200, _xml(root))
             part = server.object_layer.put_object_part(
-                bucket, key, uid, part_num, body)
+                bucket, key, uid, part_num, payload)
             self._send(200, headers={"ETag": f'"{part.etag}"'})
 
         def _complete_multipart(self, bucket, key, query, body):
@@ -554,7 +623,7 @@ def _make_handler(server: S3Server):
                 headers["x-amz-version-id"] = info.version_id
             self._send(200, _xml(root), headers=headers)
 
-        def _put_object(self, bucket, key, query, body):
+        def _put_object(self, bucket, key, query, payload):
             h = self._headers_lower()
             if "x-amz-copy-source" in h:
                 return self._copy_object(bucket, key, h)
@@ -565,7 +634,7 @@ def _make_handler(server: S3Server):
                 user_metadata=meta,
                 content_type=h.get("content-type", ""),
                 storage_class=h.get("x-amz-storage-class", "STANDARD"))
-            info = server.object_layer.put_object(bucket, key, body, opts)
+            info = server.object_layer.put_object(bucket, key, payload, opts)
             headers = {"ETag": f'"{info.etag}"'}
             if info.version_id:
                 headers["x-amz-version-id"] = info.version_id
@@ -576,7 +645,7 @@ def _make_handler(server: S3Server):
             vid = query.get("versionId", [""])[0]
             rng = h.get("range", "")
             spec = _range_spec(rng)
-            payload = b""
+            chunks = None
             if method == "HEAD":
                 # HEAD: metadata fan-out only, no shard reads.
                 info = server.object_layer.get_object_info(
@@ -584,7 +653,9 @@ def _make_handler(server: S3Server):
                 start, length = (_resolve_head_range(spec, info.size)
                                  if spec else (0, info.size))
             else:
-                info, payload = server.object_layer.get_object(
+                # Streaming read: O(window) memory, lock released when
+                # the iterator is exhausted.
+                info, chunks = server.object_layer.get_object_stream(
                     bucket, key, GetOptions(version_id=vid, range_spec=spec))
                 start, length = info.range_start, info.range_length
             if spec and info.size == 0 and spec[0] is None:
@@ -603,15 +674,32 @@ def _make_handler(server: S3Server):
             if spec:
                 headers["Content-Range"] = \
                     f"bytes {start}-{start + length - 1}/{info.size}"
-            if method == "HEAD":
+            try:
                 self.send_response(status)
+                self.send_header("x-amz-request-id", "0")
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(length))
                 for k2, v2 in headers.items():
                     self.send_header(k2, v2)
                 self.end_headers()
-                return
-            self._send(status, payload, headers=headers, content_type=ctype)
+                if method == "HEAD":
+                    return
+                sent = 0
+                try:
+                    for chunk in chunks:
+                        self.wfile.write(chunk)
+                        sent += len(chunk)
+                except Exception:  # noqa: BLE001 - headers already sent
+                    # Mid-stream failure (quorum loss, drive death) after
+                    # the status line went out: all we can do is cut the
+                    # connection short so the client sees a failed
+                    # (truncated) transfer, never a silently short 200.
+                    sent = -1
+                if sent != length:
+                    self.close_connection = True
+            finally:
+                if chunks is not None:
+                    chunks.close()
 
         def _delete_object(self, bucket, key, query):
             vid = query.get("versionId", [""])[0]

@@ -247,6 +247,117 @@ def verify_request(method: str, path: str, query: dict[str, list[str]],
 # aws-chunked streaming payload (per-chunk signatures)
 # ---------------------------------------------------------------------------
 
+class ChunkedPayloadReader:
+    """Incremental STREAMING-AWS4-HMAC-SHA256-PAYLOAD decoder.
+
+    Same framing and chunk-signature chain as decode_chunked_payload,
+    but pull-based: `.read(n)` parses frames as bytes arrive from the
+    socket, so multi-GiB streamed PUTs never materialize the encoded
+    body (reference: cmd/streaming-signature-v4.go's s3ChunkedReader).
+    `finalize()` consumes the terminal 0-chunk (verifying its signature
+    in signed mode) and drains any trailers; the put path runs it via
+    the Payload finish hook BEFORE committing the object.
+    """
+
+    _FILL = 64 * 1024
+
+    def __init__(self, raw, auth: ParsedAuth, secret: str,
+                 verify_signatures: bool = True):
+        self._raw = raw
+        self._auth = auth
+        self._verify = verify_signatures
+        self._seed_key = signing_key(secret, auth.credential.date,
+                                     auth.credential.region)
+        self._prev_sig = auth.signature
+        self._scope = auth.credential.scope()
+        self._buf = bytearray()
+        self._chunk = memoryview(b"")
+        self._done = False
+
+    # -- buffered raw access -------------------------------------------
+
+    def _fill(self) -> bool:
+        data = self._raw.read(self._FILL)
+        if not data:
+            return False
+        self._buf += data
+        return True
+
+    def _readline(self) -> bytes:
+        while True:
+            nl = self._buf.find(b"\r\n")
+            if nl >= 0:
+                line = bytes(self._buf[:nl])
+                del self._buf[:nl + 2]
+                return line
+            if not self._fill():
+                raise SigError("IncompleteBody", "truncated chunk header")
+
+    def _read_raw(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            if not self._fill():
+                raise SigError("IncompleteBody", "short chunk")
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
+    # -- frame parsing --------------------------------------------------
+
+    def _next_frame(self) -> None:
+        header = self._readline().decode("latin-1")
+        size_hex, _, ext = header.partition(";")
+        try:
+            size = int(size_hex, 16)
+        except ValueError:
+            raise SigError("InvalidChunkSizeError", size_hex) from None
+        data = self._read_raw(size)
+        if size > 0:
+            if self._read_raw(2) != b"\r\n":
+                raise SigError("IncompleteBody", "bad chunk delimiter")
+        if self._verify and (
+                self._auth.payload_hash == STREAMING_PAYLOAD
+                or (self._auth.payload_hash == STREAMING_PAYLOAD_TRAILER
+                    and size > 0)):
+            chunk_sig = ""
+            for kv in ext.split(";"):
+                if kv.startswith("chunk-signature="):
+                    chunk_sig = kv[len("chunk-signature="):]
+            sts = "\n".join([
+                "AWS4-HMAC-SHA256-PAYLOAD", self._auth.amz_date,
+                self._scope, self._prev_sig, EMPTY_SHA256,
+                hashlib.sha256(data).hexdigest()])
+            want = hmac.new(self._seed_key, sts.encode(),
+                            hashlib.sha256).hexdigest()
+            if not hmac.compare_digest(want, chunk_sig):
+                raise SigError("SignatureDoesNotMatch", "chunk signature")
+            self._prev_sig = want
+        if size == 0:
+            self._done = True
+        else:
+            self._chunk = memoryview(data)
+
+    def read(self, n: int) -> bytes:
+        while not self._chunk and not self._done:
+            self._next_frame()
+        if not self._chunk:
+            return b""
+        out = self._chunk[:n]
+        self._chunk = self._chunk[len(out):]
+        return bytes(out)
+
+    def finalize(self) -> None:
+        """Consume the 0-chunk + trailers; any further data chunk means
+        the body was longer than the declared decoded length."""
+        while not self._done:
+            self._next_frame()
+            if self._chunk:
+                raise SigError("IncompleteBody",
+                               "body exceeds decoded content length")
+        # Drain trailer lines so keep-alive sees a clean boundary.
+        while self._raw.read(self._FILL):
+            pass
+
+
 def decode_chunked_payload(body: bytes, auth: ParsedAuth, secret: str,
                            verify_signatures: bool = True) -> bytes:
     """Decode STREAMING-AWS4-HMAC-SHA256-PAYLOAD framing.

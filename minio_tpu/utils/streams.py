@@ -1,0 +1,194 @@
+"""Sized byte streams for the O(block) data path.
+
+The reference never holds whole objects in memory: PutObject pipes the
+request body through 1 MiB-block encode with readahead (reference:
+cmd/erasure-encode.go:69, cmd/erasure-object.go:1415-1428) and its hash
+readers verify content digests incrementally as bytes flow
+(internal/hash/reader.go:42). `Payload` is this framework's equivalent
+seam: a sized `.read(n)` source with an optional `finish()` hook that
+runs exactly once after the last byte is consumed — where incremental
+sha256/aws-chunk-signature verification rejects a tampered body BEFORE
+the object commit.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Callable, Optional
+
+
+class StreamError(Exception):
+    """Body ended early or a streaming integrity check failed."""
+
+
+class Payload:
+    """A sized byte source for put paths.
+
+    reader: object with read(n) -> bytes (may return fewer; b'' at EOF).
+    size: exact number of payload bytes the reader will deliver.
+    finish: optional hook called once after `size` bytes were consumed;
+        raises to abort the upload before commit (content-sha256 /
+        trailer verification lives here).
+    """
+
+    def __init__(self, reader, size: int,
+                 finish: Optional[Callable[[], None]] = None):
+        if size < 0:
+            raise ValueError("payload size must be known and non-negative")
+        self.size = size
+        self._reader = reader
+        self._finish = finish
+        self._remaining = size
+        self._finished = False
+
+    @property
+    def remaining(self) -> int:
+        return self._remaining
+
+    @classmethod
+    def wrap(cls, data) -> "Payload":
+        """bytes-like or Payload -> Payload."""
+        if isinstance(data, Payload):
+            return data
+        return cls(_BytesReader(data), len(data))
+
+    def read(self, n: int) -> bytes:
+        """Up to n payload bytes; b'' at end. Runs the finish hook on the
+        read that consumes the final byte (and on the first read of an
+        empty payload)."""
+        if self._remaining <= 0:
+            self._run_finish()
+            return b""
+        if n <= 0:
+            return b""
+        n = min(n, self._remaining)
+        chunk = self._reader.read(n)
+        if not chunk:
+            raise StreamError(
+                f"body ended {self._remaining} bytes short of declared size")
+        self._remaining -= len(chunk)
+        if self._remaining == 0:
+            self._run_finish()
+        return chunk
+
+    def read_exact(self, n: int) -> bytes:
+        """Exactly min(n, remaining) bytes."""
+        parts = []
+        want = n
+        while want > 0:
+            chunk = self.read(want)
+            if not chunk:
+                break
+            parts.append(chunk)
+            want -= len(chunk)
+        if not parts:
+            # Nothing left (or an empty payload): make sure the finish
+            # hook still runs — a 0-byte body must be verified too.
+            self.read(0)
+            return b""
+        return parts[0] if len(parts) == 1 else b"".join(parts)
+
+    def read_all(self) -> bytes:
+        return self.read_exact(self._remaining)
+
+    def _run_finish(self):
+        if not self._finished:
+            self._finished = True
+            if self._finish is not None:
+                self._finish()
+
+
+class _BytesReader:
+    def __init__(self, data):
+        self._mv = memoryview(data)
+        self._pos = 0
+
+    def read(self, n: int) -> bytes:
+        chunk = self._mv[self._pos:self._pos + n]
+        self._pos += len(chunk)
+        return bytes(chunk)
+
+
+class HashingReader:
+    """Wraps a reader, feeding every byte to a digest as it passes.
+
+    The put path reads through this so the content hash the client
+    declared can be checked the moment the body ends — no second pass,
+    no buffering (reference: internal/hash/reader.go:42)."""
+
+    def __init__(self, reader, algorithm: str = "sha256"):
+        self._reader = reader
+        self.digest = hashlib.new(algorithm)
+
+    def read(self, n: int) -> bytes:
+        chunk = self._reader.read(n)
+        if chunk:
+            self.digest.update(chunk)
+        return chunk
+
+    def hexdigest(self) -> str:
+        return self.digest.hexdigest()
+
+
+class LimitedReader:
+    """At most `limit` bytes from an underlying file-like reader."""
+
+    def __init__(self, raw, limit: int):
+        self._raw = raw
+        self._remaining = limit
+
+    def read(self, n: int) -> bytes:
+        if self._remaining <= 0:
+            return b""
+        chunk = self._raw.read(min(n, self._remaining))
+        self._remaining -= len(chunk)
+        return chunk
+
+
+class HttpChunkedReader:
+    """Incremental Transfer-Encoding: chunked decoder over a buffered
+    socket file (needs .readline()/.read()). Consumes the terminal
+    0-chunk and trailer lines fully so keep-alive connections see a
+    clean request boundary."""
+
+    def __init__(self, rfile, max_size: int = 5 * (1 << 40)):
+        self._rfile = rfile
+        self._max = max_size
+        self._seen = 0
+        self._left = 0          # unread bytes of the current chunk
+        self._done = False
+
+    def _next_chunk(self) -> None:
+        line = self._rfile.readline()
+        if not line:
+            raise StreamError("truncated chunked body")
+        try:
+            size = int(line.strip().split(b";")[0], 16)
+        except ValueError:
+            raise StreamError("bad chunk size") from None
+        self._seen += size
+        if self._seen > self._max:
+            raise StreamError("chunked body exceeds size limit")
+        if size == 0:
+            # Trailer section: zero or more header lines, then CRLF.
+            while True:
+                t = self._rfile.readline()
+                if not t or t in (b"\r\n", b"\n"):
+                    break
+            self._done = True
+        else:
+            self._left = size
+
+    def read(self, n: int) -> bytes:
+        while self._left == 0:
+            if self._done:
+                return b""
+            self._next_chunk()
+        take = min(n, self._left)
+        data = self._rfile.read(take)
+        if len(data) != take:
+            raise StreamError("truncated chunk data")
+        self._left -= take
+        if self._left == 0:
+            self._rfile.read(2)   # chunk-terminating CRLF
+        return data

@@ -24,7 +24,11 @@ class S3Client:
 
     def request(self, method: str, path: str, query: dict | None = None,
                 body: bytes = b"", headers: dict | None = None,
-                sign: bool = True, chunked: bool = False):
+                sign: bool = True, chunked: bool = False,
+                te_chunked: bool = False):
+        """te_chunked: send the (aws-chunked) body with HTTP
+        Transfer-Encoding: chunked instead of Content-Length — the SDK
+        pattern for unknown-length streaming uploads."""
         query = {k: [v] if isinstance(v, str) else v
                  for k, v in (query or {}).items()}
         headers = dict(headers or {})
@@ -62,6 +66,12 @@ class S3Client:
         # Send exactly the URI that was signed (raw-path verification).
         url = sigv4.uri_encode(path, encode_slash=False) + ("?" + qs if qs else "")
         conn = http.client.HTTPConnection(self.address, timeout=self.timeout)
+        if te_chunked:
+            # An iterable body with no Content-Length makes http.client
+            # use Transfer-Encoding: chunked.
+            step = 256 * 1024
+            body = iter([body[i:i + step]
+                         for i in range(0, len(body), step)] or [b""])
         try:
             conn.request(method, url, body=body, headers=send_headers)
             resp = conn.getresponse()

@@ -102,6 +102,22 @@ def test_streaming_chunked_put(cli):
     assert got == payload
 
 
+def test_streaming_put_te_chunked(cli):
+    """aws-chunked inside HTTP Transfer-Encoding: chunked (no
+    Content-Length) — the SDK's unknown-length streaming shape."""
+    _mk(cli, "techunk")
+    payload = os.urandom(300_000)
+    status, _, body = cli.request("PUT", "/techunk/stream", body=payload,
+                                  chunked=True, te_chunked=True)
+    assert status == 200, body
+    status, _, got = cli.request("GET", "/techunk/stream")
+    assert got == payload
+    # Keep-alive stays clean after the trailer drain: a second request
+    # on a fresh connection round-trips normally.
+    status, _, _ = cli.request("HEAD", "/techunk/stream")
+    assert status == 200
+
+
 def test_listing_v1_v2(cli):
     _mk(cli, "listing")
     for k in ("a/1.txt", "a/2.txt", "b/3.txt", "top.txt"):
