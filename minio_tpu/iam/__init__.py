@@ -1,0 +1,14 @@
+"""Identity and access management: users, service accounts, policies.
+
+The framework's analogue of the reference's IAM subsystem (cmd/iam.go,
+cmd/iam-store.go, internal/policy): credentials resolve to policy
+documents, every S3 request maps to an (action, resource) pair, and the
+policy engine decides allow/deny with explicit-deny-wins semantics.
+"""
+
+from minio_tpu.iam.policy import (Policy, PolicyError, Statement,
+                                  canned_policies, evaluate)
+from minio_tpu.iam.store import IAMSys, IAMError
+
+__all__ = ["Policy", "PolicyError", "Statement", "canned_policies",
+           "evaluate", "IAMSys", "IAMError"]

@@ -1,0 +1,129 @@
+"""Policy documents and their evaluation.
+
+Mirrors the reference's policy semantics (internal/policy/policy.go):
+a document is a list of statements, each Allow or Deny over wildcarded
+Actions and Resources; an explicit Deny always wins, absence of an
+Allow denies. Wildcards are AWS-style (`*` any run, `?` one char).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import functools
+import json
+import re
+from typing import Sequence
+
+ARN_PREFIX = "arn:aws:s3:::"
+
+
+class PolicyError(Exception):
+    pass
+
+
+def _compile(pattern: str) -> re.Pattern:
+    return re.compile(fnmatch.translate(pattern))
+
+
+@dataclasses.dataclass
+class Statement:
+    effect: str                 # "Allow" | "Deny"
+    actions: list
+    resources: list
+    _action_res: list = dataclasses.field(default_factory=list, repr=False)
+    _resource_res: list = dataclasses.field(default_factory=list, repr=False)
+
+    def __post_init__(self):
+        if self.effect not in ("Allow", "Deny"):
+            raise PolicyError(f"bad Effect {self.effect!r}")
+        if not self.actions or not self.resources:
+            raise PolicyError("statement needs Action and Resource")
+        self._action_res = [_compile(a) for a in self.actions]
+        self._resource_res = [_compile(r[len(ARN_PREFIX):]
+                                       if r.startswith(ARN_PREFIX) else r)
+                              for r in self.resources]
+
+    def matches(self, action: str, resource: str) -> bool:
+        return any(p.match(action) for p in self._action_res) and \
+            any(p.match(resource) for p in self._resource_res)
+
+
+@dataclasses.dataclass
+class Policy:
+    statements: list
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "Policy":
+        stmts = doc.get("Statement")
+        if stmts is None:
+            raise PolicyError("missing Statement")
+        if isinstance(stmts, dict):
+            stmts = [stmts]
+        out = []
+        for s in stmts:
+            actions = s.get("Action", [])
+            resources = s.get("Resource", [])
+            if isinstance(actions, str):
+                actions = [actions]
+            if isinstance(resources, str):
+                resources = [resources]
+            out.append(Statement(effect=s.get("Effect", ""),
+                                 actions=list(actions),
+                                 resources=list(resources)))
+        return cls(statements=out)
+
+    def to_json(self) -> dict:
+        return {"Version": "2012-10-17",
+                "Statement": [{"Effect": s.effect, "Action": s.actions,
+                               "Resource": s.resources}
+                              for s in self.statements]}
+
+
+def evaluate(policies: Sequence[Policy], action: str, resource: str) -> bool:
+    """Explicit Deny wins; otherwise any Allow permits; default deny
+    (reference: policy.Policy.IsAllowed)."""
+    allowed = False
+    for p in policies:
+        for s in p.statements:
+            if s.matches(action, resource):
+                if s.effect == "Deny":
+                    return False
+                allowed = True
+    return allowed
+
+
+@functools.lru_cache(maxsize=4096)
+def _policy_from_canonical(doc_json: str) -> Policy:
+    return Policy.from_json(json.loads(doc_json))
+
+
+def compile_policy(doc: dict) -> Policy:
+    """Cached document -> compiled Policy (the per-request hot path:
+    regex compilation happens once per distinct document)."""
+    return _policy_from_canonical(json.dumps(doc, sort_keys=True))
+
+
+@functools.lru_cache(maxsize=1)
+def canned_policies() -> dict[str, Policy]:
+    """The reference's built-in policies (cmd/iam.go embedded policies)."""
+    def mk(effect, actions, resources):
+        return Statement(effect=effect, actions=actions, resources=resources)
+
+    return {
+        "readonly": Policy([mk("Allow",
+                               ["s3:GetBucketLocation", "s3:GetObject",
+                                "s3:GetObjectVersion", "s3:ListBucket",
+                                "s3:ListAllMyBuckets",
+                                "s3:GetBucketVersioning"],
+                               ["*"])]),
+        "writeonly": Policy([mk("Allow",
+                                ["s3:PutObject", "s3:AbortMultipartUpload",
+                                 "s3:ListMultipartUploadParts",
+                                 "s3:ListBucketMultipartUploads"],
+                                ["*"])]),
+        "readwrite": Policy([mk("Allow", ["s3:*"], ["*"])]),
+        "diagnostics": Policy([mk("Allow", ["admin:ServerInfo",
+                                            "admin:Prometheus"], ["*"])]),
+        "consoleAdmin": Policy([mk("Allow", ["s3:*", "admin:*"], ["*"])]),
+    }

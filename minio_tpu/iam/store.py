@@ -1,0 +1,223 @@
+"""IAM store: users, service accounts, named policies, persistence.
+
+The runtime registry behind credential resolution and per-request
+authorization (reference: cmd/iam-store.go). State is one JSON document
+quorum-replicated across every drive of the first pool under the system
+volume (`config/iam/iam.json`), mirroring how the reference keeps IAM
+objects under .minio.sys/config/iam/ with quorum writes; a short TTL
+cache keeps request-path lookups off the drives.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional
+
+from minio_tpu.iam.policy import (Policy, PolicyError, canned_policies,
+                                  compile_policy)
+
+IAM_PATH = "config/iam/iam.json"
+SYS_VOL = ".mtpu.sys"
+
+
+class IAMError(Exception):
+    pass
+
+
+class IAMSys:
+    """Users + service accounts + policies with quorum persistence.
+
+    `sets`: the erasure sets whose drives replicate the IAM document
+    (the first pool's sets, like bucket metadata). root credentials are
+    implicit and NOT stored — root always passes authorization
+    (reference: cmd/iam.go's owner short-circuit)."""
+
+    _TTL = 2.0
+
+    def __init__(self, sets, root_access: str, root_secret: str):
+        self._sets = list(sets)
+        self.root_access = root_access
+        self.root_secret = root_secret
+        self._mu = threading.RLock()
+        self._state = {"users": {}, "service_accounts": {},
+                       "policies": {}, "user_policies": {}}
+        self._loaded_at = 0.0
+        self._load()
+
+    # -- persistence ----------------------------------------------------
+
+    def _disks(self):
+        return [d for es in self._sets for d in es.disks]
+
+    def _load(self) -> None:
+        votes: dict[bytes, int] = {}
+        for d in self._disks():
+            try:
+                blob = d.read_all(SYS_VOL, IAM_PATH)
+                votes[blob] = votes.get(blob, 0) + 1
+            except Exception:  # noqa: BLE001 - absent / offline
+                continue
+        if votes:
+            blob = max(votes.items(), key=lambda kv: kv[1])[0]
+            try:
+                self._state = json.loads(blob)
+            except ValueError:
+                pass
+        self._loaded_at = time.monotonic()
+
+    def _save(self) -> None:
+        blob = json.dumps(self._state, sort_keys=True).encode()
+        ok = 0
+        for d in self._disks():
+            try:
+                d.write_all(SYS_VOL, IAM_PATH, blob)
+                ok += 1
+            except Exception:  # noqa: BLE001 - offline drive
+                continue
+        if ok < len(self._disks()) // 2 + 1:
+            raise IAMError("could not persist IAM state to a drive quorum")
+
+    def _refresh(self) -> None:
+        if time.monotonic() - self._loaded_at > self._TTL:
+            self._load()
+
+    # -- credential resolution ------------------------------------------
+
+    def secret_for(self, access_key: str) -> Optional[str]:
+        """Secret key for signature verification; None = unknown key."""
+        if access_key == self.root_access:
+            return self.root_secret
+        with self._mu:
+            self._refresh()
+            u = self._state["users"].get(access_key)
+            if u is not None and u.get("status", "enabled") == "enabled":
+                return u["secret"]
+            sa = self._state["service_accounts"].get(access_key)
+            if sa is not None and sa.get("status", "enabled") == "enabled":
+                return sa["secret"]
+        return None
+
+    def is_root(self, access_key: str) -> bool:
+        return access_key == self.root_access
+
+    # -- authorization ---------------------------------------------------
+
+    def policies_for(self, access_key: str) -> list[Policy]:
+        with self._mu:
+            self._refresh()
+            names: list[str] = []
+            sa = self._state["service_accounts"].get(access_key)
+            if sa is not None:
+                embedded = sa.get("policy")
+                if embedded:
+                    try:
+                        return [compile_policy(embedded)]
+                    except (PolicyError, TypeError):
+                        return []
+                # No embedded policy: inherit the parent user's.
+                access_key = sa.get("parent", access_key)
+            names = list(self._state["user_policies"].get(access_key, []))
+            docs = []
+            canned = canned_policies()
+            for name in names:
+                stored = self._state["policies"].get(name)
+                if stored is not None:
+                    try:
+                        docs.append(compile_policy(stored))
+                        continue
+                    except (PolicyError, TypeError):
+                        continue
+                if name in canned:
+                    docs.append(canned[name])
+            return docs
+
+    def is_allowed(self, access_key: str, action: str, resource: str) -> bool:
+        if self.is_root(access_key):
+            return True
+        from minio_tpu.iam.policy import evaluate
+        return evaluate(self.policies_for(access_key), action, resource)
+
+    # -- management (root-only; enforcement is the admin handler's job) --
+
+    def add_user(self, access_key: str, secret_key: str) -> None:
+        if not access_key or access_key == self.root_access:
+            raise IAMError("invalid access key")
+        if len(secret_key) < 8:
+            raise IAMError("secret key too short")
+        with self._mu:
+            self._state["users"][access_key] = {
+                "secret": secret_key, "status": "enabled"}
+            self._save()
+
+    def remove_user(self, access_key: str) -> None:
+        with self._mu:
+            if self._state["users"].pop(access_key, None) is None:
+                raise IAMError("no such user")
+            self._state["user_policies"].pop(access_key, None)
+            # Orphan its service accounts too.
+            for k in [k for k, sa in self._state["service_accounts"].items()
+                      if sa.get("parent") == access_key]:
+                self._state["service_accounts"].pop(k, None)
+            self._save()
+
+    def set_user_status(self, access_key: str, enabled: bool) -> None:
+        with self._mu:
+            u = self._state["users"].get(access_key)
+            if u is None:
+                raise IAMError("no such user")
+            u["status"] = "enabled" if enabled else "disabled"
+            self._save()
+
+    def list_users(self) -> dict:
+        with self._mu:
+            self._refresh()
+            return {k: {"status": u.get("status", "enabled"),
+                        "policies": self._state["user_policies"].get(k, [])}
+                    for k, u in self._state["users"].items()}
+
+    def add_service_account(self, parent: str, access_key: str,
+                            secret_key: str,
+                            policy: Optional[dict] = None) -> None:
+        if parent != self.root_access and \
+                parent not in self._state["users"]:
+            raise IAMError("no such parent user")
+        if policy is not None:
+            Policy.from_json(policy)   # validate
+        with self._mu:
+            self._state["service_accounts"][access_key] = {
+                "secret": secret_key, "parent": parent,
+                "policy": policy, "status": "enabled"}
+            self._save()
+
+    def set_policy(self, name: str, doc: dict) -> None:
+        Policy.from_json(doc)   # validate before storing
+        with self._mu:
+            self._state["policies"][name] = doc
+            self._save()
+
+    def delete_policy(self, name: str) -> None:
+        with self._mu:
+            if self._state["policies"].pop(name, None) is None:
+                raise IAMError("no such policy")
+            self._save()
+
+    def list_policies(self) -> dict:
+        with self._mu:
+            self._refresh()
+            out = {name: doc for name, doc in self._state["policies"].items()}
+            for name, p in canned_policies().items():
+                out.setdefault(name, p.to_json())
+            return out
+
+    def attach_policy(self, access_key: str, names: list[str]) -> None:
+        with self._mu:
+            if access_key not in self._state["users"]:
+                raise IAMError("no such user")
+            known = set(self._state["policies"]) | set(canned_policies())
+            for n in names:
+                if n not in known:
+                    raise IAMError(f"no such policy {n!r}")
+            self._state["user_policies"][access_key] = list(names)
+            self._save()

@@ -335,28 +335,113 @@ def heal_bucket(es, bucket: str) -> dict:
                           if i in missing and e is None)}
 
 
+MRF_PATH = "mrf/pending.json"
+
+
 class MRFQueue:
     """Most-recently-failed heal queue: partial writes retry immediately
-    in the background (reference: cmd/mrf.go, bounded queue + worker)."""
+    in the background (reference: cmd/mrf.go, bounded queue + worker).
 
-    def __init__(self, es, max_items: int = 100_000, retries: int = 3):
+    Pending entries persist to the system volume (best-effort, across
+    all drives) whenever the queue has been dirty for a moment, and are
+    reloaded+replayed at boot — the reference saves its MRF queue on
+    shutdown and re-queues it at startup (cmd/mrf.go:155 healMRFDir)."""
+
+    _PERSIST_EVERY = 2.0
+
+    def __init__(self, es, max_items: int = 100_000, retries: int = 3,
+                 persist: bool = True):
         self.es = es
         self.q: "queue.Queue[tuple]" = queue.Queue(maxsize=max_items)
         self.retries = retries
         self.healed = 0
         self.dropped = 0
+        self._persist = persist
+        self._pending: dict[tuple, int] = {}   # (bucket, obj, vid) -> 1
+        self._dirty = False
+        self._last_save = 0.0
+        self._mu = threading.Lock()
         self._stop = threading.Event()
+        if persist:
+            self._load()
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
 
     def enqueue(self, bucket: str, object_: str, version_id: str = "") -> None:
         try:
             self.q.put_nowait((bucket, object_, version_id, 0))
+            with self._mu:
+                self._pending[(bucket, object_, version_id)] = 1
+                self._dirty = True
         except queue.Full:
             self.dropped += 1
 
+    # -- persistence ----------------------------------------------------
+
+    def _load(self) -> None:
+        import json
+        from minio_tpu.storage.local import SYS_VOL
+        # Union across drives: a pending heal recorded by ANY healthy
+        # drive replays (a flaky drive with a stale copy must not make
+        # entries vanish — losing a heal is worse than re-running one,
+        # and heals are idempotent).
+        entries: dict[tuple, int] = {}
+        for d in self.es.disks:
+            try:
+                items = json.loads(d.read_all(SYS_VOL, MRF_PATH))
+            except Exception:  # noqa: BLE001 - absent / offline
+                continue
+            for it in items:
+                try:
+                    entries[(it["b"], it["o"], it.get("v", ""))] = 1
+                except TypeError:
+                    continue
+        for (b, o, v) in entries:
+            try:
+                self.q.put_nowait((b, o, v, 0))
+                self._pending[(b, o, v)] = 1
+            except queue.Full:
+                self.dropped += 1
+
+    def _save(self) -> None:
+        import json
+        from minio_tpu.storage.local import SYS_VOL
+        with self._mu:
+            items = [{"b": b, "o": o, "v": v}
+                     for (b, o, v) in self._pending]
+            self._dirty = False
+        blob = json.dumps(items).encode()
+
+        def write(d):
+            def go():
+                try:
+                    d.write_all(SYS_VOL, MRF_PATH, blob)
+                except Exception:  # noqa: BLE001 - best effort
+                    pass
+            return go
+        self.es._fanout([write(d) for d in self.es.disks])
+
+    def _maybe_persist(self) -> None:
+        if not self._persist:
+            return
+        now = time.time()
+        if self._dirty and now - self._last_save >= self._PERSIST_EVERY:
+            self._last_save = now
+            self._save()
+
+    def save_now(self) -> None:
+        """Flush pending entries to disk (shutdown / testing hook)."""
+        if self._persist:
+            self._save()
+
+    # -- worker ---------------------------------------------------------
+
     def _run(self) -> None:
         while not self._stop.is_set():
+            try:
+                self._maybe_persist()
+            except Exception:  # noqa: BLE001 - e.g. pool torn down at exit
+                pass
             try:
                 bucket, object_, vid, attempt = self.q.get(timeout=0.2)
             except queue.Empty:
@@ -366,6 +451,9 @@ class MRFQueue:
                 # bitrot hits, partial writes), so verify deeply.
                 heal_object(self.es, bucket, object_, vid, deep=True)
                 self.healed += 1
+                with self._mu:
+                    self._pending.pop((bucket, object_, vid), None)
+                    self._dirty = True
             except Exception:  # noqa: BLE001 - retry w/ backoff, then drop
                 if attempt + 1 < self.retries and not self._stop.is_set():
                     time.sleep(min(2 ** attempt * 0.05, 1.0))
@@ -375,6 +463,9 @@ class MRFQueue:
                         self.dropped += 1
                 else:
                     self.dropped += 1
+                    with self._mu:
+                        self._pending.pop((bucket, object_, vid), None)
+                        self._dirty = True
             finally:
                 self.q.task_done()
 
@@ -389,3 +480,8 @@ class MRFQueue:
     def stop(self) -> None:
         self._stop.set()
         self._worker.join(timeout=2)
+        if self._persist:
+            try:
+                self._save()
+            except Exception:  # noqa: BLE001 - shutdown best effort
+                pass

@@ -124,14 +124,20 @@ def put_object_part(es, bucket: str, object_: str, upload_id: str,
         def path_for(i: int):
             return es.disks[i], eo.SYS_VOL, f"{updir}/{data_file}"
 
-        etag, werrors = es._stream_framed_writes(payload, k, m, dist,
-                                                 path_for)
+        def cleanup_staged():
+            es._fanout([lambda d=d: eo._swallow(
+                lambda: d.delete(eo.SYS_VOL, f"{updir}/{data_file}"))
+                for d in es.disks])
+
+        try:
+            etag, werrors = es._stream_framed_writes(payload, k, m, dist,
+                                                     path_for)
+        except Exception:
+            cleanup_staged()
+            raise
         staged = [i for i in range(n) if werrors[i] is None]
         if len(staged) < write_quorum:
-            es._fanout([lambda i=i: eo._swallow(
-                lambda: es.disks[i].delete(eo.SYS_VOL,
-                                           f"{updir}/{data_file}"))
-                for i in staged])
+            cleanup_staged()
             raise WriteQuorumError(bucket, object_)
         meta = {"number": part_number, "size": size, "actual_size": size,
                 "etag": etag, "mod_time": now_ns(), "file": data_file}
@@ -141,6 +147,7 @@ def put_object_part(es, bucket: str, object_: str, upload_id: str,
                 eo.SYS_VOL, f"{updir}/part.{part_number}.meta", blob)
              for i in staged])
         if sum(e2 is None for e2 in merrors) < write_quorum:
+            cleanup_staged()
             raise WriteQuorumError(bucket, object_)
         return ObjectPartInfo(number=part_number, size=size,
                               actual_size=size, etag=etag,

@@ -51,18 +51,33 @@ def _el(parent, tag, text=None):
 
 
 class Credentials:
-    """Static credential provider (IAM subsystem replaces this)."""
+    """Root credentials + optional IAM store behind one resolver.
 
-    def __init__(self, access_key: str = "", secret_key: str = ""):
+    With an IAMSys attached, non-root access keys resolve through the
+    store (users and service accounts) and per-request authorization
+    runs against their policies; without one, only root exists."""
+
+    def __init__(self, access_key: str = "", secret_key: str = "",
+                 iam=None):
         self.access_key = access_key or os.environ.get(
             "MTPU_ROOT_USER", "minioadmin")
         self.secret_key = secret_key or os.environ.get(
             "MTPU_ROOT_PASSWORD", "minioadmin")
+        self.iam = iam
 
     def secret_for(self, access_key: str):
         if access_key == self.access_key:
             return self.secret_key
+        if self.iam is not None:
+            return self.iam.secret_for(access_key)
         return None
+
+    def is_allowed(self, access_key: str, action: str, resource: str) -> bool:
+        if access_key == self.access_key:
+            return True
+        if self.iam is not None:
+            return self.iam.is_allowed(access_key, action, resource)
+        return False
 
 
 class S3Server:
@@ -235,6 +250,19 @@ def _make_handler(server: S3Server):
                 # for it (streaming modes verify per chunk instead). The
                 # RAW request path is signed — never a re-encoding of it.
                 auth = self._auth(method, raw_path, query)
+                if raw_path == "/minio/admin" or \
+                        raw_path.startswith("/minio/admin/"):
+                    return self._admin_op(method, raw_path, query, auth)
+                # Per-request policy authorization (reference:
+                # checkRequestAuthType -> IsAllowed): root passes, IAM
+                # identities evaluate their policy documents.
+                ak = auth.credential.access_key
+                for action, resource in _required_permissions(
+                        method, bucket, key, query, self._headers_lower()):
+                    if not server.credentials.is_allowed(ak, action,
+                                                         resource):
+                        raise S3Error("AccessDenied", bucket=bucket,
+                                      key=key)
                 body = b""
                 payload = None
                 # Object-data PUTs stream O(window); every other body
@@ -701,6 +729,73 @@ def _make_handler(server: S3Server):
                 if chunks is not None:
                     chunks.close()
 
+        # -- admin API (/minio/admin/v3/...) ---------------------------
+
+        def _admin_op(self, method, raw_path, query, auth):
+            """IAM management endpoints, root-only (reference:
+            cmd/admin-handlers-users.go; bodies are plain JSON rather
+            than the reference's madmin-encrypted payloads)."""
+            import json as _json
+            ak = auth.credential.access_key
+            if not server.credentials.is_allowed(ak, "admin:*", "*"):
+                raise S3Error("AccessDenied")
+            iam = server.credentials.iam
+            if iam is None:
+                raise S3Error("NotImplemented")
+            op = raw_path[len("/minio/admin/v3/"):] \
+                if raw_path.startswith("/minio/admin/v3/") else ""
+            body = self._read_body()
+            q1 = {k: v[0] for k, v in query.items()}
+
+            def ok(payload=None):
+                blob = _json.dumps(payload).encode() \
+                    if payload is not None else b""
+                self._send(200, blob, content_type="application/json")
+
+            try:
+                if op == "add-user" and method == "PUT":
+                    doc = _json.loads(body)
+                    iam.add_user(q1.get("accessKey", ""),
+                                 doc.get("secretKey", ""))
+                    return ok()
+                if op == "remove-user" and method == "DELETE":
+                    iam.remove_user(q1.get("accessKey", ""))
+                    return ok()
+                if op == "list-users" and method == "GET":
+                    return ok(iam.list_users())
+                if op == "set-user-status" and method == "PUT":
+                    iam.set_user_status(q1.get("accessKey", ""),
+                                        q1.get("status", "") == "enabled")
+                    return ok()
+                if op == "add-canned-policy" and method == "PUT":
+                    iam.set_policy(q1.get("name", ""), _json.loads(body))
+                    return ok()
+                if op == "remove-canned-policy" and method == "DELETE":
+                    iam.delete_policy(q1.get("name", ""))
+                    return ok()
+                if op == "list-canned-policies" and method == "GET":
+                    return ok(iam.list_policies())
+                if op == "set-user-or-group-policy" and method == "PUT":
+                    names = [n for n in
+                             q1.get("policyName", "").split(",") if n]
+                    iam.attach_policy(q1.get("userOrGroup", ""), names)
+                    return ok()
+                if op == "add-service-account" and method == "PUT":
+                    doc = _json.loads(body)
+                    iam.add_service_account(
+                        doc.get("parent", server.credentials.access_key),
+                        doc.get("accessKey", ""), doc.get("secretKey", ""),
+                        doc.get("policy"))
+                    return ok()
+            except ValueError:
+                raise S3Error("MalformedXML") from None
+            except Exception as e:
+                from minio_tpu.iam import IAMError
+                if isinstance(e, IAMError):
+                    raise S3Error("InvalidArgument", str(e)) from None
+                raise
+            raise S3Error("MethodNotAllowed")
+
         def _delete_object(self, bucket, key, query):
             vid = query.get("versionId", [""])[0]
             deleted = server.object_layer.delete_object(
@@ -716,6 +811,58 @@ def _make_handler(server: S3Server):
             self._send(204, headers=headers)
 
     return Handler
+
+
+def _required_permissions(method: str, bucket: str, key: str, query: dict,
+                          h: dict) -> list[tuple[str, str]]:
+    """Map one S3 request to the (action, resource) pairs it needs
+    (reference: cmd/api-router.go handler -> policy.Action wiring).
+    Resources are `bucket` / `bucket/key` (ARN prefix already stripped,
+    matching iam.policy's compiled patterns)."""
+    if not bucket:
+        return [("s3:ListAllMyBuckets", "*")] if method == "GET" else []
+    perms: list[tuple[str, str]] = []
+    if key and method == "PUT" and "x-amz-copy-source" in h:
+        src = urllib.parse.unquote(h["x-amz-copy-source"]).lstrip("/")
+        src = src.partition("?versionId=")[0]
+        perms.append(("s3:GetObject", src))
+    if not key:
+        if method == "PUT":
+            perms.append(("s3:PutBucketVersioning", bucket)
+                         if "versioning" in query
+                         else ("s3:CreateBucket", bucket))
+        elif method == "DELETE":
+            perms.append(("s3:DeleteBucket", bucket))
+        elif method == "HEAD":
+            perms.append(("s3:ListBucket", bucket))
+        elif method == "POST" and "delete" in query:
+            perms.append(("s3:DeleteObject", f"{bucket}/*"))
+        elif method == "GET":
+            if "uploads" in query:
+                perms.append(("s3:ListBucketMultipartUploads", bucket))
+            elif "versioning" in query:
+                perms.append(("s3:GetBucketVersioning", bucket))
+            elif "location" in query:
+                perms.append(("s3:GetBucketLocation", bucket))
+            else:
+                perms.append(("s3:ListBucket", bucket))
+        return perms
+    res = f"{bucket}/{key}"
+    if method in ("GET", "HEAD"):
+        if "uploadId" in query:
+            perms.append(("s3:ListMultipartUploadParts", res))
+        elif query.get("versionId", [""])[0]:
+            perms.append(("s3:GetObjectVersion", res))
+        else:
+            perms.append(("s3:GetObject", res))
+    elif method == "PUT":
+        perms.append(("s3:PutObject", res))
+    elif method == "DELETE":
+        perms.append(("s3:AbortMultipartUpload", res)
+                     if "uploadId" in query else ("s3:DeleteObject", res))
+    elif method == "POST":
+        perms.append(("s3:PutObject", res))
+    return perms
 
 
 def _b64e(s: str) -> str:

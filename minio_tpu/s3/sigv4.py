@@ -260,6 +260,11 @@ class ChunkedPayloadReader:
     """
 
     _FILL = 64 * 1024
+    # Bounds (the reference's maxLineLength / chunk-size discipline,
+    # cmd/streaming-signature-v4.go): without them one malicious giant
+    # chunk or a header with no CRLF would buffer the whole body.
+    _MAX_HEADER = 4 * 1024
+    _MAX_CHUNK = 16 << 20
 
     def __init__(self, raw, auth: ParsedAuth, secret: str,
                  verify_signatures: bool = True):
@@ -290,6 +295,9 @@ class ChunkedPayloadReader:
                 line = bytes(self._buf[:nl])
                 del self._buf[:nl + 2]
                 return line
+            if len(self._buf) > self._MAX_HEADER:
+                raise SigError("InvalidChunkSizeError",
+                               "chunk header too long")
             if not self._fill():
                 raise SigError("IncompleteBody", "truncated chunk header")
 
@@ -310,6 +318,8 @@ class ChunkedPayloadReader:
             size = int(size_hex, 16)
         except ValueError:
             raise SigError("InvalidChunkSizeError", size_hex) from None
+        if size < 0 or size > self._MAX_CHUNK:
+            raise SigError("InvalidChunkSizeError", size_hex)
         data = self._read_raw(size)
         if size > 0:
             if self._read_raw(2) != b"\r\n":

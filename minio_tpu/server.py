@@ -42,6 +42,9 @@ def main(argv=None) -> int:
                     help="drives per erasure set (default: auto 2-16)")
     ap.add_argument("--boot-timeout", type=float, default=120.0,
                     help="seconds to wait for peer nodes at boot")
+    ap.add_argument("--scanner-interval", type=float, default=60.0,
+                    help="seconds between background scanner cycles "
+                         "(0 disables the background thread)")
     ap.add_argument("drives", nargs="+",
                     help="drive dirs or http://host:port/path endpoints; "
                          "`{1...N}` ellipses expand, and each ellipses "
@@ -72,7 +75,7 @@ def main(argv=None) -> int:
     from minio_tpu.object.erasure_object import ErasureSet
     from minio_tpu.object.pools import ServerPools
     from minio_tpu.object.sets import ErasureSets
-    from minio_tpu.s3.server import S3Server
+    from minio_tpu.s3.server import Credentials, S3Server
     from minio_tpu.storage.local import LocalStorage, OfflineDisk
     from minio_tpu.storage.remote import RemoteStorage, StorageRPCService
     from minio_tpu.topology import ellipses, format as fmt_mod
@@ -223,7 +226,21 @@ def main(argv=None) -> int:
                 pass
 
     layer = ServerPools(pools)
-    srv = S3Server(layer, address=args.address)
+    # Background data scanner: usage accounting, 1/1024 deep-heal
+    # sampling, replaced-drive format restore (reference:
+    # cmd/data-scanner.go's scanner loop).
+    from minio_tpu.object.scanner import Scanner
+    all_sets = [s for p in pools for s in p.sets]
+    scanner = Scanner(all_sets, interval=args.scanner_interval)
+    if args.scanner_interval > 0:
+        scanner.start()
+    layer.scanner = scanner
+    # IAM: users/service-accounts/policies, replicated on pool 0's
+    # drives (reference: cmd/iam.go bootstrap).
+    from minio_tpu.iam import IAMSys
+    creds = Credentials()
+    creds.iam = IAMSys(pools[0].sets, creds.access_key, creds.secret_key)
+    srv = S3Server(layer, address=args.address, credentials=creds)
     print(f"minio-tpu serving S3 on {srv.address} "
           f"({len(pools)} pools, {n_sets} sets, {n_drives} drives, "
           f"{'distributed, ' if distributed else ''}"
@@ -233,6 +250,7 @@ def main(argv=None) -> int:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
+        scanner.stop()
         srv.stop()
         if grid_srv is not None:
             grid_srv.stop()

@@ -1,0 +1,191 @@
+"""IAM: policy evaluation, the store, and end-to-end enforcement through
+the S3 API (reference: cmd/iam.go, internal/policy)."""
+
+import json
+
+import pytest
+
+from minio_tpu.iam import IAMError, IAMSys, Policy, canned_policies, evaluate
+from minio_tpu.object.erasure_object import ErasureSet
+from minio_tpu.s3.server import Credentials, S3Server
+from minio_tpu.storage.local import LocalStorage
+from tests.s3client import S3Client
+
+
+# ---------------------------------------------------------------------------
+# policy engine
+# ---------------------------------------------------------------------------
+
+def _pol(effect, actions, resources):
+    return Policy.from_json({"Statement": [{
+        "Effect": effect, "Action": actions, "Resource": resources}]})
+
+
+def test_explicit_deny_wins():
+    allow = _pol("Allow", ["s3:*"], ["*"])
+    deny = _pol("Deny", ["s3:DeleteObject"], ["arn:aws:s3:::secure/*"])
+    assert evaluate([allow, deny], "s3:GetObject", "secure/x")
+    assert not evaluate([allow, deny], "s3:DeleteObject", "secure/x")
+    assert evaluate([allow, deny], "s3:DeleteObject", "other/x")
+
+
+def test_default_deny_and_wildcards():
+    p = _pol("Allow", ["s3:Get*"], ["arn:aws:s3:::data/*"])
+    assert evaluate([p], "s3:GetObject", "data/a/b")
+    assert not evaluate([p], "s3:PutObject", "data/a")
+    assert not evaluate([p], "s3:GetObject", "other/a")
+    assert not evaluate([], "s3:GetObject", "data/a")
+
+
+def test_canned_policies_shape():
+    c = canned_policies()
+    assert evaluate([c["readonly"]], "s3:GetObject", "b/k")
+    assert not evaluate([c["readonly"]], "s3:PutObject", "b/k")
+    assert evaluate([c["readwrite"]], "s3:DeleteObject", "b/k")
+    assert not evaluate([c["writeonly"]], "s3:GetObject", "b/k")
+    assert evaluate([c["writeonly"]], "s3:PutObject", "b/k")
+
+
+# ---------------------------------------------------------------------------
+# store
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def es(tmp_path):
+    disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    return ErasureSet(disks)
+
+
+def test_store_users_and_persistence(es):
+    iam = IAMSys([es], "root", "rootsecret")
+    iam.add_user("alice", "alicesecret")
+    iam.attach_policy("alice", ["readonly"])
+    assert iam.secret_for("alice") == "alicesecret"
+    assert iam.secret_for("root") == "rootsecret"
+    assert iam.secret_for("nobody") is None
+    # New instance reloads from the drives.
+    iam2 = IAMSys([es], "root", "rootsecret")
+    assert iam2.secret_for("alice") == "alicesecret"
+    assert iam2.is_allowed("alice", "s3:GetObject", "b/k")
+    assert not iam2.is_allowed("alice", "s3:PutObject", "b/k")
+    assert iam2.is_allowed("root", "s3:PutObject", "b/k")
+
+
+def test_store_service_accounts(es):
+    iam = IAMSys([es], "root", "rootsecret")
+    iam.add_user("bob", "bobsecret1")
+    iam.attach_policy("bob", ["readwrite"])
+    # Inherits parent policy.
+    iam.add_service_account("bob", "svc1", "svcsecret1")
+    assert iam.is_allowed("svc1", "s3:PutObject", "b/k")
+    # Embedded policy overrides parent.
+    iam.add_service_account("bob", "svc2", "svcsecret2", policy={
+        "Statement": [{"Effect": "Allow", "Action": ["s3:GetObject"],
+                       "Resource": ["arn:aws:s3:::b/*"]}]})
+    assert iam.is_allowed("svc2", "s3:GetObject", "b/k")
+    assert not iam.is_allowed("svc2", "s3:PutObject", "b/k")
+
+
+def test_store_disabled_user_and_errors(es):
+    iam = IAMSys([es], "root", "rootsecret")
+    iam.add_user("carol", "carolsecret")
+    iam.set_user_status("carol", False)
+    assert iam.secret_for("carol") is None
+    with pytest.raises(IAMError):
+        iam.add_user("root", "x" * 10)
+    with pytest.raises(IAMError):
+        iam.attach_policy("carol", ["nonexistent"])
+
+
+# ---------------------------------------------------------------------------
+# end-to-end enforcement over HTTP
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def srv(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("iamdrv")
+    disks = [LocalStorage(str(tmp / f"d{i}")) for i in range(4)]
+    es = ErasureSet(disks)
+    creds = Credentials("minioadmin", "minioadmin")
+    creds.iam = IAMSys([es], "minioadmin", "minioadmin")
+    server = S3Server(es, address="127.0.0.1:0", credentials=creds)
+    server.start()
+    yield server
+    server.stop()
+
+
+def test_e2e_readonly_key_gets_but_cannot_put(srv):
+    root = S3Client(srv.address)
+    assert root.request("PUT", "/iambkt")[0] == 200
+    assert root.request("PUT", "/iambkt/obj", body=b"data")[0] == 200
+
+    # Provision a read-only user through the admin API.
+    st, _, b = root.request("PUT", "/minio/admin/v3/add-user",
+                            query={"accessKey": "reader"},
+                            body=json.dumps({"secretKey": "readersecret"}
+                                            ).encode())
+    assert st == 200, b
+    st, _, b = root.request("PUT", "/minio/admin/v3/set-user-or-group-policy",
+                            query={"userOrGroup": "reader",
+                                   "policyName": "readonly"})
+    assert st == 200, b
+
+    reader = S3Client(srv.address, access_key="reader",
+                      secret_key="readersecret")
+    st, _, got = reader.request("GET", "/iambkt/obj")
+    assert st == 200 and got == b"data"
+    st, _, body = reader.request("PUT", "/iambkt/obj2", body=b"nope")
+    assert st == 403, body
+    st, _, _ = reader.request("DELETE", "/iambkt/obj")
+    assert st == 403
+    # Admin endpoints are closed to non-root identities.
+    st, _, _ = reader.request("GET", "/minio/admin/v3/list-users")
+    assert st == 403
+
+
+def test_e2e_unknown_key_rejected(srv):
+    ghost = S3Client(srv.address, access_key="ghost", secret_key="ghosts3cr3t")
+    st, _, _ = ghost.request("GET", "/iambkt/obj")
+    assert st == 403
+
+
+def test_e2e_custom_policy_scoped_to_prefix(srv):
+    root = S3Client(srv.address)
+    pol = {"Statement": [
+        {"Effect": "Allow", "Action": ["s3:GetObject", "s3:PutObject"],
+         "Resource": ["arn:aws:s3:::iambkt/app/*"]},
+        {"Effect": "Allow", "Action": ["s3:ListBucket"],
+         "Resource": ["arn:aws:s3:::iambkt"]}]}
+    st, _, b = root.request("PUT", "/minio/admin/v3/add-canned-policy",
+                            query={"name": "app-rw"},
+                            body=json.dumps(pol).encode())
+    assert st == 200, b
+    st, _, b = root.request("PUT", "/minio/admin/v3/add-user",
+                            query={"accessKey": "appuser"},
+                            body=json.dumps({"secretKey": "appsecret1"}
+                                            ).encode())
+    assert st == 200, b
+    st, _, b = root.request("PUT", "/minio/admin/v3/set-user-or-group-policy",
+                            query={"userOrGroup": "appuser",
+                                   "policyName": "app-rw"})
+    assert st == 200, b
+    app = S3Client(srv.address, access_key="appuser", secret_key="appsecret1")
+    assert app.request("PUT", "/iambkt/app/one", body=b"1")[0] == 200
+    assert app.request("GET", "/iambkt/app/one")[0] == 200
+    assert app.request("PUT", "/iambkt/other/one", body=b"1")[0] == 403
+    assert app.request("GET", "/iambkt", query={"prefix": "app/"})[0] == 200
+
+
+def test_e2e_service_account(srv):
+    root = S3Client(srv.address)
+    st, _, b = root.request("PUT", "/minio/admin/v3/add-service-account",
+                            body=json.dumps({
+                                "parent": "minioadmin",
+                                "accessKey": "svcroot",
+                                "secretKey": "svcrootsec"}).encode())
+    assert st == 200, b
+    # Root-parented service account with no embedded policy: full access
+    # is NOT implied — it has no attached policies (least surprise).
+    svc = S3Client(srv.address, access_key="svcroot", secret_key="svcrootsec")
+    st, _, _ = svc.request("GET", "/iambkt/obj")
+    assert st == 403
