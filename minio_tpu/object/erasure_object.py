@@ -22,6 +22,7 @@ missing — batched across all blocks in one device call.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import hashlib
 import zlib
@@ -543,6 +544,8 @@ class ErasureSet:
         metadata["etag"] = etag
         if opts.content_type:
             metadata["content-type"] = opts.content_type
+        if opts.tags:
+            metadata["x-amz-tagging"] = opts.tags
 
         def make_fi(shard_idx: int) -> FileInfo:
             return FileInfo(
@@ -724,6 +727,8 @@ class ErasureSet:
         metadata["etag"] = etag
         if opts.content_type:
             metadata["content-type"] = opts.content_type
+        if opts.tags:
+            metadata["x-amz-tagging"] = opts.tags
 
         def make_fi(shard_idx: int) -> FileInfo:
             return FileInfo(
@@ -1020,11 +1025,65 @@ class ErasureSet:
         meta = dict(fi.metadata)
         etag = meta.pop("etag", "")
         ctype = meta.pop("content-type", "")
+        tags = meta.pop("x-amz-tagging", "")
         return ObjectInfo(bucket=bucket, name=object_, mod_time=fi.mod_time,
                           size=fi.size, etag=etag, content_type=ctype,
                           version_id=fi.version_id, is_latest=fi.is_latest,
                           delete_marker=fi.deleted, user_metadata=meta,
-                          actual_size=fi.size)
+                          actual_size=fi.size, user_tags=tags)
+
+    def update_object_tags(self, bucket: str, object_: str,
+                           version_id: str = "",
+                           tags: Optional[str] = None) -> ObjectInfo:
+        """Set (tags=str) or remove (tags=None) a version's object tags
+        in place: each drive's own journal copy is rewritten with the
+        new metadata, preserving its shard index and inline data
+        (reference: PutObjectTags, cmd/erasure-object.go:1925)."""
+        self._check_bucket(bucket)
+        with self.ns.write(bucket, object_):
+            fis, errors = self._read_version_all(bucket, object_, version_id,
+                                                 read_data=True)
+            n = len(self.disks)
+            quorum = n // 2 + 1
+            fi, idxs = self._quorum_fileinfo(fis, quorum)
+            if fi is None:
+                raise ObjectNotFound(bucket, object_)
+            if fi.deleted:
+                raise MethodNotAllowed(bucket, object_)
+            # Only drives holding the quorum-agreeing copy are written
+            # and counted: a success on a stale-version drive must not
+            # let the update claim quorum (reference PutObjectTags
+            # bounds writes to onlineDisks of the read quorum).
+            agree = set(idxs)
+
+            def write_one(i: int):
+                dfi = fis[i]
+                meta = dict(dfi.metadata)
+                if tags is None:
+                    meta.pop("x-amz-tagging", None)
+                else:
+                    meta["x-amz-tagging"] = tags
+                self.disks[i].write_metadata(
+                    bucket, object_,
+                    dataclasses.replace(dfi, metadata=meta))
+
+            _, werrs = self._fanout(
+                [(lambda i=i: write_one(i)) if i in agree else None
+                 for i in range(n)])
+            ok = sum(1 for i in agree if werrs[i] is None)
+            if ok < quorum:
+                raise WriteQuorumError(bucket, object_)
+            if len(agree) < n:
+                # Drives outside the agreeing set are stale/missing:
+                # background heal brings them (and the new tags) over.
+                self.mrf.enqueue(bucket, object_, fi.version_id)
+        meta = dict(fi.metadata)
+        if tags is None:
+            meta.pop("x-amz-tagging", None)
+        else:
+            meta["x-amz-tagging"] = tags
+        return self._to_object_info(bucket, object_,
+                                    dataclasses.replace(fi, metadata=meta))
 
     def delete_object(self, bucket: str, object_: str,
                       opts: Optional[DeleteOptions] = None) -> DeletedObject:

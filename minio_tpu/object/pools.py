@@ -149,6 +149,10 @@ class ServerPools:
     def get_object_info(self, bucket, object_, opts=None):
         return self._search("get_object_info", bucket, object_, opts)
 
+    def update_object_tags(self, bucket, object_, version_id="", tags=None):
+        return self._search("update_object_tags", bucket, object_,
+                            version_id, tags)
+
     def list_versions_all(self, bucket, object_):
         return self._search("list_versions_all", bucket, object_)
 

@@ -177,6 +177,10 @@ class ErasureSets:
     def get_object_info(self, bucket, object_, opts=None):
         return self.set_for(object_).get_object_info(bucket, object_, opts)
 
+    def update_object_tags(self, bucket, object_, version_id="", tags=None):
+        return self.set_for(object_).update_object_tags(
+            bucket, object_, version_id, tags)
+
     def delete_object(self, bucket, object_, opts=None):
         return self.set_for(object_).delete_object(bucket, object_, opts)
 

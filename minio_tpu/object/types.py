@@ -86,6 +86,7 @@ class ObjectInfo:
     is_dir: bool = False
     actual_size: int = 0
     storage_class: str = "STANDARD"
+    user_tags: str = ""         # URL-encoded object tags
     # Resolved byte range of the payload returned by get_object.
     range_start: int = 0
     range_length: int = 0
@@ -108,6 +109,7 @@ class PutOptions:
     content_type: str = ""
     storage_class: str = "STANDARD"
     mod_time: int = 0
+    tags: str = ""              # URL-encoded object tags (x-amz-tagging)
 
 
 @dataclasses.dataclass

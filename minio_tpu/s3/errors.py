@@ -45,6 +45,9 @@ _CATALOG = {
     "ObjectLockConfigurationNotFoundError": (404, "Object Lock configuration does not exist for this bucket."),
     "NoSuchCORSConfiguration": (404, "The CORS configuration does not exist."),
     "NotImplemented": (501, "A header you provided implies functionality that is not implemented."),
+    "MalformedPolicy": (400, "Policy has invalid resource."),
+    "MalformedPOSTRequest": (400, "The body of your POST request is not well-formed multipart/form-data."),
+    "InvalidTag": (400, "The tag provided was not a valid tag."),
 }
 
 

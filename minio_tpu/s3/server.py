@@ -91,6 +91,10 @@ class S3Server:
                                          handler)
         self.httpd.daemon_threads = True
         self._thread: threading.Thread | None = None
+        # Serializes read-modify-write of bucket metadata (policy /
+        # tagging / versioning toggles) within this process; cross-node
+        # serialization would ride the dsync namespace lock.
+        self.bucket_meta_lock = threading.Lock()
 
     @property
     def address(self) -> str:
@@ -244,6 +248,13 @@ def _make_handler(server: S3Server):
         def _route(self, method: str):
             raw_path, query, bucket, key = self._parse()
             try:
+                ctype = self._headers_lower().get("content-type", "")
+                if method == "POST" and bucket and not key \
+                        and "multipart/form-data" in ctype:
+                    # Browser POST-policy upload: credentials live in
+                    # the form fields, not the Authorization header.
+                    return self._post_object(bucket, self._read_body(),
+                                             ctype)
                 # Verify the signature from headers first; the declared
                 # payload hash is part of the signed canonical request, so
                 # the body is only hashed afterwards when the mode calls
@@ -332,8 +343,66 @@ def _make_handler(server: S3Server):
                 _el(be, "CreationDate", _iso8601(b.created))
             self._send(200, _xml(root))
 
+        # Bucket sub-configurations persisted in bucket metadata
+        # (reference: cmd/bucket-metadata-sys.go keeps policy/lifecycle/
+        # tagging/... documents in one quorum-replicated record):
+        # meta key -> (absent-error, validator).
+        _BUCKET_CONFIGS = {
+            "policy": ("NoSuchBucketPolicy", "_validate_policy_json"),
+            "lifecycle": ("NoSuchLifecycleConfiguration",
+                          "_validate_xml_doc"),
+            "tagging": ("NoSuchTagSet", "_validate_xml_doc"),
+            "cors": ("NoSuchCORSConfiguration", "_validate_xml_doc"),
+            "encryption": ("ServerSideEncryptionConfigurationNotFoundError",
+                           "_validate_xml_doc"),
+        }
+
+        def _validate_policy_json(self, body: bytes) -> None:
+            import json as _json
+            try:
+                doc = _json.loads(body)
+            except ValueError:
+                raise S3Error("MalformedPolicy") from None
+            if not isinstance(doc, dict) or "Statement" not in doc:
+                raise S3Error("MalformedPolicy")
+
+        def _validate_xml_doc(self, body: bytes) -> None:
+            try:
+                ET.fromstring(body)
+            except ET.ParseError:
+                raise S3Error("MalformedXML") from None
+
+        def _bucket_config(self, method, bucket, name, query, body):
+            ol = server.object_layer
+            ol.get_bucket_info(bucket)
+            absent_err, validator = self._BUCKET_CONFIGS[name]
+            meta_key = f"config:{name}"
+            if method == "PUT":
+                getattr(self, validator)(body)
+                with server.bucket_meta_lock:
+                    meta = ol.get_bucket_meta(bucket)
+                    meta[meta_key] = body.decode("utf-8", "replace")
+                    ol.set_bucket_meta(bucket, meta)
+                return self._send(200)
+            if method == "DELETE":
+                with server.bucket_meta_lock:
+                    meta = ol.get_bucket_meta(bucket)
+                    if meta.pop(meta_key, None) is not None:
+                        ol.set_bucket_meta(bucket, meta)
+                return self._send(204)
+            stored = ol.get_bucket_meta(bucket).get(meta_key)
+            if stored is None:
+                raise S3Error(absent_err, bucket=bucket)
+            ctype = "application/json" if name == "policy" \
+                else "application/xml"
+            return self._send(200, stored.encode(), content_type=ctype)
+
         def _bucket_op(self, method, bucket, query, body):
             ol = server.object_layer
+            for name in self._BUCKET_CONFIGS:
+                if name in query:
+                    return self._bucket_config(method, bucket, name, query,
+                                               body)
             if method == "PUT":
                 if "versioning" in query:
                     return self._put_versioning(bucket, body)
@@ -356,26 +425,82 @@ def _make_handler(server: S3Server):
                     return self._send(200, _xml(root))
                 if "versioning" in query:
                     return self._get_versioning(bucket)
+                if "versions" in query:
+                    return self._list_versions(bucket, query)
                 if "object-lock" in query:
                     raise S3Error("ObjectLockConfigurationNotFoundError",
                                   bucket=bucket)
-                if "policy" in query:
-                    raise S3Error("NoSuchBucketPolicy", bucket=bucket)
-                if "lifecycle" in query:
-                    raise S3Error("NoSuchLifecycleConfiguration", bucket=bucket)
-                if "tagging" in query:
-                    raise S3Error("NoSuchTagSet", bucket=bucket)
-                if "encryption" in query:
-                    raise S3Error(
-                        "ServerSideEncryptionConfigurationNotFoundError",
-                        bucket=bucket)
                 if "replication" in query:
                     raise S3Error("ReplicationConfigurationNotFoundError",
                                   bucket=bucket)
-                if "cors" in query:
-                    raise S3Error("NoSuchCORSConfiguration", bucket=bucket)
                 return self._list_objects(bucket, query)
             raise S3Error("MethodNotAllowed")
+
+        def _list_versions(self, bucket, query):
+            """GET ?versions — ListObjectVersions (reference:
+            cmd/bucket-listobjects-handlers.go ListObjectVersionsHandler).
+
+            A version-id-marker resumes WITHIN the marker key: its
+            remaining (older) versions are emitted first, then the
+            listing continues past the key."""
+            def q(name, default=""):
+                return query.get(name, [default])[0]
+            prefix = q("prefix")
+            delimiter = q("delimiter")
+            key_marker = q("key-marker")
+            vid_marker = q("version-id-marker")
+            max_keys = int(q("max-keys", "1000") or 1000)
+            entries = []
+            if key_marker and vid_marker:
+                from minio_tpu.object.erasure_object import ErasureSet
+                try:
+                    versions = server.object_layer.list_versions_all(
+                        bucket, key_marker)
+                except Exception:  # noqa: BLE001 - marker key deleted
+                    versions = []
+                emit = False
+                for v in versions:           # latest-first journal order
+                    if emit:
+                        entries.append(ErasureSet._to_object_info(
+                            bucket, key_marker, v))
+                    elif (v.version_id or "null") == vid_marker:
+                        emit = True
+            info = server.object_layer.list_objects(
+                bucket, prefix=prefix, marker=key_marker,
+                delimiter=delimiter, max_keys=max_keys,
+                include_versions=True)
+            combined = entries + info.objects
+            truncated = info.is_truncated
+            if len(combined) > max_keys:
+                combined = combined[:max_keys]
+                truncated = True
+            root = ET.Element("ListVersionsResult", xmlns=XMLNS)
+            _el(root, "Name", bucket)
+            _el(root, "Prefix", prefix)
+            _el(root, "KeyMarker", key_marker)
+            if vid_marker:
+                _el(root, "VersionIdMarker", vid_marker)
+            _el(root, "MaxKeys", max_keys)
+            _el(root, "IsTruncated", "true" if truncated else "false")
+            if truncated and combined:
+                _el(root, "NextKeyMarker", combined[-1].name)
+                _el(root, "NextVersionIdMarker",
+                    combined[-1].version_id or "null")
+            for o in combined:
+                tag = "DeleteMarker" if o.delete_marker else "Version"
+                ve = _el(root, tag)
+                _el(ve, "Key", o.name)
+                _el(ve, "VersionId", o.version_id or "null")
+                _el(ve, "IsLatest", "true" if o.is_latest else "false")
+                _el(ve, "LastModified", _iso8601(o.mod_time))
+                if not o.delete_marker:
+                    _el(ve, "ETag", f'"{o.etag}"')
+                    _el(ve, "Size", o.size)
+                    _el(ve, "StorageClass", o.storage_class)
+            for p in info.prefixes:
+                ce = _el(root, "CommonPrefixes")
+                _el(ce, "Prefix", p)
+            self._send(200, _xml(root))
 
         def _get_versioning(self, bucket):
             ol = server.object_layer
@@ -397,7 +522,8 @@ def _make_handler(server: S3Server):
             setter = getattr(ol, "set_bucket_versioning", None)
             if setter is None:
                 raise S3Error("NotImplemented")
-            setter(bucket, status == "Enabled")
+            with server.bucket_meta_lock:
+                setter(bucket, status == "Enabled")
             self._send(200)
 
         def _list_objects(self, bucket, query):
@@ -512,12 +638,41 @@ def _make_handler(server: S3Server):
                 return self._send(204)
             if method == "GET" and "uploadId" in query:
                 return self._list_parts(bucket, key, query)
+            if "tagging" in query:
+                return self._object_tagging(method, bucket, key, query,
+                                            payload)
             if method == "PUT":
                 return self._put_object(bucket, key, query, payload)
             if method in ("GET", "HEAD"):
                 return self._get_object(method, bucket, key, query)
             if method == "DELETE":
                 return self._delete_object(bucket, key, query)
+            raise S3Error("MethodNotAllowed")
+
+        def _object_tagging(self, method, bucket, key, query, payload):
+            """GET/PUT/DELETE ?tagging on an object (reference:
+            cmd/object-handlers.go PutObjectTagsHandler et al.)."""
+            vid = query.get("versionId", [""])[0]
+            if method == "GET":
+                info = server.object_layer.get_object_info(
+                    bucket, key, GetOptions(version_id=vid))
+                root = ET.Element("Tagging", xmlns=XMLNS)
+                ts = _el(root, "TagSet")
+                for kv in urllib.parse.parse_qsl(info.user_tags):
+                    te = _el(ts, "Tag")
+                    _el(te, "Key", kv[0])
+                    _el(te, "Value", kv[1])
+                return self._send(200, _xml(root))
+            if method == "PUT":
+                body = payload.read_all() if payload is not None else b""
+                tags = _parse_tagging_xml(body)
+                server.object_layer.update_object_tags(bucket, key, vid,
+                                                       tags)
+                return self._send(200)
+            if method == "DELETE":
+                server.object_layer.update_object_tags(bucket, key, vid,
+                                                       None)
+                return self._send(204)
             raise S3Error("MethodNotAllowed")
 
         # -- multipart --------------------------------------------------
@@ -631,6 +786,12 @@ def _make_handler(server: S3Server):
             sbucket, skey = src.split("/", 1)
             sinfo, payload = server.object_layer.get_object(
                 sbucket, skey, GetOptions(version_id=src_vid))
+            if any(c in h for c in ("x-amz-copy-source-if-match",
+                                    "x-amz-copy-source-if-none-match",
+                                    "x-amz-copy-source-if-modified-since",
+                                    "x-amz-copy-source-if-unmodified-since")):
+                self._check_conditions(h, sinfo, for_read=False,
+                                       prefix="x-amz-copy-source-")
             directive = h.get("x-amz-metadata-directive", "COPY").upper()
             if directive == "REPLACE":
                 meta = {k2[len("x-amz-meta-"):]: v for k2, v in h.items()
@@ -639,10 +800,13 @@ def _make_handler(server: S3Server):
             else:
                 meta = dict(sinfo.user_metadata)
                 ctype = sinfo.content_type
+            tag_directive = h.get("x-amz-tagging-directive", "COPY").upper()
+            tags = h.get("x-amz-tagging", "") if tag_directive == "REPLACE" \
+                else sinfo.user_tags
             info = server.object_layer.put_object(
                 bucket, key, payload, PutOptions(
                     versioned=_versioned(server.object_layer, bucket),
-                    user_metadata=meta, content_type=ctype))
+                    user_metadata=meta, content_type=ctype, tags=tags))
             root = ET.Element("CopyObjectResult", xmlns=XMLNS)
             _el(root, "ETag", f'"{info.etag}"')
             _el(root, "LastModified", _iso8601(info.mod_time))
@@ -655,18 +819,94 @@ def _make_handler(server: S3Server):
             h = self._headers_lower()
             if "x-amz-copy-source" in h:
                 return self._copy_object(bucket, key, h)
+            if "if-match" in h or "if-none-match" in h:
+                # Conditional write (create-only / replace-exact): check
+                # the current version before accepting the body. Only a
+                # definitive not-found counts as absent — a transient
+                # read failure must NOT let a create-only PUT overwrite.
+                from minio_tpu.object.types import (MethodNotAllowed as _MNA,
+                                                    ObjectNotFound as _ONF,
+                                                    VersionNotFound as _VNF)
+                try:
+                    cur = server.object_layer.get_object_info(
+                        bucket, key, GetOptions())
+                except (_ONF, _VNF, _MNA):
+                    cur = None
+                if cur is None:
+                    if "if-match" in h:
+                        raise S3Error("NoSuchKey", bucket=bucket, key=key)
+                else:
+                    self._check_conditions(h, cur, for_read=False)
             meta = {k[len("x-amz-meta-"):]: v for k, v in h.items()
                     if k.startswith("x-amz-meta-")}
             opts = PutOptions(
                 versioned=_versioned(server.object_layer, bucket),
                 user_metadata=meta,
                 content_type=h.get("content-type", ""),
-                storage_class=h.get("x-amz-storage-class", "STANDARD"))
+                storage_class=h.get("x-amz-storage-class", "STANDARD"),
+                tags=h.get("x-amz-tagging", ""))
             info = server.object_layer.put_object(bucket, key, payload, opts)
             headers = {"ETag": f'"{info.etag}"'}
             if info.version_id:
                 headers["x-amz-version-id"] = info.version_id
             self._send(200, headers=headers)
+
+        def _check_conditions(self, h, info, for_read: bool,
+                              prefix: str = "") -> bool:
+            """RFC 7232 / S3 conditional requests. Returns True when a
+            read should answer 304 Not Modified; raises
+            PreconditionFailed for failed write/read preconditions.
+            prefix selects copy-source variants (x-amz-copy-source-if-*).
+            """
+            def g(name):
+                return h.get(prefix + name)
+
+            def etag_matches(val):
+                vals = [v.strip().strip('"') for v in val.split(",")]
+                return "*" in vals or info.etag in vals
+
+            def parse_http_date(val):
+                try:
+                    dt = email.utils.parsedate_to_datetime(val)
+                    return dt.timestamp()
+                except (TypeError, ValueError):
+                    return None
+
+            # Whole-second comparison: Last-Modified is served at second
+            # granularity, so sub-second mod times must truncate or
+            # revalidation (If-Modified-Since echoing our own header)
+            # could never match (RFC 7232).
+            mod_secs = info.mod_time // 1_000_000_000
+            im, inm = g("if-match"), g("if-none-match")
+            ims = parse_http_date(g("if-modified-since") or "")
+            ius = parse_http_date(g("if-unmodified-since") or "")
+            if im is not None:
+                if not etag_matches(im):
+                    raise S3Error("PreconditionFailed", bucket=info.bucket,
+                                  key=info.name)
+            elif ius is not None and mod_secs > ius:
+                raise S3Error("PreconditionFailed", bucket=info.bucket,
+                              key=info.name)
+            if inm is not None:
+                if etag_matches(inm):
+                    if for_read:
+                        return True          # 304
+                    raise S3Error("PreconditionFailed", bucket=info.bucket,
+                                  key=info.name)
+            elif ims is not None and mod_secs <= ims:
+                if for_read:
+                    return True
+                # Copy-source semantics: "only copy if modified since"
+                # fails hard when the source has not changed.
+                raise S3Error("PreconditionFailed", bucket=info.bucket,
+                              key=info.name)
+            return False
+
+        def _send_not_modified(self, info):
+            self.send_response(304)
+            self.send_header("ETag", f'"{info.etag}"')
+            self.send_header("Last-Modified", _rfc1123(info.mod_time))
+            self.end_headers()
 
         def _get_object(self, method, bucket, key, query):
             h = self._headers_lower()
@@ -674,6 +914,13 @@ def _make_handler(server: S3Server):
             rng = h.get("range", "")
             spec = _range_spec(rng)
             chunks = None
+            if any(c in h for c in ("if-match", "if-none-match",
+                                    "if-modified-since",
+                                    "if-unmodified-since")):
+                pre = server.object_layer.get_object_info(
+                    bucket, key, GetOptions(version_id=vid))
+                if self._check_conditions(h, pre, for_read=True):
+                    return self._send_not_modified(pre)
             if method == "HEAD":
                 # HEAD: metadata fan-out only, no shard reads.
                 info = server.object_layer.get_object_info(
@@ -728,6 +975,128 @@ def _make_handler(server: S3Server):
             finally:
                 if chunks is not None:
                     chunks.close()
+
+        def _post_object(self, bucket, body, ctype):
+            """Browser-form POST-policy upload (reference:
+            cmd/post-policy.go PostPolicyBucketHandler): multipart form
+            with a base64 policy document signed by the uploader's key;
+            the object is the `file` part."""
+            import base64
+            import email.parser as _ep
+            import email.policy as _epol
+            import hmac as _hmac
+            import json as _json
+            import re as _re
+
+            raw = b"Content-Type: " + ctype.encode() + b"\r\n\r\n" + body
+            msg = _ep.BytesParser(policy=_epol.default).parsebytes(raw)
+            if not msg.is_multipart():
+                raise S3Error("MalformedPOSTRequest")
+            fields: dict[str, str] = {}
+            file_data = None
+            file_name = ""
+            for part in msg.iter_parts():
+                cd = part.get("Content-Disposition", "")
+                m = _re.search(r'name="([^"]*)"', cd)
+                if not m:
+                    continue
+                name = m.group(1).lower()
+                data = part.get_payload(decode=True) or b""
+                if name == "file":
+                    file_data = data
+                    fm = _re.search(r'filename="([^"]*)"', cd)
+                    file_name = fm.group(1) if fm else ""
+                    fields.setdefault("content-type",
+                                      part.get_content_type())
+                else:
+                    fields[name] = data.decode("utf-8", "replace")
+            if file_data is None:
+                raise S3Error("InvalidArgument", "POST form missing file")
+            policy_b64 = fields.get("policy", "")
+            sig = fields.get("x-amz-signature", "")
+            cred_str = fields.get("x-amz-credential", "")
+            if not policy_b64 or not sig or not cred_str:
+                raise S3Error("AccessDenied")
+            cred = sigv4.Credential.parse(cred_str)
+            secret = server.credentials.secret_for(cred.access_key)
+            if secret is None:
+                raise S3Error("InvalidAccessKeyId")
+            skey = sigv4.signing_key(secret, cred.date, cred.region)
+            want = _hmac.new(skey, policy_b64.encode(),
+                             hashlib.sha256).hexdigest()
+            if not _hmac.compare_digest(want, sig):
+                raise S3Error("SignatureDoesNotMatch")
+            try:
+                pol = _json.loads(base64.b64decode(policy_b64))
+            except ValueError:
+                raise S3Error("MalformedPOSTRequest") from None
+            exp = pol.get("expiration", "")
+            if exp:
+                try:
+                    exp_dt = datetime.datetime.fromisoformat(
+                        exp.replace("Z", "+00:00"))
+                    if exp_dt.tzinfo is None:
+                        exp_dt = exp_dt.replace(
+                            tzinfo=datetime.timezone.utc)
+                except (ValueError, TypeError):
+                    raise S3Error("MalformedPOSTRequest") from None
+                if exp_dt < datetime.datetime.now(datetime.timezone.utc):
+                    raise S3Error("AccessDenied", "policy expired")
+            key = fields.get("key", "")
+            if not key:
+                raise S3Error("InvalidArgument", "POST form missing key")
+            key = key.replace("${filename}", file_name)
+            # Enforce the policy's own conditions (eq / starts-with /
+            # content-length-range) against the submitted form.
+            form_view = dict(fields)
+            form_view["bucket"] = bucket
+            form_view["key"] = key
+            for cond in pol.get("conditions", []):
+                if isinstance(cond, dict):
+                    items = [("eq", f"${k}", v) for k, v in cond.items()]
+                elif isinstance(cond, list) and len(cond) == 3:
+                    items = [tuple(cond)]
+                else:
+                    continue
+                for op, field, val in items:
+                    op = str(op).lower()
+                    if op == "content-length-range":
+                        continue
+                    fname = str(field).lstrip("$").lower()
+                    got = form_view.get(fname, "")
+                    if op == "eq" and got != val:
+                        raise S3Error("AccessDenied",
+                                      f"policy condition failed: {fname}")
+                    if op == "starts-with" and not got.startswith(val):
+                        raise S3Error("AccessDenied",
+                                      f"policy condition failed: {fname}")
+                if isinstance(cond, list) and \
+                        str(cond[0]).lower() == "content-length-range":
+                    lo, hi = int(cond[1]), int(cond[2])
+                    if not lo <= len(file_data) <= hi:
+                        raise S3Error("EntityTooLarge"
+                                      if len(file_data) > hi
+                                      else "EntityTooSmall")
+            if not server.credentials.is_allowed(
+                    cred.access_key, "s3:PutObject", f"{bucket}/{key}"):
+                raise S3Error("AccessDenied", bucket=bucket, key=key)
+            meta = {k[len("x-amz-meta-"):]: v for k, v in fields.items()
+                    if k.startswith("x-amz-meta-")}
+            info = server.object_layer.put_object(
+                bucket, key, file_data, PutOptions(
+                    versioned=_versioned(server.object_layer, bucket),
+                    user_metadata=meta,
+                    content_type=fields.get("content-type", ""),
+                    tags=fields.get("tagging", "")))
+            status = fields.get("success_action_status", "204")
+            if status == "201":
+                root = ET.Element("PostResponse")
+                _el(root, "Location", f"/{bucket}/{key}")
+                _el(root, "Bucket", bucket)
+                _el(root, "Key", key)
+                _el(root, "ETag", f'"{info.etag}"')
+                return self._send(201, _xml(root))
+            return self._send(200 if status == "200" else 204)
 
         # -- admin API (/minio/admin/v3/...) ---------------------------
 
@@ -813,6 +1182,34 @@ def _make_handler(server: S3Server):
     return Handler
 
 
+def _parse_tagging_xml(body: bytes) -> str:
+    """<Tagging><TagSet><Tag><Key>..</Key><Value>..</Value> -> URL-encoded
+    tag string; validates count and uniqueness (reference:
+    internal/bucket/object/tags)."""
+    try:
+        root = ET.fromstring(body)
+    except ET.ParseError:
+        raise S3Error("MalformedXML") from None
+    ns = f"{{{XMLNS}}}"
+    tags = []
+    tagset = root.find(f"{ns}TagSet")
+    if tagset is None:
+        tagset = root.find("TagSet")
+    if tagset is None:
+        raise S3Error("MalformedXML")
+    for te in list(tagset.findall(f"{ns}Tag")) + list(tagset.findall("Tag")):
+        k = te.findtext(f"{ns}Key") or te.findtext("Key") or ""
+        v = te.findtext(f"{ns}Value") or te.findtext("Value") or ""
+        if not k or len(k) > 128 or len(v) > 256:
+            raise S3Error("InvalidTag")
+        tags.append((k, v))
+    if len(tags) > 10:
+        raise S3Error("InvalidTag", "too many tags")
+    if len({k for k, _ in tags}) != len(tags):
+        raise S3Error("InvalidTag", "duplicate tag key")
+    return urllib.parse.urlencode(tags)
+
+
 def _required_permissions(method: str, bucket: str, key: str, query: dict,
                           h: dict) -> list[tuple[str, str]]:
     """Map one S3 request to the (action, resource) pairs it needs
@@ -826,7 +1223,18 @@ def _required_permissions(method: str, bucket: str, key: str, query: dict,
         src = urllib.parse.unquote(h["x-amz-copy-source"]).lstrip("/")
         src = src.partition("?versionId=")[0]
         perms.append(("s3:GetObject", src))
+    _CONFIG_ACTIONS = {
+        "policy": "BucketPolicy", "lifecycle": "LifecycleConfiguration",
+        "tagging": "BucketTagging", "cors": "BucketCORS",
+        "encryption": "EncryptionConfiguration",
+    }
     if not key:
+        for q, stem in _CONFIG_ACTIONS.items():
+            if q in query:
+                verb = {"GET": "Get", "HEAD": "Get", "PUT": "Put",
+                        "DELETE": "Delete"}.get(method, "Get")
+                perms.append((f"s3:{verb}{stem}", bucket))
+                return perms
         if method == "PUT":
             perms.append(("s3:PutBucketVersioning", bucket)
                          if "versioning" in query
@@ -842,12 +1250,19 @@ def _required_permissions(method: str, bucket: str, key: str, query: dict,
                 perms.append(("s3:ListBucketMultipartUploads", bucket))
             elif "versioning" in query:
                 perms.append(("s3:GetBucketVersioning", bucket))
+            elif "versions" in query:
+                perms.append(("s3:ListBucketVersions", bucket))
             elif "location" in query:
                 perms.append(("s3:GetBucketLocation", bucket))
             else:
                 perms.append(("s3:ListBucket", bucket))
         return perms
     res = f"{bucket}/{key}"
+    if "tagging" in query:
+        verb = {"GET": "Get", "PUT": "Put", "DELETE": "Delete"}.get(
+            method, "Get")
+        perms.append((f"s3:{verb}ObjectTagging", res))
+        return perms
     if method in ("GET", "HEAD"):
         if "uploadId" in query:
             perms.append(("s3:ListMultipartUploadParts", res))

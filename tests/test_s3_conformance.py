@@ -1,0 +1,292 @@
+"""S3 conformance: conditional requests, ListObjectVersions, tagging,
+bucket config persistence, POST-policy upload (reference:
+cmd/object-handlers.go, cmd/bucket-handlers.go, cmd/post-policy.go)."""
+
+import base64
+import datetime
+import hashlib
+import hmac
+import http.client
+import json
+import os
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from minio_tpu.object.erasure_object import ErasureSet
+from minio_tpu.s3 import sigv4
+from minio_tpu.s3.server import Credentials, S3Server
+from minio_tpu.storage.local import LocalStorage
+from tests.s3client import S3Client
+
+NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+
+
+@pytest.fixture(scope="module")
+def srv(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("confdrv")
+    disks = [LocalStorage(str(tmp / f"d{i}")) for i in range(4)]
+    es = ErasureSet(disks)
+    server = S3Server(es, address="127.0.0.1:0")
+    server.start()
+    yield server
+    server.stop()
+
+
+@pytest.fixture(scope="module")
+def cli(srv):
+    c = S3Client(srv.address)
+    assert c.request("PUT", "/conf")[0] == 200
+    return c
+
+
+# ---------------------------------------------------------------------------
+# conditional requests
+# ---------------------------------------------------------------------------
+
+def test_conditional_get(cli):
+    body = b"conditional-data"
+    st, h, _ = cli.request("PUT", "/conf/cond", body=body)
+    etag = h["ETag"]
+    st, _, _ = cli.request("GET", "/conf/cond",
+                           headers={"If-None-Match": etag})
+    assert st == 304
+    st, _, got = cli.request("GET", "/conf/cond",
+                             headers={"If-None-Match": '"other"'})
+    assert st == 200 and got == body
+    st, _, got = cli.request("GET", "/conf/cond",
+                             headers={"If-Match": etag})
+    assert st == 200 and got == body
+    st, _, _ = cli.request("GET", "/conf/cond",
+                           headers={"If-Match": '"bogus"'})
+    assert st == 412
+    future = "Fri, 01 Jan 2100 00:00:00 GMT"
+    past = "Mon, 01 Jan 2001 00:00:00 GMT"
+    st, _, _ = cli.request("GET", "/conf/cond",
+                           headers={"If-Modified-Since": future})
+    assert st == 304
+    st, _, _ = cli.request("GET", "/conf/cond",
+                           headers={"If-Modified-Since": past})
+    assert st == 200
+    st, _, _ = cli.request("GET", "/conf/cond",
+                           headers={"If-Unmodified-Since": past})
+    assert st == 412
+
+
+def test_conditional_put_create_only(cli):
+    st, _, _ = cli.request("PUT", "/conf/newobj", body=b"first",
+                           headers={"If-None-Match": "*"})
+    assert st == 200
+    st, _, _ = cli.request("PUT", "/conf/newobj", body=b"second",
+                           headers={"If-None-Match": "*"})
+    assert st == 412
+    _, _, got = cli.request("GET", "/conf/newobj")
+    assert got == b"first"
+
+
+def test_conditional_put_if_match(cli):
+    st, h, _ = cli.request("PUT", "/conf/casobj", body=b"v1")
+    etag = h["ETag"]
+    st, _, _ = cli.request("PUT", "/conf/casobj", body=b"v2",
+                           headers={"If-Match": etag})
+    assert st == 200
+    st, _, _ = cli.request("PUT", "/conf/casobj", body=b"v3",
+                           headers={"If-Match": etag})
+    assert st == 412
+    _, _, got = cli.request("GET", "/conf/casobj")
+    assert got == b"v2"
+
+
+def test_copy_source_conditions(cli):
+    st, h, _ = cli.request("PUT", "/conf/copysrc", body=b"src")
+    etag = h["ETag"]
+    st, _, _ = cli.request("PUT", "/conf/copydst", headers={
+        "x-amz-copy-source": "/conf/copysrc",
+        "x-amz-copy-source-if-match": etag})
+    assert st == 200
+    st, _, _ = cli.request("PUT", "/conf/copydst2", headers={
+        "x-amz-copy-source": "/conf/copysrc",
+        "x-amz-copy-source-if-match": '"wrong"'})
+    assert st == 412
+    st, _, _ = cli.request("PUT", "/conf/copydst3", headers={
+        "x-amz-copy-source": "/conf/copysrc",
+        "x-amz-copy-source-if-none-match": etag})
+    assert st == 412
+
+
+# ---------------------------------------------------------------------------
+# ListObjectVersions
+# ---------------------------------------------------------------------------
+
+def test_list_object_versions(cli):
+    assert cli.request("PUT", "/verb")[0] == 200
+    body = ET.tostring(ET.fromstring(
+        '<VersioningConfiguration><Status>Enabled</Status>'
+        '</VersioningConfiguration>'))
+    assert cli.request("PUT", "/verb", query={"versioning": ""},
+                       body=body)[0] == 200
+    cli.request("PUT", "/verb/doc", body=b"one")
+    cli.request("PUT", "/verb/doc", body=b"two")
+    cli.request("DELETE", "/verb/doc")
+    st, _, xml = cli.request("GET", "/verb", query={"versions": ""})
+    assert st == 200
+    root = ET.fromstring(xml)
+    versions = root.findall(f"{NS}Version")
+    markers = root.findall(f"{NS}DeleteMarker")
+    assert len(versions) == 2
+    assert len(markers) == 1
+    assert markers[0].findtext(f"{NS}IsLatest") == "true"
+    assert {v.findtext(f"{NS}Key") for v in versions} == {"doc"}
+    assert all(v.findtext(f"{NS}VersionId") for v in versions)
+
+
+# ---------------------------------------------------------------------------
+# tagging
+# ---------------------------------------------------------------------------
+
+def test_object_tagging_roundtrip(cli):
+    cli.request("PUT", "/conf/tagged", body=b"x",
+                headers={"x-amz-tagging": "env=prod&team=infra"})
+    st, _, xml = cli.request("GET", "/conf/tagged", query={"tagging": ""})
+    assert st == 200
+    root = ET.fromstring(xml)
+    tags = {t.findtext(f"{NS}Key"): t.findtext(f"{NS}Value")
+            for t in root.iter(f"{NS}Tag")}
+    assert tags == {"env": "prod", "team": "infra"}
+    # Replace via PUT ?tagging
+    body = (b'<Tagging><TagSet><Tag><Key>env</Key><Value>dev</Value>'
+            b'</Tag></TagSet></Tagging>')
+    st, _, b = cli.request("PUT", "/conf/tagged", query={"tagging": ""},
+                           body=body)
+    assert st == 200, b
+    _, _, xml = cli.request("GET", "/conf/tagged", query={"tagging": ""})
+    tags = {t.findtext(f"{NS}Key"): t.findtext(f"{NS}Value")
+            for t in ET.fromstring(xml).iter(f"{NS}Tag")}
+    assert tags == {"env": "dev"}
+    # DELETE clears
+    st, _, _ = cli.request("DELETE", "/conf/tagged", query={"tagging": ""})
+    assert st == 204
+    _, _, xml = cli.request("GET", "/conf/tagged", query={"tagging": ""})
+    assert not list(ET.fromstring(xml).iter(f"{NS}Tag"))
+
+
+def test_bucket_tagging_and_configs_persist(cli):
+    body = (b'<Tagging><TagSet><Tag><Key>owner</Key><Value>me</Value>'
+            b'</Tag></TagSet></Tagging>')
+    assert cli.request("PUT", "/conf", query={"tagging": ""},
+                       body=body)[0] == 200
+    st, _, xml = cli.request("GET", "/conf", query={"tagging": ""})
+    assert st == 200 and b"owner" in xml
+    assert cli.request("DELETE", "/conf", query={"tagging": ""})[0] == 204
+    assert cli.request("GET", "/conf", query={"tagging": ""})[0] == 404
+
+    pol = json.dumps({"Version": "2012-10-17", "Statement": [
+        {"Effect": "Allow", "Principal": "*",
+         "Action": ["s3:GetObject"],
+         "Resource": ["arn:aws:s3:::conf/*"]}]}).encode()
+    assert cli.request("PUT", "/conf", query={"policy": ""},
+                       body=pol)[0] == 200
+    st, _, got = cli.request("GET", "/conf", query={"policy": ""})
+    assert st == 200 and json.loads(got)["Statement"]
+    assert cli.request("DELETE", "/conf", query={"policy": ""})[0] == 204
+    assert cli.request("GET", "/conf", query={"policy": ""})[0] == 404
+
+    lc = (b'<LifecycleConfiguration><Rule><ID>r1</ID>'
+          b'<Status>Enabled</Status><Expiration><Days>1</Days>'
+          b'</Expiration></Rule></LifecycleConfiguration>')
+    assert cli.request("PUT", "/conf", query={"lifecycle": ""},
+                       body=lc)[0] == 200
+    st, _, got = cli.request("GET", "/conf", query={"lifecycle": ""})
+    assert st == 200 and b"<ID>r1</ID>" in got
+
+
+def test_malformed_bucket_configs_rejected(cli):
+    assert cli.request("PUT", "/conf", query={"policy": ""},
+                       body=b"{not json")[0] == 400
+    assert cli.request("PUT", "/conf", query={"lifecycle": ""},
+                       body=b"<unclosed")[0] == 400
+
+
+# ---------------------------------------------------------------------------
+# POST policy upload
+# ---------------------------------------------------------------------------
+
+def _post_form(srv_addr, bucket, fields, file_data,
+               filename="upload.bin"):
+    boundary = "----testboundary42"
+    parts = []
+    for k, v in fields.items():
+        parts.append(f"--{boundary}\r\nContent-Disposition: form-data; "
+                     f'name="{k}"\r\n\r\n{v}\r\n'.encode())
+    parts.append(f"--{boundary}\r\nContent-Disposition: form-data; "
+                 f'name="file"; filename="{filename}"\r\n'
+                 f"Content-Type: application/octet-stream\r\n\r\n".encode()
+                 + file_data + b"\r\n")
+    parts.append(f"--{boundary}--\r\n".encode())
+    body = b"".join(parts)
+    conn = http.client.HTTPConnection(srv_addr, timeout=30)
+    try:
+        conn.request("POST", f"/{bucket}", body=body, headers={
+            "Content-Type": f"multipart/form-data; boundary={boundary}"})
+        r = conn.getresponse()
+        return r.status, r.read()
+    finally:
+        conn.close()
+
+
+def _signed_policy_fields(key_prefix, bucket, access="minioadmin",
+                          secret="minioadmin", expire_mins=10):
+    now = datetime.datetime.now(datetime.timezone.utc)
+    exp = now + datetime.timedelta(minutes=expire_mins)
+    date = now.strftime("%Y%m%d")
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    cred = f"{access}/{date}/us-east-1/s3/aws4_request"
+    policy = {
+        "expiration": exp.strftime("%Y-%m-%dT%H:%M:%S.000Z"),
+        "conditions": [
+            {"bucket": bucket},
+            ["starts-with", "$key", key_prefix],
+            ["content-length-range", 0, 10 << 20],
+        ],
+    }
+    pol_b64 = base64.b64encode(json.dumps(policy).encode()).decode()
+    skey = sigv4.signing_key(secret, date, "us-east-1")
+    sig = hmac.new(skey, pol_b64.encode(), hashlib.sha256).hexdigest()
+    return {
+        "key": key_prefix + "${filename}",
+        "policy": pol_b64,
+        "x-amz-algorithm": "AWS4-HMAC-SHA256",
+        "x-amz-credential": cred,
+        "x-amz-date": amz_date,
+        "x-amz-signature": sig,
+    }
+
+
+def test_post_policy_upload(srv, cli):
+    data = os.urandom(10_000)
+    fields = _signed_policy_fields("uploads/", "conf")
+    st, body = _post_form(srv.address, "conf", fields, data,
+                          filename="file1.bin")
+    assert st == 204, body
+    st, _, got = cli.request("GET", "/conf/uploads/file1.bin")
+    assert st == 200 and got == data
+
+
+def test_post_policy_bad_signature_rejected(srv):
+    fields = _signed_policy_fields("uploads/", "conf")
+    fields["x-amz-signature"] = "0" * 64
+    st, body = _post_form(srv.address, "conf", fields, b"data")
+    assert st == 403, body
+
+
+def test_post_policy_condition_violation_rejected(srv):
+    fields = _signed_policy_fields("uploads/", "conf")
+    fields["key"] = "elsewhere/escape.bin"   # violates starts-with
+    st, body = _post_form(srv.address, "conf", fields, b"data")
+    assert st == 403, body
+
+
+def test_post_policy_expired_rejected(srv):
+    fields = _signed_policy_fields("uploads/", "conf", expire_mins=-10)
+    st, body = _post_form(srv.address, "conf", fields, b"data")
+    assert st == 403, body
