@@ -1,0 +1,154 @@
+"""Prometheus-style metrics registry for the S3 server.
+
+The observability analogue of the reference's metrics subsystem
+(cmd/metrics-v3.go): per-API request counts/latencies/bytes, object and
+capacity gauges fed by the scanner, drive online state, heal counters —
+rendered in Prometheus text exposition format at
+/minio/v2/metrics/cluster (cmd/metrics-router.go).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class Metrics:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._requests: dict[tuple[str, str], int] = {}
+        self._latency_sum: dict[str, float] = {}
+        self._latency_count: dict[str, int] = {}
+        self._bytes_rx = 0
+        self._bytes_tx = 0
+        self._start = time.time()
+
+    def record(self, api: str, status: int, seconds: float,
+               rx: int = 0, tx: int = 0) -> None:
+        klass = f"{status // 100}xx"
+        with self._mu:
+            key = (api, klass)
+            self._requests[key] = self._requests.get(key, 0) + 1
+            self._latency_sum[api] = self._latency_sum.get(api, 0.0) + seconds
+            self._latency_count[api] = self._latency_count.get(api, 0) + 1
+            self._bytes_rx += rx
+            self._bytes_tx += tx
+
+    # -- rendering -------------------------------------------------------
+
+    def render(self, object_layer=None, scanner=None) -> str:
+        lines: list[str] = []
+
+        def metric(name, help_, type_, samples):
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} {type_}")
+            for labels, value in samples:
+                if labels:
+                    lab = ",".join(f'{k}="{v}"' for k, v in labels.items())
+                    lines.append(f"{name}{{{lab}}} {value}")
+                else:
+                    lines.append(f"{name} {value}")
+
+        with self._mu:
+            reqs = dict(self._requests)
+            lat_sum = dict(self._latency_sum)
+            lat_count = dict(self._latency_count)
+            rx, tx = self._bytes_rx, self._bytes_tx
+
+        metric("minio_tpu_http_requests_total",
+               "HTTP requests by API and status class", "counter",
+               [({"api": a, "status": s}, v)
+                for (a, s), v in sorted(reqs.items())])
+        metric("minio_tpu_http_request_seconds_sum",
+               "Cumulative request latency per API", "counter",
+               [({"api": a}, round(v, 6)) for a, v in sorted(lat_sum.items())])
+        metric("minio_tpu_http_request_seconds_count",
+               "Request count per API (latency sample count)", "counter",
+               [({"api": a}, v) for a, v in sorted(lat_count.items())])
+        metric("minio_tpu_http_rx_bytes_total",
+               "Bytes received in request bodies", "counter", [({}, rx)])
+        metric("minio_tpu_http_tx_bytes_total",
+               "Bytes sent in response bodies", "counter", [({}, tx)])
+        metric("minio_tpu_process_uptime_seconds",
+               "Seconds since server start", "gauge",
+               [({}, round(time.time() - self._start, 1))])
+
+        if scanner is not None:
+            u = scanner.usage
+            metric("minio_tpu_cluster_objects_total",
+                   "Objects at last scanner cycle", "gauge",
+                   [({}, u.objects)])
+            metric("minio_tpu_cluster_versions_total",
+                   "Object versions at last scanner cycle", "gauge",
+                   [({}, u.versions)])
+            metric("minio_tpu_cluster_usage_bytes",
+                   "Logical bytes stored at last scanner cycle", "gauge",
+                   [({}, u.total_size)])
+            metric("minio_tpu_bucket_usage_bytes",
+                   "Logical bytes per bucket", "gauge",
+                   [({"bucket": b}, bu.size)
+                    for b, bu in sorted(u.buckets.items())])
+            metric("minio_tpu_heal_objects_healed_total",
+                   "Objects healed by the scanner", "counter",
+                   [({}, u.healed)])
+            metric("minio_tpu_heal_failures_total",
+                   "Scanner heal failures", "counter",
+                   [({}, u.heal_failures)])
+            metric("minio_tpu_scanner_cycles_total",
+                   "Completed scanner cycles", "counter", [({}, u.cycles)])
+
+        if object_layer is not None:
+            online, offline = 0, 0
+            total_cap = free_cap = 0
+            for _, _, di in probe_disks(object_layer):
+                if di is None:
+                    offline += 1
+                else:
+                    online += 1
+                    total_cap += di.total
+                    free_cap += di.free
+            metric("minio_tpu_drives_online", "Drives responding", "gauge",
+                   [({}, online)])
+            metric("minio_tpu_drives_offline", "Drives not responding",
+                   "gauge", [({}, offline)])
+            metric("minio_tpu_capacity_raw_total_bytes",
+                   "Raw capacity across online drives", "gauge",
+                   [({}, total_cap)])
+            metric("minio_tpu_capacity_raw_free_bytes",
+                   "Raw free capacity across online drives", "gauge",
+                   [({}, free_cap)])
+
+        return "\n".join(lines) + "\n"
+
+
+def layer_sets(object_layer) -> list:
+    """Erasure sets behind any object-layer shape (set / sets / pools)."""
+    pools = getattr(object_layer, "pools", None)
+    if pools is not None:
+        return [s for p in pools for s in p.sets]
+    sets = getattr(object_layer, "sets", None)
+    if sets is not None:
+        return list(sets)
+    return [object_layer] if hasattr(object_layer, "disks") else []
+
+
+def probe_disks(object_layer) -> list:
+    """(set_idx, disk, DiskInfo-or-None) for every drive, probed in
+    PARALLEL per set — one hung remote drive must not stack its timeout
+    onto every other drive's (health probes have deadlines)."""
+    out = []
+    for si, s in enumerate(layer_sets(object_layer)):
+        fanout = getattr(s, "_fanout", None)
+        if fanout is not None:
+            results, _ = fanout([lambda d=d: d.disk_info()
+                                 for d in s.disks])
+        else:  # pragma: no cover - every set has _fanout
+            results = []
+            for d in s.disks:
+                try:
+                    results.append(d.disk_info())
+                except Exception:  # noqa: BLE001
+                    results.append(None)
+        for d, di in zip(s.disks, results):
+            out.append((si, d, di))
+    return out

@@ -13,6 +13,7 @@ from __future__ import annotations
 import datetime
 import email.utils
 import hashlib
+import time as _time_mod
 import os
 import threading
 import urllib.parse
@@ -23,6 +24,8 @@ from minio_tpu.object.types import (DeleteOptions, GetOptions, InvalidArgument,
                                     ObjectNotFound, PutOptions)
 from minio_tpu.s3 import sigv4
 from minio_tpu.s3.errors import S3Error, from_exception
+from minio_tpu.s3.metrics import Metrics, layer_sets as _layer_sets, \
+    probe_disks as _probe_disks
 from minio_tpu.utils.streams import (HashingReader, HttpChunkedReader,
                                      LimitedReader, Payload)
 
@@ -95,6 +98,11 @@ class S3Server:
         # tagging / versioning toggles) within this process; cross-node
         # serialization would ride the dsync namespace lock.
         self.bucket_meta_lock = threading.Lock()
+        self.metrics = Metrics()
+        # Admin-triggered heal sweeps run in this background slot.
+        self.heal_status: dict = {"state": "idle"}
+        self._heal_thread: threading.Thread | None = None
+        self._heal_lock = threading.Lock()
 
     @property
     def address(self) -> str:
@@ -227,6 +235,8 @@ def _make_handler(server: S3Server):
             self.end_headers()
             if body and self.command != "HEAD":
                 self.wfile.write(body)
+                self._sent_bytes = getattr(self, "_sent_bytes", 0) \
+                    + len(body)
 
         def _send_error(self, e: Exception, bucket="", key=""):
             # The request body may be partially or fully unread (auth runs
@@ -245,9 +255,55 @@ def _make_handler(server: S3Server):
 
         # -- dispatch ---------------------------------------------------
 
+        def send_response(self, code, message=None):
+            self._last_status = code
+            super().send_response(code, message)
+
+        def _api_label(self, method, raw_path, bucket, key) -> str:
+            if raw_path.startswith("/minio/admin"):
+                return f"{method}:admin"
+            if raw_path.startswith("/minio/health"):
+                return f"{method}:health"
+            if raw_path.startswith("/minio/v2/metrics"):
+                return f"{method}:metrics"
+            scope = "object" if key else ("bucket" if bucket else "service")
+            return f"{method}:{scope}"
+
         def _route(self, method: str):
             raw_path, query, bucket, key = self._parse()
+            self._last_status = 0
+            self._sent_bytes = 0
+            t0 = _time_mod.perf_counter()
             try:
+                self._route_inner(method, raw_path, query, bucket, key)
+            finally:
+                try:
+                    rx = int(self.headers.get("Content-Length") or 0)
+                except ValueError:
+                    rx = 0
+                server.metrics.record(
+                    self._api_label(method, raw_path, bucket, key),
+                    self._last_status or 500,
+                    _time_mod.perf_counter() - t0,
+                    rx=rx, tx=self._sent_bytes)
+
+        def _route_inner(self, method, raw_path, query, bucket, key):
+            try:
+                # Unauthenticated endpoints: health probes and metrics
+                # (reference: cmd/healthcheck-handler.go is authless;
+                # metrics here follow suit for scrape simplicity).
+                if raw_path == "/minio/health/live":
+                    return self._send(200)
+                if raw_path == "/minio/health/ready":
+                    return self._health_ready()
+                if raw_path.startswith("/minio/v2/metrics"):
+                    text = server.metrics.render(
+                        object_layer=server.object_layer,
+                        scanner=getattr(server.object_layer, "scanner",
+                                        None))
+                    return self._send(200, text.encode(),
+                                      content_type="text/plain; "
+                                      "version=0.0.4")
                 ctype = self._headers_lower().get("content-type", "")
                 if method == "POST" and bucket and not key \
                         and "multipart/form-data" in ctype:
@@ -350,7 +406,7 @@ def _make_handler(server: S3Server):
         _BUCKET_CONFIGS = {
             "policy": ("NoSuchBucketPolicy", "_validate_policy_json"),
             "lifecycle": ("NoSuchLifecycleConfiguration",
-                          "_validate_xml_doc"),
+                          "_validate_lifecycle_xml"),
             "tagging": ("NoSuchTagSet", "_validate_xml_doc"),
             "cors": ("NoSuchCORSConfiguration", "_validate_xml_doc"),
             "encryption": ("ServerSideEncryptionConfigurationNotFoundError",
@@ -371,6 +427,17 @@ def _make_handler(server: S3Server):
                 ET.fromstring(body)
             except ET.ParseError:
                 raise S3Error("MalformedXML") from None
+
+        def _validate_lifecycle_xml(self, body: bytes) -> None:
+            """Semantic validation, not just well-formedness: a config
+            the scanner cannot evaluate must be rejected at PUT, never
+            accepted and silently ignored."""
+            from minio_tpu.object.lifecycle import (LifecycleError,
+                                                    parse_lifecycle)
+            try:
+                parse_lifecycle(body)
+            except LifecycleError as e:
+                raise S3Error("MalformedXML", str(e)) from None
 
         def _bucket_config(self, method, bucket, name, query, body):
             ol = server.object_layer
@@ -964,6 +1031,8 @@ def _make_handler(server: S3Server):
                     for chunk in chunks:
                         self.wfile.write(chunk)
                         sent += len(chunk)
+                        self._sent_bytes = getattr(
+                            self, "_sent_bytes", 0) + len(chunk)
                 except Exception:  # noqa: BLE001 - headers already sent
                     # Mid-stream failure (quorum loss, drive death) after
                     # the status line went out: all we can do is cut the
@@ -1098,6 +1167,92 @@ def _make_handler(server: S3Server):
                 return self._send(201, _xml(root))
             return self._send(200 if status == "200" else 204)
 
+        def _health_ready(self):
+            """Readiness: every erasure set must keep a read quorum
+            (n - parity responding drives; probed in parallel) —
+            reference: ClusterCheckHandler, cmd/healthcheck-handler.go."""
+            sets = _layer_sets(server.object_layer)
+            if not sets:
+                return self._send(503)
+            probes = _probe_disks(server.object_layer)
+            for si, s in enumerate(sets):
+                ok = sum(1 for psi, _, di in probes
+                         if psi == si and di is not None)
+                need = len(s.disks) - getattr(s, "default_parity", 0)
+                if ok < max(need, len(s.disks) // 2):
+                    return self._send(503)
+            return self._send(200)
+
+        def _admin_info(self):
+            import json as _json
+            total_objects = 0
+            scanner = getattr(server.object_layer, "scanner", None)
+            sets = _layer_sets(server.object_layer)
+            drives = []
+            for si, d, di in _probe_disks(server.object_layer):
+                entry = {"set": si,
+                         "endpoint": getattr(d, "endpoint", "")
+                         or getattr(d, "root", "")}
+                if di is not None:
+                    entry.update(state="ok", total=di.total,
+                                 used=di.used, free=di.free)
+                else:
+                    entry.update(state="offline")
+                drives.append(entry)
+            usage = {}
+            if scanner is not None:
+                u = scanner.usage
+                total_objects = u.objects
+                usage = {"objects": u.objects, "versions": u.versions,
+                         "total_size": u.total_size,
+                         "buckets": len(u.buckets),
+                         "last_update": u.last_update}
+            info = {
+                "mode": "online",
+                "sets": len(sets),
+                "drives": drives,
+                "drives_online": sum(1 for d in drives
+                                     if d["state"] == "ok"),
+                "drives_offline": sum(1 for d in drives
+                                      if d["state"] != "ok"),
+                "objects": total_objects,
+                "usage": usage,
+                "heal": server.heal_status,
+            }
+            self._send(200, _json.dumps(info).encode(),
+                       content_type="application/json")
+
+        def _admin_heal(self, query):
+            """Trigger a global heal sweep in the background; poll with
+            GET heal (reference: cmd/admin-heal-ops.go heal sequences)."""
+            import json as _json
+            deep = query.get("deep", [""])[0] in ("true", "1")
+
+            def run():
+                from minio_tpu.object.scanner import heal_set
+                total = {"buckets": 0, "objects": 0, "healed": 0,
+                         "failures": 0}
+                try:
+                    for s in _layer_sets(server.object_layer):
+                        r = heal_set(s, deep=deep)
+                        for k2 in total:
+                            total[k2] += r.get(k2, 0)
+                    server.heal_status = {"state": "done", **total}
+                except Exception as e:  # noqa: BLE001 - surfaced in status
+                    server.heal_status = {"state": "failed",
+                                          "error": str(e)[:300]}
+
+            with server._heal_lock:
+                if server._heal_thread is None or \
+                        not server._heal_thread.is_alive():
+                    server.heal_status = {"state": "running", "deep": deep}
+                    server._heal_thread = threading.Thread(target=run,
+                                                           daemon=True)
+                    server._heal_thread.start()
+            return self._send(200, _json.dumps(
+                server.heal_status).encode(),
+                content_type="application/json")
+
         # -- admin API (/minio/admin/v3/...) ---------------------------
 
         def _admin_op(self, method, raw_path, query, auth):
@@ -1108,11 +1263,19 @@ def _make_handler(server: S3Server):
             ak = auth.credential.access_key
             if not server.credentials.is_allowed(ak, "admin:*", "*"):
                 raise S3Error("AccessDenied")
+            op = raw_path[len("/minio/admin/v3/"):] \
+                if raw_path.startswith("/minio/admin/v3/") else ""
+            if op == "info" and method == "GET":
+                return self._admin_info()
+            if op == "heal" and method == "POST":
+                return self._admin_heal(query)
+            if op == "heal" and method == "GET":
+                return self._send(200,
+                                  _json.dumps(server.heal_status).encode(),
+                                  content_type="application/json")
             iam = server.credentials.iam
             if iam is None:
                 raise S3Error("NotImplemented")
-            op = raw_path[len("/minio/admin/v3/"):] \
-                if raw_path.startswith("/minio/admin/v3/") else ""
             body = self._read_body()
             q1 = {k: v[0] for k, v in query.items()}
 

@@ -232,6 +232,10 @@ def main(argv=None) -> int:
     from minio_tpu.object.scanner import Scanner
     all_sets = [s for p in pools for s in p.sets]
     scanner = Scanner(all_sets, interval=args.scanner_interval)
+    # ILM: lifecycle rules stored per bucket evaluate on every scanned
+    # object (reference: cmd/bucket-lifecycle.go via the scanner).
+    from minio_tpu.object.lifecycle import make_scanner_hook
+    scanner.on_object.append(make_scanner_hook())
     if args.scanner_interval > 0:
         scanner.start()
     layer.scanner = scanner

@@ -1,0 +1,131 @@
+"""Admin API, Prometheus metrics, health probes (reference:
+cmd/admin-handlers.go, cmd/metrics-v3.go, cmd/healthcheck-handler.go)."""
+
+import http.client
+import json
+import os
+import shutil
+import time
+
+import pytest
+
+from minio_tpu.object.erasure_object import ErasureSet
+from minio_tpu.object.scanner import Scanner
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.local import LocalStorage
+from tests.s3client import S3Client
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("admdrv")
+    roots = [str(tmp / f"d{i}") for i in range(4)]
+    disks = [LocalStorage(r) for r in roots]
+    es = ErasureSet(disks)
+    es.scanner = Scanner([es], throttle=0)
+    server = S3Server(es, address="127.0.0.1:0")
+    server.start()
+    yield server, es, roots
+    server.stop()
+
+
+@pytest.fixture(scope="module")
+def cli(env):
+    return S3Client(env[0].address)
+
+
+def _raw_get(addr, path):
+    conn = http.client.HTTPConnection(addr, timeout=10)
+    try:
+        conn.request("GET", path)
+        r = conn.getresponse()
+        return r.status, r.read()
+    finally:
+        conn.close()
+
+
+def test_health_probes_unauthenticated(env):
+    srv, es, roots = env
+    st, _ = _raw_get(srv.address, "/minio/health/live")
+    assert st == 200
+    st, _ = _raw_get(srv.address, "/minio/health/ready")
+    assert st == 200
+
+
+def test_metrics_endpoint(env, cli):
+    srv, es, roots = env
+    cli.request("PUT", "/metb")
+    cli.request("PUT", "/metb/obj", body=b"x" * 1000)
+    es.scanner.scan_cycle()
+    st, body = _raw_get(srv.address, "/minio/v2/metrics/cluster")
+    assert st == 200
+    text = body.decode()
+    assert "minio_tpu_http_requests_total" in text
+    assert 'api="PUT:object"' in text
+    assert "minio_tpu_cluster_objects_total 1" in text
+    assert "minio_tpu_drives_online 4" in text
+    assert "minio_tpu_capacity_raw_total_bytes" in text
+
+
+def test_admin_info(env, cli):
+    srv, es, roots = env
+    st, _, body = cli.request("GET", "/minio/admin/v3/info")
+    assert st == 200
+    info = json.loads(body)
+    assert info["sets"] == 1
+    assert info["drives_online"] == 4
+    assert len(info["drives"]) == 4
+    assert all(d["state"] == "ok" for d in info["drives"])
+    assert info["usage"]["objects"] >= 1
+
+
+def test_admin_heal_trigger(env, cli):
+    srv, es, roots = env
+    cli.request("PUT", "/healb")
+    body = os.urandom(50_000)
+    cli.request("PUT", "/healb/fixme", body=body)
+    shutil.rmtree(os.path.join(roots[1], "healb", "fixme"))
+    st, _, resp = cli.request("POST", "/minio/admin/v3/heal")
+    assert st == 200
+    assert json.loads(resp)["state"] in ("running", "done")
+    for _ in range(50):
+        st, _, resp = cli.request("GET", "/minio/admin/v3/heal")
+        status = json.loads(resp)
+        if status["state"] == "done":
+            break
+        time.sleep(0.1)
+    assert status["state"] == "done", status
+    assert status["healed"] >= 1
+    assert os.path.isdir(os.path.join(roots[1], "healb", "fixme"))
+    st, _, got = cli.request("GET", "/healb/fixme")
+    assert got == body
+
+
+def test_admin_endpoints_require_root(env):
+    srv, es, roots = env
+    anon = S3Client(srv.address, access_key="nobody", secret_key="xxxxxxxx")
+    st, _, _ = anon.request("GET", "/minio/admin/v3/info")
+    assert st == 403
+
+
+def test_readiness_fails_below_quorum(tmp_path):
+    disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    es = ErasureSet(disks)
+    server = S3Server(es, address="127.0.0.1:0")
+    server.start()
+    try:
+        st, _ = _raw_get(server.address, "/minio/health/ready")
+        assert st == 200
+
+        class Dead:
+            def __getattr__(self, name):
+                def fail(*a, **k):
+                    raise OSError("dead")
+                return fail
+        es.disks[0] = Dead()
+        es.disks[1] = Dead()
+        es.disks[2] = Dead()
+        st, _ = _raw_get(server.address, "/minio/health/ready")
+        assert st == 503
+    finally:
+        server.stop()
