@@ -1,0 +1,15 @@
+"""Bucket event notification: rules, webhook targets, store-and-forward.
+
+The analogue of the reference's event subsystem (internal/event/ +
+internal/store/): buckets carry notification configurations (event-name
++ prefix/suffix filters), matching object operations produce
+S3-format event records, and a store-and-forward queue delivers them to
+webhook targets — persisting undelivered events to disk so target
+downtime never loses notifications.
+"""
+
+from minio_tpu.events.notify import (EventNotifier, NotificationConfig,
+                                     WebhookTarget, parse_notification_xml)
+
+__all__ = ["EventNotifier", "NotificationConfig", "WebhookTarget",
+           "parse_notification_xml"]

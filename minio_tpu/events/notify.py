@@ -1,0 +1,278 @@
+"""Event notification engine.
+
+Reference shape: internal/event/targetlist.go fan-out, webhook target
+(internal/event/target/webhook.go) with a disk-backed retry store
+(internal/store/queuestore.go). Rules come from the bucket notification
+XML (PUT ?notification) with event-name wildcards and prefix/suffix
+filter rules.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+import uuid
+import xml.etree.ElementTree as ET
+from typing import Optional, Sequence
+
+XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
+_NS = f"{{{XMLNS}}}"
+
+
+class EventError(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class NotificationRule:
+    events: list                 # e.g. ["s3:ObjectCreated:*"]
+    prefix: str = ""
+    suffix: str = ""
+    target_id: str = "webhook"   # queue ARN tail
+
+    def matches(self, event_name: str, key: str) -> bool:
+        if not key.startswith(self.prefix) or not key.endswith(self.suffix):
+            return False
+        for pat in self.events:
+            if pat == event_name or pat == "s3:*":
+                return True
+            if pat.endswith(":*") and event_name.startswith(pat[:-1]):
+                return True
+        return False
+
+
+@dataclasses.dataclass
+class NotificationConfig:
+    rules: list = dataclasses.field(default_factory=list)
+
+
+def parse_notification_xml(xml: bytes | str) -> NotificationConfig:
+    """NotificationConfiguration XML -> config. QueueConfiguration
+    entries map to webhook targets by the ARN's trailing id
+    (arn:minio:sqs:<region>:<id>:webhook)."""
+    try:
+        root = ET.fromstring(xml)
+    except ET.ParseError as e:
+        raise EventError(f"malformed notification XML: {e}") from None
+    cfg = NotificationConfig()
+    for qel in list(root.iter(f"{_NS}QueueConfiguration")) \
+            + list(root.iter("QueueConfiguration")):
+        events = [e.text or "" for e in
+                  list(qel.findall(f"{_NS}Event")) + list(qel.findall("Event"))]
+        if not events:
+            raise EventError("QueueConfiguration without Event")
+        arn = qel.findtext(f"{_NS}Queue") or qel.findtext("Queue") or ""
+        # arn:minio:sqs:<region>:<id>:<target-type> — the trailing
+        # component names the target kind registered with the notifier.
+        target_id = arn.rsplit(":", 1)[-1] if arn else "webhook"
+        prefix = suffix = ""
+        for frel in qel.iter(f"{_NS}FilterRule"):
+            name = frel.findtext(f"{_NS}Name") or ""
+            value = frel.findtext(f"{_NS}Value") or ""
+            if name.lower() == "prefix":
+                prefix = value
+            elif name.lower() == "suffix":
+                suffix = value
+        for frel in qel.iter("FilterRule"):
+            name = frel.findtext("Name") or ""
+            value = frel.findtext("Value") or ""
+            if name.lower() == "prefix":
+                prefix = value
+            elif name.lower() == "suffix":
+                suffix = value
+        cfg.rules.append(NotificationRule(events=events, prefix=prefix,
+                                          suffix=suffix,
+                                          target_id=target_id))
+    return cfg
+
+
+def make_event_record(event_name: str, bucket: str, key: str,
+                      size: int = 0, etag: str = "",
+                      version_id: str = "") -> dict:
+    """S3 event message structure (reference: internal/event/event.go)."""
+    now = datetime.datetime.now(datetime.timezone.utc)
+    return {
+        "eventVersion": "2.1",
+        "eventSource": "minio-tpu:s3",
+        "awsRegion": "us-east-1",
+        "eventTime": now.strftime("%Y-%m-%dT%H:%M:%S.%fZ"),
+        "eventName": event_name,
+        "s3": {
+            "s3SchemaVersion": "1.0",
+            "bucket": {"name": bucket,
+                       "arn": f"arn:aws:s3:::{bucket}"},
+            "object": {"key": urllib.parse.quote(key), "size": size,
+                       "eTag": etag, "versionId": version_id,
+                       "sequencer": format(time.time_ns(), "016x")},
+        },
+    }
+
+
+class WebhookTarget:
+    """POSTs event records as JSON to an HTTP endpoint."""
+
+    def __init__(self, target_id: str, endpoint: str, timeout: float = 5.0):
+        self.target_id = target_id
+        self.endpoint = endpoint
+        self.timeout = timeout
+
+    def send(self, record: dict) -> None:
+        body = json.dumps({"Records": [record]}).encode()
+        req = urllib.request.Request(
+            self.endpoint, data=body,
+            headers={"Content-Type": "application/json",
+                     "User-Agent": "minio-tpu-notify"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            if resp.status >= 300:
+                raise EventError(f"webhook {self.endpoint}: {resp.status}")
+
+
+class EventNotifier:
+    """Rules + targets + a disk-persisted store-and-forward queue.
+
+    Undelivered events live as one JSON file each under store_dir
+    (reference: internal/store/queuestore.go); the delivery worker
+    retries with backoff, so a webhook outage delays notifications but
+    never drops them. Rule lookups read the bucket's notification
+    config through the object layer's bucket metadata."""
+
+    _RETRY_BASE = 0.5
+    _RETRY_MAX = 30.0
+
+    def __init__(self, object_layer, store_dir: str,
+                 targets: Optional[Sequence[WebhookTarget]] = None):
+        self.object_layer = object_layer
+        self.store_dir = store_dir
+        os.makedirs(store_dir, exist_ok=True)
+        self.targets = {t.target_id: t for t in (targets or [])}
+        self._cfg_cache: dict = {}
+        self.delivered = 0
+        self.failed_attempts = 0
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    # -- rule resolution -------------------------------------------------
+
+    def config_for(self, bucket: str) -> Optional[NotificationConfig]:
+        try:
+            doc = self.object_layer.get_bucket_meta(bucket) \
+                .get("config:notification")
+        except Exception:  # noqa: BLE001 - bucket gone
+            return None
+        if not doc:
+            return None
+        # Parse once per distinct document — this sits on the data path
+        # of every mutating request (bucket meta itself is TTL-cached).
+        hit = self._cfg_cache.get(bucket)
+        if hit is not None and hit[0] == doc:
+            return hit[1]
+        try:
+            cfg = parse_notification_xml(doc)
+        except EventError:
+            cfg = None
+        self._cfg_cache[bucket] = (doc, cfg)
+        return cfg
+
+    # -- ingestion -------------------------------------------------------
+
+    def notify(self, event_name: str, bucket: str, key: str,
+               size: int = 0, etag: str = "", version_id: str = "") -> None:
+        """Queue matching events; never blocks or raises into the data
+        path."""
+        try:
+            cfg = self.config_for(bucket)
+            if cfg is None:
+                return
+            record = None
+            for rule in cfg.rules:
+                if not rule.matches(event_name, key):
+                    continue
+                if rule.target_id not in self.targets:
+                    continue
+                if record is None:
+                    record = make_event_record(event_name, bucket, key,
+                                               size, etag, version_id)
+                self._enqueue(rule.target_id, record)
+        except Exception:  # noqa: BLE001 - notification is best-effort
+            return
+
+    def _enqueue(self, target_id: str, record: dict) -> None:
+        name = f"{time.time_ns():020d}-{uuid.uuid4().hex[:8]}.json"
+        tmp = os.path.join(self.store_dir, f".{name}.tmp")
+        final = os.path.join(self.store_dir, name)
+        with open(tmp, "w") as f:
+            json.dump({"target": target_id, "record": record}, f)
+        os.replace(tmp, final)
+        self._wake.set()
+
+    # -- delivery --------------------------------------------------------
+
+    def _pending_files(self) -> list[str]:
+        try:
+            return sorted(f for f in os.listdir(self.store_dir)
+                          if f.endswith(".json"))
+        except FileNotFoundError:
+            return []
+
+    def _run(self) -> None:
+        backoff = self._RETRY_BASE
+        while not self._stop.is_set():
+            files = self._pending_files()
+            if not files:
+                self._wake.wait(timeout=1.0)
+                self._wake.clear()
+                continue
+            progressed = False
+            for name in files:
+                if self._stop.is_set():
+                    return
+                path = os.path.join(self.store_dir, name)
+                try:
+                    with open(path) as f:
+                        entry = json.load(f)
+                except (OSError, ValueError):
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                    continue
+                target = self.targets.get(entry.get("target", ""))
+                if target is None:
+                    os.unlink(path)
+                    continue
+                try:
+                    target.send(entry["record"])
+                    os.unlink(path)
+                    self.delivered += 1
+                    progressed = True
+                except Exception:  # noqa: BLE001 - retry after backoff
+                    self.failed_attempts += 1
+            if progressed:
+                backoff = self._RETRY_BASE
+            else:
+                self._stop.wait(timeout=backoff)
+                backoff = min(backoff * 2, self._RETRY_MAX)
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Testing hook: wait until the store is empty."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if not self._pending_files():
+                return True
+            self._wake.set()
+            time.sleep(0.05)
+        return False
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._worker.join(timeout=2)

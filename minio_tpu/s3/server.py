@@ -103,6 +103,8 @@ class S3Server:
         self.heal_status: dict = {"state": "idle"}
         self._heal_thread: threading.Thread | None = None
         self._heal_lock = threading.Lock()
+        # Event notifier (events.EventNotifier); None = no targets.
+        self.notifier = None
 
     @property
     def address(self) -> str:
@@ -403,6 +405,7 @@ def _make_handler(server: S3Server):
         # (reference: cmd/bucket-metadata-sys.go keeps policy/lifecycle/
         # tagging/... documents in one quorum-replicated record):
         # meta key -> (absent-error, validator).
+        # meta key -> (absent-error or None for empty-doc GET, validator).
         _BUCKET_CONFIGS = {
             "policy": ("NoSuchBucketPolicy", "_validate_policy_json"),
             "lifecycle": ("NoSuchLifecycleConfiguration",
@@ -411,6 +414,7 @@ def _make_handler(server: S3Server):
             "cors": ("NoSuchCORSConfiguration", "_validate_xml_doc"),
             "encryption": ("ServerSideEncryptionConfigurationNotFoundError",
                            "_validate_xml_doc"),
+            "notification": (None, "_validate_notification_xml"),
         }
 
         def _validate_policy_json(self, body: bytes) -> None:
@@ -439,6 +443,14 @@ def _make_handler(server: S3Server):
             except LifecycleError as e:
                 raise S3Error("MalformedXML", str(e)) from None
 
+        def _validate_notification_xml(self, body: bytes) -> None:
+            from minio_tpu.events import parse_notification_xml
+            from minio_tpu.events.notify import EventError
+            try:
+                parse_notification_xml(body)
+            except EventError as e:
+                raise S3Error("MalformedXML", str(e)) from None
+
         def _bucket_config(self, method, bucket, name, query, body):
             ol = server.object_layer
             ol.get_bucket_info(bucket)
@@ -459,10 +471,22 @@ def _make_handler(server: S3Server):
                 return self._send(204)
             stored = ol.get_bucket_meta(bucket).get(meta_key)
             if stored is None:
+                if absent_err is None:
+                    # Unset notification config answers an empty
+                    # document, per S3.
+                    root = ET.Element("NotificationConfiguration",
+                                      xmlns=XMLNS)
+                    return self._send(200, _xml(root))
                 raise S3Error(absent_err, bucket=bucket)
             ctype = "application/json" if name == "policy" \
                 else "application/xml"
             return self._send(200, stored.encode(), content_type=ctype)
+
+        def _notify(self, event_name, bucket, key, size=0, etag="",
+                    version_id=""):
+            if server.notifier is not None:
+                server.notifier.notify(event_name, bucket, key, size=size,
+                                       etag=etag, version_id=version_id)
 
         def _bucket_op(self, method, bucket, query, body):
             ol = server.object_layer
@@ -671,6 +695,12 @@ def _make_handler(server: S3Server):
                     deleted = server.object_layer.delete_object(
                         bucket, key,
                         DeleteOptions(version_id=vid, versioned=versioned))
+                    self._notify(
+                        "s3:ObjectRemoved:DeleteMarkerCreated"
+                        if deleted.delete_marker
+                        else "s3:ObjectRemoved:Delete", bucket, key,
+                        version_id=deleted.delete_marker_version_id
+                        if deleted.delete_marker else vid)
                     if not quiet:
                         de = _el(root, "Deleted")
                         _el(de, "Key", key)
@@ -808,6 +838,9 @@ def _make_handler(server: S3Server):
                     raise S3Error("MalformedXML") from None
             info = server.object_layer.complete_multipart_upload(
                 bucket, key, uid, parts)
+            self._notify("s3:ObjectCreated:CompleteMultipartUpload",
+                         bucket, key, size=info.size, etag=info.etag,
+                         version_id=info.version_id)
             root = ET.Element("CompleteMultipartUploadResult", xmlns=XMLNS)
             _el(root, "Location", f"/{bucket}/{key}")
             _el(root, "Bucket", bucket)
@@ -874,6 +907,9 @@ def _make_handler(server: S3Server):
                 bucket, key, payload, PutOptions(
                     versioned=_versioned(server.object_layer, bucket),
                     user_metadata=meta, content_type=ctype, tags=tags))
+            self._notify("s3:ObjectCreated:Copy", bucket, key,
+                         size=info.size, etag=info.etag,
+                         version_id=info.version_id)
             root = ET.Element("CopyObjectResult", xmlns=XMLNS)
             _el(root, "ETag", f'"{info.etag}"')
             _el(root, "LastModified", _iso8601(info.mod_time))
@@ -913,6 +949,9 @@ def _make_handler(server: S3Server):
                 storage_class=h.get("x-amz-storage-class", "STANDARD"),
                 tags=h.get("x-amz-tagging", ""))
             info = server.object_layer.put_object(bucket, key, payload, opts)
+            self._notify("s3:ObjectCreated:Put", bucket, key,
+                         size=info.size, etag=info.etag,
+                         version_id=info.version_id)
             headers = {"ETag": f'"{info.etag}"'}
             if info.version_id:
                 headers["x-amz-version-id"] = info.version_id
@@ -1157,6 +1196,9 @@ def _make_handler(server: S3Server):
                     user_metadata=meta,
                     content_type=fields.get("content-type", ""),
                     tags=fields.get("tagging", "")))
+            self._notify("s3:ObjectCreated:Post", bucket, key,
+                         size=info.size, etag=info.etag,
+                         version_id=info.version_id)
             status = fields.get("success_action_status", "204")
             if status == "201":
                 root = ET.Element("PostResponse")
@@ -1334,6 +1376,11 @@ def _make_handler(server: S3Server):
                 bucket, key, DeleteOptions(
                     version_id=vid,
                     versioned=_versioned(server.object_layer, bucket)))
+            self._notify("s3:ObjectRemoved:DeleteMarkerCreated"
+                         if deleted.delete_marker
+                         else "s3:ObjectRemoved:Delete", bucket, key,
+                         version_id=deleted.delete_marker_version_id
+                         if deleted.delete_marker else vid)
             headers = {}
             if deleted.delete_marker:
                 headers["x-amz-delete-marker"] = "true"
@@ -1390,6 +1437,7 @@ def _required_permissions(method: str, bucket: str, key: str, query: dict,
         "policy": "BucketPolicy", "lifecycle": "LifecycleConfiguration",
         "tagging": "BucketTagging", "cors": "BucketCORS",
         "encryption": "EncryptionConfiguration",
+        "notification": "BucketNotification",
     }
     if not key:
         for q, stem in _CONFIG_ACTIONS.items():

@@ -23,6 +23,7 @@ Credentials come from MTPU_ROOT_USER / MTPU_ROOT_PASSWORD
 from __future__ import annotations
 
 import argparse
+import os
 import socket as socket_mod
 import sys
 import time
@@ -45,6 +46,13 @@ def main(argv=None) -> int:
     ap.add_argument("--scanner-interval", type=float, default=60.0,
                     help="seconds between background scanner cycles "
                          "(0 disables the background thread)")
+    ap.add_argument("--drive-timeout", type=float, default=10.0,
+                    help="per-op drive deadline in seconds; a drive "
+                         "tripping it repeatedly is circuit-broken "
+                         "(0 disables the health wrapper)")
+    ap.add_argument("--notify-webhook", default="",
+                    help="webhook endpoint URL for bucket event "
+                         "notifications (target id 'webhook')")
     ap.add_argument("drives", nargs="+",
                     help="drive dirs or http://host:port/path endpoints; "
                          "`{1...N}` ellipses expand, and each ellipses "
@@ -197,6 +205,12 @@ def main(argv=None) -> int:
         deployment_id = deployment_id or fmt.deployment_id
         ordered = [d if d is not None else OfflineDisk(f"pos-{i}")
                    for i, d in enumerate(ordered)]
+        # Deadline + circuit-breaker wrapper: a hung (not dead) drive
+        # fails fast instead of stalling every quorum fan-out
+        # (reference: cmd/xl-storage-disk-id-check.go).
+        if args.drive_timeout > 0:
+            from minio_tpu.storage.health import wrap_disks
+            ordered = wrap_disks(ordered, op_timeout=args.drive_timeout)
         sets = [ErasureSet(ordered[i:i + set_size], parity=args.parity,
                            backend=backend)
                 for i in range(0, len(ordered), set_size)]
@@ -245,6 +259,19 @@ def main(argv=None) -> int:
     creds = Credentials()
     creds.iam = IAMSys(pools[0].sets, creds.access_key, creds.secret_key)
     srv = S3Server(layer, address=args.address, credentials=creds)
+    if args.notify_webhook:
+        # Store-and-forward webhook notifications; the queue lives on
+        # the first local drive so it survives restarts.
+        from minio_tpu.events import EventNotifier, WebhookTarget
+        first_local = next((d for p in pools for s in p.sets
+                            for d in s.disks
+                            if getattr(d, "root", None)), None)
+        store = os.path.join(first_local.root, ".mtpu.sys", "events") \
+            if first_local is not None else \
+            os.path.join("/tmp", "mtpu-events")   # stable across restarts
+        srv.notifier = EventNotifier(
+            layer, store,
+            targets=[WebhookTarget("webhook", args.notify_webhook)])
     print(f"minio-tpu serving S3 on {srv.address} "
           f"({len(pools)} pools, {n_sets} sets, {n_drives} drives, "
           f"{'distributed, ' if distributed else ''}"

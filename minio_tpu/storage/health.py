@@ -1,0 +1,188 @@
+"""Drive health wrapper: latency tracking, op deadlines, circuit breaker.
+
+The analogue of the reference's xlStorageDiskIDCheck wrapper
+(cmd/xl-storage-disk-id-check.go): every StorageAPI call is timed and
+deadline-bounded, consecutive infrastructure faults (timeouts, I/O
+errors) trip a breaker that fails calls FAST while the drive is
+considered offline, and a half-open probe re-admits it after a
+cooldown. Quorum fan-outs over wrapped drives therefore stay bounded in
+latency even when a drive hangs rather than dies — the failure mode
+plain error handling never catches.
+
+Domain errors (missing files/volumes, corrupt journals) are the
+storage layer working CORRECTLY and never count against the drive.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeout
+
+from minio_tpu.storage.local import (DiskAccessDenied, FaultyDisk,
+                                     VolumeExists, VolumeNotEmpty,
+                                     VolumeNotFound)
+from minio_tpu.storage.meta import (FileNotFoundErr, MetaError,
+                                    VersionNotFoundErr)
+
+# Errors that mean "the drive answered correctly" — never breaker fuel.
+# The BUILTIN FileNotFoundError is deliberately absent: LocalStorage
+# converts every ordinary missing-object case to FileNotFoundErr, so a
+# raw one means the drive root itself vanished (unmounted disk) — the
+# reference maps that to disk-not-found, and so does this breaker.
+_DOMAIN_ERRORS = (FileNotFoundErr, VersionNotFoundErr, MetaError,
+                  VolumeNotFound, VolumeExists, VolumeNotEmpty,
+                  DiskAccessDenied, IsADirectoryError,
+                  NotADirectoryError, ValueError, KeyError)
+
+# Bulk transfer ops get a longer deadline than metadata ops.
+_BULK_OPS = {"create_file", "read_file", "rename_data"}
+
+
+class DiskHealthWrapper:
+    """Wraps any StorageAPI-shaped drive with deadlines + a breaker.
+
+    op_timeout / bulk_timeout: per-call deadlines (seconds).
+    trip_after: consecutive faults that open the breaker.
+    cooldown: seconds the breaker stays open before a half-open probe.
+    """
+
+    def __init__(self, disk, op_timeout: float = 10.0,
+                 bulk_timeout: float = 120.0, trip_after: int = 3,
+                 cooldown: float = 5.0):
+        self._disk = disk
+        self._op_timeout = op_timeout
+        self._bulk_timeout = bulk_timeout
+        self._trip_after = trip_after
+        self._cooldown = cooldown
+        self._mu = threading.Lock()
+        self._consecutive = 0
+        self._open_since: float = 0.0     # 0 = closed
+        self._half_open_probe = False
+        # op -> [count, errors, total_seconds]; small and bounded.
+        self.op_stats: dict[str, list] = {}
+        # A hung call occupies a worker until it returns; the breaker
+        # stops new submissions long before the pool exhausts.
+        self._pool = ThreadPoolExecutor(max_workers=8)
+
+    # -- introspection ---------------------------------------------------
+
+    @property
+    def wrapped(self):
+        return self._disk
+
+    @property
+    def endpoint(self):
+        return getattr(self._disk, "endpoint", "")
+
+    @property
+    def root(self):
+        return getattr(self._disk, "root", None)
+
+    def is_online(self) -> bool:
+        with self._mu:
+            return self._open_since == 0.0
+
+    def health_info(self) -> dict:
+        with self._mu:
+            return {
+                "online": self._open_since == 0.0,
+                "consecutive_faults": self._consecutive,
+                "ops": {op: {"count": s[0], "errors": s[1],
+                             "avg_ms": round(1000 * s[2] / s[0], 3)
+                             if s[0] else 0.0}
+                        for op, s in self.op_stats.items()},
+            }
+
+    # -- call path -------------------------------------------------------
+
+    def _admit(self) -> None:
+        """Fail fast while the breaker is open; let one probe through
+        after the cooldown (half-open)."""
+        with self._mu:
+            if self._open_since == 0.0:
+                return
+            if time.monotonic() - self._open_since < self._cooldown:
+                raise FaultyDisk(f"drive {self.endpoint}: breaker open")
+            if self._half_open_probe:
+                raise FaultyDisk(
+                    f"drive {self.endpoint}: breaker half-open, probing")
+            self._half_open_probe = True
+
+    def _record(self, op: str, seconds: float, failed: bool) -> None:
+        with self._mu:
+            s = self.op_stats.setdefault(op, [0, 0, 0.0])
+            s[0] += 1
+            s[1] += 1 if failed else 0
+            s[2] += seconds
+
+    def _fault(self) -> None:
+        with self._mu:
+            self._consecutive += 1
+            self._half_open_probe = False
+            if self._open_since != 0.0:
+                # Failed half-open probe: restart the cooldown, or every
+                # request after the first expiry would become a probe
+                # and eat the full op timeout.
+                self._open_since = time.monotonic()
+            elif self._consecutive >= self._trip_after:
+                self._open_since = time.monotonic()
+
+    def _ok(self) -> None:
+        with self._mu:
+            self._consecutive = 0
+            self._open_since = 0.0
+            self._half_open_probe = False
+
+    def _call(self, op: str, fn, args, kwargs):
+        self._admit()
+        timeout = self._bulk_timeout if op in _BULK_OPS else self._op_timeout
+        t0 = time.monotonic()
+        fut: Future = self._pool.submit(fn, *args, **kwargs)
+        try:
+            result = fut.result(timeout=timeout)
+        except FutureTimeout:
+            self._record(op, time.monotonic() - t0, failed=True)
+            self._fault()
+            raise FaultyDisk(
+                f"drive {self.endpoint}: {op} exceeded {timeout}s") from None
+        except _DOMAIN_ERRORS:
+            # The drive responded; the object/volume state is the news.
+            self._record(op, time.monotonic() - t0, failed=False)
+            self._ok()
+            raise
+        except Exception:
+            self._record(op, time.monotonic() - t0, failed=True)
+            self._fault()
+            raise
+        self._record(op, time.monotonic() - t0, failed=False)
+        self._ok()
+        return result
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._disk, name)
+        if not callable(attr):
+            return attr
+        cache = self.__dict__.setdefault("_bound_cache", {})
+        hit = cache.get(name)
+        if hit is not None:
+            return hit
+
+        def bound(*args, **kwargs):
+            return self._call(name, attr, args, kwargs)
+        cache[name] = bound
+        return bound
+
+
+def wrap_disks(disks, **kwargs) -> list:
+    """Health-wrap a drive list (OfflineDisk placeholders pass through —
+    they already fail fast)."""
+    out = []
+    for d in disks:
+        if d is None or type(d).__name__ == "OfflineDisk" \
+                or isinstance(d, DiskHealthWrapper):
+            out.append(d)
+        else:
+            out.append(DiskHealthWrapper(d, **kwargs))
+    return out
