@@ -61,31 +61,27 @@ def parse_notification_xml(xml: bytes | str) -> NotificationConfig:
         root = ET.fromstring(xml)
     except ET.ParseError as e:
         raise EventError(f"malformed notification XML: {e}") from None
+    # Strip namespaces once so every lookup below is plain-tag; clients
+    # send both namespaced and bare documents.
+    for el in root.iter():
+        if isinstance(el.tag, str) and "}" in el.tag:
+            el.tag = el.tag.split("}", 1)[1]
     cfg = NotificationConfig()
-    for qel in list(root.iter(f"{_NS}QueueConfiguration")) \
-            + list(root.iter("QueueConfiguration")):
-        events = [e.text or "" for e in
-                  list(qel.findall(f"{_NS}Event")) + list(qel.findall("Event"))]
+    for qel in root.iter("QueueConfiguration"):
+        events = [e.text or "" for e in qel.findall("Event")]
         if not events:
             raise EventError("QueueConfiguration without Event")
-        arn = qel.findtext(f"{_NS}Queue") or qel.findtext("Queue") or ""
+        arn = qel.findtext("Queue") or ""
         # arn:minio:sqs:<region>:<id>:<target-type> — the trailing
         # component names the target kind registered with the notifier.
         target_id = arn.rsplit(":", 1)[-1] if arn else "webhook"
         prefix = suffix = ""
-        for frel in qel.iter(f"{_NS}FilterRule"):
-            name = frel.findtext(f"{_NS}Name") or ""
-            value = frel.findtext(f"{_NS}Value") or ""
-            if name.lower() == "prefix":
-                prefix = value
-            elif name.lower() == "suffix":
-                suffix = value
         for frel in qel.iter("FilterRule"):
-            name = frel.findtext("Name") or ""
+            name = (frel.findtext("Name") or "").lower()
             value = frel.findtext("Value") or ""
-            if name.lower() == "prefix":
+            if name == "prefix":
                 prefix = value
-            elif name.lower() == "suffix":
+            elif name == "suffix":
                 suffix = value
         cfg.rules.append(NotificationRule(events=events, prefix=prefix,
                                           suffix=suffix,
@@ -129,9 +125,12 @@ class WebhookTarget:
             self.endpoint, data=body,
             headers={"Content-Type": "application/json",
                      "User-Agent": "minio-tpu-notify"})
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            if resp.status >= 300:
-                raise EventError(f"webhook {self.endpoint}: {resp.status}")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout):
+                pass
+        except urllib.error.HTTPError as e:
+            # Non-2xx statuses surface as HTTPError, not via resp.status.
+            raise EventError(f"webhook {self.endpoint}: {e.code}") from None
 
 
 class EventNotifier:
@@ -193,14 +192,17 @@ class EventNotifier:
             if cfg is None:
                 return
             record = None
-            for rule in cfg.rules:
+            queued_targets = set()   # one event per TARGET, however
+            for rule in cfg.rules:   # many rules match (reference dedup)
                 if not rule.matches(event_name, key):
                     continue
-                if rule.target_id not in self.targets:
+                if rule.target_id not in self.targets \
+                        or rule.target_id in queued_targets:
                     continue
                 if record is None:
                     record = make_event_record(event_name, bucket, key,
                                                size, etag, version_id)
+                queued_targets.add(rule.target_id)
                 self._enqueue(rule.target_id, record)
         except Exception:  # noqa: BLE001 - notification is best-effort
             return
@@ -247,15 +249,22 @@ class EventNotifier:
                     continue
                 target = self.targets.get(entry.get("target", ""))
                 if target is None:
-                    os.unlink(path)
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
                     continue
                 try:
                     target.send(entry["record"])
-                    os.unlink(path)
-                    self.delivered += 1
-                    progressed = True
                 except Exception:  # noqa: BLE001 - retry after backoff
                     self.failed_attempts += 1
+                    continue
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                self.delivered += 1
+                progressed = True
             if progressed:
                 backoff = self._RETRY_BASE
             else:

@@ -30,7 +30,14 @@ class GridClient:
         self.connect_timeout = connect_timeout
         self.call_timeout = call_timeout
         self._sock: Optional[socket.socket] = None
-        self._mu = threading.Lock()          # guards connect + write + maps
+        self._mu = threading.Lock()          # guards connect + state maps
+        # Socket writes serialize on their own lock, held per FRAME only:
+        # registering new calls (and timing out old ones) never waits on
+        # another call's in-flight sendall, and bulk transfers chunked
+        # into frames let lock RPCs interleave between chunks
+        # (reference: the grid/HTTP-stream split with frame-granular
+        # scheduling, internal/grid/README.md).
+        self._wmu = threading.Lock()
         self._mux = itertools.count(1)
         # mux -> (socket it was sent on, reply queue): a dying socket's
         # reader must only fail calls sent on THAT socket, never calls
@@ -75,8 +82,10 @@ class GridClient:
                 msg = wire.read_frame(s)
                 t = msg.get("t")
                 if t == wire.T_PING:
-                    with self._mu:
-                        if self._sock is s:
+                    with self._wmu:
+                        with self._mu:
+                            live = self._sock is s
+                        if live:
                             s.sendall(wire.pack_frame({"t": wire.T_PONG}))
                     continue
                 if t == wire.T_PONG:
@@ -99,21 +108,27 @@ class GridClient:
     # -- calls ---------------------------------------------------------
 
     def _send(self, msg: dict, mux: int, q) -> None:
+        frame = wire.pack_frame(msg)
         with self._mu:
             self._connect_locked()
             s = self._sock
             self._pending[mux] = (s, q)
-            try:
-                s.sendall(wire.pack_frame(msg))
-            except OSError as e:
+        try:
+            with self._wmu:
+                # Re-check under the write lock: a concurrent failure
+                # may have replaced the connection after registration.
+                with self._mu:
+                    if self._sock is not s:
+                        raise OSError("connection replaced")
+                s.sendall(frame)
+        except OSError as e:
+            with self._mu:
                 self._pending.pop(mux, None)
-                err = e
-            else:
-                return
-        # Send failed: drop the connection fully (close the socket so the
-        # parked reader thread exits, fail other calls in flight on it).
-        self._drop_conn(s)
-        raise GridError(f"send to {self.host}:{self.port}: {err}") from None
+            # Drop the connection fully (close the socket so the parked
+            # reader thread exits, fail other calls in flight on it).
+            self._drop_conn(s)
+            raise GridError(
+                f"send to {self.host}:{self.port}: {e}") from None
 
     def _finish(self, mux: int) -> None:
         with self._mu:

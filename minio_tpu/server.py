@@ -266,9 +266,12 @@ def main(argv=None) -> int:
         first_local = next((d for p in pools for s in p.sets
                             for d in s.disks
                             if getattr(d, "root", None)), None)
+        # Durable queue location: a local drive when we have one, else a
+        # per-deployment dir under $HOME (reboot-durable, unlike /tmp).
         store = os.path.join(first_local.root, ".mtpu.sys", "events") \
             if first_local is not None else \
-            os.path.join("/tmp", "mtpu-events")   # stable across restarts
+            os.path.join(os.path.expanduser("~"), ".mtpu",
+                         f"events-{deployment_id}")
         srv.notifier = EventNotifier(
             layer, store,
             targets=[WebhookTarget("webhook", args.notify_webhook)])

@@ -15,9 +15,10 @@ storage layer working CORRECTLY and never count against the drive.
 
 from __future__ import annotations
 
+import queue as queue_mod
 import threading
 import time
-from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FutureTimeout
 
 from minio_tpu.storage.local import (DiskAccessDenied, FaultyDisk,
@@ -38,6 +39,44 @@ _DOMAIN_ERRORS = (FileNotFoundErr, VersionNotFoundErr, MetaError,
 
 # Bulk transfer ops get a longer deadline than metadata ops.
 _BULK_OPS = {"create_file", "read_file", "rename_data"}
+# Ops returning lazy iterators: each next() must go through the
+# deadline/breaker machinery, not just the (instant) generator creation.
+_GENERATOR_OPS = {"walk_dir"}
+
+
+class _DaemonPool:
+    """Minimal executor with DAEMON workers: a call hung on dead storage
+    must never block interpreter shutdown (ThreadPoolExecutor joins its
+    workers at exit)."""
+
+    def __init__(self, workers: int):
+        self._q: "queue_mod.SimpleQueue" = queue_mod.SimpleQueue()
+        self._threads = [threading.Thread(target=self._work, daemon=True)
+                         for _ in range(workers)]
+        for t in self._threads:
+            t.start()
+
+    def submit(self, fn, *args, **kwargs) -> Future:
+        f: Future = Future()
+        self._q.put((f, fn, args, kwargs))
+        return f
+
+    def _work(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            f, fn, args, kwargs = item
+            if not f.set_running_or_notify_cancel():
+                continue
+            try:
+                f.set_result(fn(*args, **kwargs))
+            except BaseException as e:  # noqa: BLE001 - ferried to caller
+                f.set_exception(e)
+
+    def shutdown(self) -> None:
+        for _ in self._threads:
+            self._q.put(None)
 
 
 class DiskHealthWrapper:
@@ -64,7 +103,7 @@ class DiskHealthWrapper:
         self.op_stats: dict[str, list] = {}
         # A hung call occupies a worker until it returns; the breaker
         # stops new submissions long before the pool exhausts.
-        self._pool = ThreadPoolExecutor(max_workers=8)
+        self._pool = _DaemonPool(workers=8)
 
     # -- introspection ---------------------------------------------------
 
@@ -160,6 +199,26 @@ class DiskHealthWrapper:
         self._ok()
         return result
 
+    _END = object()
+
+    def _guarded_iter(self, name: str, attr, args, kwargs):
+        """Deadline-bounded iteration of a generator op: creating the
+        generator is instant, the I/O happens per next() — so every
+        step runs through the breaker/deadline machinery."""
+        it = iter(attr(*args, **kwargs))
+
+        def step():
+            try:
+                return next(it)
+            except StopIteration:
+                return self._END
+
+        while True:
+            item = self._call(name, step, (), {})
+            if item is self._END:
+                return
+            yield item
+
     def __getattr__(self, name: str):
         attr = getattr(self._disk, name)
         if not callable(attr):
@@ -169,10 +228,17 @@ class DiskHealthWrapper:
         if hit is not None:
             return hit
 
-        def bound(*args, **kwargs):
-            return self._call(name, attr, args, kwargs)
+        if name in _GENERATOR_OPS:
+            def bound(*args, **kwargs):
+                return self._guarded_iter(name, attr, args, kwargs)
+        else:
+            def bound(*args, **kwargs):
+                return self._call(name, attr, args, kwargs)
         cache[name] = bound
         return bound
+
+    def close(self) -> None:
+        self._pool.shutdown()
 
 
 def wrap_disks(disks, **kwargs) -> list:

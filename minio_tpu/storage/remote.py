@@ -31,7 +31,10 @@ from minio_tpu.storage.meta import (FileInfo, FileNotFoundErr, MetaError,
 
 # Bulk transfers chunk at this size (small enough to interleave with
 # lock/metadata frames on the shared connection).
-CHUNK = 4 << 20
+# Bulk transfer chunk: one grid frame per chunk. Kept to 1 MiB so lock
+# and metadata RPCs interleave between a big transfer's frames instead
+# of waiting behind one multi-MiB sendall (the write lock is per frame).
+CHUNK = 1 << 20
 
 _CODE_TO_EXC = {
     "FileNotFound": FileNotFoundErr,
