@@ -1,0 +1,19 @@
+"""Server-side encryption: KMS, DARE-style streaming AEAD, SSE plumbing.
+
+The content-transform column of the reference (cmd/encryption-v1.go,
+internal/crypto/, internal/kms/): objects encrypt before they reach the
+erasure layer, per-object data keys seal under a KMS master key (SSE-S3)
+or a client-supplied key (SSE-C), and ciphertext is framed in
+fixed-size AES-256-GCM packages so ranged reads decrypt only the
+packages they touch.
+"""
+
+from minio_tpu.crypto.kms import KMS, KMSError
+from minio_tpu.crypto.dare import (PACKAGE_SIZE, DareError,
+                                   decrypt_packages, encrypt_stream_size,
+                                   EncryptingPayload, package_range,
+                                   plaintext_size)
+
+__all__ = ["KMS", "KMSError", "PACKAGE_SIZE", "DareError",
+           "decrypt_packages", "encrypt_stream_size", "EncryptingPayload",
+           "package_range", "plaintext_size"]

@@ -1,0 +1,156 @@
+"""DARE-style streaming AEAD: fixed-size AES-256-GCM packages.
+
+The reference encrypts object streams with DARE (Data At Rest
+Encryption, github.com/minio/sio): the plaintext splits into fixed
+64 KiB packages, each sealed independently with a nonce derived from a
+random base nonce and the package sequence number. Random access
+follows: byte x of plaintext lives in package x // PACKAGE_SIZE, so a
+ranged GET decrypts only the packages covering the range. Reordering or
+truncating packages breaks their sequence-bound nonces/tags.
+
+Layout per package: AESGCM(key, nonce=base_nonce XOR seq) over the
+plaintext chunk with the sequence number as associated data; ciphertext
+is chunk + 16-byte tag. No header — the base nonce and sealed key live
+in object metadata, not the data stream.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterator
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+PACKAGE_SIZE = 64 * 1024
+TAG_SIZE = 16
+
+
+class DareError(Exception):
+    pass
+
+
+def _nonce(base: bytes, seq: int) -> bytes:
+    tail = int.from_bytes(base[4:], "big") ^ seq
+    return base[:4] + tail.to_bytes(8, "big")
+
+
+def encrypt_stream_size(plain_size: int) -> int:
+    """Ciphertext size for a plaintext of plain_size bytes."""
+    if plain_size == 0:
+        return 0
+    packages = (plain_size + PACKAGE_SIZE - 1) // PACKAGE_SIZE
+    return plain_size + packages * TAG_SIZE
+
+
+def plaintext_size(cipher_size: int) -> int:
+    """Inverse of encrypt_stream_size."""
+    if cipher_size == 0:
+        return 0
+    full_pkg = PACKAGE_SIZE + TAG_SIZE
+    packages = (cipher_size + full_pkg - 1) // full_pkg
+    return cipher_size - packages * TAG_SIZE
+
+
+def package_range(offset: int, length: int) -> tuple[int, int, int]:
+    """Plaintext range -> (first package seq, ciphertext offset,
+    ciphertext length) covering it."""
+    first = offset // PACKAGE_SIZE
+    last = (offset + length - 1) // PACKAGE_SIZE
+    c_off = first * (PACKAGE_SIZE + TAG_SIZE)
+    c_len = (last - first + 1) * (PACKAGE_SIZE + TAG_SIZE)
+    return first, c_off, c_len
+
+
+class EncryptingPayload:
+    """Payload-shaped reader producing the DARE ciphertext of an inner
+    Payload: .read(n), .size (ciphertext size). Packages seal as the
+    plaintext streams through — O(package) memory."""
+
+    def __init__(self, inner, key: bytes, base_nonce: bytes):
+        self._inner = inner
+        self._aead = AESGCM(key)
+        self._base = base_nonce
+        self.size = encrypt_stream_size(inner.size)
+        self._seq = 0
+        self._buf = memoryview(b"")
+        self._plain_left = inner.size
+
+    def read(self, n: int) -> bytes:
+        while not self._buf and self._plain_left > 0:
+            chunk = _read_exact(self._inner, min(PACKAGE_SIZE,
+                                                 self._plain_left))
+            self._plain_left -= len(chunk)
+            sealed = self._aead.encrypt(_nonce(self._base, self._seq),
+                                        chunk, _aad(self._seq))
+            self._seq += 1
+            self._buf = memoryview(sealed)
+        out = self._buf[:n]
+        self._buf = self._buf[len(out):]
+        return bytes(out)
+
+
+def _aad(seq: int) -> bytes:
+    return struct.pack(">Q", seq)
+
+
+def _read_exact(reader, n: int) -> bytes:
+    parts = []
+    while n > 0:
+        c = reader.read(n)
+        if not c:
+            raise DareError("plaintext stream ended early")
+        parts.append(c)
+        n -= len(c)
+    return parts[0] if len(parts) == 1 else b"".join(parts)
+
+
+def decrypt_packages(chunks: Iterator, key: bytes, base_nonce: bytes,
+                     first_seq: int, skip: int, length: int):
+    """Decrypt a ciphertext byte stream of whole packages starting at
+    package `first_seq`; yield plaintext, dropping `skip` leading bytes
+    and stopping after `length` bytes (range-GET trimming)."""
+    aead = AESGCM(key)
+    try:
+        yield from _decrypt_inner(chunks, aead, base_nonce, first_seq,
+                                  skip, length)
+    finally:
+        close = getattr(chunks, "close", None)
+        if close is not None:
+            close()
+
+
+def _decrypt_inner(chunks, aead, base_nonce, first_seq, skip, length):
+    seq = first_seq
+    buf = bytearray()
+    produced = 0
+
+    def packages():
+        nonlocal buf
+        for chunk in chunks:
+            buf += chunk
+            while len(buf) >= PACKAGE_SIZE + TAG_SIZE:
+                yield bytes(buf[:PACKAGE_SIZE + TAG_SIZE])
+                del buf[:PACKAGE_SIZE + TAG_SIZE]
+        if buf:
+            yield bytes(buf)
+
+    for pkg in packages():
+        if produced >= length:
+            break
+        try:
+            plain = aead.decrypt(_nonce(base_nonce, seq), pkg, _aad(seq))
+        except Exception:
+            raise DareError(
+                f"package {seq} fails authentication") from None
+        seq += 1
+        if skip:
+            drop = min(skip, len(plain))
+            plain = plain[drop:]
+            skip -= drop
+        if not plain:
+            continue
+        take = min(len(plain), length - produced)
+        produced += take
+        yield plain[:take]
+    if produced < length:
+        raise DareError("ciphertext stream ended early")

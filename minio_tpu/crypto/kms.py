@@ -1,0 +1,79 @@
+"""Local KMS: data-key generation and sealing under a master key.
+
+The shape of the reference's built-in KMS (internal/kms/ with
+MINIO_KMS_SECRET_KEY): one named 256-bit master key; GenerateKey
+returns a fresh random data key plus that key sealed (AES-256-GCM)
+under the master key with the usage context bound as associated data.
+Unsealing with a different context or master key fails loudly.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+
+class KMSError(Exception):
+    pass
+
+
+class KMS:
+    """Single-master-key KMS (key id -> 32-byte secret)."""
+
+    def __init__(self, keys: dict[str, bytes], default_key: str):
+        if default_key not in keys:
+            raise KMSError(f"default key {default_key!r} not configured")
+        for kid, secret in keys.items():
+            if len(secret) != 32:
+                raise KMSError(f"key {kid!r} must be 32 bytes")
+        self._keys = dict(keys)
+        self.default_key = default_key
+
+    @classmethod
+    def from_env(cls, env: str = "MTPU_KMS_SECRET_KEY"):
+        """`name:base64key` (the reference's MINIO_KMS_SECRET_KEY
+        format). Returns None when unset — SSE then reports an error."""
+        raw = os.environ.get(env, "")
+        if not raw:
+            return None
+        name, _, b64 = raw.partition(":")
+        if not name or not b64:
+            raise KMSError(f"{env} must be name:base64(32 bytes)")
+        try:
+            secret = base64.b64decode(b64)
+        except ValueError:
+            raise KMSError(f"{env}: bad base64") from None
+        return cls({name: secret}, name)
+
+    def generate_key(self, context: dict) -> tuple[bytes, str]:
+        """(plaintext 32-byte data key, sealed blob string)."""
+        key = os.urandom(32)
+        return key, self.seal(key, context)
+
+    def seal(self, key: bytes, context: dict) -> str:
+        master = self._keys[self.default_key]
+        nonce = os.urandom(12)
+        aad = json.dumps(context, sort_keys=True).encode()
+        ct = AESGCM(master).encrypt(nonce, key, aad)
+        blob = {"v": 1, "kid": self.default_key,
+                "n": base64.b64encode(nonce).decode(),
+                "c": base64.b64encode(ct).decode()}
+        return json.dumps(blob, sort_keys=True)
+
+    def unseal(self, sealed: str, context: dict) -> bytes:
+        try:
+            blob = json.loads(sealed)
+            master = self._keys[blob["kid"]]
+            nonce = base64.b64decode(blob["n"])
+            ct = base64.b64decode(blob["c"])
+        except (ValueError, KeyError, TypeError):
+            raise KMSError("malformed sealed key") from None
+        aad = json.dumps(context, sort_keys=True).encode()
+        try:
+            return AESGCM(master).decrypt(nonce, ct, aad)
+        except Exception:
+            raise KMSError("sealed key does not unseal "
+                           "(wrong master key or context)") from None

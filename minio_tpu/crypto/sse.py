@@ -1,0 +1,159 @@
+"""SSE request plumbing: header parsing, key sealing, metadata schema.
+
+Maps the S3 SSE surface onto the DARE/KMS core (reference:
+cmd/encryption-v1.go, internal/crypto/): SSE-S3 seals the per-object
+data key under the KMS master key; SSE-C seals it under the
+client-supplied 256-bit key (which is never stored — only its MD5, to
+validate later requests).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+from typing import Optional
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+from minio_tpu.crypto.kms import KMS, KMSError
+
+ALG_SSE_S3 = "SSE-S3"
+ALG_SSE_C = "SSE-C"
+
+META_ALG = "x-internal-sse-alg"
+META_KEY = "x-internal-sse-key"          # sealed data key (json)
+META_NONCE = "x-internal-sse-nonce"      # base64 12-byte base nonce
+META_SIZE = "x-internal-sse-size"        # plaintext size (decimal str)
+META_KEY_MD5 = "x-internal-sse-c-md5"    # SSE-C customer key MD5 (b64)
+
+H_SSE = "x-amz-server-side-encryption"
+H_C_ALG = "x-amz-server-side-encryption-customer-algorithm"
+H_C_KEY = "x-amz-server-side-encryption-customer-key"
+H_C_MD5 = "x-amz-server-side-encryption-customer-key-md5"
+
+
+class SSEError(Exception):
+    def __init__(self, code: str, message: str = ""):
+        super().__init__(message or code)
+        self.code = code
+
+
+def parse_sse_c(h: dict) -> Optional[tuple[bytes, str]]:
+    """(customer key, key md5 b64) from SSE-C headers, or None."""
+    alg = h.get(H_C_ALG)
+    if alg is None:
+        return None
+    if alg != "AES256":
+        raise SSEError("InvalidArgument", "SSE-C algorithm must be AES256")
+    try:
+        key = base64.b64decode(h.get(H_C_KEY, ""))
+    except ValueError:
+        raise SSEError("InvalidArgument", "bad SSE-C key") from None
+    if len(key) != 32:
+        raise SSEError("InvalidArgument", "SSE-C key must be 256 bits")
+    md5 = base64.b64encode(hashlib.md5(key).digest()).decode()
+    declared = h.get(H_C_MD5, "")
+    if declared and declared != md5:
+        raise SSEError("InvalidDigest", "SSE-C key MD5 mismatch")
+    return key, md5
+
+
+def wants_sse_s3(h: dict, bucket_encryption_cfg: Optional[str]) -> bool:
+    """Request header or bucket default encryption selects SSE-S3."""
+    val = h.get(H_SSE, "")
+    if val in ("AES256", "aws:kms"):
+        return True
+    if val:
+        raise SSEError("InvalidArgument",
+                       f"unsupported SSE algorithm {val!r}")
+    return bool(bucket_encryption_cfg and
+                "AES256" in bucket_encryption_cfg)
+
+
+def _context(bucket: str, key: str) -> dict:
+    return {"bucket": bucket, "object": key}
+
+
+def seal_with_customer_key(data_key: bytes, customer_key: bytes,
+                           context: dict) -> str:
+    nonce = os.urandom(12)
+    aad = json.dumps(context, sort_keys=True).encode()
+    ct = AESGCM(customer_key).encrypt(nonce, data_key, aad)
+    return json.dumps({"v": 1, "n": base64.b64encode(nonce).decode(),
+                       "c": base64.b64encode(ct).decode()},
+                      sort_keys=True)
+
+
+def unseal_with_customer_key(sealed: str, customer_key: bytes,
+                             context: dict) -> bytes:
+    try:
+        blob = json.loads(sealed)
+        nonce = base64.b64decode(blob["n"])
+        ct = base64.b64decode(blob["c"])
+    except (ValueError, KeyError, TypeError):
+        raise SSEError("InvalidArgument", "malformed sealed key") from None
+    aad = json.dumps(context, sort_keys=True).encode()
+    try:
+        return AESGCM(customer_key).decrypt(nonce, ct, aad)
+    except Exception:
+        raise SSEError("AccessDenied",
+                       "SSE-C key does not decrypt this object") from None
+
+
+def encrypt_metadata(bucket: str, key: str, plain_size: int,
+                     kms: Optional[KMS],
+                     customer: Optional[tuple[bytes, str]]
+                     ) -> tuple[bytes, bytes, dict]:
+    """Choose/seal the data key: returns (data_key, base_nonce,
+    internal_metadata)."""
+    base_nonce = os.urandom(12)
+    ctx = _context(bucket, key)
+    if customer is not None:
+        data_key = os.urandom(32)
+        sealed = seal_with_customer_key(data_key, customer[0], ctx)
+        meta = {META_ALG: ALG_SSE_C, META_KEY: sealed,
+                META_KEY_MD5: customer[1]}
+    else:
+        if kms is None:
+            raise SSEError("InvalidRequest",
+                           "SSE-S3 requested but no KMS is configured "
+                           "(set MTPU_KMS_SECRET_KEY)")
+        data_key, sealed = kms.generate_key(ctx)
+        meta = {META_ALG: ALG_SSE_S3, META_KEY: sealed}
+    meta[META_NONCE] = base64.b64encode(base_nonce).decode()
+    meta[META_SIZE] = str(plain_size)
+    return data_key, base_nonce, meta
+
+
+def decrypt_params(bucket: str, key: str, internal: dict,
+                   kms: Optional[KMS],
+                   customer: Optional[tuple[bytes, str]]
+                   ) -> tuple[bytes, bytes]:
+    """(data_key, base_nonce) for an encrypted object's GET path."""
+    alg = internal.get(META_ALG, "")
+    ctx = _context(bucket, key)
+    try:
+        base_nonce = base64.b64decode(internal.get(META_NONCE, ""))
+    except ValueError:
+        raise SSEError("InternalError", "corrupt SSE nonce") from None
+    if alg == ALG_SSE_C:
+        if customer is None:
+            raise SSEError("InvalidRequest",
+                           "object is SSE-C encrypted; key headers "
+                           "required")
+        if internal.get(META_KEY_MD5) != customer[1]:
+            raise SSEError("AccessDenied", "wrong SSE-C key")
+        data_key = unseal_with_customer_key(internal.get(META_KEY, ""),
+                                            customer[0], ctx)
+    elif alg == ALG_SSE_S3:
+        if kms is None:
+            raise SSEError("InvalidRequest", "KMS not configured")
+        try:
+            data_key = kms.unseal(internal.get(META_KEY, ""), ctx)
+        except KMSError as e:
+            raise SSEError("InternalError", str(e)) from None
+    else:
+        raise SSEError("InternalError", f"unknown SSE algorithm {alg!r}")
+    return data_key, base_nonce

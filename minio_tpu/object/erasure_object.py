@@ -540,12 +540,13 @@ class ErasureSet:
             or shard_file_len <= SMALL_FILE_THRESHOLD // 8
 
         data_dir = "" if inline else new_uuid()
-        metadata = dict(opts.user_metadata)
+        metadata = _clean_user_meta(opts.user_metadata)
         metadata["etag"] = etag
         if opts.content_type:
             metadata["content-type"] = opts.content_type
         if opts.tags:
             metadata["x-amz-tagging"] = opts.tags
+        metadata.update(opts.internal_metadata)
 
         def make_fi(shard_idx: int) -> FileInfo:
             return FileInfo(
@@ -723,12 +724,13 @@ class ErasureSet:
                                    f"staged {ok}/{n}, need {write_quorum}")
 
         mod_time = opts.mod_time or now_ns()
-        metadata = dict(opts.user_metadata)
+        metadata = _clean_user_meta(opts.user_metadata)
         metadata["etag"] = etag
         if opts.content_type:
             metadata["content-type"] = opts.content_type
         if opts.tags:
             metadata["x-amz-tagging"] = opts.tags
+        metadata.update(opts.internal_metadata)
 
         def make_fi(shard_idx: int) -> FileInfo:
             return FileInfo(
@@ -1026,11 +1028,23 @@ class ErasureSet:
         etag = meta.pop("etag", "")
         ctype = meta.pop("content-type", "")
         tags = meta.pop("x-amz-tagging", "")
+        internal = {k: meta.pop(k) for k in list(meta)
+                    if k.startswith("x-internal-")}
+        size = fi.size
+        # Content transforms (SSE) store the logical size internally;
+        # the API surface reports it, the storage size stays in fi.
+        logical = internal.get("x-internal-sse-size")
+        if logical is not None:
+            try:
+                size = int(logical)
+            except (TypeError, ValueError):
+                pass
         return ObjectInfo(bucket=bucket, name=object_, mod_time=fi.mod_time,
-                          size=fi.size, etag=etag, content_type=ctype,
+                          size=size, etag=etag, content_type=ctype,
                           version_id=fi.version_id, is_latest=fi.is_latest,
                           delete_marker=fi.deleted, user_metadata=meta,
-                          actual_size=fi.size, user_tags=tags)
+                          actual_size=size, user_tags=tags,
+                          internal_metadata=internal)
 
     def update_object_tags(self, bucket: str, object_: str,
                            version_id: str = "",
@@ -1272,6 +1286,14 @@ def _join_chunks(chunks) -> bytes:
     if len(chunks) == 1:
         return bytes(chunks[0])
     return b"".join(bytes(c) for c in chunks)
+
+
+def _clean_user_meta(meta: dict) -> dict:
+    """Strip keys that would collide with the internal metadata
+    namespace — a client must not be able to inject or clobber SSE
+    parameters via x-amz-meta-x-internal-* headers."""
+    return {k: v for k, v in meta.items()
+            if not k.startswith("x-internal-")}
 
 
 def _parity_matrix(k: int, m: int) -> np.ndarray:

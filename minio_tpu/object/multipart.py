@@ -69,7 +69,8 @@ def new_multipart_upload(es, bucket: str, object_: str,
         "bucket": bucket, "object": object_, "upload_id": upload_id,
         "k": k, "m": m,
         "distribution": eo.hash_order(f"{bucket}/{object_}", n),
-        "user_metadata": dict(opts.user_metadata),
+        "user_metadata": {k: v for k, v in opts.user_metadata.items()
+                          if not k.startswith("x-internal-")},
         "content_type": opts.content_type,
         "versioned": bool(opts.versioned),
         "initiated": now_ns(),

@@ -87,6 +87,9 @@ class ObjectInfo:
     actual_size: int = 0
     storage_class: str = "STANDARD"
     user_tags: str = ""         # URL-encoded object tags
+    # Internal metadata (SSE params and friends), filtered out of the
+    # user-facing x-amz-meta-* surface.
+    internal_metadata: dict = dataclasses.field(default_factory=dict)
     # Resolved byte range of the payload returned by get_object.
     range_start: int = 0
     range_length: int = 0
@@ -110,6 +113,9 @@ class PutOptions:
     storage_class: str = "STANDARD"
     mod_time: int = 0
     tags: str = ""              # URL-encoded object tags (x-amz-tagging)
+    # Internal (never user-visible) metadata, e.g. SSE crypto params;
+    # keys must start with "x-internal-".
+    internal_metadata: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass

@@ -46,6 +46,8 @@ _CATALOG = {
     "NoSuchCORSConfiguration": (404, "The CORS configuration does not exist."),
     "NotImplemented": (501, "A header you provided implies functionality that is not implemented."),
     "MalformedPolicy": (400, "Policy has invalid resource."),
+    "InvalidRequest": (400, "Invalid Request"),
+    "InvalidDigest": (400, "The Content-Md5 you specified is not valid."),
     "MalformedPOSTRequest": (400, "The body of your POST request is not well-formed multipart/form-data."),
     "InvalidTag": (400, "The tag provided was not a valid tag."),
 }

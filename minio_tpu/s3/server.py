@@ -105,6 +105,9 @@ class S3Server:
         self._heal_lock = threading.Lock()
         # Event notifier (events.EventNotifier); None = no targets.
         self.notifier = None
+        # KMS for SSE-S3 (None until configured via MTPU_KMS_SECRET_KEY).
+        from minio_tpu.crypto.kms import KMS
+        self.kms = KMS.from_env()
 
     @property
     def address(self) -> str:
@@ -776,6 +779,20 @@ def _make_handler(server: S3Server):
 
         def _initiate_multipart(self, bucket, key):
             h = self._headers_lower()
+            from minio_tpu.crypto import sse as sse_mod
+            try:
+                enc_cfg = server.object_layer.get_bucket_meta(bucket) \
+                    .get("config:encryption")
+            except Exception:  # noqa: BLE001 - bucket checked below
+                enc_cfg = None
+            if h.get(sse_mod.H_SSE) or h.get(sse_mod.H_C_ALG) or enc_cfg:
+                # v1 restriction: multipart parts are independently
+                # erasure-coded; per-part DARE streams are not wired
+                # yet. Failing LOUDLY beats silently storing plaintext
+                # in a bucket whose default demands encryption.
+                raise S3Error("NotImplemented",
+                              "SSE with multipart uploads is not "
+                              "supported yet")
             meta = {k[len("x-amz-meta-"):]: v for k, v in h.items()
                     if k.startswith("x-amz-meta-")}
             opts = PutOptions(
@@ -808,9 +825,10 @@ def _make_handler(server: S3Server):
                 spec = _range_spec(h.get("x-amz-copy-source-range", "")
                                    .replace("bytes=", "bytes=")
                                    ) if h.get("x-amz-copy-source-range") else None
-                _, body = server.object_layer.get_object(
-                    sbucket, skey, GetOptions(version_id=src_vid,
-                                              range_spec=spec))
+                # Decrypting fetch: an SSE source must contribute
+                # PLAINTEXT part bytes (range in plaintext space too).
+                _, body = self._read_source_plain(sbucket, skey, src_vid,
+                                                  spec, h)
                 part = server.object_layer.put_object_part(
                     bucket, key, uid, part_num, body)
                 root = ET.Element("CopyPartResult", xmlns=XMLNS)
@@ -884,8 +902,8 @@ def _make_handler(server: S3Server):
             if "/" not in src:
                 raise S3Error("InvalidArgument", "bad copy source")
             sbucket, skey = src.split("/", 1)
-            sinfo, payload = server.object_layer.get_object(
-                sbucket, skey, GetOptions(version_id=src_vid))
+            sinfo, payload = self._read_source_plain(sbucket, skey,
+                                                     src_vid, None, h)
             if any(c in h for c in ("x-amz-copy-source-if-match",
                                     "x-amz-copy-source-if-none-match",
                                     "x-amz-copy-source-if-modified-since",
@@ -903,17 +921,20 @@ def _make_handler(server: S3Server):
             tag_directive = h.get("x-amz-tagging-directive", "COPY").upper()
             tags = h.get("x-amz-tagging", "") if tag_directive == "REPLACE" \
                 else sinfo.user_tags
+            opts = PutOptions(
+                versioned=_versioned(server.object_layer, bucket),
+                user_metadata=meta, content_type=ctype, tags=tags)
+            out_payload, sse_headers = self._apply_sse(
+                bucket, key, Payload.wrap(payload), h, opts)
             info = server.object_layer.put_object(
-                bucket, key, payload, PutOptions(
-                    versioned=_versioned(server.object_layer, bucket),
-                    user_metadata=meta, content_type=ctype, tags=tags))
+                bucket, key, out_payload, opts)
             self._notify("s3:ObjectCreated:Copy", bucket, key,
-                         size=info.size, etag=info.etag,
+                         size=len(payload), etag=info.etag,
                          version_id=info.version_id)
             root = ET.Element("CopyObjectResult", xmlns=XMLNS)
             _el(root, "ETag", f'"{info.etag}"')
             _el(root, "LastModified", _iso8601(info.mod_time))
-            headers = {}
+            headers = dict(sse_headers)
             if info.version_id:
                 headers["x-amz-version-id"] = info.version_id
             self._send(200, _xml(root), headers=headers)
@@ -948,14 +969,153 @@ def _make_handler(server: S3Server):
                 content_type=h.get("content-type", ""),
                 storage_class=h.get("x-amz-storage-class", "STANDARD"),
                 tags=h.get("x-amz-tagging", ""))
+            plain_size = payload.size
+            payload, sse_headers = self._apply_sse(bucket, key, payload,
+                                                   h, opts)
             info = server.object_layer.put_object(bucket, key, payload, opts)
             self._notify("s3:ObjectCreated:Put", bucket, key,
-                         size=info.size, etag=info.etag,
+                         size=plain_size, etag=info.etag,
                          version_id=info.version_id)
-            headers = {"ETag": f'"{info.etag}"'}
+            headers = {"ETag": f'"{info.etag}"', **sse_headers}
             if info.version_id:
                 headers["x-amz-version-id"] = info.version_id
             self._send(200, headers=headers)
+
+        def _apply_sse(self, bucket, key, payload, h, opts):
+            """Wrap a put payload in DARE encryption when the request
+            (SSE-C / SSE-S3 headers) or the bucket's default encryption
+            config asks for it. Returns (payload, response headers)."""
+            from minio_tpu.crypto import (EncryptingPayload,
+                                          encrypt_stream_size)
+            from minio_tpu.crypto import sse as sse_mod
+            try:
+                customer = sse_mod.parse_sse_c(h)
+                enc_cfg = None
+                if customer is None:
+                    try:
+                        enc_cfg = server.object_layer.get_bucket_meta(
+                            bucket).get("config:encryption")
+                    except Exception:  # noqa: BLE001 - bucket checks later
+                        enc_cfg = None
+                    if not sse_mod.wants_sse_s3(h, enc_cfg):
+                        return payload, {}
+                data_key, nonce, imeta = sse_mod.encrypt_metadata(
+                    bucket, key, payload.size, server.kms, customer)
+            except sse_mod.SSEError as e:
+                raise S3Error(e.code, str(e)) from None
+            opts.internal_metadata = imeta
+            enc = EncryptingPayload(payload, data_key, nonce)
+            out = Payload(enc, encrypt_stream_size(payload.size))
+            if customer is not None:
+                return out, {sse_mod.H_C_ALG: "AES256",
+                             sse_mod.H_C_MD5: customer[1]}
+            return out, {sse_mod.H_SSE: "AES256"}
+
+        def _sse_response_headers(self, h, info) -> dict:
+            from minio_tpu.crypto import sse as sse_mod
+            alg = info.internal_metadata.get(sse_mod.META_ALG, "")
+            if alg == sse_mod.ALG_SSE_S3:
+                return {sse_mod.H_SSE: "AES256"}
+            if alg == sse_mod.ALG_SSE_C:
+                return {sse_mod.H_C_ALG: "AES256",
+                        sse_mod.H_C_MD5:
+                        info.internal_metadata.get(sse_mod.META_KEY_MD5,
+                                                   "")}
+            return {}
+
+        def _sse_check_head(self, h, info):
+            """HEAD/GET of an SSE-C object requires the matching key."""
+            from minio_tpu.crypto import sse as sse_mod
+            alg = info.internal_metadata.get(sse_mod.META_ALG, "")
+            if alg != sse_mod.ALG_SSE_C:
+                return
+            try:
+                customer = sse_mod.parse_sse_c(h)
+            except sse_mod.SSEError as e:
+                raise S3Error(e.code, str(e)) from None
+            if customer is None:
+                raise S3Error("InvalidRequest",
+                              "object is SSE-C encrypted; key headers "
+                              "required")
+            if customer[1] != info.internal_metadata.get(
+                    sse_mod.META_KEY_MD5):
+                raise S3Error("AccessDenied", "wrong SSE-C key")
+
+        def _read_source_plain(self, sbucket, skey, src_vid, spec, h):
+            """Copy-source fetch in PLAINTEXT space: decrypts SSE
+            sources (using x-amz-copy-source-...-customer-* headers for
+            SSE-C) and resolves ranges against the logical size."""
+            sinfo = server.object_layer.get_object_info(
+                sbucket, skey, GetOptions(version_id=src_vid))
+            if not sinfo.internal_metadata.get("x-internal-sse-alg"):
+                return server.object_layer.get_object(
+                    sbucket, skey, GetOptions(version_id=src_vid,
+                                              range_spec=spec))
+            from minio_tpu.crypto import sse as sse_mod
+            from minio_tpu.crypto.dare import (PACKAGE_SIZE,
+                                               decrypt_packages,
+                                               encrypt_stream_size,
+                                               package_range)
+            src_h = {}
+            pfx = "x-amz-copy-source-server-side-encryption-customer-"
+            for tail, name in (("algorithm", sse_mod.H_C_ALG),
+                               ("key", sse_mod.H_C_KEY),
+                               ("key-md5", sse_mod.H_C_MD5)):
+                v = h.get(pfx + tail)
+                if v is not None:
+                    src_h[name] = v
+            try:
+                src_cust = sse_mod.parse_sse_c(src_h)
+                data_key, nonce = sse_mod.decrypt_params(
+                    sbucket, skey, sinfo.internal_metadata, server.kms,
+                    src_cust)
+            except sse_mod.SSEError as e:
+                raise S3Error(e.code, str(e)) from None
+            start, length = (_resolve_head_range(spec, sinfo.size)
+                             if spec else (0, sinfo.size))
+            sinfo.range_start, sinfo.range_length = start, length
+            if length <= 0 or sinfo.size == 0:
+                return sinfo, b""
+            first, c_off, c_len = package_range(start, length)
+            c_len = min(c_len, encrypt_stream_size(sinfo.size) - c_off)
+            pin = src_vid or sinfo.version_id
+            _, raw = server.object_layer.get_object_stream(
+                sbucket, skey, GetOptions(version_id=pin, offset=c_off,
+                                          length=c_len))
+            body = b"".join(decrypt_packages(
+                raw, data_key, nonce, first,
+                start - first * PACKAGE_SIZE, length))
+            return sinfo, body
+
+        def _get_encrypted(self, bucket, key, vid, spec, h, info):
+            """Ranged decrypting GET: map the plaintext range onto
+            package-aligned ciphertext, stream, decrypt, trim."""
+            from minio_tpu.crypto import sse as sse_mod
+            from minio_tpu.crypto.dare import (PACKAGE_SIZE,
+                                               decrypt_packages,
+                                               encrypt_stream_size,
+                                               package_range)
+            try:
+                customer = sse_mod.parse_sse_c(h)
+                data_key, nonce = sse_mod.decrypt_params(
+                    bucket, key, info.internal_metadata, server.kms,
+                    customer)
+            except sse_mod.SSEError as e:
+                raise S3Error(e.code, str(e)) from None
+            start, length = (_resolve_head_range(spec, info.size)
+                             if spec else (0, info.size))
+            info.range_start, info.range_length = start, length
+            if length <= 0 or info.size == 0:
+                return info, (b for b in ()), start, max(length, 0)
+            first, c_off, c_len = package_range(start, length)
+            c_size = encrypt_stream_size(info.size)
+            c_len = min(c_len, c_size - c_off)
+            _, raw = server.object_layer.get_object_stream(
+                bucket, key, GetOptions(version_id=vid, offset=c_off,
+                                        length=c_len))
+            chunks = decrypt_packages(raw, data_key, nonce, first,
+                                      start - first * PACKAGE_SIZE, length)
+            return info, chunks, start, length
 
         def _check_conditions(self, h, info, for_read: bool,
                               prefix: str = "") -> bool:
@@ -1031,14 +1191,29 @@ def _make_handler(server: S3Server):
                 # HEAD: metadata fan-out only, no shard reads.
                 info = server.object_layer.get_object_info(
                     bucket, key, GetOptions(version_id=vid))
+                self._sse_check_head(h, info)
                 start, length = (_resolve_head_range(spec, info.size)
                                  if spec else (0, info.size))
             else:
                 # Streaming read: O(window) memory, lock released when
-                # the iterator is exhausted.
+                # the iterator is exhausted. A plaintext-space range is
+                # always valid in ciphertext space (cipher >= plain), so
+                # opening the stream first costs nothing when the object
+                # turns out to be encrypted.
                 info, chunks = server.object_layer.get_object_stream(
-                    bucket, key, GetOptions(version_id=vid, range_spec=spec))
-                start, length = info.range_start, info.range_length
+                    bucket, key, GetOptions(version_id=vid,
+                                            range_spec=spec))
+                if info.internal_metadata.get("x-internal-sse-alg"):
+                    chunks.close()
+                    self._sse_check_head(h, info)
+                    # Pin the version so params and data come from the
+                    # same object generation (unversioned buckets keep a
+                    # small overwrite race, as does the reference).
+                    pin = vid or info.version_id
+                    info, chunks, start, length = self._get_encrypted(
+                        bucket, key, pin, spec, h, info)
+                else:
+                    start, length = info.range_start, info.range_length
             if spec and info.size == 0 and spec[0] is None:
                 spec = None  # suffix range on empty object: plain 200 (AWS)
             headers = {
@@ -1046,6 +1221,7 @@ def _make_handler(server: S3Server):
                 "Last-Modified": _rfc1123(info.mod_time),
                 "Accept-Ranges": "bytes",
             }
+            headers.update(self._sse_response_headers(h, info))
             if info.version_id:
                 headers["x-amz-version-id"] = info.version_id
             for mk, mv in info.user_metadata.items():
@@ -1190,14 +1366,22 @@ def _make_handler(server: S3Server):
                 raise S3Error("AccessDenied", bucket=bucket, key=key)
             meta = {k[len("x-amz-meta-"):]: v for k, v in fields.items()
                     if k.startswith("x-amz-meta-")}
-            info = server.object_layer.put_object(
-                bucket, key, file_data, PutOptions(
-                    versioned=_versioned(server.object_layer, bucket),
-                    user_metadata=meta,
-                    content_type=fields.get("content-type", ""),
-                    tags=fields.get("tagging", "")))
+            opts = PutOptions(
+                versioned=_versioned(server.object_layer, bucket),
+                user_metadata=meta,
+                content_type=fields.get("content-type", ""),
+                tags=fields.get("tagging", ""))
+            # Bucket default encryption applies to form uploads too
+            # (explicit SSE form fields ride the same header names).
+            post_payload, _ = self._apply_sse(
+                bucket, key, Payload.wrap(file_data),
+                {sse_key: v for sse_key, v in fields.items()
+                 if sse_key.startswith("x-amz-server-side-encryption")},
+                opts)
+            info = server.object_layer.put_object(bucket, key,
+                                                  post_payload, opts)
             self._notify("s3:ObjectCreated:Post", bucket, key,
-                         size=info.size, etag=info.etag,
+                         size=len(file_data), etag=info.etag,
                          version_id=info.version_id)
             status = fields.get("success_action_status", "204")
             if status == "201":

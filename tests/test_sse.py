@@ -1,0 +1,249 @@
+"""Server-side encryption: KMS sealing, DARE packages, SSE-S3/SSE-C over
+the S3 API including ranged decrypting GETs (reference:
+cmd/encryption-v1.go, internal/crypto/, internal/kms/)."""
+
+import base64
+import hashlib
+import os
+
+import pytest
+
+from minio_tpu.crypto import (EncryptingPayload, KMS, KMSError,
+                              encrypt_stream_size, decrypt_packages,
+                              package_range, plaintext_size, PACKAGE_SIZE)
+from minio_tpu.crypto.dare import DareError
+from minio_tpu.object.erasure_object import ErasureSet
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.local import LocalStorage
+from minio_tpu.utils.streams import Payload
+from tests.s3client import S3Client
+
+MASTER = os.urandom(32)
+
+
+# ---------------------------------------------------------------------------
+# KMS
+# ---------------------------------------------------------------------------
+
+def test_kms_seal_unseal_roundtrip():
+    kms = KMS({"k1": MASTER}, "k1")
+    ctx = {"bucket": "b", "object": "o"}
+    key, sealed = kms.generate_key(ctx)
+    assert kms.unseal(sealed, ctx) == key
+    with pytest.raises(KMSError):
+        kms.unseal(sealed, {"bucket": "b", "object": "OTHER"})
+    other = KMS({"k1": os.urandom(32)}, "k1")
+    with pytest.raises(KMSError):
+        other.unseal(sealed, ctx)
+
+
+def test_kms_from_env(monkeypatch):
+    monkeypatch.setenv("MTPU_KMS_SECRET_KEY",
+                       "mykey:" + base64.b64encode(MASTER).decode())
+    kms = KMS.from_env()
+    assert kms.default_key == "mykey"
+    monkeypatch.delenv("MTPU_KMS_SECRET_KEY")
+    assert KMS.from_env() is None
+
+
+# ---------------------------------------------------------------------------
+# DARE core
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("size", [0, 1, 100, PACKAGE_SIZE,
+                                  PACKAGE_SIZE + 1, 3 * PACKAGE_SIZE + 777])
+def test_dare_roundtrip_sizes(size):
+    key, nonce = os.urandom(32), os.urandom(12)
+    plain = os.urandom(size)
+    enc = EncryptingPayload(Payload.wrap(plain), key, nonce)
+    assert enc.size == encrypt_stream_size(size)
+    ct = bytearray()
+    while True:
+        c = enc.read(50_000)
+        if not c:
+            break
+        ct += c
+    assert len(ct) == enc.size
+    assert plaintext_size(len(ct)) == size
+    if size:
+        out = b"".join(decrypt_packages(iter([bytes(ct)]), key, nonce,
+                                        0, 0, size))
+        assert out == plain
+
+
+def _read_all(reader):
+    out = bytearray()
+    while True:
+        c = reader.read(1 << 20)
+        if not c:
+            return bytes(out)
+        out += c
+
+
+def test_dare_range_decrypt():
+    key, nonce = os.urandom(32), os.urandom(12)
+    plain = os.urandom(5 * PACKAGE_SIZE + 123)
+    enc = EncryptingPayload(Payload.wrap(plain), key, nonce)
+    ct = _read_all(enc)
+    assert len(ct) == enc.size
+    lo, ln = PACKAGE_SIZE + 17, 2 * PACKAGE_SIZE + 5
+    first, c_off, c_len = package_range(lo, ln)
+    c_len = min(c_len, len(ct) - c_off)
+    out = b"".join(decrypt_packages(
+        iter([ct[c_off:c_off + c_len]]), key, nonce, first,
+        lo - first * PACKAGE_SIZE, ln))
+    assert out == plain[lo:lo + ln]
+
+
+def test_dare_detects_tamper_and_reorder():
+    key, nonce = os.urandom(32), os.urandom(12)
+    plain = os.urandom(2 * PACKAGE_SIZE)
+    ct = bytearray(_read_all(EncryptingPayload(Payload.wrap(plain), key,
+                                               nonce)))
+    assert len(ct) == 2 * (PACKAGE_SIZE + 16)
+    ct[100] ^= 1
+    with pytest.raises(DareError):
+        b"".join(decrypt_packages(iter([bytes(ct)]), key, nonce, 0, 0,
+                                  len(plain)))
+    # Swap the two packages: sequence-bound nonces reject it.
+    pkg = PACKAGE_SIZE + 16
+    good = _read_all(EncryptingPayload(Payload.wrap(plain), key, nonce))
+    swapped = good[pkg:] + good[:pkg]
+    with pytest.raises(DareError):
+        b"".join(decrypt_packages(iter([swapped]), key, nonce, 0, 0,
+                                  len(plain)))
+
+
+# ---------------------------------------------------------------------------
+# end-to-end over the S3 API
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def srv(tmp_path_factory):
+    os.environ["MTPU_KMS_SECRET_KEY"] = \
+        "testkey:" + base64.b64encode(MASTER).decode()
+    tmp = tmp_path_factory.mktemp("ssedrv")
+    disks = [LocalStorage(str(tmp / f"d{i}")) for i in range(4)]
+    es = ErasureSet(disks)
+    server = S3Server(es, address="127.0.0.1:0")
+    server.start()
+    yield server
+    server.stop()
+    os.environ.pop("MTPU_KMS_SECRET_KEY", None)
+
+
+@pytest.fixture(scope="module")
+def cli(srv):
+    c = S3Client(srv.address)
+    assert c.request("PUT", "/sseb")[0] == 200
+    return c
+
+
+def test_sse_s3_roundtrip(cli, srv):
+    body = os.urandom(200_000)
+    st, hh, _ = cli.request("PUT", "/sseb/enc1", body=body, headers={
+        "x-amz-server-side-encryption": "AES256"})
+    assert st == 200
+    assert hh.get("x-amz-server-side-encryption") == "AES256"
+    st, hh, got = cli.request("GET", "/sseb/enc1")
+    assert st == 200 and got == body
+    assert hh.get("x-amz-server-side-encryption") == "AES256"
+    assert hh.get("Content-Length") == str(len(body))
+    # Ciphertext (not plaintext) is what sits on the drives.
+    st, _, head = cli.request("HEAD", "/sseb/enc1")
+    assert st == 200
+
+
+def test_sse_s3_ranged_get(cli):
+    body = os.urandom(3 * PACKAGE_SIZE + 999)
+    assert cli.request("PUT", "/sseb/encr", body=body, headers={
+        "x-amz-server-side-encryption": "AES256"})[0] == 200
+    lo, hi = PACKAGE_SIZE - 5, 2 * PACKAGE_SIZE + 10
+    st, hh, got = cli.request("GET", "/sseb/encr",
+                              headers={"Range": f"bytes={lo}-{hi}"})
+    assert st == 206
+    assert got == body[lo:hi + 1]
+    assert hh["Content-Range"] == f"bytes {lo}-{hi}/{len(body)}"
+
+
+def test_sse_c_requires_matching_key(cli):
+    key = os.urandom(32)
+    key_b64 = base64.b64encode(key).decode()
+    md5_b64 = base64.b64encode(hashlib.md5(key).digest()).decode()
+    body = os.urandom(50_000)
+    hdr = {"x-amz-server-side-encryption-customer-algorithm": "AES256",
+           "x-amz-server-side-encryption-customer-key": key_b64,
+           "x-amz-server-side-encryption-customer-key-md5": md5_b64}
+    assert cli.request("PUT", "/sseb/cobj", body=body,
+                       headers=hdr)[0] == 200
+    # Without the key: rejected.
+    st, _, _ = cli.request("GET", "/sseb/cobj")
+    assert st == 400
+    # Wrong key: denied.
+    wrong = os.urandom(32)
+    whdr = {"x-amz-server-side-encryption-customer-algorithm": "AES256",
+            "x-amz-server-side-encryption-customer-key":
+            base64.b64encode(wrong).decode(),
+            "x-amz-server-side-encryption-customer-key-md5":
+            base64.b64encode(hashlib.md5(wrong).digest()).decode()}
+    st, _, _ = cli.request("GET", "/sseb/cobj", headers=whdr)
+    assert st == 403
+    # Right key: plaintext.
+    st, _, got = cli.request("GET", "/sseb/cobj", headers=hdr)
+    assert st == 200 and got == body
+    # HEAD enforces the key too.
+    assert cli.request("HEAD", "/sseb/cobj")[0] == 400
+    assert cli.request("HEAD", "/sseb/cobj", headers=hdr)[0] == 200
+
+
+def test_bucket_default_encryption_applies(cli):
+    enc_cfg = (b'<ServerSideEncryptionConfiguration><Rule>'
+               b'<ApplyServerSideEncryptionByDefault>'
+               b'<SSEAlgorithm>AES256</SSEAlgorithm>'
+               b'</ApplyServerSideEncryptionByDefault></Rule>'
+               b'</ServerSideEncryptionConfiguration>')
+    assert cli.request("PUT", "/sseb", query={"encryption": ""},
+                       body=enc_cfg)[0] == 200
+    body = os.urandom(10_000)
+    st, hh, _ = cli.request("PUT", "/sseb/auto", body=body)
+    assert st == 200
+    assert hh.get("x-amz-server-side-encryption") == "AES256"
+    st, _, got = cli.request("GET", "/sseb/auto")
+    assert st == 200 and got == body
+    assert cli.request("DELETE", "/sseb", query={"encryption": ""})[0] == 204
+
+
+def test_copy_encrypted_to_plaintext_and_back(cli):
+    body = os.urandom(80_000)
+    assert cli.request("PUT", "/sseb/src-enc", body=body, headers={
+        "x-amz-server-side-encryption": "AES256"})[0] == 200
+    # encrypted -> plaintext copy
+    st, _, b = cli.request("PUT", "/sseb/dst-plain", headers={
+        "x-amz-copy-source": "/sseb/src-enc"})
+    assert st == 200, b
+    st, hh, got = cli.request("GET", "/sseb/dst-plain")
+    assert got == body and "x-amz-server-side-encryption" not in hh
+    # plaintext -> encrypted copy
+    st, _, b = cli.request("PUT", "/sseb/dst-enc", headers={
+        "x-amz-copy-source": "/sseb/dst-plain",
+        "x-amz-server-side-encryption": "AES256"})
+    assert st == 200, b
+    st, hh, got = cli.request("GET", "/sseb/dst-enc")
+    assert got == body
+    assert hh.get("x-amz-server-side-encryption") == "AES256"
+
+
+def test_multipart_with_sse_rejected(cli):
+    st, _, _ = cli.request("POST", "/sseb/mp", query={"uploads": ""},
+                           headers={"x-amz-server-side-encryption":
+                                    "AES256"})
+    assert st == 501
+
+
+def test_listing_reports_plaintext_size(cli):
+    body = os.urandom(12_345)
+    cli.request("PUT", "/sseb/sized", body=body, headers={
+        "x-amz-server-side-encryption": "AES256"})
+    st, _, xml = cli.request("GET", "/sseb", query={"prefix": "sized"})
+    assert st == 200
+    assert f"<Size>{len(body)}</Size>".encode() in xml
