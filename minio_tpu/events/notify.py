@@ -119,8 +119,10 @@ class WebhookTarget:
         self.endpoint = endpoint
         self.timeout = timeout
 
-    def send(self, record: dict) -> None:
-        body = json.dumps({"Records": [record]}).encode()
+    def send(self, record: dict, wrap: bool = True) -> None:
+        """POST one record; wrap=True uses the S3 event envelope
+        ({"Records": [...]}), wrap=False posts the record bare (audit)."""
+        body = json.dumps({"Records": [record]} if wrap else record).encode()
         req = urllib.request.Request(
             self.endpoint, data=body,
             headers={"Content-Type": "application/json",

@@ -1167,7 +1167,15 @@ class ErasureSet:
         # Walk a majority of drives: any write quorum (>= n/2) must
         # intersect the walked set, so committed objects are never
         # invisible to listings even when some drives missed the write.
-        walk_disks = self.disks[:len(self.disks) // 2 + 1]
+        # The set ROTATES per call (reference: metacache askDisks
+        # rotation) so a drive that fails mid-walk only shadows objects
+        # for some requests, not persistently.
+        n_disks = len(self.disks)
+        start = getattr(self, "_walk_rotor", 0)
+        self._walk_rotor = (start + 1) % n_disks
+        rotated = [self.disks[(start + i) % n_disks]
+                   for i in range(n_disks)]
+        walk_disks = rotated[:n_disks // 2 + 1]
         iters = [disk_iter(d) for d in walk_disks if d is not None]
         merged = heapq.merge(*iters, key=lambda kv: kv[0])
 

@@ -108,6 +108,10 @@ class S3Server:
         # KMS for SSE-S3 (None until configured via MTPU_KMS_SECRET_KEY).
         from minio_tpu.crypto.kms import KMS
         self.kms = KMS.from_env()
+        # Live request tracing + optional audit webhook.
+        from minio_tpu.s3.trace import TraceBroadcaster
+        self.tracer = TraceBroadcaster()
+        self.audit = None
 
     @property
     def address(self) -> str:
@@ -278,6 +282,7 @@ def _make_handler(server: S3Server):
             raw_path, query, bucket, key = self._parse()
             self._last_status = 0
             self._sent_bytes = 0
+            self._auth_key = ""
             t0 = _time_mod.perf_counter()
             try:
                 self._route_inner(method, raw_path, query, bucket, key)
@@ -286,11 +291,21 @@ def _make_handler(server: S3Server):
                     rx = int(self.headers.get("Content-Length") or 0)
                 except ValueError:
                     rx = 0
-                server.metrics.record(
-                    self._api_label(method, raw_path, bucket, key),
-                    self._last_status or 500,
-                    _time_mod.perf_counter() - t0,
-                    rx=rx, tx=self._sent_bytes)
+                dt = _time_mod.perf_counter() - t0
+                api = self._api_label(method, raw_path, bucket, key)
+                status = self._last_status or 500
+                server.metrics.record(api, status, dt,
+                                      rx=rx, tx=self._sent_bytes)
+                if server.tracer.active or server.audit is not None:
+                    from minio_tpu.s3.trace import make_entry
+                    entry = make_entry(
+                        api, method, raw_path, bucket, key, status, dt,
+                        self.client_address[0] if self.client_address
+                        else "", self._auth_key, rx=rx,
+                        tx=self._sent_bytes)
+                    server.tracer.publish(entry)
+                    if server.audit is not None:
+                        server.audit.submit(entry)
 
         def _route_inner(self, method, raw_path, query, bucket, key):
             try:
@@ -322,6 +337,7 @@ def _make_handler(server: S3Server):
                 # for it (streaming modes verify per chunk instead). The
                 # RAW request path is signed — never a re-encoding of it.
                 auth = self._auth(method, raw_path, query)
+                self._auth_key = auth.credential.access_key
                 if raw_path == "/minio/admin" or \
                         raw_path.startswith("/minio/admin/"):
                     return self._admin_op(method, raw_path, query, auth)
@@ -1302,6 +1318,7 @@ def _make_handler(server: S3Server):
             if not policy_b64 or not sig or not cred_str:
                 raise S3Error("AccessDenied")
             cred = sigv4.Credential.parse(cred_str)
+            self._auth_key = cred.access_key   # audit/trace attribution
             secret = server.credentials.secret_for(cred.access_key)
             if secret is None:
                 raise S3Error("InvalidAccessKeyId")
@@ -1409,6 +1426,46 @@ def _make_handler(server: S3Server):
                     return self._send(503)
             return self._send(200)
 
+        def _admin_trace(self, query):
+            """Live trace stream: chunked JSON lines until the client
+            disconnects (reference: TraceHandler + pubsub; the `mc
+            admin trace` shape). ?count=N stops after N entries."""
+            import json as _json
+            import queue as _queue
+            limit = 0
+            try:
+                limit = int(query.get("count", ["0"])[0] or 0)
+            except ValueError:
+                pass
+            sub = server.tracer.subscribe()
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                sent = 0
+                while not limit or sent < limit:
+                    try:
+                        entry = sub.get(timeout=1.0)
+                    except _queue.Empty:
+                        # Heartbeat chunk: on an idle server this is the
+                        # only way a disconnected client surfaces (EPIPE)
+                        # — without it the thread and subscription leak.
+                        self.wfile.write(b"1\r\n\n\r\n")
+                        self.wfile.flush()
+                        continue
+                    line = _json.dumps(entry).encode() + b"\n"
+                    self.wfile.write(b"%x\r\n" % len(line) + line
+                                     + b"\r\n")
+                    self.wfile.flush()
+                    sent += 1
+                self.wfile.write(b"0\r\n\r\n")
+            except OSError:
+                pass        # client went away
+            finally:
+                server.tracer.unsubscribe(sub)
+                self.close_connection = True
+
         def _admin_info(self):
             import json as _json
             total_objects = 0
@@ -1493,6 +1550,8 @@ def _make_handler(server: S3Server):
                 if raw_path.startswith("/minio/admin/v3/") else ""
             if op == "info" and method == "GET":
                 return self._admin_info()
+            if op == "trace" and method == "GET":
+                return self._admin_trace(query)
             if op == "heal" and method == "POST":
                 return self._admin_heal(query)
             if op == "heal" and method == "GET":

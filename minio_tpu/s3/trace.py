@@ -1,0 +1,148 @@
+"""Request tracing and audit logging.
+
+The observability pair from the reference (§5 aux subsystems):
+  * trace — a live pub/sub of per-request records, streamed to admin
+    clients over HTTP (reference: cmd/admin-handlers.go TraceHandler +
+    pubsub, `mc admin trace` counterpart);
+  * audit — one structured record per completed request, delivered to a
+    webhook target best-effort with a bounded retry queue (reference:
+    internal/logger audit targets).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import queue
+import threading
+import time
+import urllib.request
+from typing import Optional
+
+
+def make_entry(api: str, method: str, path: str, bucket: str, key: str,
+               status: int, duration_s: float, remote: str,
+               access_key: str, rx: int = 0, tx: int = 0) -> dict:
+    """One trace/audit record (the reference's madmin.TraceInfo /
+    audit.Entry shape, trimmed)."""
+    return {
+        "version": "1",
+        "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "api": api,
+        "method": method,
+        "path": path,
+        "bucket": bucket,
+        "object": key,
+        "statusCode": status,
+        "durationMs": round(duration_s * 1000, 3),
+        "remoteHost": remote,
+        "accessKey": access_key,
+        "rx": rx,
+        "tx": tx,
+    }
+
+
+class TraceBroadcaster:
+    """Bounded pub/sub: subscribers receive every published entry while
+    subscribed; slow subscribers drop oldest entries rather than
+    backpressuring the request path."""
+
+    _DEPTH = 1000
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._subs: list[queue.Queue] = []
+
+    @property
+    def active(self) -> bool:
+        return bool(self._subs)
+
+    def subscribe(self) -> queue.Queue:
+        q: queue.Queue = queue.Queue(maxsize=self._DEPTH)
+        with self._mu:
+            self._subs.append(q)
+        return q
+
+    def unsubscribe(self, q: queue.Queue) -> None:
+        with self._mu:
+            try:
+                self._subs.remove(q)
+            except ValueError:
+                pass
+
+    def publish(self, entry: dict) -> None:
+        with self._mu:
+            subs = list(self._subs)
+        for q in subs:
+            try:
+                q.put_nowait(entry)
+            except queue.Full:
+                try:
+                    q.get_nowait()      # drop oldest
+                    q.put_nowait(entry)
+                except (queue.Empty, queue.Full):
+                    pass
+
+
+class AuditLogger:
+    """Webhook audit target with a bounded in-memory retry deque.
+
+    Audit is best-effort telemetry: a down target never blocks requests;
+    entries beyond the buffer (or failing more than _MAX_ATTEMPTS
+    deliveries — one poison entry must not dam the whole stream) count
+    as dropped. Delivery reuses the shared events WebhookTarget."""
+
+    _BUFFER = 10_000
+    _MAX_ATTEMPTS = 5
+
+    def __init__(self, endpoint: str, timeout: float = 3.0):
+        from minio_tpu.events.notify import WebhookTarget
+        self._target = WebhookTarget("audit", endpoint, timeout=timeout)
+        self.endpoint = endpoint
+        self.sent = 0
+        self.dropped = 0
+        self._q: collections.deque = collections.deque(maxlen=self._BUFFER)
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def submit(self, entry: dict) -> None:
+        if len(self._q) == self._q.maxlen:
+            self.dropped += 1
+        self._q.append((entry, 0))
+        self._wake.set()
+
+    def _run(self) -> None:
+        backoff = 0.5
+        while not self._stop.is_set():
+            if not self._q:
+                self._wake.wait(timeout=1.0)
+                self._wake.clear()
+                continue
+            entry, attempts = self._q[0]
+            try:
+                self._target.send(entry, wrap=False)
+            except Exception:  # noqa: BLE001 - retry with backoff
+                try:
+                    self._q.popleft()
+                except IndexError:
+                    continue
+                if attempts + 1 >= self._MAX_ATTEMPTS:
+                    self.dropped += 1
+                else:
+                    self._q.appendleft((entry, attempts + 1))
+                self._stop.wait(timeout=backoff)
+                backoff = min(backoff * 2, 30.0)
+                continue
+            try:
+                self._q.popleft()
+            except IndexError:
+                pass
+            self.sent += 1
+            backoff = 0.5
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._worker.join(timeout=2)

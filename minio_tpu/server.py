@@ -53,6 +53,9 @@ def main(argv=None) -> int:
     ap.add_argument("--notify-webhook", default="",
                     help="webhook endpoint URL for bucket event "
                          "notifications (target id 'webhook')")
+    ap.add_argument("--audit-webhook", default="",
+                    help="webhook endpoint URL receiving one audit "
+                         "record per completed request")
     ap.add_argument("drives", nargs="+",
                     help="drive dirs or http://host:port/path endpoints; "
                          "`{1...N}` ellipses expand, and each ellipses "
@@ -205,6 +208,15 @@ def main(argv=None) -> int:
         deployment_id = deployment_id or fmt.deployment_id
         ordered = [d if d is not None else OfflineDisk(f"pos-{i}")
                    for i, d in enumerate(ordered)]
+        # Boot janitor: crashed PUTs leave staged shards under the
+        # system volume; sweep them before serving (reference sweeps
+        # .minio.sys/tmp at startup).
+        from minio_tpu.storage.local import sweep_stale_tmp
+        for d in ordered:
+            try:
+                sweep_stale_tmp(d)
+            except Exception:  # noqa: BLE001 - janitor never blocks boot
+                pass
         # Deadline + circuit-breaker wrapper: a hung (not dead) drive
         # fails fast instead of stalling every quorum fan-out
         # (reference: cmd/xl-storage-disk-id-check.go).
@@ -259,6 +271,9 @@ def main(argv=None) -> int:
     creds = Credentials()
     creds.iam = IAMSys(pools[0].sets, creds.access_key, creds.secret_key)
     srv = S3Server(layer, address=args.address, credentials=creds)
+    if args.audit_webhook:
+        from minio_tpu.s3.trace import AuditLogger
+        srv.audit = AuditLogger(args.audit_webhook)
     if args.notify_webhook:
         # Store-and-forward webhook notifications; the queue lives on
         # the first local drive so it survives restarts.

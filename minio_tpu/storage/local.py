@@ -571,3 +571,32 @@ class LocalStorage:
         free = st.f_bavail * st.f_frsize
         return DiskInfo(total=total, free=free, used=total - free,
                         endpoint=self.endpoint, disk_id=self.disk_id())
+
+
+def sweep_stale_tmp(disk) -> int:
+    """Boot-time janitor: remove crash leftovers under the system
+    volume's tmp/ and staging/ dirs (the reference sweeps .minio.sys/tmp
+    at startup; without this, every crashed PUT's staged shards
+    accumulate forever). Only safe before the drive starts serving.
+    Returns the number of entries removed."""
+    root = getattr(disk, "root", None)
+    if root is None:
+        return 0
+    removed = 0
+    for sub in (TMP_DIR, "staging"):
+        base = os.path.join(root, SYS_VOL, sub)
+        try:
+            entries = os.listdir(base)
+        except (FileNotFoundError, NotADirectoryError):
+            continue
+        for name in entries:
+            full = os.path.join(base, name)
+            try:
+                if os.path.isdir(full):
+                    shutil.rmtree(full)
+                else:
+                    os.unlink(full)
+                removed += 1
+            except OSError:
+                continue
+    return removed
