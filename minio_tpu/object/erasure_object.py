@@ -1046,13 +1046,14 @@ class ErasureSet:
                           actual_size=size, user_tags=tags,
                           internal_metadata=internal)
 
-    def update_object_tags(self, bucket: str, object_: str,
-                           version_id: str = "",
-                           tags: Optional[str] = None) -> ObjectInfo:
-        """Set (tags=str) or remove (tags=None) a version's object tags
-        in place: each drive's own journal copy is rewritten with the
-        new metadata, preserving its shard index and inline data
-        (reference: PutObjectTags, cmd/erasure-object.go:1925)."""
+    def update_version_metadata(self, bucket: str, object_: str,
+                                version_id: str,
+                                mutate) -> ObjectInfo:
+        """Apply `mutate(meta_dict)` to one version's metadata in
+        place: each quorum-agreeing drive's own journal copy is
+        rewritten, preserving its shard index and inline data
+        (reference: PutObjectTags-style updateObjectMeta,
+        cmd/erasure-object.go:1925)."""
         self._check_bucket(bucket)
         with self.ns.write(bucket, object_):
             fis, errors = self._read_version_all(bucket, object_, version_id,
@@ -1066,17 +1067,14 @@ class ErasureSet:
                 raise MethodNotAllowed(bucket, object_)
             # Only drives holding the quorum-agreeing copy are written
             # and counted: a success on a stale-version drive must not
-            # let the update claim quorum (reference PutObjectTags
-            # bounds writes to onlineDisks of the read quorum).
+            # let the update claim quorum (reference bounds writes to
+            # onlineDisks of the read quorum).
             agree = set(idxs)
 
             def write_one(i: int):
                 dfi = fis[i]
                 meta = dict(dfi.metadata)
-                if tags is None:
-                    meta.pop("x-amz-tagging", None)
-                else:
-                    meta["x-amz-tagging"] = tags
+                mutate(meta)
                 self.disks[i].write_metadata(
                     bucket, object_,
                     dataclasses.replace(dfi, metadata=meta))
@@ -1089,15 +1087,25 @@ class ErasureSet:
                 raise WriteQuorumError(bucket, object_)
             if len(agree) < n:
                 # Drives outside the agreeing set are stale/missing:
-                # background heal brings them (and the new tags) over.
+                # background heal brings them (and the update) over.
                 self.mrf.enqueue(bucket, object_, fi.version_id)
         meta = dict(fi.metadata)
-        if tags is None:
-            meta.pop("x-amz-tagging", None)
-        else:
-            meta["x-amz-tagging"] = tags
+        mutate(meta)
         return self._to_object_info(bucket, object_,
                                     dataclasses.replace(fi, metadata=meta))
+
+    def update_object_tags(self, bucket: str, object_: str,
+                           version_id: str = "",
+                           tags: Optional[str] = None) -> ObjectInfo:
+        """Set (tags=str) or remove (tags=None) a version's object tags
+        in place (reference: PutObjectTags, cmd/erasure-object.go:1925)."""
+        def mutate(meta):
+            if tags is None:
+                meta.pop("x-amz-tagging", None)
+            else:
+                meta["x-amz-tagging"] = tags
+        return self.update_version_metadata(bucket, object_, version_id,
+                                            mutate)
 
     def delete_object(self, bucket: str, object_: str,
                       opts: Optional[DeleteOptions] = None) -> DeletedObject:

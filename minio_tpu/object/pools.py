@@ -153,6 +153,10 @@ class ServerPools:
         return self._search("update_object_tags", bucket, object_,
                             version_id, tags)
 
+    def update_version_metadata(self, bucket, object_, version_id, mutate):
+        return self._search("update_version_metadata", bucket, object_,
+                            version_id, mutate)
+
     def list_versions_all(self, bucket, object_):
         return self._search("list_versions_all", bucket, object_)
 

@@ -181,6 +181,10 @@ class ErasureSets:
         return self.set_for(object_).update_object_tags(
             bucket, object_, version_id, tags)
 
+    def update_version_metadata(self, bucket, object_, version_id, mutate):
+        return self.set_for(object_).update_version_metadata(
+            bucket, object_, version_id, mutate)
+
     def delete_object(self, bucket, object_, opts=None):
         return self.set_for(object_).delete_object(bucket, object_, opts)
 

@@ -1,0 +1,17 @@
+"""Bucket replication: rules, async replication workers, resync.
+
+The product tier the reference builds in cmd/bucket-replication.go +
+internal/bucket/replication: a bucket carries a replication
+configuration (rules with prefix filters and delete-marker handling)
+and a remote target (another S3 cluster + bucket); writes replicate
+asynchronously with a PENDING -> COMPLETED/FAILED status recorded on
+the source version, and the scanner re-queues anything left behind.
+"""
+
+from minio_tpu.replication.engine import (ReplicationEngine,
+                                          ReplicationError,
+                                          parse_replication_xml,
+                                          REPL_STATUS_KEY)
+
+__all__ = ["ReplicationEngine", "ReplicationError",
+           "parse_replication_xml", "REPL_STATUS_KEY"]

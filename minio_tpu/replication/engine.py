@@ -1,0 +1,251 @@
+"""Replication rules and the async replication engine."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import queue
+import threading
+import time
+import xml.etree.ElementTree as ET
+from typing import Optional
+
+REPL_STATUS_KEY = "x-internal-repl-status"
+REMOTE_TARGET_META = "config:remote-target"
+REPLICATION_META = "config:replication"
+
+PENDING = "PENDING"
+COMPLETED = "COMPLETED"
+FAILED = "FAILED"
+
+
+class ReplicationError(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class ReplicationRule:
+    rule_id: str = ""
+    enabled: bool = True
+    prefix: str = ""
+    delete_markers: bool = False
+
+    def matches(self, key: str) -> bool:
+        return self.enabled and key.startswith(self.prefix)
+
+
+def parse_replication_xml(xml: bytes | str) -> list[ReplicationRule]:
+    """ReplicationConfiguration XML -> rules (reference:
+    internal/bucket/replication/replication.go)."""
+    try:
+        root = ET.fromstring(xml)
+    except ET.ParseError as e:
+        raise ReplicationError(f"malformed replication XML: {e}") from None
+    for el in root.iter():
+        if isinstance(el.tag, str) and "}" in el.tag:
+            el.tag = el.tag.split("}", 1)[1]
+    rules = []
+    for rel in root.iter("Rule"):
+        r = ReplicationRule()
+        r.rule_id = rel.findtext("ID") or ""
+        r.enabled = (rel.findtext("Status") or "Enabled") != "Disabled"
+        filt = rel.find("Filter")
+        r.prefix = (filt.findtext("Prefix") if filt is not None else None) \
+            or rel.findtext("Prefix") or ""
+        dmr = rel.find("DeleteMarkerReplication")
+        if dmr is not None and (dmr.findtext("Status") or "") == "Enabled":
+            r.delete_markers = True
+        if rel.find("Destination") is None:
+            raise ReplicationError("Rule missing Destination")
+        rules.append(r)
+    if not rules:
+        raise ReplicationError("replication configuration has no rules")
+    return rules
+
+
+class ReplicationEngine:
+    """Per-server replication worker pool.
+
+    object_layer: the local object layer (bucket meta + object reads +
+    status updates). Targets resolve from each bucket's stored remote
+    target record ({endpoint, accessKey, secretKey, bucket}); clients
+    cache per bucket. SSE objects are not replicated in v1 (their data
+    keys are bound to this cluster) — they mark FAILED immediately.
+    """
+
+    _RETRIES = 5
+
+    def __init__(self, object_layer, workers: int = 2):
+        self.object_layer = object_layer
+        self.queued = 0
+        self.completed = 0
+        self.failed = 0
+        self._clients: dict[str, tuple] = {}
+        self._rules_cache: dict[str, tuple] = {}
+        self._q: "queue.Queue[tuple]" = queue.Queue(maxsize=100_000)
+        self._stop = threading.Event()
+        self._threads = [threading.Thread(target=self._run, daemon=True)
+                         for _ in range(workers)]
+        for t in self._threads:
+            t.start()
+
+    # -- configuration ---------------------------------------------------
+
+    def rules_for(self, bucket: str) -> Optional[list[ReplicationRule]]:
+        try:
+            doc = self.object_layer.get_bucket_meta(bucket) \
+                .get(REPLICATION_META)
+        except Exception:  # noqa: BLE001
+            return None
+        if not doc:
+            return None
+        # Parse once per distinct document — this runs on every PUT and
+        # DELETE of a replicated bucket.
+        hit = self._rules_cache.get(bucket)
+        if hit is not None and hit[0] == doc:
+            return hit[1]
+        try:
+            rules = parse_replication_xml(doc)
+        except ReplicationError:
+            rules = None
+        self._rules_cache[bucket] = (doc, rules)
+        return rules
+
+    def target_for(self, bucket: str):
+        """(RemoteS3 client, target bucket) or None."""
+        try:
+            doc = self.object_layer.get_bucket_meta(bucket) \
+                .get(REMOTE_TARGET_META)
+        except Exception:  # noqa: BLE001
+            return None
+        if not doc:
+            return None
+        hit = self._clients.get(bucket)
+        if hit is not None and hit[0] == doc:
+            return hit[1]
+        try:
+            rec = json.loads(doc)
+            from minio_tpu.s3.client import RemoteS3
+            client = RemoteS3(rec["endpoint"], rec["accessKey"],
+                              rec["secretKey"])
+            target = (client, rec.get("bucket", bucket))
+        except (ValueError, KeyError):
+            target = None
+        self._clients[bucket] = (doc, target)
+        return target
+
+    def should_replicate(self, bucket: str, key: str,
+                         delete: bool = False) -> bool:
+        rules = self.rules_for(bucket)
+        if not rules or self.target_for(bucket) is None:
+            return False
+        for r in rules:
+            if r.matches(key):
+                return not delete or r.delete_markers
+        return False
+
+    # -- ingestion -------------------------------------------------------
+
+    def enqueue(self, bucket: str, key: str, version_id: str = "",
+                op: str = "put") -> None:
+        try:
+            self._q.put_nowait((bucket, key, version_id, op, 0))
+            self.queued += 1
+        except queue.Full:
+            self.failed += 1
+
+    # -- delivery --------------------------------------------------------
+
+    def _set_status(self, bucket, key, version_id, status) -> None:
+        try:
+            self.object_layer.update_version_metadata(
+                bucket, key, version_id,
+                lambda meta: meta.__setitem__(REPL_STATUS_KEY, status))
+        except Exception:  # noqa: BLE001 - status is advisory
+            pass
+
+    def _replicate_put(self, bucket, key, version_id) -> None:
+        target = self.target_for(bucket)
+        if target is None:
+            raise ReplicationError("no remote target")
+        client, tbucket = target
+        from minio_tpu.object.types import GetOptions
+        info, body = self.object_layer.get_object(
+            bucket, key, GetOptions(version_id=version_id))
+        if info.internal_metadata.get("x-internal-sse-alg"):
+            raise ReplicationError("SSE objects do not replicate in v1")
+        headers = {f"x-amz-meta-{k}": v
+                   for k, v in info.user_metadata.items()}
+        if info.content_type:
+            headers["Content-Type"] = info.content_type
+        if info.user_tags:
+            headers["x-amz-tagging"] = info.user_tags
+        # Mark the replica so the far side can tell it apart (the
+        # reference sets X-Amz-Meta replication markers similarly).
+        headers["x-amz-meta-mtpu-replica"] = "true"
+        client.put_object(tbucket, key, body, headers=headers)
+
+    def _replicate_delete(self, bucket, key) -> None:
+        target = self.target_for(bucket)
+        if target is None:
+            raise ReplicationError("no remote target")
+        client, tbucket = target
+        client.delete_object(tbucket, key)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                bucket, key, vid, op, attempt = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                if op == "put":
+                    self._replicate_put(bucket, key, vid)
+                    self._set_status(bucket, key, vid, COMPLETED)
+                else:
+                    self._replicate_delete(bucket, key)
+                self.completed += 1
+            except Exception:  # noqa: BLE001 - retry then FAILED
+                if attempt + 1 < self._RETRIES and not self._stop.is_set():
+                    time.sleep(min(0.2 * 2 ** attempt, 5.0))
+                    try:
+                        self._q.put_nowait((bucket, key, vid, op,
+                                            attempt + 1))
+                    except queue.Full:
+                        self.failed += 1
+                else:
+                    self.failed += 1
+                    if op == "put":
+                        self._set_status(bucket, key, vid, FAILED)
+            finally:
+                self._q.task_done()
+
+    # -- resync (scanner hook) -------------------------------------------
+
+    def scanner_hook(self, es, bucket: str, key: str, versions) -> None:
+        """Re-queue versions stuck PENDING/FAILED (crash recovery /
+        target-outage resync, reference: replication resync)."""
+        if not versions or versions[0].deleted:
+            return
+        latest = versions[0]
+        if latest.metadata.get("x-internal-sse-alg"):
+            # SSE objects never replicate in v1: their FAILED state is
+            # terminal, not resync fuel.
+            return
+        status = latest.metadata.get(REPL_STATUS_KEY, "")
+        if status in (PENDING, FAILED) and \
+                self.should_replicate(bucket, key):
+            self.enqueue(bucket, key, latest.version_id, "put")
+
+    def drain(self, timeout: float = 15.0) -> bool:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self._q.unfinished_tasks == 0:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)

@@ -1,0 +1,95 @@
+"""Minimal SigV4 S3 client for server-to-server traffic.
+
+Replication (and future tiering) needs to speak S3 to a remote
+cluster; this is the in-tree client for that — header-signed SigV4
+requests over plain HTTP, sharing the signing helpers with the server
+side (reference: the madmin/minio-go clients embedded in cmd/)."""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import http.client
+import urllib.parse
+from typing import Optional
+
+from minio_tpu.s3 import sigv4
+
+
+class S3ClientError(Exception):
+    def __init__(self, status: int, body: bytes = b""):
+        super().__init__(f"HTTP {status}: {body[:200]!r}")
+        self.status = status
+        self.body = body
+
+
+class RemoteS3:
+    def __init__(self, address: str, access_key: str, secret_key: str,
+                 region: str = "us-east-1", timeout: float = 30.0):
+        self.address = address
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self.timeout = timeout
+
+    def request(self, method: str, path: str,
+                query: Optional[dict] = None, body: bytes = b"",
+                headers: Optional[dict] = None):
+        query = {k: [v] if isinstance(v, str) else v
+                 for k, v in (query or {}).items()}
+        headers = dict(headers or {})
+        now = datetime.datetime.now(datetime.timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        date = amz_date[:8]
+        scope = f"{date}/{self.region}/s3/aws4_request"
+        payload_hash = hashlib.sha256(body).hexdigest()
+        send = {"host": self.address, "x-amz-date": amz_date,
+                "x-amz-content-sha256": payload_hash}
+        send.update({k.lower(): v for k, v in headers.items()})
+        signed = sorted(send)
+        canon = sigv4.canonical_request(method, path, query, send,
+                                        signed, payload_hash)
+        sts = sigv4.string_to_sign(amz_date, scope, canon)
+        skey = sigv4.signing_key(self.secret_key, date, self.region)
+        sig = hmac.new(skey, sts.encode(), hashlib.sha256).hexdigest()
+        send["Authorization"] = (
+            f"{sigv4.ALGORITHM} Credential={self.access_key}/{scope}, "
+            f"SignedHeaders={';'.join(signed)}, Signature={sig}")
+        qs = urllib.parse.urlencode(
+            [(k, v) for k, vs in query.items() for v in vs])
+        url = sigv4.uri_encode(path, encode_slash=False) + \
+            ("?" + qs if qs else "")
+        conn = http.client.HTTPConnection(self.address,
+                                          timeout=self.timeout)
+        try:
+            conn.request(method, url, body=body, headers=send)
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, dict(resp.getheaders()), data
+        finally:
+            conn.close()
+
+    # -- convenience wrappers -------------------------------------------
+
+    def put_object(self, bucket: str, key: str, body: bytes,
+                   headers: Optional[dict] = None) -> None:
+        st, _, data = self.request("PUT", f"/{bucket}/{key}", body=body,
+                                   headers=headers)
+        if st != 200:
+            raise S3ClientError(st, data)
+
+    def delete_object(self, bucket: str, key: str) -> None:
+        st, _, data = self.request("DELETE", f"/{bucket}/{key}")
+        if st not in (200, 204):
+            raise S3ClientError(st, data)
+
+    def get_object(self, bucket: str, key: str) -> bytes:
+        st, _, data = self.request("GET", f"/{bucket}/{key}")
+        if st != 200:
+            raise S3ClientError(st, data)
+        return data
+
+    def head_bucket(self, bucket: str) -> bool:
+        st, _, _ = self.request("HEAD", f"/{bucket}")
+        return st == 200

@@ -112,6 +112,8 @@ class S3Server:
         from minio_tpu.s3.trace import TraceBroadcaster
         self.tracer = TraceBroadcaster()
         self.audit = None
+        # Async bucket replication engine (replication.ReplicationEngine).
+        self.replicator = None
 
     @property
     def address(self) -> str:
@@ -434,6 +436,8 @@ def _make_handler(server: S3Server):
             "encryption": ("ServerSideEncryptionConfigurationNotFoundError",
                            "_validate_xml_doc"),
             "notification": (None, "_validate_notification_xml"),
+            "replication": ("ReplicationConfigurationNotFoundError",
+                            "_validate_replication_xml"),
         }
 
         def _validate_policy_json(self, body: bytes) -> None:
@@ -468,6 +472,14 @@ def _make_handler(server: S3Server):
             try:
                 parse_notification_xml(body)
             except EventError as e:
+                raise S3Error("MalformedXML", str(e)) from None
+
+        def _validate_replication_xml(self, body: bytes) -> None:
+            from minio_tpu.replication import (ReplicationError,
+                                               parse_replication_xml)
+            try:
+                parse_replication_xml(body)
+            except ReplicationError as e:
                 raise S3Error("MalformedXML", str(e)) from None
 
         def _bucket_config(self, method, bucket, name, query, body):
@@ -539,9 +551,6 @@ def _make_handler(server: S3Server):
                     return self._list_versions(bucket, query)
                 if "object-lock" in query:
                     raise S3Error("ObjectLockConfigurationNotFoundError",
-                                  bucket=bucket)
-                if "replication" in query:
-                    raise S3Error("ReplicationConfigurationNotFoundError",
                                   bucket=bucket)
                 return self._list_objects(bucket, query)
             raise S3Error("MethodNotAllowed")
@@ -872,6 +881,8 @@ def _make_handler(server: S3Server):
                     raise S3Error("MalformedXML") from None
             info = server.object_layer.complete_multipart_upload(
                 bucket, key, uid, parts)
+            self._replicate_after_write(bucket, key, info.version_id,
+                                        self._headers_lower())
             self._notify("s3:ObjectCreated:CompleteMultipartUpload",
                          bucket, key, size=info.size, etag=info.etag,
                          version_id=info.version_id)
@@ -944,6 +955,7 @@ def _make_handler(server: S3Server):
                 bucket, key, Payload.wrap(payload), h, opts)
             info = server.object_layer.put_object(
                 bucket, key, out_payload, opts)
+            self._replicate_after_write(bucket, key, info.version_id, h)
             self._notify("s3:ObjectCreated:Copy", bucket, key,
                          size=len(payload), etag=info.etag,
                          version_id=info.version_id)
@@ -988,7 +1000,23 @@ def _make_handler(server: S3Server):
             plain_size = payload.size
             payload, sse_headers = self._apply_sse(bucket, key, payload,
                                                    h, opts)
+            # Replicate only after the SSE decision: encrypted objects
+            # do not replicate in v1 (their keys bind to this cluster),
+            # and an incoming REPLICA must not ping-pong back in
+            # active-active setups (the mtpu-replica marker).
+            replicate = (server.replicator is not None
+                         and "x-amz-meta-mtpu-replica" not in h
+                         and not opts.internal_metadata.get(
+                             "x-internal-sse-alg")
+                         and server.replicator.should_replicate(bucket,
+                                                                key))
+            if replicate:
+                from minio_tpu.replication import REPL_STATUS_KEY
+                opts.internal_metadata[REPL_STATUS_KEY] = "PENDING"
             info = server.object_layer.put_object(bucket, key, payload, opts)
+            if replicate:
+                server.replicator.enqueue(bucket, key, info.version_id,
+                                          "put")
             self._notify("s3:ObjectCreated:Put", bucket, key,
                          size=plain_size, etag=info.etag,
                          version_id=info.version_id)
@@ -996,6 +1024,27 @@ def _make_handler(server: S3Server):
             if info.version_id:
                 headers["x-amz-version-id"] = info.version_id
             self._send(200, headers=headers)
+
+        def _replicate_after_write(self, bucket, key, version_id, h):
+            """Post-hoc replication marking for write paths that cannot
+            stamp PENDING before commit (multipart complete, copy): one
+            metadata update, then enqueue — so the scanner resync also
+            covers them after a crash."""
+            r = server.replicator
+            if r is None or "x-amz-meta-mtpu-replica" in h \
+                    or not r.should_replicate(bucket, key):
+                return
+            from minio_tpu.replication import REPL_STATUS_KEY
+            try:
+                info = server.object_layer.update_version_metadata(
+                    bucket, key, version_id,
+                    lambda m: None if m.get("x-internal-sse-alg")
+                    else m.__setitem__(REPL_STATUS_KEY, "PENDING"))
+                if info.internal_metadata.get("x-internal-sse-alg"):
+                    return            # SSE objects do not replicate (v1)
+            except Exception:  # noqa: BLE001 - stamping is advisory
+                pass
+            r.enqueue(bucket, key, version_id, "put")
 
         def _apply_sse(self, bucket, key, payload, h, opts):
             """Wrap a put payload in DARE encryption when the request
@@ -1019,7 +1068,7 @@ def _make_handler(server: S3Server):
                     bucket, key, payload.size, server.kms, customer)
             except sse_mod.SSEError as e:
                 raise S3Error(e.code, str(e)) from None
-            opts.internal_metadata = imeta
+            opts.internal_metadata.update(imeta)
             enc = EncryptingPayload(payload, data_key, nonce)
             out = Payload(enc, encrypt_stream_size(payload.size))
             if customer is not None:
@@ -1238,6 +1287,9 @@ def _make_handler(server: S3Server):
                 "Accept-Ranges": "bytes",
             }
             headers.update(self._sse_response_headers(h, info))
+            repl = info.internal_metadata.get("x-internal-repl-status")
+            if repl:
+                headers["x-amz-replication-status"] = repl
             if info.version_id:
                 headers["x-amz-version-id"] = info.version_id
             for mk, mv in info.user_metadata.items():
@@ -1558,9 +1610,6 @@ def _make_handler(server: S3Server):
                 return self._send(200,
                                   _json.dumps(server.heal_status).encode(),
                                   content_type="application/json")
-            iam = server.credentials.iam
-            if iam is None:
-                raise S3Error("NotImplemented")
             body = self._read_body()
             q1 = {k: v[0] for k, v in query.items()}
 
@@ -1568,6 +1617,37 @@ def _make_handler(server: S3Server):
                 blob = _json.dumps(payload).encode() \
                     if payload is not None else b""
                 self._send(200, blob, content_type="application/json")
+
+            # Replication target management needs no IAM store.
+            if op == "set-remote-target" and method == "PUT":
+                doc = _json.loads(body)
+                for field in ("endpoint", "accessKey", "secretKey"):
+                    if not doc.get(field):
+                        raise S3Error("InvalidArgument",
+                                      f"missing {field}")
+                bkt = q1.get("bucket", "")
+                server.object_layer.get_bucket_info(bkt)
+                with server.bucket_meta_lock:
+                    meta = server.object_layer.get_bucket_meta(bkt)
+                    meta["config:remote-target"] = _json.dumps(doc)
+                    server.object_layer.set_bucket_meta(bkt, meta)
+                return ok()
+            if op == "get-remote-target" and method == "GET":
+                bkt = q1.get("bucket", "")
+                doc = server.object_layer.get_bucket_meta(bkt) \
+                    .get("config:remote-target")
+                rec = _json.loads(doc) if doc else None
+                if rec:
+                    rec.pop("secretKey", None)   # never echo secrets
+                return ok(rec)
+            if op == "replication-status" and method == "GET":
+                r = server.replicator
+                return ok({"queued": r.queued, "completed": r.completed,
+                           "failed": r.failed} if r else None)
+
+            iam = server.credentials.iam
+            if iam is None:
+                raise S3Error("NotImplemented")
 
             try:
                 if op == "add-user" and method == "PUT":
@@ -1619,6 +1699,13 @@ def _make_handler(server: S3Server):
                 bucket, key, DeleteOptions(
                     version_id=vid,
                     versioned=_versioned(server.object_layer, bucket)))
+            # Only versionless deletes (which create markers) replicate;
+            # pruning ONE old version must never destroy the replica's
+            # live object (DeleteMarkerReplication semantics).
+            if server.replicator is not None and not vid and \
+                    server.replicator.should_replicate(bucket, key,
+                                                       delete=True):
+                server.replicator.enqueue(bucket, key, op="delete")
             self._notify("s3:ObjectRemoved:DeleteMarkerCreated"
                          if deleted.delete_marker
                          else "s3:ObjectRemoved:Delete", bucket, key,
@@ -1681,6 +1768,7 @@ def _required_permissions(method: str, bucket: str, key: str, query: dict,
         "tagging": "BucketTagging", "cors": "BucketCORS",
         "encryption": "EncryptionConfiguration",
         "notification": "BucketNotification",
+        "replication": "ReplicationConfiguration",
     }
     if not key:
         for q, stem in _CONFIG_ACTIONS.items():

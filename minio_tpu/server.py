@@ -274,6 +274,11 @@ def main(argv=None) -> int:
     if args.audit_webhook:
         from minio_tpu.s3.trace import AuditLogger
         srv.audit = AuditLogger(args.audit_webhook)
+    # Async bucket replication: rules + remote targets live in bucket
+    # metadata; the scanner hook re-queues PENDING/FAILED versions.
+    from minio_tpu.replication import ReplicationEngine
+    srv.replicator = ReplicationEngine(layer)
+    scanner.on_object.append(srv.replicator.scanner_hook)
     if args.notify_webhook:
         # Store-and-forward webhook notifications; the queue lives on
         # the first local drive so it survives restarts.

@@ -1,0 +1,160 @@
+"""Bucket replication: rules, async replication to a second live
+cluster, status lifecycle, delete-marker replication, scanner resync
+(reference: cmd/bucket-replication.go, internal/bucket/replication)."""
+
+import json
+import time
+
+import pytest
+
+from minio_tpu.object.erasure_object import ErasureSet
+from minio_tpu.object.scanner import Scanner
+from minio_tpu.replication import (ReplicationEngine, ReplicationError,
+                                   parse_replication_xml)
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.local import LocalStorage
+from tests.s3client import S3Client
+
+REPL_XML = b"""<ReplicationConfiguration>
+  <Role>arn:minio:replication::r1:role</Role>
+  <Rule><ID>r1</ID><Status>Enabled</Status><Priority>1</Priority>
+    <DeleteMarkerReplication><Status>Enabled</Status>
+    </DeleteMarkerReplication>
+    <Destination><Bucket>arn:aws:s3:::dstb</Bucket></Destination>
+  </Rule>
+</ReplicationConfiguration>"""
+
+
+def test_parse_replication_rules():
+    rules = parse_replication_xml(REPL_XML)
+    assert len(rules) == 1
+    assert rules[0].enabled and rules[0].delete_markers
+    assert rules[0].matches("any/key")
+    with pytest.raises(ReplicationError):
+        parse_replication_xml(b"<ReplicationConfiguration/>")
+    with pytest.raises(ReplicationError):
+        parse_replication_xml(
+            b"<ReplicationConfiguration><Rule><ID>x</ID></Rule>"
+            b"</ReplicationConfiguration>")
+
+
+@pytest.fixture
+def clusters(tmp_path):
+    """Source (with replication engine) and target clusters."""
+    src_disks = [LocalStorage(str(tmp_path / f"s{i}")) for i in range(4)]
+    dst_disks = [LocalStorage(str(tmp_path / f"t{i}")) for i in range(4)]
+    src_es, dst_es = ErasureSet(src_disks), ErasureSet(dst_disks)
+    src = S3Server(src_es, address="127.0.0.1:0")
+    dst = S3Server(dst_es, address="127.0.0.1:0")
+    src.replicator = ReplicationEngine(src_es)
+    src.start()
+    dst.start()
+    sc = S3Client(src.address)
+    dc = S3Client(dst.address)
+    assert sc.request("PUT", "/srcb")[0] == 200
+    assert dc.request("PUT", "/dstb")[0] == 200
+    # Register the remote target + rules on the source bucket.
+    st, _, b = sc.request("PUT", "/minio/admin/v3/set-remote-target",
+                          query={"bucket": "srcb"},
+                          body=json.dumps({
+                              "endpoint": dst.address,
+                              "accessKey": "minioadmin",
+                              "secretKey": "minioadmin",
+                              "bucket": "dstb"}).encode())
+    assert st == 200, b
+    st, _, b = sc.request("PUT", "/srcb", query={"replication": ""},
+                          body=REPL_XML)
+    assert st == 200, b
+    yield src, dst, sc, dc, src_es
+    src.replicator.stop()
+    src.stop()
+    dst.stop()
+
+
+def test_put_replicates_and_status_completes(clusters):
+    src, dst, sc, dc, src_es = clusters
+    body = b"replicate me" * 1000
+    st, _, _ = sc.request("PUT", "/srcb/doc.txt", body=body,
+                          headers={"x-amz-meta-team": "infra",
+                                   "x-amz-tagging": "env=prod"})
+    assert st == 200
+    assert src.replicator.drain(15)
+    # Replica landed with metadata and tags.
+    st, hh, got = dc.request("GET", "/dstb/doc.txt")
+    assert st == 200 and got == body
+    assert hh.get("x-amz-meta-team") == "infra"
+    assert hh.get("x-amz-meta-mtpu-replica") == "true"
+    # Source status header reaches COMPLETED.
+    for _ in range(50):
+        st, hh, _ = sc.request("HEAD", "/srcb/doc.txt")
+        if hh.get("x-amz-replication-status") == "COMPLETED":
+            break
+        time.sleep(0.1)
+    assert hh.get("x-amz-replication-status") == "COMPLETED"
+
+
+def test_delete_replicates(clusters):
+    src, dst, sc, dc, src_es = clusters
+    sc.request("PUT", "/srcb/gone.txt", body=b"x")
+    assert src.replicator.drain(15)
+    assert dc.request("GET", "/dstb/gone.txt")[0] == 200
+    sc.request("DELETE", "/srcb/gone.txt")
+    assert src.replicator.drain(15)
+    assert dc.request("GET", "/dstb/gone.txt")[0] == 404
+
+
+def test_get_remote_target_hides_secret(clusters):
+    src, dst, sc, dc, src_es = clusters
+    st, _, b = sc.request("GET", "/minio/admin/v3/get-remote-target",
+                          query={"bucket": "srcb"})
+    assert st == 200
+    rec = json.loads(b)
+    assert rec["endpoint"] == dst.address
+    assert "secretKey" not in rec
+
+
+def test_scanner_resyncs_failed_replication(tmp_path):
+    """Target down at PUT time: status FAILED; once the target is back,
+    the scanner hook re-queues and completes."""
+    src_disks = [LocalStorage(str(tmp_path / f"s{i}")) for i in range(4)]
+    dst_disks = [LocalStorage(str(tmp_path / f"t{i}")) for i in range(4)]
+    src_es, dst_es = ErasureSet(src_disks), ErasureSet(dst_disks)
+    src = S3Server(src_es, address="127.0.0.1:0")
+    engine = ReplicationEngine(src_es)
+    engine._RETRIES = 1          # fail fast for the test
+    src.replicator = engine
+    src.start()
+    sc = S3Client(src.address)
+    assert sc.request("PUT", "/srcb")[0] == 200
+    # Point at a dead endpoint for now.
+    sc.request("PUT", "/minio/admin/v3/set-remote-target",
+               query={"bucket": "srcb"},
+               body=json.dumps({"endpoint": "127.0.0.1:1",
+                                "accessKey": "minioadmin",
+                                "secretKey": "minioadmin",
+                                "bucket": "dstb"}).encode())
+    sc.request("PUT", "/srcb", query={"replication": ""}, body=REPL_XML)
+    sc.request("PUT", "/srcb/lost.txt", body=b"data")
+    assert engine.drain(15)
+    st, hh, _ = sc.request("HEAD", "/srcb/lost.txt")
+    assert hh.get("x-amz-replication-status") == "FAILED"
+
+    # Target comes up; fix the remote-target record.
+    dst = S3Server(dst_es, address="127.0.0.1:0")
+    dst.start()
+    dc = S3Client(dst.address)
+    assert dc.request("PUT", "/dstb")[0] == 200
+    sc.request("PUT", "/minio/admin/v3/set-remote-target",
+               query={"bucket": "srcb"},
+               body=json.dumps({"endpoint": dst.address,
+                                "accessKey": "minioadmin",
+                                "secretKey": "minioadmin",
+                                "bucket": "dstb"}).encode())
+    scanner = Scanner([src_es], throttle=0)
+    scanner.on_object.append(engine.scanner_hook)
+    scanner.scan_cycle()
+    assert engine.drain(15)
+    assert dc.request("GET", "/dstb/lost.txt")[2] == b"data"
+    engine.stop()
+    src.stop()
+    dst.stop()
