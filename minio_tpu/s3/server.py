@@ -750,6 +750,8 @@ def _make_handler(server: S3Server):
 
         def _object_op(self, method, bucket, key, query, body, payload=None):
             _validate_object_name(key)
+            if method == "POST" and "select" in query:
+                return self._select_object(bucket, key, query, body)
             if method == "POST" and "uploads" in query:
                 return self._initiate_multipart(bucket, key)
             if method == "POST" and "uploadId" in query:
@@ -773,6 +775,30 @@ def _make_handler(server: S3Server):
             if method == "DELETE":
                 return self._delete_object(bucket, key, query)
             raise S3Error("MethodNotAllowed")
+
+        def _select_object(self, bucket, key, query, body):
+            """POST ?select&select-type=2 — SQL over one object
+            (reference: internal/s3select; the SelectObjectContent API).
+            The full object materializes for evaluation (v1)."""
+            from minio_tpu.s3select import SelectError, run_select
+            h = self._headers_lower()
+            vid = query.get("versionId", [""])[0]
+            info = server.object_layer.get_object_info(
+                bucket, key, GetOptions(version_id=vid))
+            if info.internal_metadata.get("x-internal-sse-alg"):
+                self._sse_check_head(h, info)
+                _, chunks, _, _ = self._get_encrypted(
+                    bucket, key, vid or info.version_id, None, h, info)
+                data = b"".join(chunks)
+            else:
+                _, data = server.object_layer.get_object(
+                    bucket, key, GetOptions(version_id=vid))
+            try:
+                resp = run_select(data, body)
+            except SelectError as e:
+                raise S3Error("InvalidArgument", str(e)) from None
+            self._send(200, resp,
+                       content_type="application/octet-stream")
 
         def _object_tagging(self, method, bucket, key, query, payload):
             """GET/PUT/DELETE ?tagging on an object (reference:
@@ -1800,6 +1826,9 @@ def _required_permissions(method: str, bucket: str, key: str, query: dict,
                 perms.append(("s3:ListBucket", bucket))
         return perms
     res = f"{bucket}/{key}"
+    if method == "POST" and "select" in query:
+        return [("s3:GetObjectVersion" if query.get("versionId", [""])[0]
+                 else "s3:GetObject", res)]
     if "tagging" in query:
         verb = {"GET": "Get", "PUT": "Put", "DELETE": "Delete"}.get(
             method, "Get")

@@ -1,0 +1,16 @@
+"""S3 Select: SQL queries over CSV / JSON objects.
+
+The subset analogue of the reference's internal/s3select/: a
+SelectObjectContentRequest (POST ?select&select-type=2) runs a SQL
+expression against one object's records and streams matching rows back
+in the AWS event-stream envelope. Supported: SELECT column projections
+(including *, aliases, and COUNT(*)), FROM S3Object, WHERE with
+comparison/AND/OR/NOT/parentheses and IS [NOT] NULL, LIMIT; CSV input
+(header or positional _N columns, custom delimiters) and JSON-lines
+input; CSV or JSON output.
+"""
+
+from minio_tpu.s3select.engine import SelectError, run_select
+from minio_tpu.s3select.eventstream import encode_message
+
+__all__ = ["SelectError", "run_select", "encode_message"]

@@ -1,0 +1,71 @@
+"""AWS event-stream binary framing for Select responses.
+
+Message = prelude(total_len u32BE, headers_len u32BE, prelude_crc u32BE)
+          headers payload message_crc(u32BE over everything prior).
+Header  = name_len u8, name, type u8 (7 = string), value_len u16BE,
+          value. (reference: the aws eventstream codec the SDKs speak;
+          internal/s3select/message.go writes the same frames.)
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+
+def _header(name: str, value: str) -> bytes:
+    nb, vb = name.encode(), value.encode()
+    return bytes([len(nb)]) + nb + b"\x07" + struct.pack(">H", len(vb)) + vb
+
+
+def encode_message(headers: dict[str, str], payload: bytes = b"") -> bytes:
+    hblob = b"".join(_header(k, v) for k, v in headers.items())
+    total = 12 + len(hblob) + len(payload) + 4
+    prelude = struct.pack(">II", total, len(hblob))
+    prelude_crc = struct.pack(">I", zlib.crc32(prelude))
+    body = prelude + prelude_crc + hblob + payload
+    return body + struct.pack(">I", zlib.crc32(body))
+
+
+def records_message(payload: bytes) -> bytes:
+    return encode_message({":message-type": "event",
+                           ":event-type": "Records",
+                           ":content-type": "application/octet-stream"},
+                          payload)
+
+
+def stats_message(scanned: int, processed: int, returned: int) -> bytes:
+    xml = (f"<Stats><BytesScanned>{scanned}</BytesScanned>"
+           f"<BytesProcessed>{processed}</BytesProcessed>"
+           f"<BytesReturned>{returned}</BytesReturned></Stats>").encode()
+    return encode_message({":message-type": "event",
+                           ":event-type": "Stats",
+                           ":content-type": "text/xml"}, xml)
+
+
+def end_message() -> bytes:
+    return encode_message({":message-type": "event",
+                           ":event-type": "End"})
+
+
+def decode_messages(blob: bytes):
+    """Parse a concatenated event-stream back into (headers, payload)
+    pairs — the test-side decoder."""
+    out = []
+    pos = 0
+    while pos < len(blob):
+        total, hlen = struct.unpack_from(">II", blob, pos)
+        hdr_start = pos + 12
+        headers = {}
+        hpos = hdr_start
+        while hpos < hdr_start + hlen:
+            nlen = blob[hpos]
+            name = blob[hpos + 1:hpos + 1 + nlen].decode()
+            hpos += 1 + nlen + 1                 # + type byte
+            vlen = struct.unpack_from(">H", blob, hpos)[0]
+            headers[name] = blob[hpos + 2:hpos + 2 + vlen].decode()
+            hpos += 2 + vlen
+        payload = blob[hdr_start + hlen:pos + total - 4]
+        out.append((headers, payload))
+        pos += total
+    return out

@@ -1,0 +1,304 @@
+"""The S3 Select SQL subset: tokenizer + recursive-descent parser +
+row evaluator.
+
+Grammar (case-insensitive keywords):
+
+    select   := SELECT projection FROM from_clause [WHERE expr] [LIMIT n]
+    projection := '*' | COUNT '(' '*' ')' | item (',' item)*
+    item     := column [AS? ident]
+    column   := ident ('.' ident)* | S3Object-qualified ref
+    expr     := or_expr
+    or_expr  := and_expr (OR and_expr)*
+    and_expr := not_expr (AND not_expr)*
+    not_expr := NOT not_expr | cmp
+    cmp      := operand (op operand | IS [NOT] NULL)?
+    op       := = | != | <> | < | <= | > | >=
+    operand  := literal | column | '(' expr ')'
+
+Values compare numerically when both sides parse as numbers, else as
+strings (the reference's dynamic typing for CSV input).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+
+class SQLError(Exception):
+    pass
+
+
+_TOKEN_RE = re.compile(r"""
+    \s*(?:
+        (?P<string>'(?:[^']|'')*')
+      | (?P<number>-?\d+(?:\.\d+)?)
+      | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+      | (?P<op><=|>=|!=|<>|=|<|>)
+      | (?P<punct>[(),.*])
+    )""", re.VERBOSE)
+
+_KEYWORDS = {"select", "from", "where", "limit", "and", "or", "not",
+             "as", "is", "null", "count"}
+
+
+def _tokenize(text: str) -> list[tuple[str, str]]:
+    out = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if m is None:
+            if text[pos:].strip() == "":
+                break
+            raise SQLError(f"bad token at {text[pos:pos + 20]!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        val = m.group(kind)
+        if kind == "ident" and val.lower() in _KEYWORDS:
+            out.append(("kw", val.lower()))
+        elif kind == "string":
+            out.append(("string", val[1:-1].replace("''", "'")))
+        else:
+            out.append((kind, val))
+    return out
+
+
+# -- AST --------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Col:
+    name: str
+
+    def eval(self, row: dict):
+        return row.get(self.name)
+
+
+@dataclasses.dataclass
+class Lit:
+    value: object
+
+    def eval(self, row: dict):
+        return self.value
+
+
+@dataclasses.dataclass
+class Cmp:
+    op: str
+    left: object
+    right: object
+
+    def eval(self, row: dict) -> bool:
+        a, b = self.left.eval(row), self.right.eval(row)
+        if a is None or b is None:
+            return False
+        fa, fb = _as_number(a), _as_number(b)
+        if fa is not None and fb is not None:
+            a, b = fa, fb
+        else:
+            a, b = str(a), str(b)
+        return {"=": a == b, "!=": a != b, "<>": a != b, "<": a < b,
+                "<=": a <= b, ">": a > b, ">=": a >= b}[self.op]
+
+
+@dataclasses.dataclass
+class IsNull:
+    operand: object
+    negate: bool
+
+    def eval(self, row: dict) -> bool:
+        missing = self.operand.eval(row) is None
+        return not missing if self.negate else missing
+
+
+@dataclasses.dataclass
+class Logical:
+    op: str
+    terms: list
+
+    def eval(self, row: dict) -> bool:
+        if self.op == "and":
+            return all(t.eval(row) for t in self.terms)
+        return any(t.eval(row) for t in self.terms)
+
+
+@dataclasses.dataclass
+class Not:
+    term: object
+
+    def eval(self, row: dict) -> bool:
+        return not self.term.eval(row)
+
+
+@dataclasses.dataclass
+class Query:
+    columns: Optional[list]        # [(Col, alias)] or None for '*'
+    count_star: bool
+    where: Optional[object]
+    limit: Optional[int]
+
+
+def _as_number(v) -> Optional[float]:
+    if isinstance(v, (int, float)):
+        return float(v)
+    try:
+        return float(str(v))
+    except (TypeError, ValueError):
+        return None
+
+
+class _Parser:
+    def __init__(self, tokens):
+        self.toks = tokens
+        self.pos = 0
+
+    def peek(self):
+        return self.toks[self.pos] if self.pos < len(self.toks) else ("eof", "")
+
+    def next(self):
+        t = self.peek()
+        self.pos += 1
+        return t
+
+    def expect(self, kind, val=None):
+        t = self.next()
+        if t[0] != kind or (val is not None and t[1].lower() != val):
+            raise SQLError(f"expected {val or kind}, got {t[1]!r}")
+        return t
+
+    # -- clauses --------------------------------------------------------
+
+    def parse(self) -> Query:
+        self.expect("kw", "select")
+        columns, count_star = self._projection()
+        self.expect("kw", "from")
+        self._from()
+        where = None
+        limit = None
+        if self.peek() == ("kw", "where"):
+            self.next()
+            where = self._expr()
+        if self.peek() == ("kw", "limit"):
+            self.next()
+            t = self.expect("number")
+            limit = int(float(t[1]))
+            if limit < 0 or limit != float(t[1]):
+                raise SQLError(f"LIMIT must be a non-negative integer, "
+                               f"got {t[1]}")
+        if self.peek()[0] != "eof":
+            raise SQLError(f"unexpected trailing {self.peek()[1]!r}")
+        return Query(columns=columns, count_star=count_star, where=where,
+                     limit=limit)
+
+    def _projection(self):
+        if self.peek() == ("punct", "*"):
+            self.next()
+            return None, False
+        if self.peek() == ("kw", "count"):
+            self.next()
+            self.expect("punct", "(")
+            self.expect("punct", "*")
+            self.expect("punct", ")")
+            return None, True
+        cols = []
+        while True:
+            col = self._column()
+            alias = col.name
+            if self.peek() == ("kw", "as"):
+                self.next()
+                alias = self.expect("ident")[1]
+            elif self.peek()[0] == "ident":
+                alias = self.next()[1]
+            cols.append((col, alias))
+            if self.peek() == ("punct", ","):
+                self.next()
+                continue
+            return cols, False
+
+    def _from(self):
+        # FROM S3Object[.alias] / s3object — accept and ignore aliases.
+        t = self.next()
+        if t[0] != "ident" or t[1].lower() not in ("s3object",):
+            raise SQLError("FROM must reference S3Object")
+        while self.peek() == ("punct", "."):
+            self.next()
+            self.next()
+        if self.peek()[0] == "ident":
+            self.next()      # table alias
+
+    def _column(self) -> Col:
+        t = self.next()
+        if t[0] != "ident":
+            raise SQLError(f"expected column, got {t[1]!r}")
+        name = t[1]
+        parts = [name]
+        while self.peek() == ("punct", "."):
+            self.next()
+            parts.append(self.expect("ident")[1])
+        # Strip an s3object/alias qualifier: s.col / S3Object.col.
+        if len(parts) > 1:
+            name = parts[-1]
+        return Col(name)
+
+    # -- expressions ----------------------------------------------------
+
+    def _expr(self):
+        return self._or()
+
+    def _or(self):
+        terms = [self._and()]
+        while self.peek() == ("kw", "or"):
+            self.next()
+            terms.append(self._and())
+        return terms[0] if len(terms) == 1 else Logical("or", terms)
+
+    def _and(self):
+        terms = [self._not()]
+        while self.peek() == ("kw", "and"):
+            self.next()
+            terms.append(self._not())
+        return terms[0] if len(terms) == 1 else Logical("and", terms)
+
+    def _not(self):
+        if self.peek() == ("kw", "not"):
+            self.next()
+            return Not(self._not())
+        return self._cmp()
+
+    def _cmp(self):
+        left = self._operand()
+        t = self.peek()
+        if t == ("kw", "is"):
+            self.next()
+            negate = False
+            if self.peek() == ("kw", "not"):
+                self.next()
+                negate = True
+            self.expect("kw", "null")
+            return IsNull(left, negate)
+        if t[0] == "op":
+            op = self.next()[1]
+            right = self._operand()
+            return Cmp(op, left, right)
+        return left
+
+    def _operand(self):
+        t = self.peek()
+        if t == ("punct", "("):
+            self.next()
+            e = self._expr()
+            self.expect("punct", ")")
+            return e
+        if t[0] == "string":
+            self.next()
+            return Lit(t[1])
+        if t[0] == "number":
+            self.next()
+            return Lit(float(t[1]))
+        if t[0] == "ident":
+            return self._column()
+        raise SQLError(f"unexpected {t[1]!r}")
+
+
+def parse_select(sql: str) -> Query:
+    return _Parser(_tokenize(sql)).parse()
