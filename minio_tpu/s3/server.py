@@ -783,16 +783,23 @@ def _make_handler(server: S3Server):
             from minio_tpu.s3select import SelectError, run_select
             h = self._headers_lower()
             vid = query.get("versionId", [""])[0]
-            info = server.object_layer.get_object_info(
+            # ONE read: info and bytes come from the same snapshot, so
+            # the SSE branch can never decrypt with stale params.
+            info, data = server.object_layer.get_object(
                 bucket, key, GetOptions(version_id=vid))
             if info.internal_metadata.get("x-internal-sse-alg"):
                 self._sse_check_head(h, info)
-                _, chunks, _, _ = self._get_encrypted(
-                    bucket, key, vid or info.version_id, None, h, info)
-                data = b"".join(chunks)
-            else:
-                _, data = server.object_layer.get_object(
-                    bucket, key, GetOptions(version_id=vid))
+                from minio_tpu.crypto import sse as sse_mod
+                from minio_tpu.crypto.dare import decrypt_packages
+                try:
+                    customer = sse_mod.parse_sse_c(h)
+                    data_key, nonce = sse_mod.decrypt_params(
+                        bucket, key, info.internal_metadata, server.kms,
+                        customer)
+                except sse_mod.SSEError as e:
+                    raise S3Error(e.code, str(e)) from None
+                data = b"".join(decrypt_packages(
+                    iter([data]), data_key, nonce, 0, 0, info.size))
             try:
                 resp = run_select(data, body)
             except SelectError as e:

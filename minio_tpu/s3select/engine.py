@@ -73,7 +73,13 @@ def _iter_csv(data: bytes, opts: dict):
     header_mode = opts.get("header", "NONE")
     headers = None
     header_pending = header_mode in ("USE", "IGNORE")
-    for fields in reader:
+    while True:
+        try:
+            fields = next(reader)
+        except StopIteration:
+            return
+        except csv.Error as e:
+            raise SelectError(f"malformed CSV record: {e}") from None
         if not fields:
             continue
         if header_pending:
@@ -99,8 +105,9 @@ def _iter_json(data: bytes):
             rec = json.loads(line)
         except ValueError:
             raise SelectError("malformed JSON record") from None
-        if isinstance(rec, dict):
-            yield {k: v for k, v in rec.items()}
+        if not isinstance(rec, dict):
+            raise SelectError("JSON record is not an object")
+        yield rec
 
 
 def _project(query, row: dict) -> dict:
@@ -144,7 +151,9 @@ def run_select(body: bytes, request_xml: bytes) -> bytes:
             break
         if query.where is not None:
             try:
-                keep = bool(query.where.eval(row))
+                # Three-valued logic: only TRUE keeps the row (NULL and
+                # FALSE both drop it).
+                keep = query.where.eval(row) is True
             except Exception:  # noqa: BLE001 - bad row never kills the scan
                 keep = False
             if not keep:

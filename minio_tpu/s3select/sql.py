@@ -69,6 +69,7 @@ def _tokenize(text: str) -> list[tuple[str, str]]:
 @dataclasses.dataclass
 class Col:
     name: str
+    parts: list = dataclasses.field(default_factory=list)
 
     def eval(self, row: dict):
         return row.get(self.name)
@@ -88,10 +89,13 @@ class Cmp:
     left: object
     right: object
 
-    def eval(self, row: dict) -> bool:
+    def eval(self, row: dict):
+        """SQL three-valued logic: a comparison with a NULL/missing
+        operand is NULL (None), not False — NOT must not flip it to
+        True."""
         a, b = self.left.eval(row), self.right.eval(row)
         if a is None or b is None:
-            return False
+            return None
         fa, fb = _as_number(a), _as_number(b)
         if fa is not None and fb is not None:
             a, b = fa, fb
@@ -116,18 +120,24 @@ class Logical:
     op: str
     terms: list
 
-    def eval(self, row: dict) -> bool:
+    def eval(self, row: dict):
+        vals = [t.eval(row) for t in self.terms]
         if self.op == "and":
-            return all(t.eval(row) for t in self.terms)
-        return any(t.eval(row) for t in self.terms)
+            if any(v is False for v in vals):
+                return False
+            return None if any(v is None for v in vals) else True
+        if any(v is True for v in vals):
+            return True
+        return None if any(v is None for v in vals) else False
 
 
 @dataclasses.dataclass
 class Not:
     term: object
 
-    def eval(self, row: dict) -> bool:
-        return not self.term.eval(row)
+    def eval(self, row: dict):
+        v = self.term.eval(row)
+        return None if v is None else not v
 
 
 @dataclasses.dataclass
@@ -151,6 +161,8 @@ class _Parser:
     def __init__(self, tokens):
         self.toks = tokens
         self.pos = 0
+        self._cols: list[Col] = []
+        self._aliases = {"s3object"}
 
     def peek(self):
         return self.toks[self.pos] if self.pos < len(self.toks) else ("eof", "")
@@ -187,6 +199,18 @@ class _Parser:
                                f"got {t[1]}")
         if self.peek()[0] != "eof":
             raise SQLError(f"unexpected trailing {self.peek()[1]!r}")
+        # Resolve qualified references now that the FROM alias is known:
+        # a prefix must be the table (or its alias); anything else (or
+        # nested paths) is unsupported, never silently misread.
+        for col in self._cols:
+            parts = col.parts
+            if len(parts) == 1:
+                col.name = parts[0]
+            elif len(parts) == 2 and parts[0].lower() in self._aliases:
+                col.name = parts[1]
+            else:
+                raise SQLError("unsupported column reference "
+                               f"{'.'.join(parts)!r}")
         return Query(columns=columns, count_star=count_star, where=where,
                      limit=limit)
 
@@ -216,7 +240,8 @@ class _Parser:
             return cols, False
 
     def _from(self):
-        # FROM S3Object[.alias] / s3object — accept and ignore aliases.
+        # FROM S3Object[.path][ alias] — the alias becomes a valid
+        # column qualifier.
         t = self.next()
         if t[0] != "ident" or t[1].lower() not in ("s3object",):
             raise SQLError("FROM must reference S3Object")
@@ -224,21 +249,19 @@ class _Parser:
             self.next()
             self.next()
         if self.peek()[0] == "ident":
-            self.next()      # table alias
+            self._aliases.add(self.next()[1].lower())
 
     def _column(self) -> Col:
         t = self.next()
         if t[0] != "ident":
             raise SQLError(f"expected column, got {t[1]!r}")
-        name = t[1]
-        parts = [name]
+        parts = [t[1]]
         while self.peek() == ("punct", "."):
             self.next()
             parts.append(self.expect("ident")[1])
-        # Strip an s3object/alias qualifier: s.col / S3Object.col.
-        if len(parts) > 1:
-            name = parts[-1]
-        return Col(name)
+        col = Col(parts[-1], parts)
+        self._cols.append(col)
+        return col
 
     # -- expressions ----------------------------------------------------
 
