@@ -1,0 +1,120 @@
+"""Transparent object compression (the reference's compression layer,
+cmd/object-api-utils.go S2/seekable: internal metadata records the
+scheme and a per-block index so ranged reads decompress only the blocks
+they touch).
+
+Scheme: the plaintext splits into fixed 1 MiB blocks, each deflated
+independently (zlib — the in-tree codec; the reference uses S2). The
+stored stream is the concatenation of compressed blocks; the block
+index (cumulative compressed offsets) lives in internal metadata, so
+plaintext offset -> block -> stored byte range is one lookup.
+
+v1 scope: objects up to the streaming threshold (32 MiB) — exactly the
+buffered-put path — and never combined with SSE (the reference also
+disables compression for encrypted objects by default). Incompressible
+payloads (compressed >= original) store uncompressed automatically.
+"""
+
+from __future__ import annotations
+
+import base64
+import struct
+import zlib
+
+BLOCK = 1 << 20
+
+META_SCHEME = "x-internal-comp"          # "zlib-blocks"
+META_SIZE = "x-internal-comp-size"       # plaintext size
+META_INDEX = "x-internal-comp-index"     # base64 packed u32 cumulative ends
+
+SCHEME = "zlib-blocks"
+
+# Extensions/content-types that compress well (reference default
+# allowlist shape, internal/config/compress).
+DEFAULT_EXTENSIONS = (".txt", ".log", ".csv", ".json", ".tar", ".xml",
+                      ".bin", ".ndjson", ".tsv", ".yaml", ".yml", ".md")
+DEFAULT_MIME_PREFIXES = ("text/", "application/json", "application/xml",
+                         "application/csv")
+
+
+class CompressionError(Exception):
+    pass
+
+
+def eligible(key: str, content_type: str) -> bool:
+    k = key.lower()
+    if any(k.endswith(ext) for ext in DEFAULT_EXTENSIONS):
+        return True
+    ct = (content_type or "").lower()
+    return any(ct.startswith(p) for p in DEFAULT_MIME_PREFIXES)
+
+
+def compress(data: bytes) -> tuple[bytes, dict] | None:
+    """Compress into the block scheme; None when not worth storing
+    (incompressible)."""
+    blocks = []
+    ends = []
+    total = 0
+    for off in range(0, len(data), BLOCK):
+        blob = zlib.compress(data[off:off + BLOCK], 6)
+        blocks.append(blob)
+        total += len(blob)
+        ends.append(total)
+    if total >= len(data):
+        return None
+    index = base64.b64encode(
+        struct.pack(f">{len(ends)}I", *ends)).decode()
+    meta = {META_SCHEME: SCHEME, META_SIZE: str(len(data)),
+            META_INDEX: index}
+    return b"".join(blocks), meta
+
+
+def _index(meta: dict) -> list[int]:
+    try:
+        raw = base64.b64decode(meta[META_INDEX])
+        if not raw or len(raw) % 4:
+            raise ValueError("bad index length")
+        return list(struct.unpack(f">{len(raw) // 4}I", raw))
+    except (KeyError, ValueError, struct.error):
+        raise CompressionError("corrupt compression index") from None
+
+
+def decompress_range(stored: bytes, meta: dict, offset: int,
+                     length: int, stored_base: int = 0) -> bytes:
+    """Plaintext [offset, offset+length) from stored bytes.
+
+    stored_base: the absolute offset `stored[0]` corresponds to in the
+    full stored stream (ranged readers fetch only the covering blocks).
+    """
+    if meta.get(META_SCHEME) != SCHEME:
+        raise CompressionError(f"unknown scheme {meta.get(META_SCHEME)!r}")
+    plain_size = int(meta.get(META_SIZE, "0"))
+    if offset < 0 or length < 0 or offset + length > plain_size:
+        raise CompressionError("range out of bounds")
+    if length == 0:
+        return b""
+    ends = _index(meta)
+    first = offset // BLOCK
+    last = (offset + length - 1) // BLOCK
+    out = bytearray()
+    for b in range(first, last + 1):
+        lo = (ends[b - 1] if b else 0) - stored_base
+        hi = ends[b] - stored_base
+        if lo < 0 or hi > len(stored):
+            raise CompressionError("stored window does not cover range")
+        try:
+            out += zlib.decompress(stored[lo:hi])
+        except zlib.error:
+            raise CompressionError(
+                f"block {b} fails decompression") from None
+    skip = offset - first * BLOCK
+    return bytes(out[skip:skip + length])
+
+
+def stored_range(meta: dict, offset: int, length: int) -> tuple[int, int]:
+    """Stored byte range covering plaintext [offset, offset+length)."""
+    ends = _index(meta)
+    first = offset // BLOCK
+    last = (offset + length - 1) // BLOCK if length else first
+    lo = ends[first - 1] if first else 0
+    return lo, ends[min(last, len(ends) - 1)] - lo

@@ -532,7 +532,7 @@ class ErasureSet:
         shard_size = e.shard_size()
         framed = self._encode_and_frame(data, k, m)
 
-        etag = hashlib.md5(data).hexdigest()
+        etag = opts.etag or hashlib.md5(data).hexdigest()
         version_id = opts.version_id or (new_uuid() if opts.versioned else "")
         mod_time = opts.mod_time or now_ns()
         shard_file_len = e.shard_file_size(len(data))
@@ -714,6 +714,7 @@ class ErasureSet:
         try:
             etag, errors = self._stream_framed_writes(
                 payload, k, m, distribution, path_for)
+            etag = opts.etag or etag
         except Exception:
             cleanup_staging()
             raise
@@ -1031,9 +1032,11 @@ class ErasureSet:
         internal = {k: meta.pop(k) for k in list(meta)
                     if k.startswith("x-internal-")}
         size = fi.size
-        # Content transforms (SSE) store the logical size internally;
-        # the API surface reports it, the storage size stays in fi.
-        logical = internal.get("x-internal-sse-size")
+        # Content transforms (SSE, compression) store the logical size
+        # internally; the API surface reports it, the storage size
+        # stays in fi.
+        logical = internal.get("x-internal-sse-size") \
+            or internal.get("x-internal-comp-size")
         if logical is not None:
             try:
                 size = int(logical)

@@ -116,6 +116,9 @@ class PutOptions:
     # Internal (never user-visible) metadata, e.g. SSE crypto params;
     # keys must start with "x-internal-".
     internal_metadata: dict = dataclasses.field(default_factory=dict)
+    # Pre-computed etag override (content transforms hash the LOGICAL
+    # bytes; the store would otherwise hash what it stores).
+    etag: str = ""
 
 
 @dataclasses.dataclass

@@ -174,6 +174,12 @@ class ReplicationEngine:
             bucket, key, GetOptions(version_id=version_id))
         if info.internal_metadata.get("x-internal-sse-alg"):
             raise ReplicationError("SSE objects do not replicate in v1")
+        if info.internal_metadata.get("x-internal-comp"):
+            # The stored stream is compressed: replicate PLAINTEXT (the
+            # target applies its own transforms).
+            from minio_tpu.crypto import compress as comp
+            body = comp.decompress_range(body, info.internal_metadata,
+                                         0, info.size)
         headers = {f"x-amz-meta-{k}": v
                    for k, v in info.user_metadata.items()}
         if info.content_type:

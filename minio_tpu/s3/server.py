@@ -114,6 +114,9 @@ class S3Server:
         self.audit = None
         # Async bucket replication engine (replication.ReplicationEngine).
         self.replicator = None
+        # Transparent compression for eligible content (off by default;
+        # --compression enables).
+        self.compression = False
 
     @property
     def address(self) -> str:
@@ -800,6 +803,13 @@ def _make_handler(server: S3Server):
                     raise S3Error(e.code, str(e)) from None
                 data = b"".join(decrypt_packages(
                     iter([data]), data_key, nonce, 0, 0, info.size))
+            elif info.internal_metadata.get("x-internal-comp"):
+                from minio_tpu.crypto import compress as comp
+                try:
+                    data = comp.decompress_range(
+                        data, info.internal_metadata, 0, info.size)
+                except comp.CompressionError as e:
+                    raise S3Error("InternalError", str(e)) from None
             try:
                 resp = run_select(data, body)
             except SelectError as e:
@@ -1033,6 +1043,7 @@ def _make_handler(server: S3Server):
             plain_size = payload.size
             payload, sse_headers = self._apply_sse(bucket, key, payload,
                                                    h, opts)
+            payload = self._apply_compression(key, payload, opts)
             # Replicate only after the SSE decision: encrypted objects
             # do not replicate in v1 (their keys bind to this cluster),
             # and an incoming REPLICA must not ping-pong back in
@@ -1109,6 +1120,53 @@ def _make_handler(server: S3Server):
                              sse_mod.H_C_MD5: customer[1]}
             return out, {sse_mod.H_SSE: "AES256"}
 
+        def _apply_compression(self, key, payload, opts):
+            """Compress eligible buffered-size plaintext objects
+            (reference: cmd/object-api-utils.go compression gate — never
+            combined with SSE, never for incompressible payloads)."""
+            from minio_tpu.crypto import compress as comp
+            from minio_tpu.object.erasure_object import STREAM_THRESHOLD
+            if not server.compression \
+                    or opts.internal_metadata.get("x-internal-sse-alg") \
+                    or payload.size == 0 \
+                    or payload.size > STREAM_THRESHOLD \
+                    or not comp.eligible(key, opts.content_type):
+                return payload
+            data = payload.read_all()
+            result = comp.compress(data)
+            if result is None:           # incompressible: store as-is
+                return Payload.wrap(data)
+            stored, meta = result
+            opts.internal_metadata.update(meta)
+            # ETag must hash the LOGICAL bytes (single-PUT clients
+            # verify ETag == md5(body)), not the compressed stream.
+            opts.etag = hashlib.md5(data).hexdigest()
+            return Payload.wrap(stored)
+
+        def _get_compressed(self, bucket, key, vid, spec, info):
+            """Ranged read of a compressed object: fetch the covering
+            stored blocks, decompress, trim to the plaintext range."""
+            from minio_tpu.crypto import compress as comp
+            start, length = (_resolve_head_range(spec, info.size)
+                             if spec else (0, info.size))
+            info.range_start, info.range_length = start, length
+            if length <= 0 or info.size == 0:
+                return info, (b for b in ()), start, max(length, 0)
+            imeta = info.internal_metadata
+            lo, ln = comp.stored_range(imeta, start, length)
+            pin = vid or info.version_id
+            _, stored = server.object_layer.get_object(
+                bucket, key, GetOptions(version_id=pin, offset=lo,
+                                        length=ln))
+            try:
+                plain = comp.decompress_range(stored, imeta, start,
+                                              length, stored_base=lo)
+            except comp.CompressionError as e:
+                raise S3Error("InternalError", str(e)) from None
+            # Generator (not iter([...])): the GET handler's finally
+            # calls chunks.close().
+            return info, (c for c in (plain,)), start, length
+
         def _sse_response_headers(self, h, info) -> dict:
             from minio_tpu.crypto import sse as sse_mod
             alg = info.internal_metadata.get(sse_mod.META_ALG, "")
@@ -1145,6 +1203,11 @@ def _make_handler(server: S3Server):
             SSE-C) and resolves ranges against the logical size."""
             sinfo = server.object_layer.get_object_info(
                 sbucket, skey, GetOptions(version_id=src_vid))
+            if sinfo.internal_metadata.get("x-internal-comp"):
+                sinfo, chunks, _, _ = self._get_compressed(
+                    sbucket, skey, src_vid or sinfo.version_id, spec,
+                    sinfo)
+                return sinfo, b"".join(chunks)
             if not sinfo.internal_metadata.get("x-internal-sse-alg"):
                 return server.object_layer.get_object(
                     sbucket, skey, GetOptions(version_id=src_vid,
@@ -1292,24 +1355,58 @@ def _make_handler(server: S3Server):
                 self._sse_check_head(h, info)
                 start, length = (_resolve_head_range(spec, info.size)
                                  if spec else (0, info.size))
-            else:
-                # Streaming read: O(window) memory, lock released when
-                # the iterator is exhausted. A plaintext-space range is
-                # always valid in ciphertext space (cipher >= plain), so
-                # opening the stream first costs nothing when the object
-                # turns out to be encrypted.
+            elif spec is None:
+                # Whole-object GET: one streaming read; rerouted to the
+                # transform paths only when the returned info says so.
                 info, chunks = server.object_layer.get_object_stream(
-                    bucket, key, GetOptions(version_id=vid,
-                                            range_spec=spec))
-                if info.internal_metadata.get("x-internal-sse-alg"):
+                    bucket, key, GetOptions(version_id=vid))
+                imeta = info.internal_metadata
+                if imeta.get("x-internal-sse-alg"):
                     chunks.close()
                     self._sse_check_head(h, info)
                     # Pin the version so params and data come from the
-                    # same object generation (unversioned buckets keep a
-                    # small overwrite race, as does the reference).
+                    # same generation (unversioned buckets keep a small
+                    # overwrite race, as does the reference).
+                    pin = vid or info.version_id
+                    info, chunks, start, length = self._get_encrypted(
+                        bucket, key, pin, None, h, info)
+                elif imeta.get("x-internal-comp"):
+                    chunks.close()
+                    info, chunks, start, length = self._get_compressed(
+                        bucket, key, vid or info.version_id, None, info)
+                else:
+                    start, length = info.range_start, info.range_length
+            else:
+                # Ranged GET: open once and reroute on the returned
+                # info when the object carries a transform (SSE grows
+                # the offset space, compression shrinks it). A
+                # plaintext range exceeding a COMPRESSED stored size
+                # raises InvalidRange here — only then fall back to an
+                # info-first read.
+                from minio_tpu.object.types import InvalidRange as _IR
+                info = chunks = None
+                try:
+                    info, chunks = \
+                        server.object_layer.get_object_stream(
+                            bucket, key, GetOptions(version_id=vid,
+                                                    range_spec=spec))
+                except _IR:
+                    info = server.object_layer.get_object_info(
+                        bucket, key, GetOptions(version_id=vid))
+                    if not info.internal_metadata.get("x-internal-comp"):
+                        raise      # genuinely out of range
+                imeta = info.internal_metadata
+                if imeta.get("x-internal-sse-alg"):
+                    chunks.close()
+                    self._sse_check_head(h, info)
                     pin = vid or info.version_id
                     info, chunks, start, length = self._get_encrypted(
                         bucket, key, pin, spec, h, info)
+                elif imeta.get("x-internal-comp"):
+                    if chunks is not None:
+                        chunks.close()
+                    info, chunks, start, length = self._get_compressed(
+                        bucket, key, vid or info.version_id, spec, info)
                 else:
                     start, length = info.range_start, info.range_length
             if spec and info.size == 0 and spec[0] is None:

@@ -56,6 +56,9 @@ def main(argv=None) -> int:
     ap.add_argument("--audit-webhook", default="",
                     help="webhook endpoint URL receiving one audit "
                          "record per completed request")
+    ap.add_argument("--compression", action="store_true",
+                    help="transparently compress eligible objects "
+                         "(text-like extensions/content types)")
     ap.add_argument("drives", nargs="+",
                     help="drive dirs or http://host:port/path endpoints; "
                          "`{1...N}` ellipses expand, and each ellipses "
@@ -271,6 +274,7 @@ def main(argv=None) -> int:
     creds = Credentials()
     creds.iam = IAMSys(pools[0].sets, creds.access_key, creds.secret_key)
     srv = S3Server(layer, address=args.address, credentials=creds)
+    srv.compression = args.compression
     if args.audit_webhook:
         from minio_tpu.s3.trace import AuditLogger
         srv.audit = AuditLogger(args.audit_webhook)

@@ -88,7 +88,6 @@ def srv(tmp_path_factory):
 
 def test_admin_trace_streams_requests(srv):
     cli = S3Client(srv.address)
-    assert cli.request("PUT", "/trb")[0] == 200
 
     entries = []
 
@@ -107,13 +106,13 @@ def test_admin_trace_streams_requests(srv):
                 "x-amz-content-sha256": payload_hash}
         signed = sorted(hdrs)
         canon = sigv4.canonical_request(
-            "GET", "/minio/admin/v3/trace", {"count": ["3"]}, hdrs,
+            "GET", "/minio/admin/v3/trace", {"count": ["4"]}, hdrs,
             signed, payload_hash)
         sts = sigv4.string_to_sign(amz_date, scope, canon)
         skey = sigv4.signing_key("minioadmin", date, "us-east-1")
         sig = hmac_mod.new(skey, sts.encode(), hashlib.sha256).hexdigest()
         conn = http.client.HTTPConnection(srv.address, timeout=20)
-        conn.request("GET", "/minio/admin/v3/trace?count=3", headers={
+        conn.request("GET", "/minio/admin/v3/trace?count=4", headers={
             **hdrs,
             "Authorization": f"{sigv4.ALGORITHM} "
             f"Credential=minioadmin/{scope}, "
@@ -128,15 +127,19 @@ def test_admin_trace_streams_requests(srv):
     t = threading.Thread(target=consume, daemon=True)
     t.start()
     time.sleep(0.3)                 # subscriber attached
+    # EVERY traced request happens after subscription — a publish from
+    # an earlier request could otherwise land late and interleave.
+    assert cli.request("PUT", "/trb")[0] == 200
     cli.request("PUT", "/trb/one", body=b"1")
     cli.request("GET", "/trb/one")
     cli.request("DELETE", "/trb/one")
     t.join(timeout=15)
-    assert len(entries) == 3, entries
+    assert len(entries) == 4, entries
     apis = [e["api"] for e in entries]
-    assert apis == ["PUT:object", "GET:object", "DELETE:object"]
+    assert apis == ["PUT:bucket", "PUT:object", "GET:object",
+                    "DELETE:object"]
     assert all(e["accessKey"] == "minioadmin" for e in entries)
-    assert entries[0]["bucket"] == "trb"
+    assert entries[1]["bucket"] == "trb"
 
 
 # ---------------------------------------------------------------------------
