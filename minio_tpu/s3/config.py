@@ -1,0 +1,105 @@
+"""Server configuration subsystem: persisted KV settings + hot apply.
+
+The lightweight analogue of the reference's config system
+(internal/config/config.go KV store with hot reload via admin API):
+settings live in one JSON document quorum-replicated across the first
+pool's drives, are loaded at boot, and apply live ON THE NODE that
+serves the set-config request (other nodes of a distributed deployment
+pick the persisted document up at their next boot — cross-node hot
+reload would ride the peer control plane).
+
+Supported keys (unknown keys persist but are inert):
+  compression        "on" | "off"   — transparent object compression
+  scanner_interval   seconds (float) — background scanner cadence
+  scanner_deep_every N               — deep-heal sampling rate
+  scanner_throttle   seconds (float) — per-object scanner sleep
+"""
+
+from __future__ import annotations
+
+import json
+
+SYS_VOL = ".mtpu.sys"
+CONFIG_PATH = "config/server.json"
+
+
+class ConfigError(Exception):
+    pass
+
+
+def _disks(object_layer):
+    from minio_tpu.s3.metrics import layer_sets
+    return [d for s in layer_sets(object_layer) for d in s.disks]
+
+
+def load_config(object_layer) -> dict:
+    votes: dict[bytes, int] = {}
+    for d in _disks(object_layer):
+        try:
+            blob = d.read_all(SYS_VOL, CONFIG_PATH)
+            votes[blob] = votes.get(blob, 0) + 1
+        except Exception:  # noqa: BLE001 - absent / offline
+            continue
+    if not votes:
+        return {}
+    blob = max(votes.items(), key=lambda kv: kv[1])[0]
+    try:
+        cfg = json.loads(blob)
+        return cfg if isinstance(cfg, dict) else {}
+    except ValueError:
+        return {}
+
+
+def save_config(object_layer, cfg: dict) -> None:
+    blob = json.dumps(cfg, sort_keys=True).encode()
+    disks = _disks(object_layer)
+    ok = 0
+    for d in disks:
+        try:
+            d.write_all(SYS_VOL, CONFIG_PATH, blob)
+            ok += 1
+        except Exception:  # noqa: BLE001 - offline drive
+            continue
+    if ok < len(disks) // 2 + 1:
+        raise ConfigError("could not persist config to a drive quorum")
+
+
+def validate(updates: dict) -> None:
+    for k, v in updates.items():
+        if k == "compression" and v not in ("on", "off"):
+            raise ConfigError("compression must be 'on' or 'off'")
+        if k in ("scanner_interval", "scanner_throttle"):
+            try:
+                if float(v) < 0:
+                    raise ValueError
+            except (TypeError, ValueError):
+                raise ConfigError(
+                    f"{k} must be a non-negative number") from None
+        if k == "scanner_deep_every":
+            try:
+                if int(v) < 1:
+                    raise ValueError
+            except (TypeError, ValueError):
+                raise ConfigError(
+                    f"{k} must be a positive integer") from None
+
+
+def apply_config(server, cfg: dict) -> list[str]:
+    """Apply live-reloadable settings; returns the keys that changed
+    behavior."""
+    applied = []
+    if "compression" in cfg:
+        server.compression = cfg["compression"] == "on"
+        applied.append("compression")
+    scanner = getattr(server.object_layer, "scanner", None)
+    if scanner is not None:
+        if "scanner_interval" in cfg:
+            scanner.interval = float(cfg["scanner_interval"])
+            applied.append("scanner_interval")
+        if "scanner_deep_every" in cfg:
+            scanner.deep_every = int(cfg["scanner_deep_every"])
+            applied.append("scanner_deep_every")
+        if "scanner_throttle" in cfg:
+            scanner.throttle = float(cfg["scanner_throttle"])
+            applied.append("scanner_throttle")
+    return applied

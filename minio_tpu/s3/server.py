@@ -1355,34 +1355,16 @@ def _make_handler(server: S3Server):
                 self._sse_check_head(h, info)
                 start, length = (_resolve_head_range(spec, info.size)
                                  if spec else (0, info.size))
-            elif spec is None:
-                # Whole-object GET: one streaming read; rerouted to the
-                # transform paths only when the returned info says so.
-                info, chunks = server.object_layer.get_object_stream(
-                    bucket, key, GetOptions(version_id=vid))
-                imeta = info.internal_metadata
-                if imeta.get("x-internal-sse-alg"):
-                    chunks.close()
-                    self._sse_check_head(h, info)
-                    # Pin the version so params and data come from the
-                    # same generation (unversioned buckets keep a small
-                    # overwrite race, as does the reference).
-                    pin = vid or info.version_id
-                    info, chunks, start, length = self._get_encrypted(
-                        bucket, key, pin, None, h, info)
-                elif imeta.get("x-internal-comp"):
-                    chunks.close()
-                    info, chunks, start, length = self._get_compressed(
-                        bucket, key, vid or info.version_id, None, info)
-                else:
-                    start, length = info.range_start, info.range_length
             else:
-                # Ranged GET: open once and reroute on the returned
-                # info when the object carries a transform (SSE grows
-                # the offset space, compression shrinks it). A
-                # plaintext range exceeding a COMPRESSED stored size
-                # raises InvalidRange here — only then fall back to an
-                # info-first read.
+                # One streaming read, rerouted on the returned info when
+                # the object carries a transform (SSE grows the offset
+                # space, compression shrinks it). A plaintext range
+                # exceeding a COMPRESSED stored size raises InvalidRange
+                # at the open — only then fall back to an info-first
+                # read; spec=None can never take that path. Version
+                # pinning keeps params and data from the same generation
+                # (unversioned buckets keep a small overwrite race, as
+                # does the reference).
                 from minio_tpu.object.types import InvalidRange as _IR
                 info = chunks = None
                 try:
@@ -1399,9 +1381,9 @@ def _make_handler(server: S3Server):
                 if imeta.get("x-internal-sse-alg"):
                     chunks.close()
                     self._sse_check_head(h, info)
-                    pin = vid or info.version_id
                     info, chunks, start, length = self._get_encrypted(
-                        bucket, key, pin, spec, h, info)
+                        bucket, key, vid or info.version_id, spec, h,
+                        info)
                 elif imeta.get("x-internal-comp"):
                     if chunks is not None:
                         chunks.close()
@@ -1747,6 +1729,31 @@ def _make_handler(server: S3Server):
                 blob = _json.dumps(payload).encode() \
                     if payload is not None else b""
                 self._send(200, blob, content_type="application/json")
+
+            # Config subsystem: persisted KV with hot apply (reference:
+            # admin SetConfigKV/GetConfigKV over internal/config).
+            if op == "get-config" and method == "GET":
+                from minio_tpu.s3 import config as cfg_mod
+                return ok(cfg_mod.load_config(server.object_layer))
+            if op == "set-config" and method == "PUT":
+                from minio_tpu.s3 import config as cfg_mod
+                try:
+                    updates = _json.loads(body)
+                    if not isinstance(updates, dict):
+                        raise ValueError("config must be an object")
+                    cfg_mod.validate(updates)
+                    # Lock the read-modify-write so two concurrent
+                    # set-configs cannot drop each other's keys. Hot
+                    # apply reaches THIS node; peers pick the persisted
+                    # document up at their next boot.
+                    with server.bucket_meta_lock:
+                        cfg = cfg_mod.load_config(server.object_layer)
+                        cfg.update(updates)
+                        cfg_mod.save_config(server.object_layer, cfg)
+                    applied = cfg_mod.apply_config(server, cfg)
+                except (ValueError, cfg_mod.ConfigError) as e:
+                    raise S3Error("InvalidArgument", str(e)) from None
+                return ok({"applied": applied})
 
             # Replication target management needs no IAM store.
             if op == "set-remote-target" and method == "PUT":

@@ -12,6 +12,7 @@ from __future__ import annotations
 import datetime
 import hashlib
 import hmac
+import time
 import urllib.parse
 from dataclasses import dataclass
 from typing import Optional
@@ -188,6 +189,12 @@ def verify_request(method: str, path: str, query: dict[str, list[str]],
     mismatch; returns the parsed auth (callers use the access key for
     policy checks and the payload-hash mode for body handling).
     """
+    # Legacy SigV2 (header "AWS AKID:sig" or presigned ?Signature=):
+    # verified by its own HMAC-SHA1 scheme, mapped into a ParsedAuth.
+    if headers.get("authorization", "").startswith("AWS ") or \
+            ("Signature" in query and "AWSAccessKeyId" in query):
+        return _verify_v2(method, path, query, headers, secret_for)
+
     presigned = "X-Amz-Signature" in query
     auth = parse_presigned(query) if presigned else parse_auth_header(headers)
     secret = secret_for(auth.credential.access_key)
@@ -241,6 +248,102 @@ def verify_request(method: str, path: str, query: dict[str, list[str]],
     if not hmac.compare_digest(want, auth.signature):
         raise SigError("SignatureDoesNotMatch")
     return auth
+
+
+# ---------------------------------------------------------------------------
+# Legacy SigV2 (reference: cmd/signature-v4.go's v2 sibling,
+# cmd/auth-handler.go routing)
+# ---------------------------------------------------------------------------
+
+# Subresources included in the V2 canonicalized resource, per the spec.
+_V2_SUBRESOURCES = {
+    "acl", "delete", "lifecycle", "location", "logging", "notification",
+    "partNumber", "policy", "requestPayment", "replication", "response-content-type",
+    "response-content-language", "response-expires", "response-cache-control",
+    "response-content-disposition", "response-content-encoding", "select",
+    "select-type", "tagging", "torrent", "uploadId", "uploads", "versionId",
+    "versioning", "versions", "website", "encryption", "cors",
+}
+
+
+def _v2_string_to_sign(method: str, path: str, query: dict,
+                       headers: dict, expires: str = "") -> str:
+    md5 = headers.get("content-md5", "")
+    ctype = headers.get("content-type", "")
+    # Per the V2 spec: when x-amz-date is present it rides in the
+    # CanonicalizedAmzHeaders section and the Date slot is EMPTY;
+    # presigned requests put Expires in the Date slot.
+    if expires:
+        date = expires
+    elif "x-amz-date" in headers:
+        date = ""
+    else:
+        date = headers.get("date", "")
+    amz = []
+    for k in sorted(headers):
+        if k.startswith("x-amz-"):
+            amz.append(f"{k}:{headers[k].strip()}")
+    sub = []
+    for k in sorted(query):
+        if k in _V2_SUBRESOURCES:
+            v = query[k][0]
+            sub.append(f"{k}={v}" if v else k)
+    resource = path + ("?" + "&".join(sub) if sub else "")
+    return "\n".join([method, md5, ctype, date] + amz + [resource])
+
+
+def _verify_v2(method: str, path: str, query: dict, headers: dict,
+               secret_for) -> ParsedAuth:
+    import base64
+    import urllib.parse as _up
+    presigned = "Signature" in query
+    if presigned:
+        access = query.get("AWSAccessKeyId", [""])[0]
+        signature = query.get("Signature", [""])[0]
+        expires = query.get("Expires", [""])[0]
+        try:
+            if time.time() > int(expires):
+                raise SigError("AccessDenied", "Request has expired")
+        except ValueError:
+            raise SigError("AccessDenied", "bad Expires") from None
+    else:
+        hdr = headers.get("authorization", "")
+        rest = hdr[len("AWS "):]
+        access, _, signature = rest.partition(":")
+        expires = ""
+        if not access or not signature:
+            raise SigError("AuthorizationHeaderMalformed", hdr)
+        # Same +/-15 min replay window the V4 path enforces.
+        import email.utils as _eu
+        date_hdr = headers.get("x-amz-date") or headers.get("date", "")
+        try:
+            when = _eu.parsedate_to_datetime(date_hdr)
+            if when.tzinfo is None:
+                when = when.replace(tzinfo=datetime.timezone.utc)
+        except (TypeError, ValueError):
+            raise SigError("AccessDenied",
+                           "missing or malformed Date header") from None
+        skew = abs((datetime.datetime.now(datetime.timezone.utc)
+                    - when).total_seconds())
+        if skew > 15 * 60:
+            raise SigError("AccessDenied",
+                           "request time too skewed from server time")
+    secret = secret_for(access)
+    if secret is None:
+        raise SigError("InvalidAccessKeyId", access)
+    sts = _v2_string_to_sign(method, _up.unquote(path), query, headers,
+                             expires)
+    want = base64.b64encode(hmac.new(secret.encode(), sts.encode("utf-8"),
+                                     hashlib.sha1).digest()).decode()
+    if not hmac.compare_digest(want, signature):
+        raise SigError("SignatureDoesNotMatch")
+    # Map into the V4 auth shape: full body already read & unverified
+    # (V2 has no payload hash), so treat as UNSIGNED-PAYLOAD.
+    cred = Credential(access_key=access, date=time.strftime("%Y%m%d"),
+                      region="us-east-1", service="s3")
+    return ParsedAuth(credential=cred, signed_headers=[],
+                      signature=signature, amz_date="",
+                      payload_hash=UNSIGNED_PAYLOAD)
 
 
 # ---------------------------------------------------------------------------

@@ -275,6 +275,12 @@ def main(argv=None) -> int:
     creds.iam = IAMSys(pools[0].sets, creds.access_key, creds.secret_key)
     srv = S3Server(layer, address=args.address, credentials=creds)
     srv.compression = args.compression
+    # Persisted config overrides flags (the flags seed first boot).
+    from minio_tpu.s3 import config as cfg_mod
+    try:
+        cfg_mod.apply_config(srv, cfg_mod.load_config(layer))
+    except Exception:  # noqa: BLE001 - config is optional
+        pass
     if args.audit_webhook:
         from minio_tpu.s3.trace import AuditLogger
         srv.audit = AuditLogger(args.audit_webhook)
