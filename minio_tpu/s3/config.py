@@ -50,17 +50,28 @@ def load_config(object_layer) -> dict:
         return {}
 
 
-def save_config(object_layer, cfg: dict) -> None:
+def save_config(object_layer, cfg: dict,
+                prev: dict | None = None) -> None:
+    """Quorum-write the config; on quorum failure, best-effort restore
+    `prev` to any drives that took the new blob, so a REJECTED update
+    cannot win the plurality vote at the next load."""
     blob = json.dumps(cfg, sort_keys=True).encode()
     disks = _disks(object_layer)
-    ok = 0
+    wrote = []
     for d in disks:
         try:
             d.write_all(SYS_VOL, CONFIG_PATH, blob)
-            ok += 1
+            wrote.append(d)
         except Exception:  # noqa: BLE001 - offline drive
             continue
-    if ok < len(disks) // 2 + 1:
+    if len(wrote) < len(disks) // 2 + 1:
+        if prev is not None:
+            old = json.dumps(prev, sort_keys=True).encode()
+            for d in wrote:
+                try:
+                    d.write_all(SYS_VOL, CONFIG_PATH, old)
+                except Exception:  # noqa: BLE001 - best effort
+                    pass
         raise ConfigError("could not persist config to a drive quorum")
 
 

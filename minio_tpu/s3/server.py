@@ -1590,6 +1590,63 @@ def _make_handler(server: S3Server):
                     return self._send(503)
             return self._send(200)
 
+        def _admin_speedtest(self, q1):
+            """Self-measured object throughput (reference: `mc admin
+            speedtest`, cmd/perf-tests.go): timed PUTs then GETs of
+            synthetic objects through the full object layer, cleaned up
+            afterwards."""
+            import json as _json
+            import os as _os
+            import time as _time
+            try:
+                size = int(q1.get("size", str(4 << 20)))
+                count = int(q1.get("count", "8"))
+            except ValueError:
+                raise S3Error("InvalidArgument") from None
+            size = max(1 << 10, min(size, 256 << 20))
+            count = max(1, min(count, 64))
+            ol = server.object_layer
+            bucket = "mtpu-speedtest-tmp"
+            from minio_tpu.object.types import BucketExists
+            try:
+                ol.make_bucket(bucket)
+            except BucketExists:
+                pass        # shared across runs; keys are run-unique
+            body = _os.urandom(size)
+            run = _os.urandom(6).hex()    # concurrent runs never collide
+            keys = [f"obj-{run}-{i}" for i in range(count)]
+            try:
+                t0 = _time.perf_counter()
+                for k2 in keys:
+                    ol.put_object(bucket, k2, body, PutOptions())
+                put_s = _time.perf_counter() - t0
+                t0 = _time.perf_counter()
+                for k2 in keys:
+                    ol.get_object(bucket, k2)
+                get_s = _time.perf_counter() - t0
+            finally:
+                # Mid-run failures must not strand synthetic data.
+                for k2 in keys:
+                    try:
+                        ol.delete_object(bucket, k2)
+                    except Exception:  # noqa: BLE001 - best effort
+                        pass
+                try:
+                    ol.delete_bucket(bucket)
+                except Exception:  # noqa: BLE001 - other runs active
+                    pass
+            total = size * count
+            result = {
+                "object_size": size,
+                "objects": count,
+                "put_seconds": round(put_s, 4),
+                "get_seconds": round(get_s, 4),
+                "put_mibps": round(total / put_s / (1 << 20), 2),
+                "get_mibps": round(total / get_s / (1 << 20), 2),
+            }
+            self._send(200, _json.dumps(result).encode(),
+                       content_type="application/json")
+
         def _admin_trace(self, query):
             """Live trace stream: chunked JSON lines until the client
             disconnects (reference: TraceHandler + pubsub; the `mc
@@ -1730,6 +1787,9 @@ def _make_handler(server: S3Server):
                     if payload is not None else b""
                 self._send(200, blob, content_type="application/json")
 
+            if op == "speedtest" and method == "POST":
+                return self._admin_speedtest(q1)
+
             # Config subsystem: persisted KV with hot apply (reference:
             # admin SetConfigKV/GetConfigKV over internal/config).
             if op == "get-config" and method == "GET":
@@ -1742,17 +1802,27 @@ def _make_handler(server: S3Server):
                     if not isinstance(updates, dict):
                         raise ValueError("config must be an object")
                     cfg_mod.validate(updates)
+                except ValueError as e:
+                    raise S3Error("InvalidArgument", str(e)) from None
+                except cfg_mod.ConfigError as e:
+                    raise S3Error("InvalidArgument", str(e)) from None
+                try:
                     # Lock the read-modify-write so two concurrent
                     # set-configs cannot drop each other's keys. Hot
                     # apply reaches THIS node; peers pick the persisted
                     # document up at their next boot.
                     with server.bucket_meta_lock:
-                        cfg = cfg_mod.load_config(server.object_layer)
+                        prev = cfg_mod.load_config(server.object_layer)
+                        cfg = dict(prev)
                         cfg.update(updates)
-                        cfg_mod.save_config(server.object_layer, cfg)
-                    applied = cfg_mod.apply_config(server, cfg)
-                except (ValueError, cfg_mod.ConfigError) as e:
-                    raise S3Error("InvalidArgument", str(e)) from None
+                        cfg_mod.save_config(server.object_layer, cfg,
+                                            prev=prev)
+                except cfg_mod.ConfigError as e:
+                    # Persistence failure is a SERVICE error, not a bad
+                    # request.
+                    raise S3Error("InternalError", str(e)) from None
+                # Apply only what THIS request changed.
+                applied = cfg_mod.apply_config(server, updates)
                 return ok({"applied": applied})
 
             # Replication target management needs no IAM store.

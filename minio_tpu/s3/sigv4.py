@@ -295,7 +295,6 @@ def _v2_string_to_sign(method: str, path: str, query: dict,
 def _verify_v2(method: str, path: str, query: dict, headers: dict,
                secret_for) -> ParsedAuth:
     import base64
-    import urllib.parse as _up
     presigned = "Signature" in query
     if presigned:
         access = query.get("AWSAccessKeyId", [""])[0]
@@ -331,8 +330,9 @@ def _verify_v2(method: str, path: str, query: dict, headers: dict,
     secret = secret_for(access)
     if secret is None:
         raise SigError("InvalidAccessKeyId", access)
-    sts = _v2_string_to_sign(method, _up.unquote(path), query, headers,
-                             expires)
+    # The RAW (still percent-encoded) request path is what V2 clients
+    # sign — never a decoded re-rendering of it.
+    sts = _v2_string_to_sign(method, path, query, headers, expires)
     want = base64.b64encode(hmac.new(secret.encode(), sts.encode("utf-8"),
                                      hashlib.sha1).digest()).decode()
     if not hmac.compare_digest(want, signature):

@@ -129,3 +129,17 @@ def test_readiness_fails_below_quorum(tmp_path):
         assert st == 503
     finally:
         server.stop()
+
+
+def test_admin_speedtest(env, cli):
+    srv, es, roots = env
+    st, _, body = cli.request("POST", "/minio/admin/v3/speedtest",
+                              query={"size": str(256 * 1024),
+                                     "count": "4"})
+    assert st == 200, body
+    r = json.loads(body)
+    assert r["objects"] == 4 and r["object_size"] == 256 * 1024
+    assert r["put_mibps"] > 0 and r["get_mibps"] > 0
+    # Synthetic bucket cleaned up.
+    st, _, body = cli.request("GET", "/")
+    assert b"speedtest" not in body
