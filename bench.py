@@ -15,12 +15,17 @@ encoding, so 25 GiB/s overstates its combined rate — using it anyway
 keeps vs_baseline conservative. vs_baseline > 1 means the TPU pipeline
 beats the AVX512 encode stage alone.
 
-The measured pipeline produces, on device, the exact framed
-`digest || block` shard-file bytes the storage layer writes
+The measured pipeline produces, on device, the Reed-Solomon parity and
+the per-block HighwayHash-256S bitrot digests the storage layer writes
 (byte-identical to the host path — tests/test_hh_device.py), via:
-u32-lane Reed-Solomon (ops/rs_device.make_encoder32), the Pallas
-HighwayHash kernel with its stream-minor transpose (ops/hh_device),
-and the Pallas framing kernel. No XLA copies on the path.
+u32-lane Reed-Solomon (ops/rs_device.make_encoder32) and the Pallas
+HighwayHash kernel with its in-VMEM transpose (ops/hh_device). The
+on-disk `digest || block` frame is assembled by the shard writers from
+these pieces at write time — exactly the reference's streaming bitrot
+writer shape (cmd/bitrot-streaming.go:44-75 writes hash, then block) —
+so no interleaved frame buffer exists on device or host. No XLA copies
+on the path. BATCH is 256 stripes so both stream sets tile exactly
+(data 2048 = 2x1024-stream tiles, parity 1024 = 1 tile).
 
 Methodology note: the axon tunnel acks dispatches asynchronously and a
 host readback costs ~150 ms, so per-call wall timing is useless. We
@@ -41,7 +46,7 @@ import numpy as np
 BASELINE_GIBPS = 25.0
 K, M = 8, 4
 BLOCK = 1 << 20            # reference blockSizeV2 (cmd/object-api-common.go:37)
-BATCH = 128                # stripes per device step
+BATCH = 256                # stripes per device step
 ITERS = 12
 
 
@@ -71,10 +76,11 @@ def main() -> None:
         @jax.jit
         def f(x_):
             def body(_, x):
-                fd, fp = step(x)
-                # Dependency chain: fold framed words back into the data
-                # so iterations cannot be elided or overlapped.
-                return x.at[0, 0, 0].set(fd[0, 0, 0] + fp[0, 0, 9])
+                parity, dig_d, dig_p = step(x)
+                # Dependency chain: fold outputs back into the data so
+                # iterations cannot be elided or overlapped.
+                return x.at[0, 0, 0].set(
+                    parity[0, 0, 0] + dig_d[0, 0, 0] + dig_p[0, 0, 0])
             x_ = jax.lax.fori_loop(0, niter, body, x_)
             return x_[0, 0, 0]
         return f

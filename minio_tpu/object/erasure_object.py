@@ -481,13 +481,14 @@ class ErasureSet:
             padded[:full] = stacked
             stacked = padded
         rows = _framer_for(k, m)(stacked)
-        frame_bytes = full * (bitrot.digest_size(bitrot.DEFAULT_ALGORITHM)
-                              + shard_size)
+        # rows[i] = per-block (digest, block) piece tuples; pad blocks
+        # are whole trailing tuples, so trimming is list slicing. The
+        # `hash || block` on-disk frame is assembled by the writer from
+        # the pieces (reference cmd/bitrot-streaming.go:44-75 likewise
+        # writes hash then block; no interleaved buffer ever exists).
         for i in range(n):
-            row = rows[i]
-            chunks[i].append(memoryview(row)[:frame_bytes]
-                             if row.shape[0] != frame_bytes
-                             else memoryview(row))
+            for pieces in rows[i][:full]:
+                chunks[i].extend(pieces)
         tail = total - full * BLOCK_SIZE
         if tail:
             tail_shards = e.split(data[full * BLOCK_SIZE:])

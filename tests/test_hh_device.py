@@ -15,8 +15,8 @@ import jax.numpy as jnp
 
 from minio_tpu.erasure.codec import Erasure
 from minio_tpu.ops import gf256
-from minio_tpu.ops.hh_device import (_init_smem_np, _init_state_np,
-                                     _pallas_frame, _pick_pchunk,
+from minio_tpu.ops.hh_device import (_hash_words_pallas, _init_smem_np,
+                                     _init_state_np, _pick_pchunk,
                                      hash_blocks_device, hash_blocks_pallas,
                                      make_encode_framer)
 from minio_tpu.storage import bitrot
@@ -67,19 +67,30 @@ def test_pallas_hash_matches_host(s, length):
     assert np.array_equal(want, got)
 
 
-def test_pallas_frame_layout():
-    """Framing kernel interleaves digest||block per drive correctly."""
-    b, x, l4 = 4, 3, 128
-    rng = np.random.default_rng(0)
-    shards = rng.integers(0, 2 ** 31, size=(b, x, l4), dtype=np.uint32)
-    digs = rng.integers(0, 2 ** 31, size=(b, x, 8), dtype=np.uint32)
-    out = np.asarray(_pallas_frame(jnp.asarray(shards), jnp.asarray(digs),
-                                   interpret=not _ON_TPU))
-    assert out.shape == (b, x, 8 + l4)
-    for bi in range(b):
-        for xi in range(x):
-            assert np.array_equal(out[bi, xi, :8], digs[bi, xi])
-            assert np.array_equal(out[bi, xi, 8:], shards[bi, xi])
+def _hash_words(words, pchunk):
+    """Run the natural-layout kernel (interpret off-TPU) -> [S, 32] u8."""
+    out = _hash_words_pallas(jnp.asarray(words),
+                             jnp.asarray(_init_smem_np(MAGIC_KEY)),
+                             pchunk=pchunk, interpret=not _ON_TPU)
+    return np.asarray(jax.lax.bitcast_convert_type(out, jnp.uint8)) \
+        .reshape(out.shape[0], 32)
+
+
+@pytest.mark.parametrize("shape,pchunk", [
+    ((130, 512 // 4), 16),        # 2-D fast path, stream padding
+    ((10, 8, 4096 // 4), 16),     # 3-D fast path (no reshape), padding
+    ((5, 4, 1024 // 4), 16),      # 3-D, X=4 (parity-shaped), padding
+])
+def test_hh_kernel_nt_matches_host(shape, pchunk):
+    """The transpose-fused natural-layout kernel (_hh_kernel_nt), both
+    2-D and 3-D block-spec variants, byte-identical to the host hash in
+    interpret mode — a TPU-only regression here must fail off-TPU too."""
+    rng = np.random.default_rng(sum(shape))
+    words = rng.integers(0, 2 ** 32, size=shape, dtype=np.uint32)
+    blocks = words.reshape(-1, shape[-1]).view(np.uint8)
+    want = highwayhash256_many(MAGIC_KEY, blocks)
+    got = _hash_words(words, pchunk)
+    assert np.array_equal(want, got)
 
 
 # ---------------------------------------------------------------------------
@@ -105,6 +116,11 @@ _FRAMER_CONFIGS = [(4, 2, 3, 512), (8, 4, 2, 1024)] if _ON_TPU \
     else [(4, 2, 3, 512)]
 
 
+def _join_pieces(row) -> bytes:
+    """row = per-block (digest, block) piece tuples -> the framed file."""
+    return b"".join(bytes(p) for pieces in row for p in pieces)
+
+
 @pytest.mark.parametrize("k,m,b,l", _FRAMER_CONFIGS)
 def test_framer_matches_host_bitrot(k, m, b, l):
     rng = np.random.default_rng(k * m)
@@ -114,13 +130,14 @@ def test_framer_matches_host_bitrot(k, m, b, l):
     want = _host_framed(data, k, m)
     assert len(rows) == k + m
     for i in range(k + m):
-        assert rows[i].tobytes() == want[i], f"drive {i} differs"
+        assert len(rows[i]) == b
+        assert _join_pieces(rows[i]) == want[i], f"drive {i} differs"
 
 
 @pytest.mark.skipif(not _ON_TPU, reason="compiled u32 pipeline needs TPU")
 def test_framer_u32_pipeline_on_tpu():
-    """The full u32 Pallas pipeline (encode32 + hash + frame) on real
-    hardware, eligible shape, including stream padding."""
+    """The full u32 Pallas pipeline (encode32 + hash) on real hardware,
+    eligible shape, including stream padding."""
     k, m = 8, 4
     rng = np.random.default_rng(5)
     data = rng.integers(0, 256, size=(10, k, 4096), dtype=np.uint8)
@@ -128,4 +145,4 @@ def test_framer_u32_pipeline_on_tpu():
     rows = framer(data)
     want = _host_framed(data, k, m)
     for i in range(k + m):
-        assert rows[i].tobytes() == want[i], f"drive {i} differs"
+        assert _join_pieces(rows[i]) == want[i], f"drive {i} differs"
