@@ -58,7 +58,10 @@ STAGING_PREFIX = "staging"
 STREAM_WINDOW_BLOCKS = 32
 STREAM_THRESHOLD = STREAM_WINDOW_BLOCKS * BLOCK_SIZE
 # Streamed GETs decode and yield this many plaintext bytes per step.
-GET_WINDOW_BYTES = 16 << 20
+# 32 MiB = 32 erasure blocks: at EC:4 that is k*32 = 256 shard blocks
+# per window, enough streams for device-batched bitrot verification
+# (ops/hh_device.framed_digests_eligible).
+GET_WINDOW_BYTES = 32 << 20
 
 _RESERVED_BUCKETS = {SYS_VOL}
 
@@ -947,8 +950,8 @@ class ErasureSet:
                 continue
             holders[dfi.erasure.index - 1] = disk_idx
 
-        def fetch(shard_idx: int) -> Optional[np.ndarray]:
-            """Verified data bytes of this shard for the block window."""
+        def fetch_raw(shard_idx: int):
+            """Raw framed bytes of this shard's block window (no verify)."""
             disk_idx = holders.get(shard_idx)
             if disk_idx is None:
                 return None
@@ -961,29 +964,37 @@ class ErasureSet:
                         blob = d.read_version(bucket, object_,
                                               fi.version_id,
                                               read_data=True).inline_data or b""
-                    blob = blob[framed_lo:framed_hi]
-                else:
-                    blob = d.read_file(
-                        bucket, f"{object_}/{fi.data_dir}/{part_file}",
-                        offset=framed_lo, length=framed_hi - framed_lo)
-                reader = bitrot.FramedShardReader(blob, shard_size, win_len)
-                blocks = [reader.block(b)
-                          for b in range(ceil_frac(win_len, shard_size))]
-                return np.concatenate(blocks) if blocks else \
-                    np.zeros(0, dtype=np.uint8)
+                    return blob[framed_lo:framed_hi]
+                return d.read_file(
+                    bucket, f"{object_}/{fi.data_dir}/{part_file}",
+                    offset=framed_lo, length=framed_hi - framed_lo)
             except Exception:  # noqa: BLE001 - bad shard == missing shard
                 return None
 
+        # Bitrot verification batches across shards AND blocks — on the
+        # device when this set runs the TPU backend and the window is
+        # big enough to fill vector tiles, vectorized-host otherwise
+        # (read-side counterpart of the fused PUT pipeline; the
+        # reference hashes per block in ReadAt,
+        # cmd/bitrot-streaming.go:161-200).
+        use_device = _on_tpu() and hasattr(self.backend,
+                                           "apply_matrix_device")
+
+        def verify(blobs):
+            return bitrot.read_framed_blocks_many(
+                blobs, shard_size, win_len, device=use_device)
+
         # Read data shards first; hedge with parity shards for failures.
         shards: list[Optional[np.ndarray]] = [None] * n
-        results, _ = self._fanout([lambda s=s: fetch(s) for s in range(k)])
-        for s, r in enumerate(results):
+        results, _ = self._fanout([lambda s=s: fetch_raw(s)
+                                   for s in range(k)])
+        for s, r in enumerate(verify(results)):
             shards[s] = r
         missing = [s for s in range(k) if shards[s] is None]
         if missing:
-            extra, _ = self._fanout([lambda s=s: fetch(s)
+            extra, _ = self._fanout([lambda s=s: fetch_raw(s)
                                      for s in range(k, n)])
-            for j, r in enumerate(extra):
+            for j, r in enumerate(verify(extra)):
                 shards[k + j] = r
             available = sum(1 for s in shards if s is not None)
             if available < k:

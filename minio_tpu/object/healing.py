@@ -141,6 +141,8 @@ def _heal_object_locked(es, bucket: str, object_: str, version_id: str,
     part_shards: list[list[Optional[np.ndarray]]] = \
         [[None] * (k + m) for _ in parts]
 
+    use_device = hasattr(es.backend, "apply_matrix_device")
+
     def load_all_parts(disk_idx: int) -> Optional[list[np.ndarray]]:
         d = es.disks[disk_idx]
         dfi = fis[disk_idx]
@@ -148,16 +150,20 @@ def _heal_object_locked(es, bucket: str, object_: str, version_id: str,
         try:
             for p in parts:
                 plen = e.shard_file_size(p.size)
-                nblocks = ceil_frac(plen, shard_size) if plen else 0
                 if inline:
                     blob = dfi.inline_data or b""
                 else:
                     blob = d.read_file(
                         bucket, f"{object_}/{fi.data_dir}/part.{p.number}")
-                reader = bitrot.FramedShardReader(blob, shard_size, plen)
-                chunks = [reader.block(b) for b in range(nblocks)]
-                out.append(np.concatenate(chunks) if chunks
-                           else np.zeros(0, np.uint8))
+                # Batched bitrot verify: all of this shard file's blocks
+                # hash in one pass (device when the set runs the TPU
+                # backend and the file is large enough — deep heal reads
+                # whole shard files, the best-case batch).
+                arr, = bitrot.read_framed_blocks_many(
+                    [blob], shard_size, plen, device=use_device)
+                if arr is None:
+                    return None
+                out.append(arr)
             return out
         except Exception:  # noqa: BLE001 - treat as corrupt
             return None

@@ -636,6 +636,94 @@ def hash_blocks_device(key: bytes, blocks, mode: str = "auto") -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# Device digests of bitrot-framed shard windows (the GET/heal read path)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("pchunk", "interpret"))
+def _framed_digests_jit(blobs, init, pchunk: int, interpret: bool = False):
+    """blobs: tuple of u32 [nb_i, fw] framed-frame arrays (fw = 8 digest
+    words + block words). One concat + slice on device (HBM-speed), then
+    the Pallas hash over all blocks as one stream set."""
+    stacked = jnp.concatenate(blobs, axis=0) if len(blobs) > 1 else blobs[0]
+    words = stacked[:, 8:]
+    return _hash_words_pallas(words, init, pchunk, interpret=interpret)
+
+
+# Device rows per hash dispatch: exactly one 1024-stream tile. Bounds
+# HBM use per call (~3x 128 MiB at 128 KiB blocks) so multi-GiB heal
+# reads can never OOM the chip, and keeps the jit cache to a handful of
+# keys per frame width: (1024, fw) for full chunks plus (pad, fw) with
+# pad a multiple of _FRAMED_PAD for the combined remainder.
+_FRAMED_CHUNK = 1024
+_FRAMED_PAD = 256
+
+
+def framed_digests_device(blobs: list[np.ndarray],
+                          interpret: bool = False) -> np.ndarray:
+    """HighwayHash-256 digests of every framed block across shard blobs.
+
+    blobs: u32 arrays [nb_i, fw], each row one on-disk frame
+    (`digest || block`, reference cmd/bitrot-streaming.go:44-75) — pass
+    zero-copy views of the raw shard-file bytes. Returns uint8
+    [sum(nb_i), 32] recomputed digests of the block payloads, hashed on
+    device in batched kernel passes (the read-side counterpart of the
+    fused PUT pipeline: GETs dominate object-store traffic, so per-block
+    host hashing is the wrong place to spend CPU).
+
+    Dispatch shape discipline: whole _FRAMED_CHUNK-row slices of each
+    blob go to the device as zero-copy views; the sub-chunk remainders
+    of all blobs are packed into ONE host-padded array (rounded up to a
+    _FRAMED_PAD multiple — pad rows hash garbage, sliced off). Every
+    compiled shape is therefore from a small fixed set, not one per
+    distinct shard-file size."""
+    fw = blobs[0].shape[1]
+    w = fw - 8
+    pchunk = _pick_pchunk(w // 8)
+    init = jnp.asarray(_init_smem_np(MAGIC_KEY))
+    parts: list[tuple[int, int, np.ndarray]] = []  # (out_off, rows, view)
+    rem: list[tuple[int, np.ndarray]] = []         # (out_off, view)
+    off = 0
+    for b in blobs:
+        nb = b.shape[0]
+        whole = (nb // _FRAMED_CHUNK) * _FRAMED_CHUNK
+        for lo in range(0, whole, _FRAMED_CHUNK):
+            parts.append((off + lo, _FRAMED_CHUNK,
+                          b[lo:lo + _FRAMED_CHUNK]))
+        if whole < nb:
+            rem.append((off + whole, b[whole:]))
+        off += nb
+    out = np.empty((off, 32), dtype=np.uint8)
+    for out_off, rows, view in parts:
+        d = _framed_digests_jit((jnp.asarray(view),), init, pchunk,
+                                interpret=interpret)
+        out[out_off:out_off + rows] = \
+            np.ascontiguousarray(np.asarray(d)).view(np.uint8)
+    if rem:
+        total = sum(v.shape[0] for _, v in rem)
+        pad = -(-total // _FRAMED_PAD) * _FRAMED_PAD
+        packed = np.zeros((pad, fw), dtype=np.uint32)
+        pos = 0
+        for _, v in rem:
+            packed[pos:pos + v.shape[0]] = v
+            pos += v.shape[0]
+        d = np.ascontiguousarray(np.asarray(_framed_digests_jit(
+            (jnp.asarray(packed),), init, pchunk,
+            interpret=interpret))).view(np.uint8)
+        pos = 0
+        for out_off, v in rem:
+            out[out_off:out_off + v.shape[0]] = d[pos:pos + v.shape[0]]
+            pos += v.shape[0]
+    return out                                    # [S, 32]
+
+
+def framed_digests_eligible(n_blocks: int, shard_size: int) -> bool:
+    """Worth dispatching to the device: enough streams to fill vector
+    tiles and a whole-packet block length."""
+    return (jax.default_backend() == "tpu" and shard_size % 1024 == 0
+            and n_blocks >= 256 and _pick_pchunk(shard_size // 4 // 8) >= 8)
+
+
+# ---------------------------------------------------------------------------
 # Fused encode + bitrot digests
 # ---------------------------------------------------------------------------
 

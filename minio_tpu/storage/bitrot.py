@@ -195,6 +195,102 @@ def verify_framed_shard(blob: bytes, shard_size: int, data_size: int,
         r.block(i)
 
 
+def read_framed_blocks_many(blobs, shard_size: int, data_size: int,
+                            algorithm: str = DEFAULT_ALGORITHM,
+                            device: bool = False):
+    """Batched verified reads of same-shape framed shard blobs.
+
+    blobs: sequence of bytes-like or None (a missing shard). Returns a
+    list with, per blob, the verified un-framed data (uint8 [data_size])
+    or None if the entry was None, malformed, or failed digest
+    verification. This is the GET/heal hot path: instead of the
+    reference's per-block ReadAt hashing (cmd/bitrot-streaming.go:
+    161-200), ALL full blocks across all shards hash in one batch — on
+    the TPU (ops/hh_device.framed_digests_device) when `device` is set
+    and the batch is big enough, else in the vectorized lockstep host
+    core. Ragged tail blocks hash per blob.
+    """
+    n_items = len(blobs)
+    hsize = digest_size(algorithm)
+    frame = hsize + shard_size
+    nb = (data_size + shard_size - 1) // shard_size
+    if nb == 0:
+        return [np.zeros(0, dtype=np.uint8) if b is not None else None
+                for b in blobs]
+    tail = data_size - (nb - 1) * shard_size
+    full = nb if tail == shard_size else nb - 1
+    if tail == shard_size:
+        tail = 0
+    # Exact framed geometry for ANY algorithm (one digest per block) —
+    # a truncated or padded blob must demote to a missing shard here,
+    # never raise out of the batch.
+    expect = full * frame + ((hsize + tail) if tail else 0)
+
+    arrs: list = [None] * n_items
+    for i, blob in enumerate(blobs):
+        if blob is None or len(blob) != expect:
+            continue
+        arrs[i] = np.frombuffer(blob, dtype=np.uint8)
+    oks = [i for i in range(n_items) if arrs[i] is not None]
+    if not oks:
+        return [None] * n_items
+
+    bad = set()
+    if full:
+        wants = {i: arrs[i][:full * frame].reshape(full, frame)[:, :hsize]
+                 for i in oks}
+        blockv = {i: arrs[i][:full * frame].reshape(full, frame)[:, hsize:]
+                  for i in oks}
+        use_dev = (device and algorithm == HIGHWAYHASH256S
+                   and frame % 4 == 0)
+        got_dev = None
+        if use_dev:
+            from minio_tpu.ops import hh_device
+            if hh_device.framed_digests_eligible(full * len(oks),
+                                                 shard_size):
+                u32 = [arrs[i][:full * frame].view(np.uint32)
+                       .reshape(full, frame // 4) for i in oks]
+                try:
+                    got_dev = hh_device.framed_digests_device(u32) \
+                        .reshape(len(oks), full, hsize)
+                except Exception:  # noqa: BLE001 - device trouble is not
+                    got_dev = None  # corruption; fall back to host hashing
+        if got_dev is not None:
+            for j, i in enumerate(oks):
+                if not np.array_equal(got_dev[j], wants[i]):
+                    bad.add(i)
+        else:
+            for i in oks:
+                got = hash_blocks_many(algorithm,
+                                       np.ascontiguousarray(blockv[i]))
+                if not np.array_equal(got, wants[i]):
+                    bad.add(i)
+    if tail:
+        off = full * frame
+        for i in oks:
+            if i in bad:
+                continue
+            want = arrs[i][off:off + hsize].tobytes()
+            data = arrs[i][off + hsize:off + hsize + tail]
+            if len(want) < hsize or data.shape[0] < tail or \
+                    hash_block(algorithm, data) != want:
+                bad.add(i)
+
+    out: list = [None] * n_items
+    for i in oks:
+        if i in bad:
+            continue
+        data = np.empty(data_size, dtype=np.uint8)
+        if full:
+            data[:full * shard_size].reshape(full, shard_size)[:] = \
+                arrs[i][:full * frame].reshape(full, frame)[:, hsize:]
+        if tail:
+            off = full * frame
+            data[full * shard_size:] = arrs[i][off + hsize:off + hsize + tail]
+        out[i] = data
+    return out
+
+
 class SelfTestError(Exception):
     """A bitrot digest differs from the reference. Fatal at boot."""
 

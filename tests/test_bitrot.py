@@ -50,3 +50,68 @@ def test_non_highway_algorithms_are_stdlib():
     assert bitrot.hash_block(bitrot.SHA256, data) == hashlib.sha256(data).digest()
     assert bitrot.hash_block(bitrot.BLAKE2B512, data) == \
         hashlib.blake2b(data, digest_size=64).digest()
+
+
+# ---------------------------------------------------------------------------
+# Batched verified reads (the GET/heal read path)
+# ---------------------------------------------------------------------------
+
+def _framed(shard: np.ndarray, shard_size: int) -> bytes:
+    return bitrot.frame_shard(shard, shard_size)
+
+
+@pytest.mark.parametrize("data_size,shard_size", [
+    (4 * 512, 512),          # exact blocks
+    (4 * 512 + 100, 512),    # ragged tail
+    (100, 512),              # single short block
+    (0, 512),                # empty
+])
+def test_read_framed_blocks_many_roundtrip(data_size, shard_size):
+    rng = np.random.default_rng(data_size)
+    shards = [rng.integers(0, 256, size=data_size, dtype=np.uint8)
+              for _ in range(5)]
+    blobs = [_framed(s, shard_size) for s in shards]
+    blobs[2] = None                       # missing shard passes through
+    out = bitrot.read_framed_blocks_many(blobs, shard_size, data_size)
+    assert out[2] is None
+    for i in (0, 1, 3, 4):
+        assert out[i] is not None
+        assert np.array_equal(out[i], shards[i])
+
+
+def test_read_framed_blocks_many_detects_corruption():
+    shard_size, data_size = 512, 4 * 512 + 77
+    rng = np.random.default_rng(7)
+    shards = [rng.integers(0, 256, size=data_size, dtype=np.uint8)
+              for _ in range(4)]
+    blobs = [bytearray(_framed(s, shard_size)) for s in shards]
+    blobs[1][700] ^= 0xFF                 # corrupt a full-block byte
+    blobs[3][-1] ^= 0xFF                  # corrupt the ragged tail
+    out = bitrot.read_framed_blocks_many(
+        [bytes(b) for b in blobs], shard_size, data_size)
+    assert out[1] is None and out[3] is None
+    assert np.array_equal(out[0], shards[0])
+    assert np.array_equal(out[2], shards[2])
+
+
+def test_read_framed_blocks_many_rejects_wrong_size():
+    shard_size, data_size = 512, 3 * 512
+    rng = np.random.default_rng(3)
+    s = rng.integers(0, 256, size=data_size, dtype=np.uint8)
+    blob = _framed(s, shard_size)
+    out = bitrot.read_framed_blocks_many(
+        [blob[:-1], blob + b"x", blob], shard_size, data_size)
+    assert out[0] is None and out[1] is None
+    assert np.array_equal(out[2], s)
+
+
+def test_read_framed_blocks_many_matches_reader():
+    """Batch output byte-identical to the per-block FramedShardReader."""
+    shard_size, data_size = 256, 5 * 256 + 13
+    rng = np.random.default_rng(11)
+    s = rng.integers(0, 256, size=data_size, dtype=np.uint8)
+    blob = _framed(s, shard_size)
+    batch, = bitrot.read_framed_blocks_many([blob], shard_size, data_size)
+    r = bitrot.FramedShardReader(blob, shard_size, data_size)
+    blocks = [r.block(i) for i in range(6)]
+    assert np.array_equal(batch, np.concatenate(blocks))

@@ -146,3 +146,20 @@ def test_framer_u32_pipeline_on_tpu():
     want = _host_framed(data, k, m)
     for i in range(k + m):
         assert _join_pieces(rows[i]) == want[i], f"drive {i} differs"
+
+
+def test_framed_digests_device_matches_host():
+    """Read-path device digests of framed shard windows == host hashes
+    (interpret off-TPU). Frame layout: `digest || block` per row."""
+    from minio_tpu.ops.hh_device import framed_digests_device
+    shard_size = 1024
+    rng = np.random.default_rng(21)
+    blobs, want = [], []
+    for nb in (3, 5):
+        blocks = rng.integers(0, 256, size=(nb, shard_size), dtype=np.uint8)
+        digs = highwayhash256_many(MAGIC_KEY, blocks)
+        framed = np.concatenate([digs, blocks], axis=1)   # [nb, 32+ss]
+        blobs.append(np.ascontiguousarray(framed).view(np.uint32))
+        want.append(digs)
+    got = framed_digests_device(blobs, interpret=not _ON_TPU)
+    assert np.array_equal(got, np.concatenate(want, axis=0))
