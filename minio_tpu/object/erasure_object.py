@@ -63,6 +63,10 @@ STREAM_THRESHOLD = STREAM_WINDOW_BLOCKS * BLOCK_SIZE
 # (ops/hh_device.framed_digests_eligible).
 GET_WINDOW_BYTES = 32 << 20
 
+# PUTs below this many full erasure blocks encode on the host codec
+# even when the set runs the TPU backend (see _encode_and_frame).
+MIN_DEVICE_BLOCKS = 8
+
 _RESERVED_BUCKETS = {SYS_VOL}
 
 
@@ -436,7 +440,8 @@ class ErasureSet:
             return np.zeros((stacked.shape[0], 0, stacked.shape[2]), np.uint8)
         pm = _parity_matrix(e.data_blocks, e.parity_blocks)
         be = e.backend
-        if hasattr(be, "apply_matrix_device"):
+        cutover = getattr(be, "HOST_CUTOVER_BYTES", 0)
+        if hasattr(be, "apply_matrix_device") and stacked.nbytes >= cutover:
             import jax.numpy as jnp
             out = be.apply_matrix_device(pm, jnp.asarray(stacked))
             return np.asarray(out)
@@ -470,7 +475,12 @@ class ErasureSet:
         # only when this set was explicitly configured with a device
         # backend (server --ec-backend tpu/auto), so host/mock backends
         # see every encode, same as the tail path below.
-        use_device = (full > 0 and m > 0 and _on_tpu()
+        # Small PUTs stay on the host codec: a sub-batch dispatch cannot
+        # fill the device's 1024-stream vector tiles and pays the full
+        # host<->device round-trip latency for one object — the same
+        # reason the reference keeps small IO on the calling goroutine.
+        # 8 blocks * k shards is the point where batching starts to win.
+        use_device = (full >= MIN_DEVICE_BLOCKS and m > 0 and _on_tpu()
                       and hasattr(self.backend, "apply_matrix_device")
                       and BLOCK_SIZE % k == 0 and shard_size % 1024 == 0)
         if not use_device:

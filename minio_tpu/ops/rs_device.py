@@ -369,13 +369,15 @@ class DeviceBackend:
     einsum path), or "auto" (pallas on TPU, xla elsewhere).
     """
 
-    def __init__(self, mode: str = "auto"):
+    def __init__(self, mode: str = "auto", host_cutover: int | None = None):
         if mode not in ("auto", "pallas", "xla"):
             raise ValueError(f"unknown mode {mode!r}")
         if mode == "auto":
             mode = "pallas" if _on_tpu() else "xla"
         self.mode = mode
         self._interpret = mode == "pallas" and not _on_tpu()
+        if host_cutover is not None:
+            self.HOST_CUTOVER_BYTES = host_cutover
 
     # -- device-array API (stays on device; used by batched/jit callers) ----
 
@@ -428,8 +430,21 @@ class DeviceBackend:
 
     # -- ECBackend protocol (numpy in / numpy out) --------------------------
 
+    # Below this many input bytes a host->device->host round trip costs
+    # more than the transform itself (and the batch cannot fill the
+    # kernel's vector tiles): small PUT/GET/reconstruct calls run the
+    # host GF core instead, keeping p50 latency of 1 MiB objects at
+    # host-codec level while large batches ride the MXU.
+    HOST_CUTOVER_BYTES = 8 << 20
+
     def apply_matrix(self, matrix: np.ndarray, shards: np.ndarray) -> np.ndarray:
         shards = np.ascontiguousarray(shards, dtype=np.uint8)
+        if shards.nbytes < self.HOST_CUTOVER_BYTES:
+            # Same host core the pure-host codec uses (native C++ nibble
+            # kernel when built) — small objects must not regress vs the
+            # host backend.
+            from minio_tpu.erasure.codec import _HOST
+            return _HOST.apply_matrix(matrix, shards)
         out = self.apply_matrix_device(matrix, jnp.asarray(shards[None]))
         return np.asarray(jax.device_get(out))[0]
 

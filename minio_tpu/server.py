@@ -85,6 +85,14 @@ def main(argv=None) -> int:
             print(f"ec-backend auto-detect: no TPU ({type(e).__name__}: {e}); "
                   "using host GF kernels", file=sys.stderr)
             backend = None
+    if backend is not None:
+        # Boot gate for the DEVICE kernels too: the golden-vector sweep
+        # with the host cutover disabled, so the Pallas/XLA GF path that
+        # large PUTs will actually run is what gets verified (the plain
+        # erasure_self_test above covers the host core only — its
+        # 256-byte vectors are all below HOST_CUTOVER_BYTES).
+        from minio_tpu.ops.rs_device import DeviceBackend
+        erasure_self_test(DeviceBackend(host_cutover=0))
 
     from minio_tpu.object.erasure_object import ErasureSet
     from minio_tpu.object.pools import ServerPools

@@ -260,20 +260,24 @@ def read_framed_blocks_many(blobs, shard_size: int, data_size: int,
                 if not np.array_equal(got_dev[j], wants[i]):
                     bad.add(i)
         else:
-            for i in oks:
-                got = hash_blocks_many(algorithm,
-                                       np.ascontiguousarray(blockv[i]))
-                if not np.array_equal(got, wants[i]):
+            # One vectorized lockstep pass over ALL shards' full blocks.
+            stacked = np.concatenate([blockv[i] for i in oks]) \
+                if len(oks) > 1 else np.ascontiguousarray(blockv[oks[0]])
+            got = hash_blocks_many(algorithm, stacked) \
+                .reshape(len(oks), full, hsize)
+            for j, i in enumerate(oks):
+                if not np.array_equal(got[j], wants[i]):
                     bad.add(i)
     if tail:
         off = full * frame
         for i in oks:
             if i in bad:
                 continue
+            # Exact blob length was already enforced above, so the tail
+            # frame is complete — only the digest can disagree.
             want = arrs[i][off:off + hsize].tobytes()
             data = arrs[i][off + hsize:off + hsize + tail]
-            if len(want) < hsize or data.shape[0] < tail or \
-                    hash_block(algorithm, data) != want:
+            if hash_block(algorithm, data) != want:
                 bad.add(i)
 
     out: list = [None] * n_items

@@ -163,3 +163,26 @@ def test_framed_digests_device_matches_host():
         want.append(digs)
     got = framed_digests_device(blobs, interpret=not _ON_TPU)
     assert np.array_equal(got, np.concatenate(want, axis=0))
+
+
+def test_framed_digests_device_chunked(monkeypatch):
+    """The whole-chunk dispatch path and its output-offset bookkeeping
+    (framed_digests_device splits blobs into _FRAMED_CHUNK-row device
+    calls + one padded remainder): shrink the chunk constants so tiny
+    interpret-mode shapes exercise chunk slicing, multi-chunk blobs, and
+    chunk/remainder mixing."""
+    from minio_tpu.ops import hh_device
+    monkeypatch.setattr(hh_device, "_FRAMED_CHUNK", 4)
+    monkeypatch.setattr(hh_device, "_FRAMED_PAD", 2)
+    shard_size = 1024
+    rng = np.random.default_rng(33)
+    blobs, want = [], []
+    for nb in (9, 4, 3):    # 2 chunks + rem 1; 1 chunk exactly; rem only
+        blocks = rng.integers(0, 256, size=(nb, shard_size), dtype=np.uint8)
+        digs = highwayhash256_many(MAGIC_KEY, blocks)
+        framed = np.ascontiguousarray(
+            np.concatenate([digs, blocks], axis=1))
+        blobs.append(framed.view(np.uint32))
+        want.append(digs)
+    got = hh_device.framed_digests_device(blobs, interpret=not _ON_TPU)
+    assert np.array_equal(got, np.concatenate(want, axis=0))

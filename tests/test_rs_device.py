@@ -25,7 +25,9 @@ except Exception:
 
 @pytest.fixture(scope="module", params=["xla", "pallas"])
 def backend(request):
-    return DeviceBackend(mode=request.param)
+    # host_cutover=0: these tests exist to exercise the DEVICE kernels;
+    # the production small-input host reroute would make them vacuous.
+    return DeviceBackend(mode=request.param, host_cutover=0)
 
 
 def _skip_slow_interpret(backend, heavy: bool):
