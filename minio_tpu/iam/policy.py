@@ -4,6 +4,12 @@ Mirrors the reference's policy semantics (internal/policy/policy.go):
 a document is a list of statements, each Allow or Deny over wildcarded
 Actions and Resources; an explicit Deny always wins, absence of an
 Allow denies. Wildcards are AWS-style (`*` any run, `?` one char).
+
+Statements may carry Condition blocks (internal/policy/condition/) —
+operator -> {key -> values} — evaluated against a per-request context
+(aws:SourceIp, s3:prefix, ...), and, for bucket policies, a Principal
+(internal/policy/statement.go) matched against the requesting access
+key, with "*" covering anonymous requests.
 """
 
 from __future__ import annotations
@@ -11,11 +17,15 @@ from __future__ import annotations
 import dataclasses
 import fnmatch
 import functools
+import ipaddress
 import json
 import re
-from typing import Sequence
+from typing import Optional, Sequence
 
 ARN_PREFIX = "arn:aws:s3:::"
+
+# Principal value meaning "everyone, including anonymous".
+ANY_PRINCIPAL = "*"
 
 
 class PolicyError(Exception):
@@ -26,11 +36,93 @@ def _compile(pattern: str) -> re.Pattern:
     return re.compile(fnmatch.translate(pattern))
 
 
+def _str_values(v) -> list[str]:
+    """Condition values normalized to strings (JSON allows bool/number)."""
+    vals = v if isinstance(v, list) else [v]
+    out = []
+    for x in vals:
+        if isinstance(x, bool):
+            out.append("true" if x else "false")
+        else:
+            out.append(str(x))
+    return out
+
+
+def _cond_op(op: str, ctx_vals: list[str], want: list[str]) -> bool:
+    """One condition operator over the request's values for a key.
+
+    `ctx_vals` empty means the key is absent from the request: positive
+    operators fail, negated ones pass (AWS "if the key is not present,
+    the condition is not met / is met" semantics; the reference encodes
+    the same in each condition function's evaluate())."""
+    negated = op.startswith("StringNot") or op == "NotIpAddress" or \
+        op.startswith("NumericNot")
+    if not ctx_vals:
+        return negated
+    if op in ("StringEquals", "StringNotEquals"):
+        hit = any(c in want for c in ctx_vals)
+    elif op in ("StringEqualsIgnoreCase", "StringNotEqualsIgnoreCase"):
+        wl = [w.lower() for w in want]
+        hit = any(c.lower() in wl for c in ctx_vals)
+    elif op in ("StringLike", "StringNotLike"):
+        pats = [_compile(w) for w in want]
+        hit = any(p.match(c) for p in pats for c in ctx_vals)
+    elif op in ("IpAddress", "NotIpAddress"):
+        nets = []
+        for w in want:
+            try:
+                nets.append(ipaddress.ip_network(w, strict=False))
+            except ValueError:
+                continue
+        def _in(c):
+            try:
+                a = ipaddress.ip_address(c)
+            except ValueError:
+                return False
+            return any(a in n for n in nets)
+        hit = any(_in(c) for c in ctx_vals)
+    elif op == "Bool":
+        hit = any(c.lower() == w.lower() for w in want for c in ctx_vals)
+    elif op.startswith("Numeric"):
+        try:
+            cv = [float(c) for c in ctx_vals]
+            wv = [float(w) for w in want]
+        except ValueError:
+            return False
+        cmps = {"NumericEquals": lambda a, b: a == b,
+                "NumericNotEquals": lambda a, b: a == b,  # negated below
+                "NumericLessThan": lambda a, b: a < b,
+                "NumericLessThanEquals": lambda a, b: a <= b,
+                "NumericGreaterThan": lambda a, b: a > b,
+                "NumericGreaterThanEquals": lambda a, b: a >= b}
+        f = cmps.get(op)
+        if f is None:
+            return False
+        hit = any(f(a, b) for a in cv for b in wv)
+    else:
+        # Unknown operator: validated away at parse time; reaching here
+        # means an old stored doc — fail closed (see from_json).
+        return False
+    return not hit if negated else hit
+
+
+_KNOWN_OPS = {"StringEquals", "StringNotEquals", "StringEqualsIgnoreCase",
+              "StringNotEqualsIgnoreCase", "StringLike", "StringNotLike",
+              "IpAddress", "NotIpAddress", "Bool", "NumericEquals",
+              "NumericNotEquals", "NumericLessThan", "NumericLessThanEquals",
+              "NumericGreaterThan", "NumericGreaterThanEquals"}
+
+
 @dataclasses.dataclass
 class Statement:
     effect: str                 # "Allow" | "Deny"
     actions: list
     resources: list
+    # Condition: {operator: {key: [values]}}; empty = unconditional.
+    conditions: dict = dataclasses.field(default_factory=dict)
+    # Principal patterns (bucket policies); None = identity policy,
+    # applies to whomever it is attached to.
+    principals: Optional[list] = None
     _action_res: list = dataclasses.field(default_factory=list, repr=False)
     _resource_res: list = dataclasses.field(default_factory=list, repr=False)
 
@@ -39,14 +131,83 @@ class Statement:
             raise PolicyError(f"bad Effect {self.effect!r}")
         if not self.actions or not self.resources:
             raise PolicyError("statement needs Action and Resource")
+        if not isinstance(self.conditions, dict):
+            raise PolicyError("Condition must be an object")
+        for op, kv in self.conditions.items():
+            # ForAllValues:/ForAnyValue: qualifiers are accepted and
+            # treated as their base operator (our context keys are
+            # single-valued, where the two coincide).
+            base = op.split(":", 1)[-1]
+            if base not in _KNOWN_OPS:
+                raise PolicyError(f"unsupported condition operator {op!r}")
+            if not isinstance(kv, dict):
+                raise PolicyError("condition operator needs {key: values}")
+            # Values must be evaluable NOW: a CIDR or number that fails
+            # to parse at request time would make the condition never
+            # match, silently disarming any Deny it guards.
+            for vals in kv.values():
+                for v in _str_values(vals):
+                    if base in ("IpAddress", "NotIpAddress"):
+                        try:
+                            ipaddress.ip_network(v, strict=False)
+                        except ValueError:
+                            raise PolicyError(
+                                f"bad CIDR {v!r} in {op}") from None
+                    elif base.startswith("Numeric"):
+                        try:
+                            float(v)
+                        except ValueError:
+                            raise PolicyError(
+                                f"bad number {v!r} in {op}") from None
         self._action_res = [_compile(a) for a in self.actions]
         self._resource_res = [_compile(r[len(ARN_PREFIX):]
                                        if r.startswith(ARN_PREFIX) else r)
                               for r in self.resources]
 
-    def matches(self, action: str, resource: str) -> bool:
+    def conditions_met(self, context: Optional[dict]) -> bool:
+        if not self.conditions:
+            return True
+        ctx = {k.lower(): v for k, v in (context or {}).items()}
+        for op, kv in self.conditions.items():
+            base = op.split(":", 1)[-1]
+            for ckey, want in kv.items():
+                got = ctx.get(ckey.lower())
+                ctx_vals = [] if got is None else _str_values(got)
+                if not _cond_op(base, ctx_vals, _str_values(want)):
+                    return False
+        return True
+
+    def principal_matches(self, access_key: Optional[str]) -> bool:
+        """`access_key` None/"" = anonymous. Identity policies (no
+        Principal) match whoever they are attached to; bucket-policy
+        principals match "*" (everyone) or the key itself, accepting
+        both bare access keys and user-ARN forms the reference stores
+        (arn:aws:iam::...:user/<name>)."""
+        if self.principals is None:
+            return True
+        ak = access_key or ""
+        for p in self.principals:
+            if p == ANY_PRINCIPAL:
+                return True
+            if not ak:
+                continue
+            if p == ak or p.rpartition("/")[2] == ak:
+                return True
+        return False
+
+    def matches(self, action: str, resource: str,
+                context: Optional[dict] = None,
+                access_key: Optional[str] = None,
+                require_principal: bool = False) -> bool:
+        if require_principal and self.principals is None:
+            # Bucket-policy evaluation: a statement without a Principal
+            # is an identity-policy shape and must grant nobody there —
+            # matching everyone would silently make the bucket public.
+            return False
         return any(p.match(action) for p in self._action_res) and \
-            any(p.match(resource) for p in self._resource_res)
+            any(p.match(resource) for p in self._resource_res) and \
+            self.principal_matches(access_key) and \
+            self.conditions_met(context)
 
 
 @dataclasses.dataclass
@@ -62,6 +223,12 @@ class Policy:
             stmts = [stmts]
         out = []
         for s in stmts:
+            # Negated selectors are NOT supported: silently ignoring
+            # NotPrincipal would turn "everyone except X" into
+            # "everyone including X" — reject the document instead.
+            for neg in ("NotPrincipal", "NotAction", "NotResource"):
+                if neg in s:
+                    raise PolicyError(f"{neg} is not supported")
             actions = s.get("Action", [])
             resources = s.get("Resource", [])
             if isinstance(actions, str):
@@ -70,27 +237,68 @@ class Policy:
                 resources = [resources]
             out.append(Statement(effect=s.get("Effect", ""),
                                  actions=list(actions),
-                                 resources=list(resources)))
+                                 resources=list(resources),
+                                 conditions=s.get("Condition") or {},
+                                 principals=_parse_principal(
+                                     s.get("Principal"))))
         return cls(statements=out)
 
     def to_json(self) -> dict:
-        return {"Version": "2012-10-17",
-                "Statement": [{"Effect": s.effect, "Action": s.actions,
-                               "Resource": s.resources}
-                              for s in self.statements]}
+        out = []
+        for s in self.statements:
+            d = {"Effect": s.effect, "Action": s.actions,
+                 "Resource": s.resources}
+            if s.conditions:
+                d["Condition"] = s.conditions
+            if s.principals is not None:
+                d["Principal"] = {"AWS": s.principals}
+            out.append(d)
+        return {"Version": "2012-10-17", "Statement": out}
 
 
-def evaluate(policies: Sequence[Policy], action: str, resource: str) -> bool:
+def _parse_principal(p) -> Optional[list]:
+    """S3 Principal forms -> list of principal patterns, None if absent.
+    Accepts "*", {"AWS": "*"}, {"AWS": [...]}, {"CanonicalUser": ...}."""
+    if p is None:
+        return None
+    if isinstance(p, str):
+        return [p]
+    if isinstance(p, dict):
+        vals: list[str] = []
+        for v in p.values():
+            vals.extend(v if isinstance(v, list) else [v])
+        return vals
+    raise PolicyError("bad Principal")
+
+
+def evaluate(policies: Sequence[Policy], action: str, resource: str,
+             context: Optional[dict] = None,
+             access_key: Optional[str] = None) -> bool:
     """Explicit Deny wins; otherwise any Allow permits; default deny
     (reference: policy.Policy.IsAllowed)."""
+    return decide(policies, action, resource, context, access_key) == "Allow"
+
+
+def decide(policies: Sequence[Policy], action: str, resource: str,
+           context: Optional[dict] = None,
+           access_key: Optional[str] = None,
+           require_principal: bool = False) -> Optional[str]:
+    """Tri-state evaluation: "Deny" on an explicit matching Deny,
+    "Allow" on a matching Allow with no Deny, None when nothing
+    matches — so identity and bucket policies can be merged deny-wins
+    with 'neither said anything' distinguishable from 'allowed'
+    (reference: cmd/auth-handler.go isPutActionAllowed merging IAM and
+    policy decisions). `require_principal=True` is the bucket-policy
+    mode: statements without a Principal match nobody."""
     allowed = False
     for p in policies:
         for s in p.statements:
-            if s.matches(action, resource):
+            if s.matches(action, resource, context, access_key,
+                         require_principal):
                 if s.effect == "Deny":
-                    return False
+                    return "Deny"
                 allowed = True
-    return allowed
+    return "Allow" if allowed else None
 
 
 @functools.lru_cache(maxsize=4096)

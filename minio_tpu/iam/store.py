@@ -133,11 +133,23 @@ class IAMSys:
                     docs.append(canned[name])
             return docs
 
-    def is_allowed(self, access_key: str, action: str, resource: str) -> bool:
+    def is_allowed(self, access_key: str, action: str, resource: str,
+                   context: Optional[dict] = None) -> bool:
         if self.is_root(access_key):
             return True
         from minio_tpu.iam.policy import evaluate
-        return evaluate(self.policies_for(access_key), action, resource)
+        return evaluate(self.policies_for(access_key), action, resource,
+                        context)
+
+    def decide(self, access_key: str, action: str, resource: str,
+               context: Optional[dict] = None) -> Optional[str]:
+        """Tri-state identity decision ("Allow"/"Deny"/None) so callers
+        can merge with bucket policy (root short-circuits to Allow)."""
+        if self.is_root(access_key):
+            return "Allow"
+        from minio_tpu.iam.policy import decide
+        return decide(self.policies_for(access_key), action, resource,
+                      context)
 
     # -- management (root-only; enforcement is the admin handler's job) --
 

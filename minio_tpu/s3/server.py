@@ -53,6 +53,11 @@ def _el(parent, tag, text=None):
     return e
 
 
+# Sentinel: a bucket policy exists on disk but cannot be compiled; the
+# authorizer fails closed on it (distinct from None = no policy).
+_BAD_POLICY = object()
+
+
 class Credentials:
     """Root credentials + optional IAM store behind one resolver.
 
@@ -81,6 +86,17 @@ class Credentials:
         if self.iam is not None:
             return self.iam.is_allowed(access_key, action, resource)
         return False
+
+    def decide(self, access_key: str, action: str, resource: str,
+               context=None):
+        """Tri-state identity decision; without an IAM store every
+        non-root signed identity is unknown -> None (not Deny), so a
+        bucket policy may still grant it."""
+        if access_key == self.access_key:
+            return "Allow"
+        if self.iam is not None:
+            return self.iam.decide(access_key, action, resource, context)
+        return None
 
 
 class S3Server:
@@ -184,6 +200,79 @@ def _make_handler(server: S3Server):
             return sigv4.verify_request(
                 method, path, query, self._headers_lower(),
                 server.credentials.secret_for)
+
+        def _auth_context(self, access_key: str, query: dict,
+                          h: dict) -> dict:
+            """Condition-key context for policy evaluation (reference:
+            cmd/auth-handler.go getConditionValues). Keys are stored
+            lowercase; Statement.conditions_met folds case."""
+            ctx = {
+                "aws:sourceip": self.client_address[0]
+                if self.client_address else "",
+                "aws:securetransport": "false",
+                "aws:useragent": h.get("user-agent", ""),
+                "aws:referer": h.get("referer", ""),
+                "aws:username": access_key,
+                "aws:userid": access_key,
+            }
+            for qk in ("prefix", "delimiter", "max-keys", "versionId"):
+                v = query.get(qk, [""])[0]
+                if v:
+                    ctx[f"s3:{qk.lower()}"] = v
+            for hk, hv in h.items():
+                if hk.startswith("x-amz-"):
+                    ctx[f"s3:{hk}"] = hv
+            return ctx
+
+        def _bucket_policy(self, bucket: str):
+            """Compiled bucket policy, None when absent, or _BAD_POLICY
+            when a stored document fails to compile — the caller must
+            fail CLOSED on that (returning None would silently drop the
+            document's Deny statements)."""
+            if not bucket or bucket == "*":
+                return None
+            import json as _json
+            try:
+                stored = server.object_layer.get_bucket_meta(bucket).get(
+                    "config:policy")
+            except Exception:  # noqa: BLE001 - bucket missing / offline
+                return None
+            if not stored:
+                return None
+            try:
+                from minio_tpu.iam.policy import compile_policy
+                return compile_policy(_json.loads(stored))
+            except Exception:  # noqa: BLE001 - legacy/corrupt document
+                return _BAD_POLICY
+
+        def _authorize(self, ak: str, anonymous: bool, action: str,
+                       resource: str, ctx: dict) -> bool:
+            """Merge identity and bucket-policy decisions, deny-wins
+            (reference: cmd/auth-handler.go:433-449,758): root always
+            passes; anonymous requires an explicit bucket-policy Allow;
+            signed identities pass if either side allows and neither
+            explicitly denies."""
+            if ak == server.credentials.access_key:
+                return True
+            from minio_tpu.iam.policy import decide
+            bp = self._bucket_policy(resource.split("/", 1)[0])
+            if bp is _BAD_POLICY:
+                # A policy exists but cannot be evaluated: every
+                # non-owner request to the bucket is refused rather
+                # than guessing what it said.
+                return False
+            bp_decision = None if bp is None else decide(
+                [bp], action, resource, ctx,
+                ak if not anonymous else None, require_principal=True)
+            if bp_decision == "Deny":
+                return False
+            if anonymous:
+                return bp_decision == "Allow"
+            id_decision = server.credentials.decide(ak, action, resource,
+                                                    ctx)
+            if id_decision == "Deny":
+                return False
+            return id_decision == "Allow" or bp_decision == "Allow"
 
         def _make_payload(self, auth) -> Payload:
             """Sized streaming payload for object-data PUTs: the body is
@@ -341,19 +430,34 @@ def _make_handler(server: S3Server):
                 # the body is only hashed afterwards when the mode calls
                 # for it (streaming modes verify per chunk instead). The
                 # RAW request path is signed — never a re-encoding of it.
-                auth = self._auth(method, raw_path, query)
+                # Requests with no credentials at all are anonymous and
+                # authorized purely by bucket policy (reference:
+                # cmd/auth-handler.go:433-449 authTypeAnonymous ->
+                # globalPolicySys.IsAllowed).
+                h = self._headers_lower()
+                if "authorization" not in h \
+                        and "X-Amz-Signature" not in query \
+                        and "Signature" not in query:
+                    auth = sigv4.anonymous_auth()
+                else:
+                    auth = self._auth(method, raw_path, query)
                 self._auth_key = auth.credential.access_key
                 if raw_path == "/minio/admin" or \
                         raw_path.startswith("/minio/admin/"):
+                    if auth.anonymous:
+                        raise S3Error("AccessDenied")
                     return self._admin_op(method, raw_path, query, auth)
                 # Per-request policy authorization (reference:
                 # checkRequestAuthType -> IsAllowed): root passes, IAM
-                # identities evaluate their policy documents.
+                # identities evaluate their policies merged deny-wins
+                # with the bucket policy; anonymous identities need an
+                # explicit bucket-policy Allow.
                 ak = auth.credential.access_key
+                ctx = self._auth_context(ak, query, h)
                 for action, resource in _required_permissions(
-                        method, bucket, key, query, self._headers_lower()):
-                    if not server.credentials.is_allowed(ak, action,
-                                                         resource):
+                        method, bucket, key, query, h):
+                    if not self._authorize(ak, auth.anonymous, action,
+                                           resource, ctx):
                         raise S3Error("AccessDenied", bucket=bucket,
                                       key=key)
                 body = b""
@@ -451,6 +555,20 @@ def _make_handler(server: S3Server):
                 raise S3Error("MalformedPolicy") from None
             if not isinstance(doc, dict) or "Statement" not in doc:
                 raise S3Error("MalformedPolicy")
+            # Full compile: unsupported condition operators and bad
+            # principals are rejected HERE, not silently ignored at
+            # evaluation time (ignoring a condition would over-grant).
+            from minio_tpu.iam.policy import Policy, PolicyError
+            try:
+                pol = Policy.from_json(doc)
+            except PolicyError as e:
+                raise S3Error("MalformedPolicy", str(e)) from None
+            # Bucket policies are principal-scoped by definition; a
+            # statement without one is an identity-policy document
+            # pasted in the wrong place (AWS rejects these too).
+            if any(s.principals is None for s in pol.statements):
+                raise S3Error("MalformedPolicy",
+                              "bucket policy statements need a Principal")
 
         def _validate_xml_doc(self, body: bytes) -> None:
             try:
@@ -1479,22 +1597,31 @@ def _make_handler(server: S3Server):
             policy_b64 = fields.get("policy", "")
             sig = fields.get("x-amz-signature", "")
             cred_str = fields.get("x-amz-credential", "")
-            if not policy_b64 or not sig or not cred_str:
-                raise S3Error("AccessDenied")
-            cred = sigv4.Credential.parse(cred_str)
-            self._auth_key = cred.access_key   # audit/trace attribution
-            secret = server.credentials.secret_for(cred.access_key)
-            if secret is None:
-                raise S3Error("InvalidAccessKeyId")
-            skey = sigv4.signing_key(secret, cred.date, cred.region)
-            want = _hmac.new(skey, policy_b64.encode(),
-                             hashlib.sha256).hexdigest()
-            if not _hmac.compare_digest(want, sig):
-                raise S3Error("SignatureDoesNotMatch")
-            try:
-                pol = _json.loads(base64.b64decode(policy_b64))
-            except ValueError:
-                raise S3Error("MalformedPOSTRequest") from None
+            # A form with no credentials at all is an anonymous upload,
+            # authorized purely by bucket policy below (reference:
+            # cmd/post-policy.go treats a missing policy as anonymous).
+            anonymous = not policy_b64 and not sig and not cred_str
+            if anonymous:
+                access_key = ""
+                pol = {}
+            else:
+                if not policy_b64 or not sig or not cred_str:
+                    raise S3Error("AccessDenied")
+                cred = sigv4.Credential.parse(cred_str)
+                access_key = cred.access_key
+                self._auth_key = access_key   # audit/trace attribution
+                secret = server.credentials.secret_for(access_key)
+                if secret is None:
+                    raise S3Error("InvalidAccessKeyId")
+                skey = sigv4.signing_key(secret, cred.date, cred.region)
+                want = _hmac.new(skey, policy_b64.encode(),
+                                 hashlib.sha256).hexdigest()
+                if not _hmac.compare_digest(want, sig):
+                    raise S3Error("SignatureDoesNotMatch")
+                try:
+                    pol = _json.loads(base64.b64decode(policy_b64))
+                except ValueError:
+                    raise S3Error("MalformedPOSTRequest") from None
             exp = pol.get("expiration", "")
             if exp:
                 try:
@@ -1542,8 +1669,12 @@ def _make_handler(server: S3Server):
                         raise S3Error("EntityTooLarge"
                                       if len(file_data) > hi
                                       else "EntityTooSmall")
-            if not server.credentials.is_allowed(
-                    cred.access_key, "s3:PutObject", f"{bucket}/{key}"):
+            # Same deny-wins identity + bucket-policy merge as every
+            # header-authorized request (was a plain IAM check, which
+            # bypassed bucket-policy Deny statements).
+            ctx = self._auth_context(access_key, {}, self._headers_lower())
+            if not self._authorize(access_key, anonymous, "s3:PutObject",
+                                   f"{bucket}/{key}", ctx):
                 raise S3Error("AccessDenied", bucket=bucket, key=key)
             meta = {k[len("x-amz-meta-"):]: v for k, v in fields.items()
                     if k.startswith("x-amz-meta-")}

@@ -117,6 +117,18 @@ class ParsedAuth:
     amz_date: str
     payload_hash: str
     presigned: bool = False
+    anonymous: bool = False
+
+
+def anonymous_auth() -> ParsedAuth:
+    """Pseudo-auth for requests carrying no credentials at all; the
+    caller authorizes them against bucket policy (reference:
+    cmd/auth-handler.go authTypeAnonymous). Body is by definition
+    unsigned."""
+    return ParsedAuth(
+        credential=Credential(access_key="", date="", region="", service="s3"),
+        signed_headers=[], signature="", amz_date="",
+        payload_hash=UNSIGNED_PAYLOAD, anonymous=True)
 
 
 def parse_auth_header(headers: dict[str, str]) -> ParsedAuth:

@@ -1,0 +1,339 @@
+"""Bucket-policy evaluation: Condition operators, Principal matching,
+anonymous access, and deny-wins merge with IAM identities (reference:
+cmd/auth-handler.go:433-449,758, internal/policy/condition/)."""
+
+import http.client
+import json
+
+import pytest
+
+from minio_tpu.iam import IAMSys, Policy, evaluate
+from minio_tpu.iam.policy import PolicyError, decide
+from minio_tpu.object.erasure_object import ErasureSet
+from minio_tpu.s3.server import Credentials, S3Server
+from minio_tpu.storage.local import LocalStorage
+from tests.s3client import S3Client
+
+
+# ---------------------------------------------------------------------------
+# engine: conditions, principals, tri-state decide
+# ---------------------------------------------------------------------------
+
+def _pol(effect, actions, resources, condition=None, principal=None):
+    s = {"Effect": effect, "Action": actions, "Resource": resources}
+    if condition:
+        s["Condition"] = condition
+    if principal is not None:
+        s["Principal"] = principal
+    return Policy.from_json({"Statement": [s]})
+
+
+def test_condition_string_equals_and_like():
+    p = _pol("Allow", ["s3:ListBucket"], ["data"],
+             condition={"StringEquals": {"s3:prefix": ["app/"]}})
+    assert evaluate([p], "s3:ListBucket", "data", {"s3:prefix": "app/"})
+    assert not evaluate([p], "s3:ListBucket", "data", {"s3:prefix": "x/"})
+    # Absent key fails a positive operator.
+    assert not evaluate([p], "s3:ListBucket", "data", {})
+    like = _pol("Allow", ["s3:ListBucket"], ["data"],
+                condition={"StringLike": {"s3:prefix": ["app/*"]}})
+    assert evaluate([like], "s3:ListBucket", "data",
+                    {"s3:prefix": "app/sub/"})
+
+
+def test_condition_negated_absent_key_passes():
+    p = _pol("Allow", ["s3:GetObject"], ["data/*"],
+             condition={"StringNotEquals": {"aws:referer": ["evil.example"]}})
+    assert evaluate([p], "s3:GetObject", "data/k", {})          # absent -> met
+    assert evaluate([p], "s3:GetObject", "data/k",
+                    {"aws:Referer": "ok.example"})
+    assert not evaluate([p], "s3:GetObject", "data/k",
+                        {"aws:Referer": "evil.example"})
+
+
+def test_condition_ip_address():
+    p = _pol("Allow", ["s3:GetObject"], ["data/*"],
+             condition={"IpAddress": {"aws:SourceIp": ["10.0.0.0/8"]}})
+    assert evaluate([p], "s3:GetObject", "data/k",
+                    {"aws:SourceIp": "10.1.2.3"})
+    assert not evaluate([p], "s3:GetObject", "data/k",
+                        {"aws:SourceIp": "192.168.1.1"})
+    n = _pol("Allow", ["s3:GetObject"], ["data/*"],
+             condition={"NotIpAddress": {"aws:SourceIp": ["10.0.0.0/8"]}})
+    assert not evaluate([n], "s3:GetObject", "data/k",
+                        {"aws:SourceIp": "10.1.2.3"})
+    assert evaluate([n], "s3:GetObject", "data/k",
+                    {"aws:SourceIp": "192.168.1.1"})
+
+
+def test_condition_bool_and_numeric():
+    p = _pol("Deny", ["s3:*"], ["*"],
+             condition={"Bool": {"aws:SecureTransport": "false"}})
+    assert decide([p], "s3:GetObject", "b/k",
+                  {"aws:SecureTransport": "false"}) == "Deny"
+    assert decide([p], "s3:GetObject", "b/k",
+                  {"aws:SecureTransport": "true"}) is None
+    q = _pol("Allow", ["s3:ListBucket"], ["b"],
+             condition={"NumericLessThanEquals": {"s3:max-keys": "100"}})
+    assert evaluate([q], "s3:ListBucket", "b", {"s3:max-keys": "50"})
+    assert not evaluate([q], "s3:ListBucket", "b", {"s3:max-keys": "500"})
+
+
+def test_unknown_condition_operator_rejected():
+    with pytest.raises(PolicyError):
+        _pol("Allow", ["s3:*"], ["*"],
+             condition={"DateLessThanIfExists": {"aws:CurrentTime": "x"}})
+
+
+def test_principal_matching():
+    anyone = _pol("Allow", ["s3:GetObject"], ["pub/*"], principal="*")
+    assert evaluate([anyone], "s3:GetObject", "pub/k", access_key=None)
+    assert evaluate([anyone], "s3:GetObject", "pub/k", access_key="alice")
+    named = _pol("Allow", ["s3:GetObject"], ["pub/*"],
+                 principal={"AWS": ["arn:aws:iam:::user/alice"]})
+    assert evaluate([named], "s3:GetObject", "pub/k", access_key="alice")
+    assert not evaluate([named], "s3:GetObject", "pub/k", access_key="bob")
+    assert not evaluate([named], "s3:GetObject", "pub/k", access_key=None)
+
+
+def test_decide_tri_state():
+    allow = _pol("Allow", ["s3:GetObject"], ["b/*"], principal="*")
+    deny = _pol("Deny", ["s3:GetObject"], ["b/secret/*"], principal="*")
+    assert decide([allow, deny], "s3:GetObject", "b/k") == "Allow"
+    assert decide([allow, deny], "s3:GetObject", "b/secret/k") == "Deny"
+    assert decide([allow, deny], "s3:PutObject", "b/k") is None
+
+
+# ---------------------------------------------------------------------------
+# end-to-end over HTTP
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def srv(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("bpdrv")
+    disks = [LocalStorage(str(tmp / f"d{i}")) for i in range(4)]
+    es = ErasureSet(disks)
+    creds = Credentials("minioadmin", "minioadmin")
+    creds.iam = IAMSys([es], "minioadmin", "minioadmin")
+    server = S3Server(es, address="127.0.0.1:0", credentials=creds)
+    server.start()
+    yield server
+    server.stop()
+
+
+def _anon(address, method, path, body=None, headers=None):
+    host, _, port = address.rpartition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=10)
+    try:
+        conn.request(method, path, body=body, headers=headers or {})
+        r = conn.getresponse()
+        return r.status, r.read()
+    finally:
+        conn.close()
+
+
+def _put_policy(root, bucket, doc):
+    return root.request("PUT", f"/{bucket}", query={"policy": ""},
+                        body=json.dumps(doc).encode())
+
+
+def test_anonymous_denied_without_policy(srv):
+    root = S3Client(srv.address)
+    assert root.request("PUT", "/pubbkt")[0] == 200
+    assert root.request("PUT", "/pubbkt/obj", body=b"hello")[0] == 200
+    st, _ = _anon(srv.address, "GET", "/pubbkt/obj")
+    assert st == 403
+
+
+def test_public_read_policy_allows_anonymous_get_not_put(srv):
+    root = S3Client(srv.address)
+    st, _, b = _put_policy(root, "pubbkt", {"Statement": [
+        {"Effect": "Allow", "Principal": "*", "Action": ["s3:GetObject"],
+         "Resource": ["arn:aws:s3:::pubbkt/*"]}]})
+    assert st == 200, b
+    st, body = _anon(srv.address, "GET", "/pubbkt/obj")
+    assert st == 200 and body == b"hello"
+    # GetObject grant does not cover PUT, listing, or deletion.
+    st, _ = _anon(srv.address, "PUT", "/pubbkt/obj2", body=b"x",
+                  headers={"Content-Length": "1"})
+    assert st == 403
+    st, _ = _anon(srv.address, "GET", "/pubbkt")
+    assert st == 403
+    st, _ = _anon(srv.address, "DELETE", "/pubbkt/obj")
+    assert st == 403
+    # Admin API never opens anonymously.
+    st, _ = _anon(srv.address, "GET", "/minio/admin/v3/list-users")
+    assert st == 403
+
+
+def test_anonymous_put_with_policy_roundtrips(srv):
+    root = S3Client(srv.address)
+    assert root.request("PUT", "/dropbox")[0] == 200
+    st, _, b = _put_policy(root, "dropbox", {"Statement": [
+        {"Effect": "Allow", "Principal": "*",
+         "Action": ["s3:PutObject", "s3:GetObject"],
+         "Resource": ["arn:aws:s3:::dropbox/*"]}]})
+    assert st == 200, b
+    payload = b"anonymous body bytes"
+    st, _ = _anon(srv.address, "PUT", "/dropbox/up.txt", body=payload,
+                  headers={"Content-Length": str(len(payload))})
+    assert st == 200
+    st, body = _anon(srv.address, "GET", "/dropbox/up.txt")
+    assert st == 200 and body == payload
+
+
+def test_bucket_policy_deny_overrides_iam_allow(srv):
+    root = S3Client(srv.address)
+    st, _, b = root.request("PUT", "/minio/admin/v3/add-user",
+                            query={"accessKey": "powerful"},
+                            body=json.dumps(
+                                {"secretKey": "powerfulsecret"}).encode())
+    assert st == 200, b
+    st, _, b = root.request("PUT", "/minio/admin/v3/set-user-or-group-policy",
+                            query={"userOrGroup": "powerful",
+                                   "policyName": "readwrite"})
+    assert st == 200, b
+    assert root.request("PUT", "/denybkt")[0] == 200
+    assert root.request("PUT", "/denybkt/obj", body=b"d")[0] == 200
+    st, _, b = _put_policy(root, "denybkt", {"Statement": [
+        {"Effect": "Deny", "Principal": "*", "Action": ["s3:DeleteObject"],
+         "Resource": ["arn:aws:s3:::denybkt/*"]}]})
+    assert st == 200, b
+    user = S3Client(srv.address, access_key="powerful",
+                    secret_key="powerfulsecret")
+    # IAM readwrite allows everything, but the bucket policy's explicit
+    # Deny wins for deletes; reads stay allowed.
+    assert user.request("GET", "/denybkt/obj")[0] == 200
+    assert user.request("DELETE", "/denybkt/obj")[0] == 403
+    # Root bypasses policy (owner short-circuit).
+    assert root.request("DELETE", "/denybkt/obj")[0] == 204
+
+
+def test_bucket_policy_grants_signed_user_without_iam_policy(srv):
+    root = S3Client(srv.address)
+    st, _, b = root.request("PUT", "/minio/admin/v3/add-user",
+                            query={"accessKey": "npuser"},
+                            body=json.dumps(
+                                {"secretKey": "npusersecret"}).encode())
+    assert st == 200, b
+    assert root.request("PUT", "/grantbkt")[0] == 200
+    assert root.request("PUT", "/grantbkt/obj", body=b"g")[0] == 200
+    user = S3Client(srv.address, access_key="npuser",
+                    secret_key="npusersecret")
+    assert user.request("GET", "/grantbkt/obj")[0] == 403
+    st, _, b = _put_policy(root, "grantbkt", {"Statement": [
+        {"Effect": "Allow", "Principal": {"AWS": ["npuser"]},
+         "Action": ["s3:GetObject"],
+         "Resource": ["arn:aws:s3:::grantbkt/*"]}]})
+    assert st == 200, b
+    st, _, got = user.request("GET", "/grantbkt/obj")
+    assert st == 200 and got == b"g"
+    # The grant names npuser only; anonymous stays shut out.
+    st, _ = _anon(srv.address, "GET", "/grantbkt/obj")
+    assert st == 403
+
+
+def test_source_ip_condition_enforced(srv):
+    root = S3Client(srv.address)
+    assert root.request("PUT", "/ipbkt")[0] == 200
+    assert root.request("PUT", "/ipbkt/obj", body=b"i")[0] == 200
+    st, _, b = _put_policy(root, "ipbkt", {"Statement": [
+        {"Effect": "Allow", "Principal": "*", "Action": ["s3:GetObject"],
+         "Resource": ["arn:aws:s3:::ipbkt/*"],
+         "Condition": {"IpAddress": {"aws:SourceIp": ["127.0.0.0/8"]}}}]})
+    assert st == 200, b
+    st, _ = _anon(srv.address, "GET", "/ipbkt/obj")
+    assert st == 200
+    st, _, b = _put_policy(root, "ipbkt", {"Statement": [
+        {"Effect": "Allow", "Principal": "*", "Action": ["s3:GetObject"],
+         "Resource": ["arn:aws:s3:::ipbkt/*"],
+         "Condition": {"IpAddress": {"aws:SourceIp": ["10.0.0.0/8"]}}}]})
+    assert st == 200, b
+    st, _ = _anon(srv.address, "GET", "/ipbkt/obj")
+    assert st == 403
+
+
+def test_unsupported_condition_rejected_at_put(srv):
+    root = S3Client(srv.address)
+    assert root.request("PUT", "/condbkt")[0] == 200
+    st, _, body = _put_policy(root, "condbkt", {"Statement": [
+        {"Effect": "Allow", "Principal": "*", "Action": ["s3:GetObject"],
+         "Resource": ["arn:aws:s3:::condbkt/*"],
+         "Condition": {"DateGreaterThan": {"aws:CurrentTime": "x"}}}]})
+    assert st == 400 and b"MalformedPolicy" in body
+
+
+def test_malformed_docs_rejected_at_put(srv):
+    root = S3Client(srv.address)
+    assert root.request("PUT", "/rejbkt")[0] == 200
+    # Identity-policy shape (no Principal) must not be storable as a
+    # bucket policy — it would otherwise match nobody (or, worse in the
+    # old code, everybody).
+    st, _, body = _put_policy(root, "rejbkt", {"Statement": [
+        {"Effect": "Allow", "Action": ["s3:GetObject"],
+         "Resource": ["arn:aws:s3:::rejbkt/*"]}]})
+    assert st == 400 and b"MalformedPolicy" in body
+    # NotPrincipal would invert to an over-grant if ignored: reject.
+    st, _, body = _put_policy(root, "rejbkt", {"Statement": [
+        {"Effect": "Allow", "NotPrincipal": {"AWS": "mallory"},
+         "Action": ["s3:GetObject"],
+         "Resource": ["arn:aws:s3:::rejbkt/*"]}]})
+    assert st == 400 and b"MalformedPolicy" in body
+    # Unparseable CIDR would silently disarm the condition: reject.
+    st, _, body = _put_policy(root, "rejbkt", {"Statement": [
+        {"Effect": "Deny", "Principal": "*", "Action": ["s3:*"],
+         "Resource": ["arn:aws:s3:::rejbkt/*"],
+         "Condition": {"IpAddress": {"aws:SourceIp": ["10.0.0.0/8x"]}}}]})
+    assert st == 400 and b"MalformedPolicy" in body
+
+
+def test_uncompilable_stored_policy_fails_closed(srv):
+    """A policy document that reaches the metadata store without passing
+    validation (legacy format, corruption) must deny all non-owner
+    access, not silently drop its statements."""
+    root = S3Client(srv.address)
+    assert root.request("PUT", "/corruptbkt")[0] == 200
+    assert root.request("PUT", "/corruptbkt/obj", body=b"c")[0] == 200
+    ol = srv.object_layer
+    meta = ol.get_bucket_meta("corruptbkt")
+    meta["config:policy"] = json.dumps({"Statement": [
+        {"Effect": "Allow", "Principal": "*", "Action": ["s3:GetObject"],
+         "Resource": ["arn:aws:s3:::corruptbkt/*"],
+         "Condition": {"FutureOperator": {"x": "y"}}}]})
+    ol.set_bucket_meta("corruptbkt", meta)
+    user = S3Client(srv.address, access_key="powerful",
+                    secret_key="powerfulsecret")   # readwrite IAM user
+    assert user.request("GET", "/corruptbkt/obj")[0] == 403
+    st, _ = _anon(srv.address, "GET", "/corruptbkt/obj")
+    assert st == 403
+    # Owner still passes (root short-circuit).
+    assert root.request("GET", "/corruptbkt/obj")[0] == 200
+
+
+def test_anonymous_post_policy_upload(srv):
+    """Browser-form POST with no credentials rides the bucket policy
+    (reference: cmd/post-policy.go anonymous path)."""
+    root = S3Client(srv.address)
+    assert root.request("PUT", "/formbkt")[0] == 200
+    body = (b"--BOUND\r\n"
+            b'Content-Disposition: form-data; name="key"\r\n\r\n'
+            b"form.txt\r\n"
+            b"--BOUND\r\n"
+            b'Content-Disposition: form-data; name="file"; '
+            b'filename="f.txt"\r\n'
+            b"Content-Type: text/plain\r\n\r\n"
+            b"form upload bytes\r\n"
+            b"--BOUND--\r\n")
+    hdrs = {"Content-Type": "multipart/form-data; boundary=BOUND",
+            "Content-Length": str(len(body))}
+    st, _ = _anon(srv.address, "POST", "/formbkt", body=body, headers=hdrs)
+    assert st == 403
+    st, _, b = _put_policy(root, "formbkt", {"Statement": [
+        {"Effect": "Allow", "Principal": "*",
+         "Action": ["s3:PutObject", "s3:GetObject"],
+         "Resource": ["arn:aws:s3:::formbkt/*"]}]})
+    assert st == 200, b
+    st, _ = _anon(srv.address, "POST", "/formbkt", body=body, headers=hdrs)
+    assert st in (200, 204)
+    st, got = _anon(srv.address, "GET", "/formbkt/form.txt")
+    assert st == 200 and got == b"form upload bytes"
