@@ -1,0 +1,81 @@
+"""Peer control plane: change-notification fan-out.
+
+The analogue of the reference's NotificationSys + peer REST client
+(cmd/notification.go:49, cmd/peer-rest-client.go:304): a node that
+mutates shared cluster state (bucket metadata, IAM, config) broadcasts
+a reload to every peer so their caches drop immediately instead of
+serving stale authorization or versioning state for up to a cache TTL.
+The TTL remains the fallback for peers that are down or unreachable at
+broadcast time — they re-read the (already-persisted) truth from the
+drives within one TTL of coming back.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+RELOAD_HANDLER = "peer.reload"
+
+# Reload kinds a peer understands.
+KIND_IAM = "iam"
+KIND_BUCKET_META = "bucket-meta"
+KIND_CONFIG = "config"
+
+
+class PeerNotifier:
+    """Best-effort synchronous fan-out to every peer.
+
+    Broadcasts run all peers in parallel and wait up to `timeout` for
+    each, so a credential revocation or policy change has reached every
+    reachable node by the time the admin call returns (the reference's
+    NotificationSys collects per-peer errors the same way). Failures
+    are swallowed: the state is already quorum-persisted, and the
+    peer's cache TTL bounds its staleness.
+    """
+
+    def __init__(self, clients, timeout: float = 2.0):
+        self._clients = list(clients)
+        self._timeout = timeout
+
+    def broadcast(self, kind: str, bucket: str = "") -> None:
+        if not self._clients:
+            return
+        payload = {"kind": kind, "bucket": bucket}
+        threads = [threading.Thread(target=self._one, args=(c, payload),
+                                    daemon=True)
+                   for c in self._clients]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(self._timeout)
+
+    def _one(self, client, payload) -> None:
+        try:
+            client.call(RELOAD_HANDLER, payload, timeout=self._timeout)
+        except Exception:  # noqa: BLE001 - peer down; TTL is the fallback
+            pass
+
+
+def make_reload_handler(iam=None, object_layer=None,
+                        apply_config: Callable | None = None):
+    """Build the receiving side: a grid handler that drops the local
+    cache named by the payload (reference: cmd/peer-rest-server.go's
+    LoadBucketMetadataHandler / LoadUserHandler / SignalServiceHandler
+    family, collapsed into one keyed endpoint)."""
+
+    def handler(payload):
+        kind = (payload or {}).get("kind", "")
+        if kind == KIND_IAM and iam is not None:
+            iam.invalidate()
+        elif kind == KIND_BUCKET_META and object_layer is not None:
+            object_layer.invalidate_bucket_meta(
+                (payload or {}).get("bucket", ""))
+        elif kind == KIND_CONFIG and apply_config is not None:
+            try:
+                apply_config()
+            except Exception:  # noqa: BLE001 - bad config must not kill RPC
+                pass
+        return "ok"
+
+    return handler

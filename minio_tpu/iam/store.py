@@ -44,6 +44,10 @@ class IAMSys:
         self._state = {"users": {}, "service_accounts": {},
                        "policies": {}, "user_policies": {}}
         self._loaded_at = 0.0
+        # Peer fan-out hook: called after every successful _save so the
+        # other nodes drop their IAM caches immediately (reference:
+        # cmd/iam.go notifies peers on every IAM object write).
+        self.on_change = None
         self._load()
 
     # -- persistence ----------------------------------------------------
@@ -79,9 +83,29 @@ class IAMSys:
         if ok < len(self._disks()) // 2 + 1:
             raise IAMError("could not persist IAM state to a drive quorum")
 
+    def _fire_change(self) -> None:
+        """Run the peer fan-out AFTER the mutator released _mu: the
+        broadcast can block up to its timeout on a partitioned peer,
+        and holding the lock through it would stall every credential
+        lookup on this node (and deadlock-by-timeout against a peer
+        mutating concurrently)."""
+        cb = self.on_change
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 - fan-out must not fail writes
+                pass
+
     def _refresh(self) -> None:
         if time.monotonic() - self._loaded_at > self._TTL:
             self._load()
+
+    def invalidate(self) -> None:
+        """Force the next lookup to re-read from the drives (called by
+        the peer control plane when another node changed IAM state —
+        a revoked credential must stop working NOW, not after the TTL)."""
+        with self._mu:
+            self._loaded_at = 0.0
 
     # -- credential resolution ------------------------------------------
 
@@ -162,6 +186,7 @@ class IAMSys:
             self._state["users"][access_key] = {
                 "secret": secret_key, "status": "enabled"}
             self._save()
+        self._fire_change()
 
     def remove_user(self, access_key: str) -> None:
         with self._mu:
@@ -173,6 +198,7 @@ class IAMSys:
                       if sa.get("parent") == access_key]:
                 self._state["service_accounts"].pop(k, None)
             self._save()
+        self._fire_change()
 
     def set_user_status(self, access_key: str, enabled: bool) -> None:
         with self._mu:
@@ -181,6 +207,7 @@ class IAMSys:
                 raise IAMError("no such user")
             u["status"] = "enabled" if enabled else "disabled"
             self._save()
+        self._fire_change()
 
     def list_users(self) -> dict:
         with self._mu:
@@ -202,18 +229,21 @@ class IAMSys:
                 "secret": secret_key, "parent": parent,
                 "policy": policy, "status": "enabled"}
             self._save()
+        self._fire_change()
 
     def set_policy(self, name: str, doc: dict) -> None:
         Policy.from_json(doc)   # validate before storing
         with self._mu:
             self._state["policies"][name] = doc
             self._save()
+        self._fire_change()
 
     def delete_policy(self, name: str) -> None:
         with self._mu:
             if self._state["policies"].pop(name, None) is None:
                 raise IAMError("no such policy")
             self._save()
+        self._fire_change()
 
     def list_policies(self) -> dict:
         with self._mu:
@@ -233,3 +263,4 @@ class IAMSys:
                     raise IAMError(f"no such policy {n!r}")
             self._state["user_policies"][access_key] = list(names)
             self._save()
+        self._fire_change()

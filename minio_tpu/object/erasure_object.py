@@ -261,7 +261,7 @@ class ErasureSet:
             raise WriteQuorumError(bucket)
         # Drop bucket metadata so a recreated bucket starts fresh
         # (versioning state must not survive deletion).
-        getattr(self, "_bmeta_cache", {}).pop(bucket, None)
+        self.invalidate_bucket_meta(bucket)
         self._fanout([lambda d=d: _swallow(
             lambda: d.delete(SYS_VOL, f"buckets/{bucket}", recursive=True))
             for d in self.disks])
@@ -313,9 +313,22 @@ class ErasureSet:
         _, errors = self._fanout(
             [lambda d=d: d.write_all(SYS_VOL, self._bucket_meta_path(bucket),
                                      blob) for d in self.disks])
-        getattr(self, "_bmeta_cache", {}).pop(bucket, None)
+        self.invalidate_bucket_meta(bucket)
         if sum(e is None for e in errors) < len(self.disks) // 2 + 1:
             raise WriteQuorumError(bucket)
+
+    def invalidate_bucket_meta(self, bucket: str = "") -> None:
+        """Drop the TTL cache for one bucket ("" = all): the peer
+        control plane calls this when another node rewrites bucket
+        metadata, so policy/versioning changes take effect here
+        immediately instead of after the TTL."""
+        cache = getattr(self, "_bmeta_cache", None)
+        if cache is None:
+            return
+        if bucket:
+            cache.pop(bucket, None)
+        else:
+            cache.clear()
 
     def bucket_versioning(self, bucket: str) -> bool:
         return bool(self.get_bucket_meta(bucket).get("versioning"))

@@ -29,6 +29,12 @@ class ServerPools:
         if not pools:
             raise ValueError("at least one pool required")
         self.pools = list(pools)
+        # Peer fan-out hook: callable(bucket) invoked after every
+        # bucket-metadata mutation through this layer, so a distributed
+        # boot can broadcast cache invalidations (grid.peers); firing
+        # at the layer that owns the write keeps future callers from
+        # silently bypassing the broadcast.
+        self.on_bucket_meta_change = None
 
     # -- placement -----------------------------------------------------
 
@@ -103,6 +109,15 @@ class ServerPools:
                 not_found += 1
         if not_found == len(self.pools):
             raise BucketNotFound(bucket)
+        self._fire_meta_change(bucket)
+
+    def _fire_meta_change(self, bucket: str) -> None:
+        cb = self.on_bucket_meta_change
+        if cb is not None:
+            try:
+                cb(bucket)
+            except Exception:  # noqa: BLE001 - fan-out must not fail writes
+                pass
 
     # -- bucket metadata ----------------------------------------------
 
@@ -116,6 +131,11 @@ class ServerPools:
     def set_bucket_meta(self, bucket: str, meta: dict) -> None:
         for p in self.pools:
             p.set_bucket_meta(bucket, meta)
+        self._fire_meta_change(bucket)
+
+    def invalidate_bucket_meta(self, bucket: str = "") -> None:
+        for p in self.pools:
+            p.invalidate_bucket_meta(bucket)
 
     def bucket_versioning(self, bucket: str) -> bool:
         return bool(self.get_bucket_meta(bucket).get("versioning"))

@@ -155,6 +155,10 @@ class ErasureSets:
         for s in self.sets:
             s.set_bucket_meta(bucket, meta)
 
+    def invalidate_bucket_meta(self, bucket: str = "") -> None:
+        for s in self.sets:
+            s.invalidate_bucket_meta(bucket)
+
     def bucket_versioning(self, bucket: str) -> bool:
         return bool(self.get_bucket_meta(bucket).get("versioning"))
 

@@ -133,6 +133,10 @@ class S3Server:
         # Transparent compression for eligible content (off by default;
         # --compression enables).
         self.compression = False
+        # Peer control plane fan-out: callable(kind, bucket="") set by
+        # the distributed boot (grid.peers.PeerNotifier.broadcast);
+        # None on single-node deployments.
+        self.peer_notify = None
 
     @property
     def address(self) -> str:
@@ -1940,8 +1944,8 @@ def _make_handler(server: S3Server):
                 try:
                     # Lock the read-modify-write so two concurrent
                     # set-configs cannot drop each other's keys. Hot
-                    # apply reaches THIS node; peers pick the persisted
-                    # document up at their next boot.
+                    # apply reaches THIS node; peers reload over the
+                    # control plane (TTL/reboot as the fallback).
                     with server.bucket_meta_lock:
                         prev = cfg_mod.load_config(server.object_layer)
                         cfg = dict(prev)
@@ -1954,6 +1958,8 @@ def _make_handler(server: S3Server):
                     raise S3Error("InternalError", str(e)) from None
                 # Apply only what THIS request changed.
                 applied = cfg_mod.apply_config(server, updates)
+                if server.peer_notify is not None:
+                    server.peer_notify("config")
                 return ok({"applied": applied})
 
             # Replication target management needs no IAM store.

@@ -289,6 +289,24 @@ def main(argv=None) -> int:
         cfg_mod.apply_config(srv, cfg_mod.load_config(layer))
     except Exception:  # noqa: BLE001 - config is optional
         pass
+    if distributed:
+        # Peer control plane: mutations of shared state (IAM, bucket
+        # metadata, config) fan out an immediate cache invalidation to
+        # every peer; the per-cache TTL covers unreachable peers
+        # (reference: cmd/notification.go + cmd/peer-rest-client.go:304).
+        from minio_tpu.grid.peers import (PeerNotifier, RELOAD_HANDLER,
+                                          make_reload_handler)
+        peer_notifier = PeerNotifier(
+            [client_for(h, p + GRID_PORT_OFFSET) for h, p in remote_nodes])
+        grid_srv.register(RELOAD_HANDLER, make_reload_handler(
+            iam=creds.iam, object_layer=layer,
+            apply_config=lambda: cfg_mod.apply_config(
+                srv, cfg_mod.load_config(layer))))
+        srv.peer_notify = peer_notifier.broadcast
+        creds.iam.on_change = lambda: peer_notifier.broadcast("iam")
+        layer.on_bucket_meta_change = \
+            lambda bucket: peer_notifier.broadcast("bucket-meta",
+                                                   bucket=bucket)
     if args.audit_webhook:
         from minio_tpu.s3.trace import AuditLogger
         srv.audit = AuditLogger(args.audit_webhook)

@@ -283,27 +283,73 @@ def test_dist_nslock_interface():
 
 
 # ---------------------------------------------------------------------------
+# peer control plane
+# ---------------------------------------------------------------------------
+
+def test_peer_notifier_reload_handler():
+    """PeerNotifier fan-out reaches a registered reload handler and
+    drops the right caches (reference: cmd/notification.go)."""
+    from minio_tpu.grid.peers import (PeerNotifier, RELOAD_HANDLER,
+                                      make_reload_handler)
+
+    class FakeIAM:
+        invalidated = 0
+
+        def invalidate(self):
+            self.invalidated += 1
+
+    class FakeLayer:
+        dropped = None
+
+        def invalidate_bucket_meta(self, bucket=""):
+            self.dropped = bucket
+
+    applied = []
+    iam, layer = FakeIAM(), FakeLayer()
+    srv = GridServer(0, host="127.0.0.1")
+    srv.register(RELOAD_HANDLER, make_reload_handler(
+        iam=iam, object_layer=layer,
+        apply_config=lambda: applied.append(1)))
+    srv.start()
+    try:
+        n = PeerNotifier([GridClient("127.0.0.1", srv.port)])
+        n.broadcast("iam")
+        n.broadcast("bucket-meta", bucket="bkt")
+        n.broadcast("config")
+        assert iam.invalidated == 1
+        assert layer.dropped == "bkt"
+        assert applied == [1]
+        # Unknown kinds and dead peers are silently tolerated.
+        n.broadcast("future-kind")
+        dead = PeerNotifier([GridClient("127.0.0.1", 1)], timeout=0.5)
+        dead.broadcast("iam")
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
 # multi-process cluster (verify-healing style)
 # ---------------------------------------------------------------------------
 
 BASE = 9480
 
 
-def _node_cmd(idx: int, endpoints: list[str]) -> list[str]:
+def _node_cmd(idx: int, endpoints: list[str], base: int = BASE,
+              extra: tuple = ()) -> list[str]:
     return [sys.executable, "-m", "minio_tpu.server",
-            "--address", f"127.0.0.1:{BASE + idx}",
+            "--address", f"127.0.0.1:{base + idx}",
             "--ec-backend", "host", "--boot-timeout", "60",
-            *endpoints]
+            *extra, *endpoints]
 
 
-def _spawn(idx, endpoints, tmp_path):
+def _spawn(idx, endpoints, tmp_path, base: int = BASE, extra: tuple = ()):
     env = dict(os.environ,
                JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
                PYTHONPATH=os.path.dirname(os.path.dirname(
                    os.path.abspath(__file__))))
     log = open(tmp_path / f"node{idx}.log", "wb")
-    return subprocess.Popen(_node_cmd(idx, endpoints), stdout=log,
-                            stderr=subprocess.STDOUT, env=env)
+    return subprocess.Popen(_node_cmd(idx, endpoints, base, extra),
+                            stdout=log, stderr=subprocess.STDOUT, env=env)
 
 
 def _wait_ready(tmp_path, idx, timeout=90):
@@ -315,6 +361,77 @@ def _wait_ready(tmp_path, idx, timeout=90):
         time.sleep(0.5)
     raise TimeoutError(
         f"node {idx} not ready:\n{path.read_bytes().decode()[-2000:]}")
+
+
+def test_two_node_change_propagation(tmp_path):
+    """Bucket-metadata and IAM changes made on one node take effect on
+    the other IMMEDIATELY via the peer control plane — no TTL sleeps
+    anywhere in this test (reference: cmd/peer-rest-client.go:304
+    fan-out on every shared-state write)."""
+    import json as _json
+    base = 9484
+    endpoints = []
+    for n in range(2):
+        for d in range(2):
+            os.makedirs(tmp_path / f"n{n}" / f"d{d}")
+            endpoints.append(
+                f"http://127.0.0.1:{base + n}{tmp_path}/n{n}/d{d}")
+
+    procs = []
+    try:
+        for n in range(2):
+            procs.append(_spawn(n, endpoints, tmp_path, base=base,
+                                extra=("--scanner-interval", "0")))
+        for n in range(2):
+            _wait_ready(tmp_path, n)
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from s3client import S3Client
+        c0 = S3Client(f"127.0.0.1:{base}")
+        c1 = S3Client(f"127.0.0.1:{base + 1}")
+
+        # --- bucket metadata: versioning toggle ------------------------
+        assert c0.request("PUT", "/propbkt")[0] == 200
+        # Warm node1's bucket-meta cache with versioning OFF.
+        assert c1.request("PUT", "/propbkt/obj", body=b"v1")[0] == 200
+        # Toggle versioning via node0; node1 must see it on the very
+        # next write (stale cache would overwrite without a version).
+        vxml = (b'<VersioningConfiguration><Status>Enabled</Status>'
+                b'</VersioningConfiguration>')
+        st, _, b = c0.request("PUT", "/propbkt", query={"versioning": ""},
+                              body=vxml)
+        assert st == 200, b
+        assert c1.request("PUT", "/propbkt/obj", body=b"v2")[0] == 200
+        st, _, listing = c1.request("GET", "/propbkt",
+                                    query={"versions": ""})
+        assert st == 200
+        assert listing.count(b"<Version>") == 2, listing
+
+        # --- IAM: credential revocation --------------------------------
+        st, _, b = c0.request("PUT", "/minio/admin/v3/add-user",
+                              query={"accessKey": "tempu"},
+                              body=_json.dumps(
+                                  {"secretKey": "tempsecret1"}).encode())
+        assert st == 200, b
+        st, _, b = c0.request(
+            "PUT", "/minio/admin/v3/set-user-or-group-policy",
+            query={"userOrGroup": "tempu", "policyName": "readwrite"})
+        assert st == 200, b
+        u1 = S3Client(f"127.0.0.1:{base + 1}", access_key="tempu",
+                      secret_key="tempsecret1")
+        # Warm node1's IAM cache: the user works there.
+        st, _, got = u1.request("GET", "/propbkt/obj")
+        assert st == 200 and got == b"v2"
+        # Revoke via node0; node1 must refuse the NEXT request.
+        st, _, b = c0.request("DELETE", "/minio/admin/v3/remove-user",
+                              query={"accessKey": "tempu"})
+        assert st == 200, b
+        assert u1.request("GET", "/propbkt/obj")[0] == 403
+    finally:
+        for p in procs:
+            try:
+                p.send_signal(signal.SIGKILL)
+            except OSError:
+                pass
 
 
 @pytest.mark.slow
