@@ -177,6 +177,25 @@ def make_scanner_hook(now_fn=None):
         cache[bucket] = (doc, rules)
         return rules
 
+    def locked(versions, version_id: str) -> bool:
+        """WORM guard on the scanner's own deletes: ILM must never
+        destroy a version under active retention or legal hold
+        (reference: lifecycle evaluation consults object-lock state,
+        internal/bucket/lifecycle + enforceRetentionForDeletion).
+        Retention uses the REAL clock even under now_fn acceleration —
+        a test-accelerated ILM age must not unlock WORM data."""
+        import time as _t
+        from minio_tpu.object import objectlock as olock
+        for v in versions:
+            if v.version_id != version_id:
+                continue
+            m = getattr(v, "metadata", None) or {}
+            if not (m.get(olock.META_MODE) or m.get(olock.META_HOLD)):
+                return False
+            return olock.check_version_deletable(
+                m, _t.time_ns(), False) is not None
+        return False
+
     def hook(es, bucket: str, key: str, versions) -> None:
         rules = rules_for(es, bucket)
         if not rules:
@@ -186,9 +205,15 @@ def make_scanner_hook(now_fn=None):
         for a in evaluate(rules, key, versions, now=now):
             try:
                 if a.kind == "expire_latest":
+                    # Versioned: stacks a delete marker (never destroys
+                    # data). Unversioned destroys the only copy — and an
+                    # unversioned bucket cannot be lock-enabled, so no
+                    # lock check is needed here.
                     es.delete_object(bucket, key,
                                      DeleteOptions(versioned=versioned))
                 elif a.kind in ("delete_version", "drop_marker"):
+                    if locked(versions, a.version_id):
+                        continue
                     es.delete_object(bucket, key, DeleteOptions(
                         version_id=a.version_id, versioned=versioned))
             except Exception:  # noqa: BLE001 - next cycle retries

@@ -1,0 +1,295 @@
+"""Object lock: WORM retention and legal holds.
+
+The analogue of the reference's object-lock subsystem
+(internal/bucket/object/lock/lock.go, enforced from
+cmd/object-handlers.go:2705,2862 PutObjectRetentionHandler /
+PutObjectLegalHoldHandler and cmd/erasure-object.go's delete checks):
+
+- a bucket opts in at creation (`x-amz-bucket-object-lock-enabled`) or
+  via PutObjectLockConfiguration; lock-enabled buckets are versioned
+  and versioning can never be suspended on them;
+- versions carry retention (GOVERNANCE | COMPLIANCE until a date) and
+  an independent legal hold (ON | OFF), stored in version metadata;
+- deleting a retained/held VERSION is refused; GOVERNANCE (only) can
+  be bypassed by an identity holding s3:BypassGovernanceRetention via
+  the `x-amz-bypass-governance-retention: true` header; COMPLIANCE
+  retention can be extended but never shortened, by anyone.
+
+Versionless deletes only stack a delete marker and are always allowed
+(S3 semantics: the data stays, WORM is about version destruction).
+
+Lock state lives in internal metadata keys so it never leaks into the
+x-amz-meta-* user surface; the handlers translate to/from the
+x-amz-object-lock-* wire headers.
+"""
+
+from __future__ import annotations
+
+import datetime
+import xml.etree.ElementTree as ET
+from typing import Optional
+
+XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
+
+GOVERNANCE = "GOVERNANCE"
+COMPLIANCE = "COMPLIANCE"
+
+# Internal metadata keys (PutOptions.internal_metadata requires the
+# x-internal- prefix; _to_object_info routes them to internal_metadata).
+META_MODE = "x-internal-lock-mode"
+META_UNTIL = "x-internal-lock-until"      # ISO8601, as received
+META_HOLD = "x-internal-lock-hold"        # "ON" | "OFF"
+
+# Wire headers (PutObject / CreateMultipartUpload / responses).
+H_MODE = "x-amz-object-lock-mode"
+H_UNTIL = "x-amz-object-lock-retain-until-date"
+H_HOLD = "x-amz-object-lock-legal-hold"
+H_BYPASS = "x-amz-bypass-governance-retention"
+
+# Bucket metadata key holding the lock configuration document.
+BUCKET_META_KEY = "object_lock"
+
+
+class ObjectLockError(Exception):
+    """Maps to S3 error codes via `code`."""
+
+    def __init__(self, code: str, msg: str = ""):
+        self.code = code
+        super().__init__(msg or code)
+
+
+def parse_iso8601(s: str) -> int:
+    """RetainUntilDate -> ns since epoch (S3 sends RFC3339/ISO8601)."""
+    try:
+        dt = datetime.datetime.fromisoformat(s.replace("Z", "+00:00"))
+    except (ValueError, TypeError):
+        raise ObjectLockError("InvalidArgument",
+                              f"bad RetainUntilDate {s!r}") from None
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=datetime.timezone.utc)
+    return int(dt.timestamp() * 1e9)
+
+
+def _iso(ns: int) -> str:
+    return datetime.datetime.fromtimestamp(
+        ns / 1e9, tz=datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
+
+
+# -- bucket configuration ---------------------------------------------------
+
+def parse_lock_config_xml(body: bytes) -> dict:
+    """<ObjectLockConfiguration> -> {"enabled": True, "mode"?, "days"?,
+    "years"?}; validates like the reference (exactly one of Days/Years
+    when a default-retention rule is present)."""
+    try:
+        root = ET.fromstring(body)
+    except ET.ParseError:
+        raise ObjectLockError("MalformedXML") from None
+    ns = f"{{{XMLNS}}}"
+
+    def find(el, tag):
+        return el.findtext(f"{ns}{tag}") or el.findtext(tag)
+
+    enabled = find(root, "ObjectLockEnabled") or ""
+    if enabled != "Enabled":
+        raise ObjectLockError("MalformedXML",
+                              "ObjectLockEnabled must be 'Enabled'")
+    cfg: dict = {"enabled": True}
+    rule = root.find(f"{ns}Rule")
+    if rule is None:
+        rule = root.find("Rule")
+    if rule is not None:
+        dr = rule.find(f"{ns}DefaultRetention")
+        if dr is None:
+            dr = rule.find("DefaultRetention")
+        if dr is None:
+            raise ObjectLockError("MalformedXML", "Rule needs "
+                                  "DefaultRetention")
+        mode = find(dr, "Mode") or ""
+        if mode not in (GOVERNANCE, COMPLIANCE):
+            raise ObjectLockError("MalformedXML", f"bad Mode {mode!r}")
+        days, years = find(dr, "Days"), find(dr, "Years")
+        if (days is None) == (years is None):
+            raise ObjectLockError("MalformedXML",
+                                  "exactly one of Days or Years")
+        try:
+            n = int(days if days is not None else years)
+        except ValueError:
+            raise ObjectLockError("MalformedXML", "bad Days/Years") from None
+        if n <= 0:
+            raise ObjectLockError("InvalidArgument",
+                                  "retention period must be positive")
+        cfg["mode"] = mode
+        cfg["days" if days is not None else "years"] = n
+    return cfg
+
+
+def lock_config_xml(cfg: dict) -> bytes:
+    root = ET.Element("ObjectLockConfiguration", xmlns=XMLNS)
+    ET.SubElement(root, "ObjectLockEnabled").text = "Enabled"
+    if cfg.get("mode"):
+        rule = ET.SubElement(root, "Rule")
+        dr = ET.SubElement(rule, "DefaultRetention")
+        ET.SubElement(dr, "Mode").text = cfg["mode"]
+        if "days" in cfg:
+            ET.SubElement(dr, "Days").text = str(cfg["days"])
+        else:
+            ET.SubElement(dr, "Years").text = str(cfg["years"])
+    return b'<?xml version="1.0" encoding="UTF-8"?>\n' + ET.tostring(root)
+
+
+def default_retention_meta(cfg: dict, now_ns: int) -> dict:
+    """Bucket default retention -> internal metadata for a new version
+    (reference: lock.FilterObjectLockMetadata + default application at
+    PUT, cmd/api-headers.go)."""
+    if not cfg or not cfg.get("mode"):
+        return {}
+    days = cfg.get("days", 0) + 365 * cfg.get("years", 0)
+    until = now_ns + days * 86400 * 10**9
+    return {META_MODE: cfg["mode"], META_UNTIL: _iso(until)}
+
+
+# -- per-version state ------------------------------------------------------
+
+def headers_to_meta(h: dict, lock_enabled: bool, now_ns: int) -> dict:
+    """x-amz-object-lock-* request headers -> internal metadata.
+    Raises unless the bucket has object lock enabled (the reference
+    refuses lock headers on unlocked buckets)."""
+    mode = h.get(H_MODE, "")
+    until = h.get(H_UNTIL, "")
+    hold = h.get(H_HOLD, "")
+    if not (mode or until or hold):
+        return {}
+    if not lock_enabled:
+        raise ObjectLockError("InvalidRequest",
+                              "bucket is missing ObjectLockConfiguration")
+    out: dict = {}
+    if mode or until:
+        if mode not in (GOVERNANCE, COMPLIANCE) or not until:
+            raise ObjectLockError("InvalidArgument",
+                                  "lock mode and retain-until-date must "
+                                  "both be set")
+        if parse_iso8601(until) <= now_ns:
+            raise ObjectLockError("InvalidArgument",
+                                  "RetainUntilDate must be in the future")
+        out[META_MODE] = mode
+        out[META_UNTIL] = until
+    if hold:
+        if hold not in ("ON", "OFF"):
+            raise ObjectLockError("InvalidArgument",
+                                  f"bad legal hold {hold!r}")
+        out[META_HOLD] = hold
+    return out
+
+
+def meta_to_headers(imeta: dict) -> dict:
+    out = {}
+    if imeta.get(META_MODE):
+        out[H_MODE] = imeta[META_MODE]
+        out[H_UNTIL] = imeta.get(META_UNTIL, "")
+    if imeta.get(META_HOLD):
+        out[H_HOLD] = imeta[META_HOLD]
+    return out
+
+
+def retention_xml(imeta: dict) -> bytes:
+    root = ET.Element("Retention", xmlns=XMLNS)
+    if imeta.get(META_MODE):
+        ET.SubElement(root, "Mode").text = imeta[META_MODE]
+        ET.SubElement(root, "RetainUntilDate").text = \
+            imeta.get(META_UNTIL, "")
+    return b'<?xml version="1.0" encoding="UTF-8"?>\n' + ET.tostring(root)
+
+
+def parse_retention_xml(body: bytes) -> tuple[str, str]:
+    """-> (mode, until_iso); ("", "") clears (empty doc)."""
+    try:
+        root = ET.fromstring(body)
+    except ET.ParseError:
+        raise ObjectLockError("MalformedXML") from None
+    ns = f"{{{XMLNS}}}"
+    mode = root.findtext(f"{ns}Mode") or root.findtext("Mode") or ""
+    until = root.findtext(f"{ns}RetainUntilDate") or \
+        root.findtext("RetainUntilDate") or ""
+    if not mode and not until:
+        return "", ""
+    if mode not in (GOVERNANCE, COMPLIANCE):
+        raise ObjectLockError("MalformedXML", f"bad Mode {mode!r}")
+    if not until:
+        raise ObjectLockError("MalformedXML", "missing RetainUntilDate")
+    parse_iso8601(until)
+    return mode, until
+
+
+def legal_hold_xml(imeta: dict) -> bytes:
+    root = ET.Element("LegalHold", xmlns=XMLNS)
+    ET.SubElement(root, "Status").text = imeta.get(META_HOLD) or "OFF"
+    return b'<?xml version="1.0" encoding="UTF-8"?>\n' + ET.tostring(root)
+
+
+def parse_legal_hold_xml(body: bytes) -> str:
+    try:
+        root = ET.fromstring(body)
+    except ET.ParseError:
+        raise ObjectLockError("MalformedXML") from None
+    ns = f"{{{XMLNS}}}"
+    status = root.findtext(f"{ns}Status") or root.findtext("Status") or ""
+    if status not in ("ON", "OFF"):
+        raise ObjectLockError("MalformedXML", f"bad Status {status!r}")
+    return status
+
+
+# -- enforcement ------------------------------------------------------------
+
+def retained_until(imeta: dict) -> int:
+    """Active retention deadline in ns, 0 if none/expired-irrelevant."""
+    if not imeta.get(META_MODE):
+        return 0
+    try:
+        return parse_iso8601(imeta.get(META_UNTIL, ""))
+    except ObjectLockError:
+        # Unparseable stored date: treat as retained forever rather
+        # than silently unprotected.
+        return 1 << 62
+
+
+def check_version_deletable(imeta: dict, now_ns: int,
+                            bypass_governance: bool) -> Optional[str]:
+    """None if the version may be destroyed, else the S3 error code
+    (reference: enforceRetentionForDeletion,
+    cmd/bucket-object-lock.go)."""
+    if imeta.get(META_HOLD) == "ON":
+        return "AccessDenied"
+    mode = imeta.get(META_MODE)
+    if not mode:
+        return None
+    if retained_until(imeta) <= now_ns:
+        return None
+    if mode == GOVERNANCE and bypass_governance:
+        return None
+    return "AccessDenied"
+
+
+def check_retention_change(imeta: dict, new_mode: str, new_until: str,
+                           now_ns: int,
+                           bypass_governance: bool) -> Optional[str]:
+    """May the version's retention be set to (new_mode, new_until)?
+    COMPLIANCE only ever extends; GOVERNANCE shrinks/clears only with
+    bypass (reference: checkPutObjectRetentionAllowed,
+    cmd/object-handlers.go:2705)."""
+    cur_mode = imeta.get(META_MODE)
+    cur_until = retained_until(imeta)
+    if not cur_mode or cur_until <= now_ns:
+        return None                       # nothing active: any change ok
+    new_ns = parse_iso8601(new_until) if new_until else 0
+    if cur_mode == COMPLIANCE:
+        # Extension in COMPLIANCE is the single permitted change.
+        if new_mode == COMPLIANCE and new_ns >= cur_until:
+            return None
+        return "AccessDenied"
+    # GOVERNANCE: strengthening to a later date is fine; anything else
+    # (shorten, clear, mode change) needs the bypass permission.
+    if new_mode == GOVERNANCE and new_ns >= cur_until:
+        return None
+    return None if bypass_governance else "AccessDenied"

@@ -50,6 +50,9 @@ _CATALOG = {
     "InvalidDigest": (400, "The Content-Md5 you specified is not valid."),
     "MalformedPOSTRequest": (400, "The body of your POST request is not well-formed multipart/form-data."),
     "InvalidTag": (400, "The tag provided was not a valid tag."),
+    "InvalidBucketState": (409, "The request is not valid with the current state of the bucket."),
+    "NoSuchObjectLockConfiguration": (404, "The specified object does not have an ObjectLock configuration."),
+    "InvalidRetentionDate": (400, "Date must be provided in ISO 8601 format."),
 }
 
 

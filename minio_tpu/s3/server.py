@@ -650,11 +650,26 @@ def _make_handler(server: S3Server):
                 if name in query:
                     return self._bucket_config(method, bucket, name, query,
                                                body)
+            if "object-lock" in query:
+                return self._object_lock_config(method, bucket, body)
             if method == "PUT":
                 if "versioning" in query:
                     return self._put_versioning(bucket, body)
                 _validate_bucket_name(bucket)
                 ol.make_bucket(bucket)
+                if self._headers_lower().get(
+                        "x-amz-bucket-object-lock-enabled", "").lower() \
+                        == "true":
+                    # Lock-enabled buckets are born versioned with the
+                    # lock flag set atomically-enough (no objects can
+                    # exist yet) — reference: cmd/bucket-handlers.go
+                    # PutBucketHandler's objectLockEnabled path.
+                    from minio_tpu.object import objectlock as olock
+                    with server.bucket_meta_lock:
+                        meta = ol.get_bucket_meta(bucket)
+                        meta["versioning"] = True
+                        meta[olock.BUCKET_META_KEY] = {"enabled": True}
+                        ol.set_bucket_meta(bucket, meta)
                 return self._send(200, headers={"Location": f"/{bucket}"})
             if method == "HEAD":
                 ol.get_bucket_info(bucket)
@@ -674,11 +689,45 @@ def _make_handler(server: S3Server):
                     return self._get_versioning(bucket)
                 if "versions" in query:
                     return self._list_versions(bucket, query)
-                if "object-lock" in query:
-                    raise S3Error("ObjectLockConfigurationNotFoundError",
-                                  bucket=bucket)
                 return self._list_objects(bucket, query)
             raise S3Error("MethodNotAllowed")
+
+        def _lock_config(self, bucket) -> dict:
+            """The bucket's object-lock config ({} when lock-less).
+            Read failures PROPAGATE: returning {} on a transient error
+            would fail every lock check open (new versions without
+            default retention, versioning suspendable mid-outage)."""
+            from minio_tpu.object import objectlock as olock
+            return server.object_layer.get_bucket_meta(bucket).get(
+                olock.BUCKET_META_KEY) or {}
+
+        def _object_lock_config(self, method, bucket, body):
+            """GET/PUT ?object-lock (reference: cmd/bucket-handlers.go
+            GetBucketObjectLockConfigHandler /
+            PutBucketObjectLockConfigHandler)."""
+            from minio_tpu.object import objectlock as olock
+            ol = server.object_layer
+            ol.get_bucket_info(bucket)
+            if method == "GET":
+                cfg = self._lock_config(bucket)
+                if not cfg.get("enabled"):
+                    raise S3Error("ObjectLockConfigurationNotFoundError",
+                                  bucket=bucket)
+                return self._send(200, olock.lock_config_xml(cfg))
+            if method != "PUT":
+                raise S3Error("MethodNotAllowed")
+            try:
+                cfg = olock.parse_lock_config_xml(body)
+            except olock.ObjectLockError as e:
+                raise S3Error(e.code, str(e)) from None
+            with server.bucket_meta_lock:
+                meta = ol.get_bucket_meta(bucket)
+                # Enabling lock on an existing bucket requires (and
+                # then pins) versioning.
+                meta["versioning"] = True
+                meta[olock.BUCKET_META_KEY] = cfg
+                ol.set_bucket_meta(bucket, meta)
+            return self._send(200)
 
         def _list_versions(self, bucket, query):
             """GET ?versions — ListObjectVersions (reference:
@@ -766,6 +815,14 @@ def _make_handler(server: S3Server):
             setter = getattr(ol, "set_bucket_versioning", None)
             if setter is None:
                 raise S3Error("NotImplemented")
+            if status != "Enabled" and self._lock_config(bucket).get(
+                    "enabled"):
+                # WORM guarantee: a lock-enabled bucket can never stop
+                # versioning (reference: cmd/bucket-handlers.go
+                # PutBucketVersioningHandler's object-lock refusal).
+                raise S3Error("InvalidBucketState",
+                              "object lock requires versioning",
+                              bucket=bucket)
             with server.bucket_meta_lock:
                 setter(bucket, status == "Enabled")
             self._send(200)
@@ -841,10 +898,12 @@ def _make_handler(server: S3Server):
                      tree.findtext("Quiet") or "") == "true"
             root = ET.Element("DeleteResult", xmlns=XMLNS)
             versioned = _versioned(server.object_layer, bucket)
+            h = self._headers_lower()
             for obj in objs[:1000]:
                 key = obj.findtext(f"{ns}Key") or obj.findtext("Key") or ""
                 vid = obj.findtext(f"{ns}VersionId") or obj.findtext("VersionId") or ""
                 try:
+                    self._check_version_deletable(bucket, key, vid, h)
                     deleted = server.object_layer.delete_object(
                         bucket, key,
                         DeleteOptions(version_id=vid, versioned=versioned))
@@ -893,6 +952,12 @@ def _make_handler(server: S3Server):
             if "tagging" in query:
                 return self._object_tagging(method, bucket, key, query,
                                             payload)
+            if "retention" in query:
+                return self._object_retention(method, bucket, key, query,
+                                              payload)
+            if "legal-hold" in query:
+                return self._object_legal_hold(method, bucket, key, query,
+                                               payload)
             if method == "PUT":
                 return self._put_object(bucket, key, query, payload)
             if method in ("GET", "HEAD"):
@@ -965,6 +1030,140 @@ def _make_handler(server: S3Server):
                 return self._send(204)
             raise S3Error("MethodNotAllowed")
 
+        def _object_lock_put_meta(self, bucket, h) -> dict:
+            """Lock metadata for a new version: explicit request
+            headers win; otherwise the bucket's default-retention rule
+            applies (reference: cmd/api-headers.go +
+            cmd/bucket-object-lock.go defaults at PutObject)."""
+            from minio_tpu.object import objectlock as olock
+            cfg = self._lock_config(bucket)
+            now = _time_mod.time_ns()
+            try:
+                explicit = olock.headers_to_meta(h, cfg.get("enabled", False),
+                                                 now)
+            except olock.ObjectLockError as e:
+                raise S3Error(e.code, str(e)) from None
+            # Merge: the bucket default supplies retention unless the
+            # request set its own mode — a legal-hold-only header must
+            # not suppress the default-retention rule.
+            out = olock.default_retention_meta(cfg, now)
+            out.update(explicit)
+            return out
+
+        def _can_bypass_governance(self, bucket, key, h) -> bool:
+            """Governance bypass needs BOTH the explicit header and the
+            s3:BypassGovernanceRetention permission (reference:
+            cmd/bucket-object-lock.go enforceRetentionBypassForDelete)."""
+            if h.get(
+                    "x-amz-bypass-governance-retention", "").lower() != "true":
+                return False
+            ak = self._auth_key
+            return self._authorize(ak, ak == "",
+                                   "s3:BypassGovernanceRetention",
+                                   f"{bucket}/{key}",
+                                   self._auth_context(ak, {}, h))
+
+        def _object_retention(self, method, bucket, key, query, payload):
+            """GET/PUT ?retention (reference: cmd/object-handlers.go
+            GetObjectRetentionHandler / PutObjectRetentionHandler:2705)."""
+            from minio_tpu.object import objectlock as olock
+            vid = query.get("versionId", [""])[0]
+            # Consistent gate for every verb: retention APIs only exist
+            # on lock-enabled buckets (checked before any object read).
+            if not self._lock_config(bucket).get("enabled"):
+                raise S3Error("InvalidRequest", "bucket is missing "
+                              "ObjectLockConfiguration", bucket=bucket)
+            if method == "GET":
+                info = server.object_layer.get_object_info(
+                    bucket, key, GetOptions(version_id=vid))
+                if not info.internal_metadata.get(olock.META_MODE):
+                    raise S3Error("NoSuchObjectLockConfiguration",
+                                  bucket=bucket, key=key)
+                return self._send(200, olock.retention_xml(
+                    info.internal_metadata))
+            if method != "PUT":
+                raise S3Error("MethodNotAllowed")
+            body = payload.read_all() if payload is not None else b""
+            h = self._headers_lower()
+            try:
+                mode, until = olock.parse_retention_xml(body)
+                now = _time_mod.time_ns()
+                if until and olock.parse_iso8601(until) <= now:
+                    raise S3Error("InvalidArgument",
+                                  "RetainUntilDate must be in the future")
+                info = server.object_layer.get_object_info(
+                    bucket, key, GetOptions(version_id=vid))
+                denial = olock.check_retention_change(
+                    info.internal_metadata, mode, until, now,
+                    self._can_bypass_governance(bucket, key, h))
+            except olock.ObjectLockError as e:
+                raise S3Error(e.code, str(e)) from None
+            if denial:
+                raise S3Error(denial, "existing retention forbids this "
+                              "change", bucket=bucket, key=key)
+
+            def mutate(meta):
+                if mode:
+                    meta[olock.META_MODE] = mode
+                    meta[olock.META_UNTIL] = until
+                else:
+                    meta.pop(olock.META_MODE, None)
+                    meta.pop(olock.META_UNTIL, None)
+            server.object_layer.update_version_metadata(bucket, key, vid,
+                                                        mutate)
+            return self._send(200)
+
+        def _object_legal_hold(self, method, bucket, key, query, payload):
+            """GET/PUT ?legal-hold (reference: cmd/object-handlers.go
+            GetObjectLegalHoldHandler / PutObjectLegalHoldHandler:2862)."""
+            from minio_tpu.object import objectlock as olock
+            vid = query.get("versionId", [""])[0]
+            if not self._lock_config(bucket).get("enabled"):
+                raise S3Error("InvalidRequest", "bucket is missing "
+                              "ObjectLockConfiguration", bucket=bucket)
+            if method == "GET":
+                info = server.object_layer.get_object_info(
+                    bucket, key, GetOptions(version_id=vid))
+                return self._send(200, olock.legal_hold_xml(
+                    info.internal_metadata))
+            if method != "PUT":
+                raise S3Error("MethodNotAllowed")
+            body = payload.read_all() if payload is not None else b""
+            try:
+                status = olock.parse_legal_hold_xml(body)
+            except olock.ObjectLockError as e:
+                raise S3Error(e.code, str(e)) from None
+            server.object_layer.update_version_metadata(
+                bucket, key, vid,
+                lambda meta: meta.__setitem__(olock.META_HOLD, status))
+            return self._send(200)
+
+        def _check_version_deletable(self, bucket, key, vid, h):
+            """Refuse destroying a retained/held version (reference:
+            enforceRetentionForDeletion via DeleteObjectHandler). Only
+            version-targeted deletes destroy data; marker stacking is
+            always allowed."""
+            if not vid:
+                return
+            from minio_tpu.object import objectlock as olock
+            from minio_tpu.object.types import (MethodNotAllowed as _MNA,
+                                                ObjectNotFound as _ONF,
+                                                VersionNotFound as _VNF)
+            try:
+                info = server.object_layer.get_object_info(
+                    bucket, key, GetOptions(version_id=vid))
+            except (_ONF, _VNF, _MNA):
+                return          # absent or a delete marker: nothing held
+            imeta = info.internal_metadata
+            if not (imeta.get(olock.META_MODE) or imeta.get(olock.META_HOLD)):
+                return
+            denial = olock.check_version_deletable(
+                imeta, _time_mod.time_ns(),
+                self._can_bypass_governance(bucket, key, h))
+            if denial:
+                raise S3Error(denial, "object version is WORM-protected",
+                              bucket=bucket, key=key)
+
         # -- multipart --------------------------------------------------
 
         def _initiate_multipart(self, bucket, key):
@@ -990,6 +1189,8 @@ def _make_handler(server: S3Server):
                 user_metadata=meta,
                 content_type=h.get("content-type", ""),
                 storage_class=h.get("x-amz-storage-class", "STANDARD"))
+            opts.internal_metadata.update(
+                self._object_lock_put_meta(bucket, h))
             uid = server.object_layer.new_multipart_upload(bucket, key, opts)
             root = ET.Element("InitiateMultipartUploadResult", xmlns=XMLNS)
             _el(root, "Bucket", bucket)
@@ -1116,6 +1317,10 @@ def _make_handler(server: S3Server):
             opts = PutOptions(
                 versioned=_versioned(server.object_layer, bucket),
                 user_metadata=meta, content_type=ctype, tags=tags)
+            # Copies into a lock-enabled bucket honor lock headers and
+            # the default-retention rule like any other new version.
+            opts.internal_metadata.update(
+                self._object_lock_put_meta(bucket, h))
             out_payload, sse_headers = self._apply_sse(
                 bucket, key, Payload.wrap(payload), h, opts)
             info = server.object_layer.put_object(
@@ -1162,6 +1367,8 @@ def _make_handler(server: S3Server):
                 content_type=h.get("content-type", ""),
                 storage_class=h.get("x-amz-storage-class", "STANDARD"),
                 tags=h.get("x-amz-tagging", ""))
+            opts.internal_metadata.update(
+                self._object_lock_put_meta(bucket, h))
             plain_size = payload.size
             payload, sse_headers = self._apply_sse(bucket, key, payload,
                                                    h, opts)
@@ -1521,6 +1728,8 @@ def _make_handler(server: S3Server):
                 "Accept-Ranges": "bytes",
             }
             headers.update(self._sse_response_headers(h, info))
+            from minio_tpu.object import objectlock as olock
+            headers.update(olock.meta_to_headers(info.internal_metadata))
             repl = info.internal_metadata.get("x-internal-repl-status")
             if repl:
                 headers["x-amz-replication-status"] = repl
@@ -1687,6 +1896,10 @@ def _make_handler(server: S3Server):
                 user_metadata=meta,
                 content_type=fields.get("content-type", ""),
                 tags=fields.get("tagging", ""))
+            # Form fields carry the same x-amz-object-lock-* names as
+            # headers; lock metadata and bucket defaults apply equally.
+            opts.internal_metadata.update(
+                self._object_lock_put_meta(bucket, fields))
             # Bucket default encryption applies to form uploads too
             # (explicit SSE form fields ride the same header names).
             post_payload, _ = self._apply_sse(
@@ -2039,6 +2252,8 @@ def _make_handler(server: S3Server):
 
         def _delete_object(self, bucket, key, query):
             vid = query.get("versionId", [""])[0]
+            self._check_version_deletable(bucket, key, vid,
+                                          self._headers_lower())
             deleted = server.object_layer.delete_object(
                 bucket, key, DeleteOptions(
                     version_id=vid,
@@ -2121,6 +2336,9 @@ def _required_permissions(method: str, bucket: str, key: str, query: dict,
                         "DELETE": "Delete"}.get(method, "Get")
                 perms.append((f"s3:{verb}{stem}", bucket))
                 return perms
+        if "object-lock" in query:
+            verb = "Put" if method == "PUT" else "Get"
+            return [(f"s3:{verb}BucketObjectLockConfiguration", bucket)]
         if method == "PUT":
             perms.append(("s3:PutBucketVersioning", bucket)
                          if "versioning" in query
@@ -2152,6 +2370,12 @@ def _required_permissions(method: str, bucket: str, key: str, query: dict,
             method, "Get")
         perms.append((f"s3:{verb}ObjectTagging", res))
         return perms
+    if "retention" in query:
+        verb = "Put" if method == "PUT" else "Get"
+        return [(f"s3:{verb}ObjectRetention", res)]
+    if "legal-hold" in query:
+        verb = "Put" if method == "PUT" else "Get"
+        return [(f"s3:{verb}ObjectLegalHold", res)]
     if method in ("GET", "HEAD"):
         if "uploadId" in query:
             perms.append(("s3:ListMultipartUploadParts", res))
