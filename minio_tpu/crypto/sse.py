@@ -27,6 +27,7 @@ META_KEY = "x-internal-sse-key"          # sealed data key (json)
 META_NONCE = "x-internal-sse-nonce"      # base64 12-byte base nonce
 META_SIZE = "x-internal-sse-size"        # plaintext size (decimal str)
 META_KEY_MD5 = "x-internal-sse-c-md5"    # SSE-C customer key MD5 (b64)
+META_MULTIPART = "x-internal-sse-mp"     # "1": per-part DARE streams
 
 H_SSE = "x-amz-server-side-encryption"
 H_C_ALG = "x-amz-server-side-encryption-customer-algorithm"
@@ -74,6 +75,20 @@ def wants_sse_s3(h: dict, bucket_encryption_cfg: Optional[str]) -> bool:
 
 def _context(bucket: str, key: str) -> dict:
     return {"bucket": bucket, "object": key}
+
+
+def part_key(data_key: bytes, part_number: int) -> bytes:
+    """Per-part encryption key for multipart DARE streams.
+
+    Each part is an independent DARE stream; deriving a distinct key
+    per part (HMAC over the object data key, like the reference's
+    DerivePartKey in cmd/encryption-v1.go:643 territory) makes the
+    shared base nonce safe — (key, nonce, seq) never repeats across
+    parts — and binds each part's ciphertext to its part number, so
+    parts cannot be reordered on disk undetected."""
+    import hmac as _hmac
+    return _hmac.new(data_key, b"dare-part-%d" % part_number,
+                     hashlib.sha256).digest()
 
 
 def seal_with_customer_key(data_key: bytes, customer_key: bytes,

@@ -166,10 +166,18 @@ class ErasureSet:
         from minio_tpu.object import multipart
         return multipart.new_multipart_upload(self, bucket, object_, opts)
 
-    def put_object_part(self, bucket, object_, upload_id, part_number, data):
+    def put_object_part(self, bucket, object_, upload_id, part_number, data,
+                        actual_size=None, nonce=""):
         from minio_tpu.object import multipart
         return multipart.put_object_part(self, bucket, object_, upload_id,
-                                         part_number, data)
+                                         part_number, data,
+                                         actual_size=actual_size,
+                                         nonce=nonce)
+
+    def get_multipart_upload(self, bucket, object_, upload_id):
+        from minio_tpu.object import multipart
+        return multipart.get_multipart_upload(self, bucket, object_,
+                                              upload_id)
 
     def complete_multipart_upload(self, bucket, object_, upload_id, parts):
         from minio_tpu.object import multipart
@@ -1082,7 +1090,8 @@ class ErasureSet:
                           version_id=fi.version_id, is_latest=fi.is_latest,
                           delete_marker=fi.deleted, user_metadata=meta,
                           actual_size=size, user_tags=tags,
-                          internal_metadata=internal)
+                          internal_metadata=internal,
+                          parts=list(fi.parts or []))
 
     def update_version_metadata(self, bucket: str, object_: str,
                                 version_id: str,

@@ -71,6 +71,10 @@ def new_multipart_upload(es, bucket: str, object_: str,
         "distribution": eo.hash_order(f"{bucket}/{object_}", n),
         "user_metadata": {k: v for k, v in opts.user_metadata.items()
                           if not k.startswith("x-internal-")},
+        # SSE params, object-lock state, ...: applied to the final
+        # object's metadata at complete (the reference persists them in
+        # the upload's fileInfo the same way).
+        "internal_metadata": dict(opts.internal_metadata),
         "content_type": opts.content_type,
         "versioned": bool(opts.versioned),
         "initiated": now_ns(),
@@ -98,8 +102,22 @@ def _read_upload(es, bucket: str, object_: str, upload_id: str) -> dict:
     raise UploadNotFound(upload_id)
 
 
+def get_multipart_upload(es, bucket: str, object_: str,
+                         upload_id: str) -> dict:
+    """The upload's persisted record (metadata, EC layout) — the API
+    layer consults it for SSE parameters before encrypting parts."""
+    return _read_upload(es, bucket, object_, upload_id)
+
+
 def put_object_part(es, bucket: str, object_: str, upload_id: str,
-                    part_number: int, data) -> ObjectPartInfo:
+                    part_number: int, data,
+                    actual_size: Optional[int] = None,
+                    nonce: str = "") -> ObjectPartInfo:
+    """`actual_size`: logical (pre-transform) part size when `data` is
+    a transformed stream (SSE ciphertext); defaults to the stored
+    size. `nonce`: the part's DARE base nonce (base64) when encrypted —
+    fresh per attempt, persisted with the part so re-uploads never
+    reuse an AES-GCM (key, nonce) pair."""
     from minio_tpu.object import erasure_object as eo
     from minio_tpu.utils.streams import Payload
     if not (1 <= part_number <= MAX_PARTS):
@@ -110,6 +128,7 @@ def put_object_part(es, bucket: str, object_: str, upload_id: str,
     write_quorum = k + (1 if k == m else 0)
     payload = Payload.wrap(data)
     size = payload.size
+    logical = actual_size if actual_size is not None else size
     # Each upload attempt gets its own data file; the atomic .meta replace
     # referencing it is the commit point, so a crash or concurrent
     # re-upload of the same part can never pair a torn data file with a
@@ -140,8 +159,9 @@ def put_object_part(es, bucket: str, object_: str, upload_id: str,
         if len(staged) < write_quorum:
             cleanup_staged()
             raise WriteQuorumError(bucket, object_)
-        meta = {"number": part_number, "size": size, "actual_size": size,
-                "etag": etag, "mod_time": now_ns(), "file": data_file}
+        meta = {"number": part_number, "size": size,
+                "actual_size": logical, "etag": etag, "mod_time": now_ns(),
+                "file": data_file, "nonce": nonce}
         blob = json.dumps(meta).encode()
         _, merrors = es._fanout(
             [lambda i=i: es.disks[i].write_all(
@@ -151,15 +171,15 @@ def put_object_part(es, bucket: str, object_: str, upload_id: str,
             cleanup_staged()
             raise WriteQuorumError(bucket, object_)
         return ObjectPartInfo(number=part_number, size=size,
-                              actual_size=size, etag=etag,
-                              mod_time=meta["mod_time"])
+                              actual_size=logical, etag=etag,
+                              mod_time=meta["mod_time"], nonce=nonce)
 
     body = payload.read_all()
     framed = es._encode_and_frame(body, k, m)
     etag = hashlib.md5(body).hexdigest()
     meta = {"number": part_number, "size": size,
-            "actual_size": size, "etag": etag, "mod_time": now_ns(),
-            "file": data_file}
+            "actual_size": logical, "etag": etag, "mod_time": now_ns(),
+            "file": data_file, "nonce": nonce}
 
     def write_one(disk_idx: int):
         d = es.disks[disk_idx]
@@ -174,8 +194,8 @@ def put_object_part(es, bucket: str, object_: str, upload_id: str,
     if sum(e2 is None for e2 in errors) < write_quorum:
         raise WriteQuorumError(bucket, object_)
     return ObjectPartInfo(number=part_number, size=size,
-                          actual_size=size, etag=etag,
-                          mod_time=meta["mod_time"])
+                          actual_size=logical, etag=etag,
+                          mod_time=meta["mod_time"], nonce=nonce)
 
 
 def _read_part_meta(es, updir: str, part_number: int) -> Optional[dict]:
@@ -282,25 +302,36 @@ def complete_multipart_upload(es, bucket: str, object_: str, upload_id: str,
     part_files: dict[int, str] = {}
     md5_concat = b""
     total = 0
+    actual_total = 0
     for idx, (num, etag) in enumerate(parts):
         meta = _read_part_meta(es, updir, num)
         clean = etag.strip('"')
         if meta is None or meta["etag"] != clean:
             raise InvalidPart(f"part {num}")
-        if meta["size"] < MIN_PART_SIZE and idx != len(parts) - 1:
+        if meta["actual_size"] < MIN_PART_SIZE and idx != len(parts) - 1:
+            # The S3 minimum is on the CLIENT payload; ciphertext
+            # expansion must not let an undersized part slip through.
             raise EntityTooSmall(f"part {num}")
         fi_parts.append(ObjectPartInfo(
             number=num, size=meta["size"], actual_size=meta["actual_size"],
-            etag=clean, mod_time=meta["mod_time"]))
+            etag=clean, mod_time=meta["mod_time"],
+            nonce=meta.get("nonce", "")))
         part_files[num] = meta.get("file", f"part.{num}")
         md5_concat += bytes.fromhex(clean)
         total += meta["size"]
+        actual_total += meta["actual_size"]
 
     etag = hashlib.md5(md5_concat).hexdigest() + f"-{len(parts)}"
     version_id = new_uuid() if rec.get("versioned") else ""
     mod_time = now_ns()
     data_dir = new_uuid()
     metadata = dict(rec.get("user_metadata") or {})
+    metadata.update(rec.get("internal_metadata") or {})
+    if metadata.get("x-internal-sse-alg"):
+        # The plaintext size is unknowable at initiate; the summed part
+        # logical sizes ARE it (the GET path and HEAD report from this
+        # key, crypto/sse.py META_SIZE).
+        metadata["x-internal-sse-size"] = str(actual_total)
     metadata["etag"] = etag
     if rec.get("content_type"):
         metadata["content-type"] = rec["content_type"]

@@ -206,9 +206,15 @@ class ServerPools:
                 continue
         raise UploadNotFound(upload_id)
 
-    def put_object_part(self, bucket, object_, upload_id, part_number, data):
+    def put_object_part(self, bucket, object_, upload_id, part_number, data,
+                        actual_size=None, nonce=""):
         return self._upload_pool(bucket, object_, upload_id).put_object_part(
-            bucket, object_, upload_id, part_number, data)
+            bucket, object_, upload_id, part_number, data,
+            actual_size=actual_size, nonce=nonce)
+
+    def get_multipart_upload(self, bucket, object_, upload_id):
+        return self._upload_pool(bucket, object_, upload_id) \
+            .get_multipart_upload(bucket, object_, upload_id)
 
     def complete_multipart_upload(self, bucket, object_, upload_id, parts):
         return self._upload_pool(bucket, object_, upload_id) \

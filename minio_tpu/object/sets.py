@@ -201,9 +201,15 @@ class ErasureSets:
         return self.set_for(object_).new_multipart_upload(bucket, object_,
                                                           opts)
 
-    def put_object_part(self, bucket, object_, upload_id, part_number, data):
+    def put_object_part(self, bucket, object_, upload_id, part_number, data,
+                        actual_size=None, nonce=""):
         return self.set_for(object_).put_object_part(
-            bucket, object_, upload_id, part_number, data)
+            bucket, object_, upload_id, part_number, data,
+            actual_size=actual_size, nonce=nonce)
+
+    def get_multipart_upload(self, bucket, object_, upload_id):
+        return self.set_for(object_).get_multipart_upload(
+            bucket, object_, upload_id)
 
     def complete_multipart_upload(self, bucket, object_, upload_id, parts):
         return self.set_for(object_).complete_multipart_upload(

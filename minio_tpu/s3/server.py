@@ -815,15 +815,18 @@ def _make_handler(server: S3Server):
             setter = getattr(ol, "set_bucket_versioning", None)
             if setter is None:
                 raise S3Error("NotImplemented")
-            if status != "Enabled" and self._lock_config(bucket).get(
-                    "enabled"):
-                # WORM guarantee: a lock-enabled bucket can never stop
-                # versioning (reference: cmd/bucket-handlers.go
-                # PutBucketVersioningHandler's object-lock refusal).
-                raise S3Error("InvalidBucketState",
-                              "object lock requires versioning",
-                              bucket=bucket)
             with server.bucket_meta_lock:
+                # Lock-config check INSIDE the metadata lock: checked
+                # outside, a concurrent PutObjectLockConfiguration could
+                # commit between check and write, leaving a WORM bucket
+                # unversioned. WORM guarantee: a lock-enabled bucket can
+                # never stop versioning (reference:
+                # cmd/bucket-handlers.go PutBucketVersioningHandler).
+                if status != "Enabled" and self._lock_config(bucket).get(
+                        "enabled"):
+                    raise S3Error("InvalidBucketState",
+                                  "object lock requires versioning",
+                                  bucket=bucket)
                 setter(bucket, status == "Enabled")
             self._send(200)
 
@@ -988,8 +991,23 @@ def _make_handler(server: S3Server):
                         customer)
                 except sse_mod.SSEError as e:
                     raise S3Error(e.code, str(e)) from None
-                data = b"".join(decrypt_packages(
-                    iter([data]), data_key, nonce, 0, 0, info.size))
+                if info.internal_metadata.get(sse_mod.META_MULTIPART) \
+                        and info.parts:
+                    # Per-part DARE streams decrypt independently,
+                    # each under its own stored base nonce.
+                    import base64 as _b64
+                    out, off = [], 0
+                    for p in info.parts:
+                        pn = _b64.b64decode(p.nonce) if p.nonce else nonce
+                        out.append(b"".join(decrypt_packages(
+                            iter([data[off:off + p.size]]),
+                            sse_mod.part_key(data_key, p.number), pn,
+                            0, 0, p.actual_size)))
+                        off += p.size
+                    data = b"".join(out)
+                else:
+                    data = b"".join(decrypt_packages(
+                        iter([data]), data_key, nonce, 0, 0, info.size))
             elif info.internal_metadata.get("x-internal-comp"):
                 from minio_tpu.crypto import compress as comp
                 try:
@@ -1145,6 +1163,13 @@ def _make_handler(server: S3Server):
             always allowed."""
             if not vid:
                 return
+            # Lock can never be disabled once enabled, so a bucket whose
+            # (TTL-cached) config lacks it holds no retained versions —
+            # skip the per-version quorum metadata read on the common
+            # path (bulk version deletes would otherwise double their
+            # metadata I/O).
+            if not self._lock_config(bucket).get("enabled"):
+                return
             from minio_tpu.object import objectlock as olock
             from minio_tpu.object.types import (MethodNotAllowed as _MNA,
                                                 ObjectNotFound as _ONF,
@@ -1169,19 +1194,6 @@ def _make_handler(server: S3Server):
         def _initiate_multipart(self, bucket, key):
             h = self._headers_lower()
             from minio_tpu.crypto import sse as sse_mod
-            try:
-                enc_cfg = server.object_layer.get_bucket_meta(bucket) \
-                    .get("config:encryption")
-            except Exception:  # noqa: BLE001 - bucket checked below
-                enc_cfg = None
-            if h.get(sse_mod.H_SSE) or h.get(sse_mod.H_C_ALG) or enc_cfg:
-                # v1 restriction: multipart parts are independently
-                # erasure-coded; per-part DARE streams are not wired
-                # yet. Failing LOUDLY beats silently storing plaintext
-                # in a bucket whose default demands encryption.
-                raise S3Error("NotImplemented",
-                              "SSE with multipart uploads is not "
-                              "supported yet")
             meta = {k[len("x-amz-meta-"):]: v for k, v in h.items()
                     if k.startswith("x-amz-meta-")}
             opts = PutOptions(
@@ -1191,12 +1203,77 @@ def _make_handler(server: S3Server):
                 storage_class=h.get("x-amz-storage-class", "STANDARD"))
             opts.internal_metadata.update(
                 self._object_lock_put_meta(bucket, h))
+            # SSE multipart: choose/seal the object data key NOW and
+            # persist the params with the upload; each part becomes its
+            # own DARE stream under a per-part derived key (reference:
+            # cmd/encryption-v1.go:643 part-boundary crypto).
+            sse_headers = {}
+            try:
+                customer = sse_mod.parse_sse_c(h)
+                enc_cfg = None
+                if customer is None:
+                    try:
+                        enc_cfg = server.object_layer.get_bucket_meta(
+                            bucket).get("config:encryption")
+                    except Exception:  # noqa: BLE001 - checked at create
+                        enc_cfg = None
+                if customer is not None or sse_mod.wants_sse_s3(h, enc_cfg):
+                    _, _, imeta = sse_mod.encrypt_metadata(
+                        bucket, key, 0, server.kms, customer)
+                    imeta[sse_mod.META_MULTIPART] = "1"
+                    opts.internal_metadata.update(imeta)
+                    if customer is not None:
+                        sse_headers = {sse_mod.H_C_ALG: "AES256",
+                                       sse_mod.H_C_MD5: customer[1]}
+                    else:
+                        sse_headers = {sse_mod.H_SSE: "AES256"}
+            except sse_mod.SSEError as e:
+                raise S3Error(e.code, str(e)) from None
             uid = server.object_layer.new_multipart_upload(bucket, key, opts)
             root = ET.Element("InitiateMultipartUploadResult", xmlns=XMLNS)
             _el(root, "Bucket", bucket)
             _el(root, "Key", key)
             _el(root, "UploadId", uid)
-            self._send(200, _xml(root))
+            self._send(200, _xml(root), headers=sse_headers)
+
+        def _part_sse_wrap(self, bucket, key, uid, part_num, payload, h):
+            """Encrypt one part's payload when the upload was initiated
+            with SSE: an independent DARE stream under the per-part
+            derived key (crypto/sse.part_key) and a FRESH random base
+            nonce persisted with the part — a re-uploaded part number
+            must never reuse an AES-GCM (key, nonce, seq) tuple on
+            different plaintext. Returns (payload, actual_size|None,
+            part nonce b64, response headers). Errors reading the
+            upload record PROPAGATE: silently storing an SSE part as
+            plaintext is the one unacceptable failure mode."""
+            from minio_tpu.crypto import (EncryptingPayload,
+                                          encrypt_stream_size)
+            from minio_tpu.crypto import sse as sse_mod
+            rec = server.object_layer.get_multipart_upload(bucket, key, uid)
+            imeta = rec.get("internal_metadata") or {}
+            if not imeta.get(sse_mod.META_ALG):
+                return payload, None, "", {}
+            try:
+                customer = sse_mod.parse_sse_c(h)
+                data_key, _ = sse_mod.decrypt_params(
+                    bucket, key, imeta, server.kms, customer)
+            except sse_mod.SSEError as e:
+                raise S3Error(e.code, str(e)) from None
+            part_nonce = os.urandom(12)
+            plain = payload.size
+            enc = EncryptingPayload(payload,
+                                    sse_mod.part_key(data_key, part_num),
+                                    part_nonce)
+            # The inner payload runs its own finish (signature/trailer
+            # verification) as the encryptor drains its last byte.
+            out = Payload(enc, encrypt_stream_size(plain))
+            if customer is not None:
+                hdrs = {sse_mod.H_C_ALG: "AES256",
+                        sse_mod.H_C_MD5: customer[1]}
+            else:
+                hdrs = {sse_mod.H_SSE: "AES256"}
+            import base64 as _b64
+            return out, plain, _b64.b64encode(part_nonce).decode(), hdrs
 
         def _put_part(self, bucket, key, query, payload, h):
             try:
@@ -1220,15 +1297,21 @@ def _make_handler(server: S3Server):
                 # PLAINTEXT part bytes (range in plaintext space too).
                 _, body = self._read_source_plain(sbucket, skey, src_vid,
                                                   spec, h)
+                cpay, actual, pnonce, sse_hdrs = self._part_sse_wrap(
+                    bucket, key, uid, part_num, Payload.wrap(body), h)
                 part = server.object_layer.put_object_part(
-                    bucket, key, uid, part_num, body)
+                    bucket, key, uid, part_num, cpay, actual_size=actual,
+                    nonce=pnonce)
                 root = ET.Element("CopyPartResult", xmlns=XMLNS)
                 _el(root, "ETag", f'"{part.etag}"')
                 _el(root, "LastModified", _iso8601(part.mod_time))
-                return self._send(200, _xml(root))
+                return self._send(200, _xml(root), headers=sse_hdrs)
+            payload, actual, pnonce, sse_hdrs = self._part_sse_wrap(
+                bucket, key, uid, part_num, payload, h)
             part = server.object_layer.put_object_part(
-                bucket, key, uid, part_num, payload)
-            self._send(200, headers={"ETag": f'"{part.etag}"'})
+                bucket, key, uid, part_num, payload, actual_size=actual,
+                nonce=pnonce)
+            self._send(200, headers={"ETag": f'"{part.etag}"', **sse_hdrs})
 
         def _complete_multipart(self, bucket, key, query, body):
             uid = query["uploadId"][0]
@@ -1542,10 +1625,6 @@ def _make_handler(server: S3Server):
                     sbucket, skey, GetOptions(version_id=src_vid,
                                               range_spec=spec))
             from minio_tpu.crypto import sse as sse_mod
-            from minio_tpu.crypto.dare import (PACKAGE_SIZE,
-                                               decrypt_packages,
-                                               encrypt_stream_size,
-                                               package_range)
             src_h = {}
             pfx = "x-amz-copy-source-server-side-encryption-customer-"
             for tail, name in (("algorithm", sse_mod.H_C_ALG),
@@ -1554,32 +1633,19 @@ def _make_handler(server: S3Server):
                 v = h.get(pfx + tail)
                 if v is not None:
                     src_h[name] = v
-            try:
-                src_cust = sse_mod.parse_sse_c(src_h)
-                data_key, nonce = sse_mod.decrypt_params(
-                    sbucket, skey, sinfo.internal_metadata, server.kms,
-                    src_cust)
-            except sse_mod.SSEError as e:
-                raise S3Error(e.code, str(e)) from None
-            start, length = (_resolve_head_range(spec, sinfo.size)
-                             if spec else (0, sinfo.size))
-            sinfo.range_start, sinfo.range_length = start, length
-            if length <= 0 or sinfo.size == 0:
-                return sinfo, b""
-            first, c_off, c_len = package_range(start, length)
-            c_len = min(c_len, encrypt_stream_size(sinfo.size) - c_off)
-            pin = src_vid or sinfo.version_id
-            _, raw = server.object_layer.get_object_stream(
-                sbucket, skey, GetOptions(version_id=pin, offset=c_off,
-                                          length=c_len))
-            body = b"".join(decrypt_packages(
-                raw, data_key, nonce, first,
-                start - first * PACKAGE_SIZE, length))
-            return sinfo, body
+            # The GET-side decryptor handles both single-stream and
+            # per-part multipart DARE layouts.
+            sinfo, chunks, _, _ = self._get_encrypted(
+                sbucket, skey, src_vid or sinfo.version_id, spec, src_h,
+                sinfo)
+            return sinfo, b"".join(chunks)
 
         def _get_encrypted(self, bucket, key, vid, spec, h, info):
             """Ranged decrypting GET: map the plaintext range onto
-            package-aligned ciphertext, stream, decrypt, trim."""
+            package-aligned ciphertext, stream, decrypt, trim. An SSE
+            multipart object is a sequence of independent per-part DARE
+            streams (reference: cmd/encryption-v1.go:643 part-boundary
+            decryption); a single PUT is one stream."""
             from minio_tpu.crypto import sse as sse_mod
             from minio_tpu.crypto.dare import (PACKAGE_SIZE,
                                                decrypt_packages,
@@ -1597,6 +1663,13 @@ def _make_handler(server: S3Server):
             info.range_start, info.range_length = start, length
             if length <= 0 or info.size == 0:
                 return info, (b for b in ()), start, max(length, 0)
+            if info.internal_metadata.get(sse_mod.META_MULTIPART) \
+                    and info.parts:
+                gen = self._decrypt_parts_gen(bucket, key,
+                                              vid or info.version_id,
+                                              info, data_key, nonce,
+                                              start, length)
+                return info, gen, start, length
             first, c_off, c_len = package_range(start, length)
             c_size = encrypt_stream_size(info.size)
             c_len = min(c_len, c_size - c_off)
@@ -1606,6 +1679,85 @@ def _make_handler(server: S3Server):
             chunks = decrypt_packages(raw, data_key, nonce, first,
                                       start - first * PACKAGE_SIZE, length)
             return info, chunks, start, length
+
+        def _decrypt_parts_gen(self, bucket, key, vid, info, data_key,
+                               nonce, start, length):
+            """Plaintext range [start, start+length) across per-part
+            DARE streams. Part boundaries in the STORED stream are the
+            summed ciphertext part sizes; in the plaintext space the
+            summed logical sizes. The whole covering stored range is
+            fetched in ONE get_object_stream call — the per-part slices
+            are contiguous (first part reads to its stored end, middles
+            whole, last from its start), and a single read means a
+            single version resolution, so a concurrent overwrite in an
+            unversioned bucket cannot interleave versions mid-response.
+            Each part decrypts under its derived key and its own stored
+            base nonce."""
+            import base64 as _b64
+            from minio_tpu.crypto import sse as sse_mod
+            from minio_tpu.crypto.dare import (PACKAGE_SIZE,
+                                               decrypt_packages,
+                                               package_range)
+            # Plan: (part, first_seq, skip, plain_len, stored_lo, stored_len)
+            plan = []
+            pos, remaining = start, length
+            plain_off = stored_off = 0
+            for p in info.parts:
+                if remaining <= 0:
+                    break
+                if pos >= plain_off + p.actual_size:
+                    plain_off += p.actual_size
+                    stored_off += p.size
+                    continue
+                in_off = pos - plain_off
+                in_len = min(remaining, p.actual_size - in_off)
+                first, c_off, c_len = package_range(in_off, in_len)
+                c_len = min(c_len, p.size - c_off)
+                plan.append((p, first, in_off - first * PACKAGE_SIZE,
+                             in_len, stored_off + c_off, c_len))
+                pos += in_len
+                remaining -= in_len
+                plain_off += p.actual_size
+                stored_off += p.size
+            if not plan:
+                return
+            lo = plan[0][4]
+            hi = plan[-1][4] + plan[-1][5]
+            _, raw = server.object_layer.get_object_stream(
+                bucket, key, GetOptions(version_id=vid, offset=lo,
+                                        length=hi - lo))
+            carry = bytearray()
+            raw_iter = iter(raw)
+
+            def take(n):
+                """Yield exactly n bytes from the shared stored stream."""
+                nonlocal carry
+                while n > 0:
+                    if carry:
+                        chunk = bytes(carry[:n])
+                        del carry[:len(chunk)]
+                    else:
+                        try:
+                            chunk = next(raw_iter)
+                        except StopIteration:
+                            return       # decryptor reports the shortfall
+                        if len(chunk) > n:
+                            carry.extend(chunk[n:])
+                            chunk = chunk[:n]
+                    n -= len(chunk)
+                    yield chunk
+
+            try:
+                for p, first, skip, plain_len, _s_lo, s_len in plan:
+                    part_nonce = _b64.b64decode(p.nonce) if p.nonce \
+                        else nonce
+                    yield from decrypt_packages(
+                        take(s_len), sse_mod.part_key(data_key, p.number),
+                        part_nonce, first, skip, plain_len)
+            finally:
+                close = getattr(raw, "close", None)
+                if close is not None:
+                    close()
 
         def _check_conditions(self, h, info, for_read: bool,
                               prefix: str = "") -> bool:

@@ -87,15 +87,23 @@ class ObjectPartInfo:
     actual_size: int             # original client payload size
     mod_time: int = 0
     etag: str = ""
+    # SSE multipart: this part's DARE base nonce, base64 (fresh random
+    # per upload ATTEMPT — a re-uploaded part must never reuse AES-GCM
+    # (key, nonce) pairs on different plaintext). "" for plain parts.
+    nonce: str = ""
 
     def to_map(self) -> dict:
-        return {"n": self.number, "s": self.size, "as": self.actual_size,
-                "mt": self.mod_time, "etag": self.etag}
+        m = {"n": self.number, "s": self.size, "as": self.actual_size,
+             "mt": self.mod_time, "etag": self.etag}
+        if self.nonce:
+            m["nc"] = self.nonce
+        return m
 
     @classmethod
     def from_map(cls, m: dict) -> "ObjectPartInfo":
         return cls(number=m["n"], size=m["s"], actual_size=m.get("as", m["s"]),
-                   mod_time=m.get("mt", 0), etag=m.get("etag", ""))
+                   mod_time=m.get("mt", 0), etag=m.get("etag", ""),
+                   nonce=m.get("nc", ""))
 
 
 @dataclasses.dataclass

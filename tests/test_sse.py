@@ -233,11 +233,145 @@ def test_copy_encrypted_to_plaintext_and_back(cli):
     assert hh.get("x-amz-server-side-encryption") == "AES256"
 
 
-def test_multipart_with_sse_rejected(cli):
-    st, _, _ = cli.request("POST", "/sseb/mp", query={"uploads": ""},
-                           headers={"x-amz-server-side-encryption":
-                                    "AES256"})
-    assert st == 501
+def _mp_upload(cli, bucket, key, parts, init_headers=None,
+               part_headers=None):
+    """Initiate → upload parts → complete; returns (statuses, etags)."""
+    st, _, body = cli.request("POST", f"/{bucket}/{key}",
+                              query={"uploads": ""},
+                              headers=init_headers or {})
+    assert st == 200, body
+    uid = body.split(b"<UploadId>")[1].split(b"</UploadId>")[0].decode()
+    etags = []
+    for i, data in enumerate(parts, start=1):
+        st, hh, b2 = cli.request("PUT", f"/{bucket}/{key}",
+                                 query={"partNumber": str(i),
+                                        "uploadId": uid},
+                                 body=data, headers=part_headers or {})
+        assert st == 200, b2
+        etags.append(hh.get("etag") or hh.get("ETag"))
+    xml = "<CompleteMultipartUpload>" + "".join(
+        f"<Part><PartNumber>{i}</PartNumber><ETag>{e}</ETag></Part>"
+        for i, e in enumerate(etags, start=1)) + \
+        "</CompleteMultipartUpload>"
+    st, _, b3 = cli.request("POST", f"/{bucket}/{key}",
+                            query={"uploadId": uid}, body=xml.encode())
+    assert st == 200, b3
+    return uid, etags
+
+
+def test_multipart_sse_s3_roundtrip_and_ranges(cli):
+    """16 x 5 MiB-class encrypted multipart: full read, ranged reads
+    across part boundaries, part-straddling and suffix ranges
+    (reference: cmd/encryption-v1.go:643 part-boundary decryption)."""
+    part_size = 5 << 20
+    parts = [os.urandom(part_size) for _ in range(3)] + [os.urandom(1234)]
+    whole = b"".join(parts)
+    _mp_upload(cli, "sseb", "mpenc", parts,
+               init_headers={"x-amz-server-side-encryption": "AES256"})
+    st, hh, got = cli.request("GET", "/sseb/mpenc")
+    assert st == 200 and got == whole
+    assert hh.get("x-amz-server-side-encryption") == "AES256"
+    # HEAD reports the plaintext size.
+    st, hh, _ = cli.request("HEAD", "/sseb/mpenc")
+    assert int(hh.get("content-length") or hh.get("Content-Length")) == \
+        len(whole)
+    # Range inside one part.
+    st, _, got = cli.request("GET", "/sseb/mpenc",
+                             headers={"Range": "bytes=1000-1999"})
+    assert st == 206 and got == whole[1000:2000]
+    # Range straddling the part-1/part-2 boundary.
+    lo, hi = part_size - 500, part_size + 499
+    st, _, got = cli.request("GET", "/sseb/mpenc",
+                             headers={"Range": f"bytes={lo}-{hi}"})
+    assert st == 206 and got == whole[lo:hi + 1]
+    # Range spanning three parts.
+    lo, hi = part_size - 10, 2 * part_size + 9
+    st, _, got = cli.request("GET", "/sseb/mpenc",
+                             headers={"Range": f"bytes={lo}-{hi}"})
+    assert st == 206 and got == whole[lo:hi + 1]
+    # Suffix range into the small final part.
+    st, _, got = cli.request("GET", "/sseb/mpenc",
+                             headers={"Range": "bytes=-2000"})
+    assert st == 206 and got == whole[-2000:]
+
+
+def test_multipart_sse_c_requires_key_on_parts_and_get(cli):
+    key = os.urandom(32)
+    key_b64 = base64.b64encode(key).decode()
+    md5 = base64.b64encode(hashlib.md5(key).digest()).decode()
+    hdrs = {"x-amz-server-side-encryption-customer-algorithm": "AES256",
+            "x-amz-server-side-encryption-customer-key": key_b64,
+            "x-amz-server-side-encryption-customer-key-md5": md5}
+    parts = [os.urandom(5 << 20), os.urandom(999)]
+    _mp_upload(cli, "sseb", "mpssec", parts, init_headers=hdrs,
+               part_headers=hdrs)
+    # GET without the key: refused.
+    st, _, _ = cli.request("GET", "/sseb/mpssec")
+    assert st == 400
+    # With the key: byte-identical.
+    st, _, got = cli.request("GET", "/sseb/mpssec", headers=hdrs)
+    assert st == 200 and got == b"".join(parts)
+    # Wrong key on a part upload: refused.
+    st, _, body = cli.request("POST", "/sseb/mpssec2",
+                              query={"uploads": ""}, headers=hdrs)
+    assert st == 200
+    uid = body.split(b"<UploadId>")[1].split(b"</UploadId>")[0].decode()
+    bad = dict(hdrs)
+    bk = os.urandom(32)
+    bad["x-amz-server-side-encryption-customer-key"] = \
+        base64.b64encode(bk).decode()
+    bad["x-amz-server-side-encryption-customer-key-md5"] = \
+        base64.b64encode(hashlib.md5(bk).digest()).decode()
+    st, _, _ = cli.request("PUT", "/sseb/mpssec2",
+                           query={"partNumber": "1", "uploadId": uid},
+                           body=b"x" * 100, headers=bad)
+    assert st == 403
+
+
+def test_multipart_sse_part_reupload_gets_fresh_nonce(cli):
+    """Re-uploading a part must produce different ciphertext for the
+    same plaintext (fresh DARE base nonce per attempt): AES-GCM
+    (key, nonce) reuse across different plaintexts would be a
+    confidentiality break, and the only observable of the fix is the
+    ciphertext etag changing."""
+    st, _, body = cli.request("POST", "/sseb/reup", query={"uploads": ""},
+                              headers={"x-amz-server-side-encryption":
+                                       "AES256"})
+    assert st == 200
+    uid = body.split(b"<UploadId>")[1].split(b"</UploadId>")[0].decode()
+    data = os.urandom(100_000)
+    st, h1, _ = cli.request("PUT", "/sseb/reup",
+                            query={"partNumber": "1", "uploadId": uid},
+                            body=data)
+    assert st == 200
+    st, h2, _ = cli.request("PUT", "/sseb/reup",
+                            query={"partNumber": "1", "uploadId": uid},
+                            body=data)
+    assert st == 200
+    e1 = h1.get("etag") or h1.get("ETag")
+    e2 = h2.get("etag") or h2.get("ETag")
+    assert e1 != e2, "same plaintext re-encrypted under the same nonce"
+    # The LAST upload wins and decrypts correctly.
+    xml = (f"<CompleteMultipartUpload><Part><PartNumber>1</PartNumber>"
+           f"<ETag>{e2}</ETag></Part></CompleteMultipartUpload>")
+    st, _, b3 = cli.request("POST", "/sseb/reup", query={"uploadId": uid},
+                            body=xml.encode())
+    assert st == 200, b3
+    st, _, got = cli.request("GET", "/sseb/reup")
+    assert st == 200 and got == data
+
+
+def test_multipart_sse_copy_to_plaintext(cli):
+    """CopyObject out of an encrypted multipart source decrypts at part
+    boundaries."""
+    parts = [os.urandom(5 << 20), os.urandom(4321)]
+    _mp_upload(cli, "sseb", "mpsrc", parts,
+               init_headers={"x-amz-server-side-encryption": "AES256"})
+    st, _, b = cli.request("PUT", "/sseb/mpcopy", headers={
+        "x-amz-copy-source": "/sseb/mpsrc"})
+    assert st == 200, b
+    st, _, got = cli.request("GET", "/sseb/mpcopy")
+    assert st == 200 and got == b"".join(parts)
 
 
 def test_listing_reports_plaintext_size(cli):
