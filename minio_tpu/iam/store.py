@@ -41,8 +41,15 @@ class IAMSys:
         self.root_access = root_access
         self.root_secret = root_secret
         self._mu = threading.RLock()
+        # groups: name -> [member access keys]; group policy attachments
+        # share the user_policies map (the reference keeps one mapped-
+        # policy space for users and groups too, cmd/iam-store.go).
+        # sts: temporary credentials from AssumeRole — expiring keys
+        # whose permissions are the parent's, intersected with an
+        # optional session policy (cmd/sts-handlers.go:61).
         self._state = {"users": {}, "service_accounts": {},
-                       "policies": {}, "user_policies": {}}
+                       "policies": {}, "user_policies": {},
+                       "groups": {}, "sts": {}}
         self._loaded_at = 0.0
         # Peer fan-out hook: called after every successful _save so the
         # other nodes drop their IAM caches immediately (reference:
@@ -66,9 +73,14 @@ class IAMSys:
         if votes:
             blob = max(votes.items(), key=lambda kv: kv[1])[0]
             try:
-                self._state = json.loads(blob)
+                loaded = json.loads(blob)
             except ValueError:
-                pass
+                loaded = None
+            if isinstance(loaded, dict):
+                # Older persisted documents predate groups/sts.
+                loaded.setdefault("groups", {})
+                loaded.setdefault("sts", {})
+                self._state = loaded
         self._loaded_at = time.monotonic()
 
     def _save(self) -> None:
@@ -110,7 +122,9 @@ class IAMSys:
     # -- credential resolution ------------------------------------------
 
     def secret_for(self, access_key: str) -> Optional[str]:
-        """Secret key for signature verification; None = unknown key."""
+        """Secret key for signature verification; None = unknown key.
+        Expired STS credentials resolve to None — an expired temporary
+        key fails auth exactly like an unknown one."""
         if access_key == self.root_access:
             return self.root_secret
         with self._mu:
@@ -121,6 +135,30 @@ class IAMSys:
             sa = self._state["service_accounts"].get(access_key)
             if sa is not None and sa.get("status", "enabled") == "enabled":
                 return sa["secret"]
+            st = self._state["sts"].get(access_key)
+            if st is not None and time.time_ns() < st.get("expiry_ns", 0) \
+                    and self._parent_live(st.get("parent", "")):
+                return st["secret"]
+        return None
+
+    def _parent_live(self, parent: str) -> bool:
+        """Disabling or deleting a user must revoke its outstanding
+        STS credentials immediately, not at their expiry (call under
+        _mu)."""
+        if parent == self.root_access:
+            return True
+        u = self._state["users"].get(parent)
+        return u is not None and u.get("status", "enabled") == "enabled"
+
+    def session_token_for(self, access_key: str) -> Optional[str]:
+        """The session token an STS credential must present on every
+        request (None for permanent credentials)."""
+        with self._mu:
+            self._refresh()
+            st = self._state["sts"].get(access_key)
+            if st is not None and time.time_ns() < st.get("expiry_ns", 0) \
+                    and self._parent_live(st.get("parent", "")):
+                return st.get("token", "")
         return None
 
     def is_root(self, access_key: str) -> bool:
@@ -128,10 +166,29 @@ class IAMSys:
 
     # -- authorization ---------------------------------------------------
 
+    def _compile_names(self, names) -> list[Policy]:
+        docs = []
+        canned = canned_policies()
+        for name in names:
+            stored = self._state["policies"].get(name)
+            if stored is not None:
+                try:
+                    docs.append(compile_policy(stored))
+                    continue
+                except (PolicyError, TypeError):
+                    continue
+            if name in canned:
+                docs.append(canned[name])
+        return docs
+
     def policies_for(self, access_key: str) -> list[Policy]:
+        """The identity's own policies: directly attached ones plus
+        those of every group it belongs to (reference: PolicyDBGet
+        merges user and group mappings, cmd/iam-store.go). STS keys
+        resolve to their parent's policies; the session policy is
+        intersected separately in decide()."""
         with self._mu:
             self._refresh()
-            names: list[str] = []
             sa = self._state["service_accounts"].get(access_key)
             if sa is not None:
                 embedded = sa.get("policy")
@@ -142,38 +199,62 @@ class IAMSys:
                         return []
                 # No embedded policy: inherit the parent user's.
                 access_key = sa.get("parent", access_key)
+            st = self._state["sts"].get(access_key)
+            if st is not None:
+                if time.time_ns() >= st.get("expiry_ns", 0) or \
+                        not self._parent_live(st.get("parent", "")):
+                    return []
+                access_key = st.get("parent", access_key)
+                if access_key == self.root_access:
+                    # Root-parented STS keys inherit everything; the
+                    # session policy (if any) still bounds them.
+                    return [canned_policies()["consoleAdmin"]]
             names = list(self._state["user_policies"].get(access_key, []))
-            docs = []
-            canned = canned_policies()
-            for name in names:
-                stored = self._state["policies"].get(name)
-                if stored is not None:
-                    try:
-                        docs.append(compile_policy(stored))
-                        continue
-                    except (PolicyError, TypeError):
-                        continue
-                if name in canned:
-                    docs.append(canned[name])
-            return docs
+            for gname, members in self._state["groups"].items():
+                if access_key in (members or []):
+                    names.extend(self._state["user_policies"].get(gname, []))
+            return self._compile_names(names)
+
+    def _session_policy(self, access_key: str) -> Optional[Policy]:
+        with self._mu:
+            self._refresh()
+            st = self._state["sts"].get(access_key)
+            if st is None or not st.get("policy"):
+                return None
+            try:
+                return compile_policy(st["policy"])
+            except (PolicyError, TypeError):
+                # An unevaluable session policy grants NOTHING (the
+                # intersection direction must fail closed).
+                from minio_tpu.iam.policy import Policy as _P
+                return _P(statements=[])
 
     def is_allowed(self, access_key: str, action: str, resource: str,
                    context: Optional[dict] = None) -> bool:
-        if self.is_root(access_key):
-            return True
-        from minio_tpu.iam.policy import evaluate
-        return evaluate(self.policies_for(access_key), action, resource,
-                        context)
+        return self.decide(access_key, action, resource,
+                           context) == "Allow"
 
     def decide(self, access_key: str, action: str, resource: str,
                context: Optional[dict] = None) -> Optional[str]:
         """Tri-state identity decision ("Allow"/"Deny"/None) so callers
-        can merge with bucket policy (root short-circuits to Allow)."""
+        can merge with bucket policy (root short-circuits to Allow).
+        STS session policies INTERSECT: the request must be allowed by
+        both the parent's policies and the session policy (reference:
+        cmd/iam.go IsAllowedSTS)."""
         if self.is_root(access_key):
             return "Allow"
         from minio_tpu.iam.policy import decide
-        return decide(self.policies_for(access_key), action, resource,
+        base = decide(self.policies_for(access_key), action, resource,
                       context)
+        sess = self._session_policy(access_key)
+        if sess is not None:
+            sp = decide([sess], action, resource, context)
+            if sp == "Deny" or base == "Deny":
+                return "Deny"
+            if base == "Allow" and sp == "Allow":
+                return "Allow"
+            return None
+        return base
 
     # -- management (root-only; enforcement is the admin handler's job) --
 
@@ -183,6 +264,11 @@ class IAMSys:
         if len(secret_key) < 8:
             raise IAMError("secret key too short")
         with self._mu:
+            if access_key in self._state["groups"]:
+                # users and groups share the policy-attachment
+                # namespace; a collision would make attach/remove
+                # ambiguous.
+                raise IAMError("a group with that name exists")
             self._state["users"][access_key] = {
                 "secret": secret_key, "status": "enabled"}
             self._save()
@@ -197,6 +283,16 @@ class IAMSys:
             for k in [k for k, sa in self._state["service_accounts"].items()
                       if sa.get("parent") == access_key]:
                 self._state["service_accounts"].pop(k, None)
+            # Its STS keys die with it, and its group memberships go —
+            # a future user recreated under the same name must not
+            # inherit this one's group grants.
+            for k in [k for k, st in self._state["sts"].items()
+                      if st.get("parent") == access_key]:
+                self._state["sts"].pop(k, None)
+            for g, members in self._state["groups"].items():
+                if access_key in (members or []):
+                    self._state["groups"][g] = \
+                        [m for m in members if m != access_key]
             self._save()
         self._fire_change()
 
@@ -254,9 +350,11 @@ class IAMSys:
             return out
 
     def attach_policy(self, access_key: str, names: list[str]) -> None:
+        """Attach named policies to a user OR a group."""
         with self._mu:
-            if access_key not in self._state["users"]:
-                raise IAMError("no such user")
+            if access_key not in self._state["users"] and \
+                    access_key not in self._state["groups"]:
+                raise IAMError("no such user or group")
             known = set(self._state["policies"]) | set(canned_policies())
             for n in names:
                 if n not in known:
@@ -264,3 +362,97 @@ class IAMSys:
             self._state["user_policies"][access_key] = list(names)
             self._save()
         self._fire_change()
+
+    # -- groups ----------------------------------------------------------
+
+    def update_group_members(self, group: str, members: list[str],
+                             remove: bool = False) -> None:
+        """Add (or remove) members; an unknown group is created on add
+        (reference: cmd/iam.go AddUsersToGroup semantics). Members must
+        be existing users."""
+        if not group:
+            raise IAMError("invalid group name")
+        with self._mu:
+            if group in self._state["users"]:
+                raise IAMError("a user with that name exists")
+            if remove and group not in self._state["groups"]:
+                raise IAMError("no such group")
+            for m in members:
+                if not remove and m not in self._state["users"]:
+                    raise IAMError(f"no such user {m!r}")
+            cur = list(self._state["groups"].get(group, []))
+            if remove:
+                cur = [m for m in cur if m not in members]
+            else:
+                cur.extend(m for m in members if m not in cur)
+            self._state["groups"][group] = cur
+            self._save()
+        self._fire_change()
+
+    def remove_group(self, group: str) -> None:
+        with self._mu:
+            if self._state["groups"].pop(group, None) is None:
+                raise IAMError("no such group")
+            self._state["user_policies"].pop(group, None)
+            self._save()
+        self._fire_change()
+
+    def list_groups(self) -> dict:
+        with self._mu:
+            self._refresh()
+            return {g: {"members": list(ms or []),
+                        "policies": self._state["user_policies"].get(g, [])}
+                    for g, ms in self._state["groups"].items()}
+
+    # -- STS --------------------------------------------------------------
+
+    # AWS bounds: 15 minutes to 12 hours (cmd/sts-handlers.go).
+    STS_MIN_S, STS_MAX_S, STS_DEFAULT_S = 900, 12 * 3600, 3600
+
+    def assume_role(self, parent: str, duration_s: Optional[int] = None,
+                    session_policy: Optional[dict] = None) -> dict:
+        """Mint temporary credentials for an authenticated identity
+        (reference: cmd/sts-handlers.go:61 AssumeRole). The temp key's
+        permissions are the parent's, intersected with the optional
+        session policy; it expires hard at `duration_s`."""
+        import base64
+        import os as _os
+        if duration_s is None:
+            duration_s = self.STS_DEFAULT_S
+        if not self.STS_MIN_S <= duration_s <= self.STS_MAX_S:
+            raise IAMError(f"DurationSeconds must be in "
+                           f"[{self.STS_MIN_S}, {self.STS_MAX_S}]")
+        if session_policy is not None:
+            Policy.from_json(session_policy)   # validate before storing
+        ak = "STS" + base64.b32encode(_os.urandom(10)).decode().rstrip("=")
+        sk = base64.b64encode(_os.urandom(30)).decode()
+        token = base64.b64encode(_os.urandom(48)).decode()
+        expiry_ns = time.time_ns() + duration_s * 10**9
+        with self._mu:
+            # Parent check under the lock on FRESH state: a user revoked
+            # on a peer moments ago must not mint 12-hour credentials
+            # from this node's stale cache.
+            self._refresh()
+            if parent != self.root_access and \
+                    not self._parent_live(parent):
+                # Service accounts and STS keys cannot chain AssumeRole
+                # (the reference rejects non-user parents too).
+                raise IAMError("AssumeRole requires an active user "
+                               "identity")
+            self._prune_expired_sts()
+            self._state["sts"][ak] = {
+                "secret": sk, "parent": parent, "token": token,
+                "expiry_ns": expiry_ns, "policy": session_policy}
+            self._save()
+        self._fire_change()
+        return {"access_key": ak, "secret_key": sk, "session_token": token,
+                "expiry_ns": expiry_ns}
+
+    def _prune_expired_sts(self) -> None:
+        """Drop long-expired temp credentials so the document cannot
+        grow without bound (called under _mu before STS writes)."""
+        now = time.time_ns()
+        dead = [k for k, st in self._state["sts"].items()
+                if now >= st.get("expiry_ns", 0)]
+        for k in dead:
+            self._state["sts"].pop(k, None)

@@ -446,6 +446,19 @@ def _make_handler(server: S3Server):
                 else:
                     auth = self._auth(method, raw_path, query)
                 self._auth_key = auth.credential.access_key
+                # STS credentials must present their session token on
+                # every request (reference: cmd/auth-handler.go's
+                # getSessionToken check); permanent keys have none.
+                if not auth.anonymous and \
+                        server.credentials.iam is not None:
+                    tok = server.credentials.iam.session_token_for(
+                        auth.credential.access_key)
+                    if tok is not None:
+                        presented = h.get("x-amz-security-token", "") or \
+                            query.get("X-Amz-Security-Token", [""])[0]
+                        if presented != tok:
+                            raise S3Error("AccessDenied",
+                                          "invalid session token")
                 if raw_path == "/minio/admin" or \
                         raw_path.startswith("/minio/admin/"):
                     if auth.anonymous:
@@ -488,6 +501,10 @@ def _make_handler(server: S3Server):
                 if not bucket:
                     if method == "GET":
                         return self._list_buckets()
+                    if method == "POST":
+                        # STS rides POST / with a form body (reference:
+                        # cmd/sts-handlers.go router).
+                        return self._sts_op(auth, body)
                     raise S3Error("MethodNotAllowed")
                 try:
                     if not key:
@@ -519,6 +536,55 @@ def _make_handler(server: S3Server):
             self._route("HEAD")
 
         # -- service / bucket ops --------------------------------------
+
+        def _sts_op(self, auth, body: bytes):
+            """POST / — STS AssumeRole (reference:
+            cmd/sts-handlers.go:61 AssumeRole): any authenticated USER
+            identity mints temporary credentials scoped to its own
+            permissions, optionally narrowed by a session policy."""
+            import json as _json
+            form = dict(urllib.parse.parse_qsl(
+                body.decode("utf-8", "replace")))
+            action = form.get("Action", "")
+            if action != "AssumeRole":
+                raise S3Error("NotImplemented", f"STS action {action!r}")
+            if auth.anonymous:
+                raise S3Error("AccessDenied")
+            iam = server.credentials.iam
+            if iam is None:
+                raise S3Error("NotImplemented", "no IAM store")
+            duration = None
+            if form.get("DurationSeconds"):
+                try:
+                    duration = int(form["DurationSeconds"])
+                except ValueError:
+                    raise S3Error("InvalidArgument",
+                                  "bad DurationSeconds") from None
+            policy = None
+            if form.get("Policy"):
+                try:
+                    policy = _json.loads(form["Policy"])
+                except ValueError:
+                    raise S3Error("MalformedPolicy") from None
+            from minio_tpu.iam import IAMError
+            from minio_tpu.iam.policy import PolicyError
+            try:
+                rec = iam.assume_role(auth.credential.access_key,
+                                      duration, policy)
+            except PolicyError as e:
+                raise S3Error("MalformedPolicy", str(e)) from None
+            except IAMError as e:
+                raise S3Error("AccessDenied", str(e)) from None
+            root = ET.Element(
+                "AssumeRoleResponse",
+                xmlns="https://sts.amazonaws.com/doc/2011-06-15/")
+            res = _el(root, "AssumeRoleResult")
+            creds = _el(res, "Credentials")
+            _el(creds, "AccessKeyId", rec["access_key"])
+            _el(creds, "SecretAccessKey", rec["secret_key"])
+            _el(creds, "SessionToken", rec["session_token"])
+            _el(creds, "Expiration", _iso8601(rec["expiry_ns"]))
+            self._send(200, _xml(root))
 
         def _list_buckets(self):
             buckets = server.object_layer.list_buckets()
@@ -1212,11 +1278,11 @@ def _make_handler(server: S3Server):
                 customer = sse_mod.parse_sse_c(h)
                 enc_cfg = None
                 if customer is None:
-                    try:
-                        enc_cfg = server.object_layer.get_bucket_meta(
-                            bucket).get("config:encryption")
-                    except Exception:  # noqa: BLE001 - checked at create
-                        enc_cfg = None
+                    # A metadata read failure PROPAGATES: guessing "no
+                    # default encryption" on a transient error would
+                    # silently store the whole object as plaintext.
+                    enc_cfg = server.object_layer.get_bucket_meta(
+                        bucket).get("config:encryption")
                 if customer is not None or sse_mod.wants_sse_s3(h, enc_cfg):
                     _, _, imeta = sse_mod.encrypt_metadata(
                         bucket, key, 0, server.kms, customer)
@@ -1513,11 +1579,11 @@ def _make_handler(server: S3Server):
                 customer = sse_mod.parse_sse_c(h)
                 enc_cfg = None
                 if customer is None:
-                    try:
-                        enc_cfg = server.object_layer.get_bucket_meta(
-                            bucket).get("config:encryption")
-                    except Exception:  # noqa: BLE001 - bucket checks later
-                        enc_cfg = None
+                    # Propagate metadata read failures: a swallowed
+                    # error here would store plaintext in a bucket
+                    # whose default demands encryption.
+                    enc_cfg = server.object_layer.get_bucket_meta(
+                        bucket).get("config:encryption")
                     if not sse_mod.wants_sse_s3(h, enc_cfg):
                         return payload, {}
                 data_key, nonce, imeta = sse_mod.encrypt_metadata(
@@ -2393,6 +2459,18 @@ def _make_handler(server: S3Server):
                         doc.get("accessKey", ""), doc.get("secretKey", ""),
                         doc.get("policy"))
                     return ok()
+                if op == "update-group-members" and method == "PUT":
+                    doc = _json.loads(body)
+                    iam.update_group_members(
+                        doc.get("group", ""),
+                        list(doc.get("members") or []),
+                        remove=bool(doc.get("remove")))
+                    return ok()
+                if op == "remove-group" and method == "DELETE":
+                    iam.remove_group(q1.get("group", ""))
+                    return ok()
+                if op == "list-groups" and method == "GET":
+                    return ok(iam.list_groups())
             except ValueError:
                 raise S3Error("MalformedXML") from None
             except Exception as e:

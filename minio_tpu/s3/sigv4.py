@@ -43,7 +43,8 @@ class Credential:
     @classmethod
     def parse(cls, scope: str) -> "Credential":
         parts = scope.split("/")
-        if len(parts) != 5 or parts[4] != "aws4_request" or parts[3] != "s3":
+        if len(parts) != 5 or parts[4] != "aws4_request" \
+                or parts[3] not in ("s3", "sts"):
             raise SigError("AuthorizationHeaderMalformed",
                            f"bad credential scope {scope!r}")
         return cls(access_key=parts[0], date=parts[1], region=parts[2],
@@ -255,7 +256,8 @@ def verify_request(method: str, path: str, query: dict[str, list[str]],
                               auth.signed_headers, payload_hash,
                               drop_query=drop, raw_path=path)
     sts = string_to_sign(sts_date, auth.credential.scope(), canon)
-    key = signing_key(secret, auth.credential.date, auth.credential.region)
+    key = signing_key(secret, auth.credential.date, auth.credential.region,
+                      auth.credential.service)
     want = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
     if not hmac.compare_digest(want, auth.signature):
         raise SigError("SignatureDoesNotMatch")

@@ -15,12 +15,14 @@ from minio_tpu.s3 import sigv4
 
 class S3Client:
     def __init__(self, address: str, access_key="minioadmin",
-                 secret_key="minioadmin", region="us-east-1", timeout=30):
+                 secret_key="minioadmin", region="us-east-1", timeout=30,
+                 session_token: str = ""):
         self.address = address
         self.access_key = access_key
         self.secret_key = secret_key
         self.region = region
         self.timeout = timeout
+        self.session_token = session_token
 
     def request(self, method: str, path: str, query: dict | None = None,
                 body: bytes = b"", headers: dict | None = None,
@@ -45,6 +47,8 @@ class S3Client:
         else:
             payload_hash = hashlib.sha256(body).hexdigest()
         send_headers["x-amz-content-sha256"] = payload_hash
+        if self.session_token:
+            send_headers["x-amz-security-token"] = self.session_token
         send_headers.update(headers)
 
         if sign:

@@ -1,0 +1,269 @@
+"""STS AssumeRole (temporary credentials, session-policy intersection,
+expiry, session tokens) and IAM groups (membership-resolved policies)
+(reference: cmd/sts-handlers.go:61, cmd/iam.go group handling)."""
+
+import json
+import time
+import urllib.parse
+
+import pytest
+
+from minio_tpu.iam import IAMError, IAMSys
+from minio_tpu.object.erasure_object import ErasureSet
+from minio_tpu.s3.server import Credentials, S3Server
+from minio_tpu.storage.local import LocalStorage
+from tests.s3client import S3Client
+
+
+# ---------------------------------------------------------------------------
+# store-level semantics
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def iam(tmp_path):
+    disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    return IAMSys([ErasureSet(disks)], "root", "rootsecret")
+
+
+def test_group_policies_grant_members(iam):
+    iam.add_user("gina", "ginasecret")
+    iam.update_group_members("readers", ["gina"])
+    iam.attach_policy("readers", ["readonly"])
+    assert iam.is_allowed("gina", "s3:GetObject", "b/k")
+    assert not iam.is_allowed("gina", "s3:PutObject", "b/k")
+    # Removal revokes the group grant.
+    iam.update_group_members("readers", ["gina"], remove=True)
+    assert not iam.is_allowed("gina", "s3:GetObject", "b/k")
+    # Unknown members are rejected; groups persist across reloads.
+    with pytest.raises(IAMError):
+        iam.update_group_members("readers", ["ghost"])
+    iam.update_group_members("readers", ["gina"])
+    iam2 = IAMSys(iam._sets, "root", "rootsecret")
+    assert iam2.is_allowed("gina", "s3:GetObject", "b/k")
+    assert iam2.list_groups()["readers"]["members"] == ["gina"]
+
+
+def test_assume_role_inherits_and_intersects(iam):
+    iam.add_user("carol", "carolsecret")
+    iam.attach_policy("carol", ["readwrite"])
+    rec = iam.assume_role("carol")
+    ak = rec["access_key"]
+    assert iam.secret_for(ak) == rec["secret_key"]
+    assert iam.session_token_for(ak) == rec["session_token"]
+    # Inherits the parent's permissions...
+    assert iam.is_allowed(ak, "s3:PutObject", "b/k")
+    # ...but never root's short-circuit.
+    assert not iam.is_root(ak)
+    # Session policy INTERSECTS: parent allows rw, session only read.
+    rec2 = iam.assume_role("carol", session_policy={"Statement": [
+        {"Effect": "Allow", "Action": ["s3:GetObject"],
+         "Resource": ["arn:aws:s3:::b/*"]}]})
+    ak2 = rec2["access_key"]
+    assert iam.is_allowed(ak2, "s3:GetObject", "b/k")
+    assert not iam.is_allowed(ak2, "s3:PutObject", "b/k")
+    # A session policy can never EXPAND beyond the parent.
+    iam.attach_policy("carol", ["readonly"])
+    rec3 = iam.assume_role("carol", session_policy={"Statement": [
+        {"Effect": "Allow", "Action": ["s3:*"], "Resource": ["*"]}]})
+    assert not iam.is_allowed(rec3["access_key"], "s3:PutObject", "b/k")
+
+
+def test_assume_role_expiry_and_bounds(iam):
+    iam.add_user("dave", "davesecret1")
+    with pytest.raises(IAMError):
+        iam.assume_role("dave", duration_s=10)          # below AWS minimum
+    with pytest.raises(IAMError):
+        iam.assume_role("dave", duration_s=13 * 3600)   # above maximum
+    rec = iam.assume_role("dave")
+    ak = rec["access_key"]
+    assert iam.secret_for(ak) is not None
+    # Force expiry: the key must die everywhere at once.
+    iam._state["sts"][ak]["expiry_ns"] = time.time_ns() - 1
+    assert iam.secret_for(ak) is None
+    assert iam.session_token_for(ak) is None
+    assert not iam.is_allowed(ak, "s3:GetObject", "b/k")
+    # Service accounts cannot chain AssumeRole.
+    iam.add_service_account("dave", "svcdave", "svcdavesecret")
+    with pytest.raises(IAMError):
+        iam.assume_role("svcdave")
+
+
+def test_sts_dies_with_parent(iam):
+    """Disabling or deleting a user revokes its STS keys immediately."""
+    iam.add_user("hank", "hanksecret")
+    iam.attach_policy("hank", ["readwrite"])
+    rec = iam.assume_role("hank")
+    ak = rec["access_key"]
+    assert iam.secret_for(ak) is not None
+    iam.set_user_status("hank", enabled=False)
+    assert iam.secret_for(ak) is None
+    assert iam.session_token_for(ak) is None
+    assert not iam.is_allowed(ak, "s3:GetObject", "b/k")
+    iam.set_user_status("hank", enabled=True)
+    assert iam.secret_for(ak) is not None     # re-enable restores
+    iam.remove_user("hank")
+    assert iam.secret_for(ak) is None
+    assert ak not in iam._state["sts"]        # purged, not just dead
+
+
+def test_user_group_namespace_and_membership_hygiene(iam):
+    iam.add_user("iris", "irissecret")
+    # A group may not shadow a user and vice versa.
+    with pytest.raises(IAMError):
+        iam.update_group_members("iris", [])
+    iam.update_group_members("team", ["iris"])
+    with pytest.raises(IAMError):
+        iam.add_user("team", "teamsecret1")
+    # remove=True on an unknown group is an error, not a phantom group.
+    with pytest.raises(IAMError):
+        iam.update_group_members("nope", ["iris"], remove=True)
+    assert "nope" not in iam.list_groups()
+    # Deleting a user scrubs its memberships: a recreated same-name
+    # user must not inherit the old group grants.
+    iam.attach_policy("team", ["readwrite"])
+    iam.remove_user("iris")
+    assert iam.list_groups()["team"]["members"] == []
+    iam.add_user("iris", "irissecret2")
+    assert not iam.is_allowed("iris", "s3:PutObject", "b/k")
+
+
+# ---------------------------------------------------------------------------
+# end-to-end over HTTP
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def srv(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("stsdrv")
+    disks = [LocalStorage(str(tmp / f"d{i}")) for i in range(4)]
+    es = ErasureSet(disks)
+    creds = Credentials("minioadmin", "minioadmin")
+    creds.iam = IAMSys([es], "minioadmin", "minioadmin")
+    server = S3Server(es, address="127.0.0.1:0", credentials=creds)
+    server.start()
+    yield server
+    server.stop()
+
+
+def _assume_role(cli, **form):
+    body = urllib.parse.urlencode(
+        {"Action": "AssumeRole", "Version": "2011-06-15", **form}).encode()
+    st, _, resp = cli.request(
+        "POST", "/", body=body,
+        headers={"Content-Type": "application/x-www-form-urlencoded"})
+    return st, resp
+
+
+def _field(xml: bytes, tag: str) -> str:
+    return xml.split(f"<{tag}>".encode())[1].split(
+        f"</{tag}>".encode())[0].decode()
+
+
+def test_e2e_assume_role_and_session_token(srv):
+    root = S3Client(srv.address)
+    assert root.request("PUT", "/stsbkt")[0] == 200
+    assert root.request("PUT", "/stsbkt/obj", body=b"sts data")[0] == 200
+    st, _, b = root.request("PUT", "/minio/admin/v3/add-user",
+                            query={"accessKey": "erin"},
+                            body=json.dumps(
+                                {"secretKey": "erinsecret"}).encode())
+    assert st == 200, b
+    st, _, b = root.request("PUT",
+                            "/minio/admin/v3/set-user-or-group-policy",
+                            query={"userOrGroup": "erin",
+                                   "policyName": "readonly"})
+    assert st == 200, b
+    erin = S3Client(srv.address, access_key="erin", secret_key="erinsecret")
+    st, resp = _assume_role(erin, DurationSeconds="900")
+    assert st == 200, resp
+    ak = _field(resp, "AccessKeyId")
+    sk = _field(resp, "SecretAccessKey")
+    tok = _field(resp, "SessionToken")
+    assert _field(resp, "Expiration")
+    temp = S3Client(srv.address, access_key=ak, secret_key=sk,
+                    session_token=tok)
+    st, _, got = temp.request("GET", "/stsbkt/obj")
+    assert st == 200 and got == b"sts data"
+    # Parent is readonly: writes refused for the temp key too.
+    assert temp.request("PUT", "/stsbkt/obj2", body=b"x")[0] == 403
+    # Requests WITHOUT the session token are refused outright.
+    no_tok = S3Client(srv.address, access_key=ak, secret_key=sk)
+    assert no_tok.request("GET", "/stsbkt/obj")[0] == 403
+    wrong = S3Client(srv.address, access_key=ak, secret_key=sk,
+                     session_token="forged")
+    assert wrong.request("GET", "/stsbkt/obj")[0] == 403
+    # Admin surface stays closed to temp credentials.
+    assert temp.request("GET", "/minio/admin/v3/list-users")[0] == 403
+
+
+def test_e2e_expired_sts_key_fails_auth(srv):
+    root = S3Client(srv.address)
+    erin = S3Client(srv.address, access_key="erin", secret_key="erinsecret")
+    st, resp = _assume_role(erin)
+    assert st == 200
+    ak, sk = _field(resp, "AccessKeyId"), _field(resp, "SecretAccessKey")
+    tok = _field(resp, "SessionToken")
+    # Expire it in place (the store is shared within this process).
+    srv.credentials.iam._state["sts"][ak]["expiry_ns"] = \
+        time.time_ns() - 1
+    temp = S3Client(srv.address, access_key=ak, secret_key=sk,
+                    session_token=tok)
+    st, _, body = temp.request("GET", "/stsbkt/obj")
+    assert st == 403 and b"InvalidAccessKeyId" in body
+
+
+def test_e2e_session_policy_restricts(srv):
+    erin = S3Client(srv.address, access_key="erin", secret_key="erinsecret")
+    pol = {"Statement": [{"Effect": "Allow", "Action": ["s3:GetObject"],
+                          "Resource": ["arn:aws:s3:::stsbkt/obj"]}]}
+    st, resp = _assume_role(erin, Policy=json.dumps(pol))
+    assert st == 200, resp
+    temp = S3Client(srv.address,
+                    access_key=_field(resp, "AccessKeyId"),
+                    secret_key=_field(resp, "SecretAccessKey"),
+                    session_token=_field(resp, "SessionToken"))
+    assert temp.request("GET", "/stsbkt/obj")[0] == 200
+    # readonly parent allows ListBucket; the session policy does not.
+    assert temp.request("GET", "/stsbkt")[0] == 403
+    # Anonymous AssumeRole is refused.
+    import http.client
+    host, _, port = srv.address.rpartition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=10)
+    body = b"Action=AssumeRole&Version=2011-06-15"
+    conn.request("POST", "/", body=body,
+                 headers={"Content-Type":
+                          "application/x-www-form-urlencoded",
+                          "Content-Length": str(len(body))})
+    r = conn.getresponse()
+    assert r.status == 403
+    conn.close()
+
+
+def test_e2e_groups_grant_access(srv):
+    root = S3Client(srv.address)
+    st, _, b = root.request("PUT", "/minio/admin/v3/add-user",
+                            query={"accessKey": "frank"},
+                            body=json.dumps(
+                                {"secretKey": "franksecret"}).encode())
+    assert st == 200, b
+    st, _, b = root.request("PUT", "/minio/admin/v3/update-group-members",
+                            body=json.dumps(
+                                {"group": "ops",
+                                 "members": ["frank"]}).encode())
+    assert st == 200, b
+    st, _, b = root.request("PUT",
+                            "/minio/admin/v3/set-user-or-group-policy",
+                            query={"userOrGroup": "ops",
+                                   "policyName": "readwrite"})
+    assert st == 200, b
+    frank = S3Client(srv.address, access_key="frank",
+                     secret_key="franksecret")
+    assert frank.request("PUT", "/stsbkt/frankobj", body=b"f")[0] == 200
+    st, _, b = root.request("GET", "/minio/admin/v3/list-groups")
+    assert st == 200 and b"frank" in b
+    # Removing the member revokes the grant.
+    st, _, b = root.request("PUT", "/minio/admin/v3/update-group-members",
+                            body=json.dumps(
+                                {"group": "ops", "members": ["frank"],
+                                 "remove": True}).encode())
+    assert st == 200, b
+    assert frank.request("PUT", "/stsbkt/frankobj2", body=b"f")[0] == 403
