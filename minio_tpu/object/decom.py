@@ -1,0 +1,315 @@
+"""Pool decommission: drain one pool's objects into the others.
+
+The analogue of the reference's erasure-server-pool decommissioning
+(cmd/erasure-server-pool-decom.go:1269 decommissionPool + its
+checkpointed resume): an admin marks a pool for draining; a background
+worker walks every bucket and migrates each object's FULL version stack
+(data versions re-encoded into the destination's geometry, delete
+markers preserved, metadata/etags/part boundaries byte-identical via
+ErasureSet.restore_version) into the remaining pools, then deletes the
+source copy. Progress checkpoints persist on the SURVIVING pools'
+drives, so a crashed or restarted server resumes where it left off
+(the reference persists decomState in pool.bin the same way).
+
+While a drain runs:
+- new writes place in non-decommissioning pools (ServerPools excludes
+  the pool from placement);
+- reads keep succeeding: the version stack is restored to the
+  destination BEFORE the source copy is deleted, and pool search
+  visits destinations first, so every moment of the migration has the
+  key readable somewhere.
+
+When the walk completes the pool is marked "complete"; the operator
+restarts the server without the drained pool's endpoints.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional
+
+from minio_tpu.storage.local import SYS_VOL
+
+DECOM_PATH = "config/decom.json"
+CHECKPOINT_EVERY = 16          # objects between checkpoint persists
+
+
+class DecomError(Exception):
+    pass
+
+
+def pool_signature(pool) -> str:
+    """Stable identity for a pool: hash of its sorted drive endpoints.
+    Pool INDICES shift when the operator removes the drained pool from
+    the topology; a persisted index would then point at a live pool and
+    exclude it from placement forever."""
+    import hashlib
+    ids = []
+    for s in pool.sets:
+        for d in s.disks:
+            ids.append(getattr(d, "endpoint", "") or
+                       getattr(d, "root", ""))
+    return hashlib.sha256("\n".join(sorted(ids)).encode()).hexdigest()[:16]
+
+
+def find_pool_by_signature(pools_layer, sig: str):
+    """Current index of the pool with this signature, or None (the
+    pool was removed from the topology)."""
+    for i, p in enumerate(pools_layer.pools):
+        if pool_signature(p) == sig:
+            return i
+    return None
+
+
+def _state_disks(pools_layer, skip_idx: int):
+    """Drives of the FIRST surviving pool — the state must not live on
+    the pool being removed."""
+    for i, p in enumerate(pools_layer.pools):
+        if i != skip_idx:
+            return [d for s in p.sets for d in s.disks]
+    raise DecomError("cannot decommission the only pool")
+
+
+def load_state(pools_layer) -> Optional[dict]:
+    """Quorum-read the decom state document from any pool (None when no
+    decommission was ever started)."""
+    for p in pools_layer.pools:
+        votes: dict[bytes, int] = {}
+        for s in p.sets:
+            for d in s.disks:
+                try:
+                    blob = d.read_all(SYS_VOL, DECOM_PATH)
+                    votes[blob] = votes.get(blob, 0) + 1
+                except Exception:  # noqa: BLE001 - absent / offline
+                    continue
+        if votes:
+            blob = max(votes.items(), key=lambda kv: kv[1])[0]
+            try:
+                return json.loads(blob)
+            except ValueError:
+                continue
+    return None
+
+
+def _save_state(pools_layer, state: dict) -> None:
+    blob = json.dumps(state, sort_keys=True).encode()
+    disks = _state_disks(pools_layer, state["pool"])
+    ok = 0
+    for d in disks:
+        try:
+            d.write_all(SYS_VOL, DECOM_PATH, blob)
+            ok += 1
+        except Exception:  # noqa: BLE001 - offline drive
+            continue
+    if ok < len(disks) // 2 + 1:
+        raise DecomError("could not persist decommission state to a quorum")
+
+
+class Decommission:
+    """One pool-drain driver (start fresh or resume from a checkpoint)."""
+
+    def __init__(self, pools_layer, pool_idx: int,
+                 state: Optional[dict] = None,
+                 checkpoint_every: int = CHECKPOINT_EVERY):
+        if not 0 <= pool_idx < len(pools_layer.pools):
+            raise DecomError(f"no pool {pool_idx}")
+        if len(pools_layer.pools) < 2:
+            raise DecomError("cannot decommission the only pool")
+        self.layer = pools_layer
+        self.pool_idx = pool_idx
+        self.checkpoint_every = checkpoint_every
+        self.state = state or {
+            "pool": pool_idx, "status": "draining",
+            "pool_sig": pool_signature(pools_layer.pools[pool_idx]),
+            "started_ns": time.time_ns(),
+            "bucket": "", "marker": "",        # resume checkpoint
+            "migrated": 0, "failed": 0,
+        }
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- control ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.layer.decommissioning.add(self.pool_idx)
+        _save_state(self.layer, self.state)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"decom-pool{self.pool_idx}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Pause the drain (state stays 'draining'; a resume picks up
+        from the last checkpoint). Persists the current progress so a
+        clean pause loses nothing — only a hard crash falls back to
+        the periodic checkpoint."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+        if self.state.get("status") == "draining":
+            try:
+                _save_state(self.layer, self.state)
+            except DecomError:
+                pass
+
+    def wait(self, timeout: float = 300) -> bool:
+        if self._thread is not None:
+            self._thread.join(timeout)
+            return not self._thread.is_alive()
+        return True
+
+    # -- the drain -------------------------------------------------------
+
+    def _run(self) -> None:
+        try:
+            self._drain()
+        except Exception as e:  # noqa: BLE001 - recorded, resumable
+            self.state["status"] = "failed"
+            self.state["error"] = str(e)
+            try:
+                _save_state(self.layer, self.state)
+            except DecomError:
+                pass
+
+    def _drain(self) -> None:
+        src = self.layer.pools[self.pool_idx]
+        since_ckpt = 0
+        buckets = sorted(b.name for b in src.list_buckets())
+        # Resume: skip buckets already fully drained.
+        start_bucket = self.state.get("bucket", "")
+        for bucket in buckets:
+            if bucket < start_bucket:
+                continue
+            marker = self.state.get("marker", "") \
+                if bucket == start_bucket else ""
+            while not self._stop.is_set():
+                page = src.list_objects(bucket, marker=marker,
+                                        max_keys=256,
+                                        include_versions=True)
+                keys = sorted({o.name for o in page.objects})
+                for key in keys:
+                    if self._stop.is_set():
+                        return
+                    try:
+                        self._migrate_key(src, bucket, key)
+                        self.state["migrated"] += 1
+                    except Exception as e:  # noqa: BLE001 - keep going
+                        self.state["failed"] += 1
+                        self.state["last_error"] = f"{bucket}/{key}: {e}"
+                    # Track progress after every key (a clean stop()
+                    # persists it exactly); hit the drives only every
+                    # checkpoint_every keys.
+                    self.state["bucket"] = bucket
+                    self.state["marker"] = key
+                    since_ckpt += 1
+                    if since_ckpt >= self.checkpoint_every:
+                        since_ckpt = 0
+                        _save_state(self.layer, self.state)
+                if not page.is_truncated:
+                    break
+                marker = page.next_marker
+            if self._stop.is_set():
+                return
+            self.state["bucket"] = bucket
+            self.state["marker"] = ""
+            _save_state(self.layer, self.state)
+        if self.state["failed"]:
+            self.state["status"] = "failed"
+        else:
+            self.state["status"] = "complete"
+            self.state["finished_ns"] = time.time_ns()
+        _save_state(self.layer, self.state)
+
+    def _migrate_key(self, src_pool, bucket: str, key: str) -> None:
+        """Move one key's whole version stack.
+
+        Shape: snapshot → restore (no locks held across sets — in
+        distributed mode src and dst share the cluster-wide per-key
+        lock resource, so nesting them would deadlock) → verify +
+        clean up under the source key lock. Versions restore NEWEST
+        FIRST so the destination's latest-version resolution (markers
+        included) is correct at every intermediate step. Inside the
+        locked verify, versions that were deleted during the copy are
+        removed from the destination too (the API routes version
+        deletes to every pool while a drain runs), so an acknowledged
+        delete can never resurrect; the source copies are destroyed
+        only after everything landed — reads never see the key absent.
+        """
+        from minio_tpu.object.types import (DeleteOptions, GetOptions,
+                                            MethodNotAllowed,
+                                            ObjectNotFound, VersionNotFound)
+        src_set = src_pool.set_for(key)
+        dst_set = self.layer.pools[self._dst_idx()].set_for(key)
+        for _attempt in range(5):
+            try:
+                versions = src_set.list_versions_all(bucket, key)
+            except ObjectNotFound:
+                return                  # deleted mid-walk: nothing to do
+            for fi in sorted(versions, key=lambda f: -f.mod_time):
+                if not fi.version_id:
+                    # Null-version care: a concurrent overwrite during
+                    # the drain placed a NEWER null version in the
+                    # destination; restoring the old one would replace
+                    # it. Only restore when ours is the newest known.
+                    try:
+                        cur_dst = dst_set.list_versions_all(bucket, key)
+                        if any(v.version_id == "" and
+                               v.mod_time >= fi.mod_time
+                               for v in cur_dst):
+                            continue
+                    except ObjectNotFound:
+                        pass
+                data = None
+                if not fi.deleted:
+                    try:
+                        _, data = src_set.get_object(
+                            bucket, key,
+                            GetOptions(version_id=fi.version_id))
+                    except (VersionNotFound, MethodNotAllowed,
+                            ObjectNotFound):
+                        continue        # pruned mid-walk
+                dst_set.restore_version(bucket, key, fi, data)
+            with src_set.ns.write(bucket, key):
+                try:
+                    cur = src_set.list_versions_all(bucket, key)
+                except ObjectNotFound:
+                    cur = []
+                snap_ids = {v.version_id for v in versions}
+                cur_ids = {v.version_id for v in cur}
+                if not cur_ids <= snap_ids:
+                    continue            # stack changed mid-copy: redo
+                for vid in snap_ids - cur_ids:
+                    # Deleted from the source while we copied: the
+                    # restored destination copy must go too (unlocked
+                    # internal — this thread holds the key lock).
+                    try:
+                        dst_set._delete_object_locked(
+                            bucket, key, DeleteOptions(
+                                version_id=vid, versioned=False))
+                    except (ObjectNotFound, VersionNotFound):
+                        pass
+                for fi in cur:
+                    try:
+                        src_set._delete_object_locked(
+                            bucket, key, DeleteOptions(
+                                version_id=fi.version_id,
+                                versioned=False))
+                    except (ObjectNotFound, VersionNotFound):
+                        pass
+                return
+        raise DecomError(f"{bucket}/{key}: version stack kept changing")
+
+    def _dst_idx(self) -> int:
+        """Surviving pool with the most free space (the reference picks
+        by available capacity too)."""
+        best, best_free = None, -1
+        for i, p in enumerate(self.layer.pools):
+            if i == self.pool_idx or i in self.layer.decommissioning:
+                continue
+            free = p.free_space()
+            if free > best_free:
+                best, best_free = i, free
+        if best is None:
+            raise DecomError("no destination pool available")
+        return best

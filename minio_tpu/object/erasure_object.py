@@ -638,6 +638,82 @@ class ErasureSet:
                           user_metadata=dict(opts.user_metadata),
                           actual_size=len(data))
 
+    def restore_version(self, bucket: str, object_: str, src_fi,
+                        data: Optional[bytes]) -> None:
+        """Write one version copied from ANOTHER erasure set into this
+        set's geometry — the decommission/rebalance transfer primitive
+        (reference: cmd/erasure-server-pool-decom.go decommissionObject
+        re-putting through the destination pool).
+
+        `src_fi`: the source FileInfo (version id, mod time, metadata
+        map, parts, deleted flag) — preserved verbatim so the version
+        is indistinguishable from the original (same etag, same SSE
+        params, same part boundaries for part-aware decryption).
+        `data`: the full STORED byte stream (None for delete markers);
+        re-encoded here because the destination's (k, m) geometry can
+        differ from the source's."""
+        self._check_bucket(bucket)
+        n = len(self.disks)
+        if src_fi.deleted:
+            fi = FileInfo(volume=bucket, name=object_,
+                          version_id=src_fi.version_id, deleted=True,
+                          mod_time=src_fi.mod_time)
+            _, errors = self._fanout(
+                [lambda d=d: d.write_metadata(bucket, object_, fi)
+                 for d in self.disks])
+            if sum(e is None for e in errors) < n // 2 + 1:
+                raise WriteQuorumError(bucket, object_)
+            return
+        m = self.default_parity
+        k = n - m
+        write_quorum = k + (1 if k == m else 0)
+        distribution = hash_order(f"{bucket}/{object_}", n)
+        parts = list(src_fi.parts or [])
+        if not parts:
+            parts = [ObjectPartInfo(number=1, size=len(data or b""),
+                                    actual_size=len(data or b""))]
+        data_dir = new_uuid()
+        staging = f"{STAGING_PREFIX}/{new_uuid()}"
+        # Frame each part independently: the read path opens part files
+        # one by one and sizes shards per part.
+        framed_parts = []
+        off = 0
+        for p in parts:
+            framed_parts.append(
+                (p.number, self._encode_and_frame(data[off:off + p.size],
+                                                  k, m)))
+            off += p.size
+
+        def write_one(disk_idx: int):
+            d = self.disks[disk_idx]
+            shard_idx = distribution[disk_idx] - 1
+            for num, framed in framed_parts:
+                d.create_file(SYS_VOL, f"{staging}/{data_dir}/part.{num}",
+                              list(framed[shard_idx]))
+            fi = FileInfo(
+                volume=bucket, name=object_,
+                version_id=src_fi.version_id, deleted=False,
+                data_dir=data_dir, mod_time=src_fi.mod_time,
+                size=src_fi.size, metadata=dict(src_fi.metadata),
+                parts=[dataclasses.replace(p) for p in parts],
+                erasure=ErasureInfo(
+                    data_blocks=k, parity_blocks=m, block_size=BLOCK_SIZE,
+                    index=shard_idx + 1,
+                    distribution=tuple(distribution)))
+            d.rename_data(SYS_VOL, staging, fi, bucket, object_)
+
+        with self.ns.write(bucket, object_):
+            _, errors = self._fanout(
+                [lambda i=i: write_one(i) for i in range(n)])
+        ok = sum(e is None for e in errors)
+        if ok < write_quorum:
+            self._fanout([lambda d=d: _swallow(
+                lambda: d.delete(SYS_VOL, staging, recursive=True))
+                for d in self.disks])
+            raise WriteQuorumError(bucket, object_)
+        if ok < n:
+            self.mrf.enqueue(bucket, object_, src_fi.version_id)
+
     # ------------------------------------------------------------------
     # Streaming PutObject (O(window) memory)
     # ------------------------------------------------------------------

@@ -22,6 +22,10 @@ from minio_tpu.object.types import (BucketNotFound, ListObjectsInfo,
 _MISSES = (ObjectNotFound, VersionNotFound)
 
 
+class DecomUnavailable(Exception):
+    """Every pool is draining: no placement target exists."""
+
+
 class ServerPools:
     """Top-level ObjectLayer over one or more pools."""
 
@@ -35,8 +39,23 @@ class ServerPools:
         # at the layer that owns the write keeps future callers from
         # silently bypassing the broadcast.
         self.on_bucket_meta_change = None
+        # Pool indices being drained (object/decom.py): excluded from
+        # new-object placement, searched LAST so reads prefer the
+        # destination copy during a drain.
+        self.decommissioning: set[int] = set()
+        self._decom = None             # active Decommission driver
 
     # -- placement -----------------------------------------------------
+
+    def _pool_order(self) -> list[int]:
+        """Search order: draining pools LAST, so during a decommission
+        reads find the destination's (complete, possibly newer) version
+        stack before the source's leftover."""
+        if not self.decommissioning:
+            return list(range(len(self.pools)))
+        return [i for i in range(len(self.pools))
+                if i not in self.decommissioning] + \
+            sorted(self.decommissioning)
 
     def _pool_of_existing(self, bucket: str, object_: str) -> Optional[int]:
         """Pool already holding any version of the key, else None.
@@ -44,7 +63,8 @@ class ServerPools:
         still lives in that pool.)"""
         if len(self.pools) == 1:
             return 0
-        for i, p in enumerate(self.pools):
+        for i in self._pool_order():
+            p = self.pools[i]
             try:
                 p.get_object_info(bucket, object_)
                 return i
@@ -58,14 +78,21 @@ class ServerPools:
         return None
 
     def _pool_for_new(self) -> int:
-        if len(self.pools) == 1:
-            return 0
-        frees = [p.free_space() for p in self.pools]
-        return max(range(len(frees)), key=lambda i: frees[i])
+        candidates = [i for i in range(len(self.pools))
+                      if i not in self.decommissioning]
+        if not candidates:
+            raise DecomUnavailable("every pool is decommissioning")
+        if len(candidates) == 1:
+            return candidates[0]
+        return max(candidates, key=lambda i: self.pools[i].free_space())
 
     def _put_pool(self, bucket: str, object_: str) -> int:
         idx = self._pool_of_existing(bucket, object_)
-        return self._pool_for_new() if idx is None else idx
+        if idx is None or idx in self.decommissioning:
+            # Existing versions in a draining pool stay readable there;
+            # NEW versions must land where the drain is copying TO.
+            return self._pool_for_new()
+        return idx
 
     # -- buckets -------------------------------------------------------
 
@@ -153,9 +180,10 @@ class ServerPools:
 
     def _search(self, fn_name: str, bucket, object_, *args, **kw):
         last: Exception = ObjectNotFound(bucket, object_)
-        for p in self.pools:
+        for i in self._pool_order():
             try:
-                return getattr(p, fn_name)(bucket, object_, *args, **kw)
+                return getattr(self.pools[i], fn_name)(bucket, object_,
+                                                       *args, **kw)
             except _MISSES as e:
                 last = e
         raise last
@@ -181,6 +209,30 @@ class ServerPools:
         return self._search("list_versions_all", bucket, object_)
 
     def delete_object(self, bucket, object_, opts=None):
+        from minio_tpu.object.types import DeleteOptions
+        opts = opts or DeleteOptions()
+        if self.decommissioning:
+            marker = opts.versioned and not opts.version_id
+            if marker:
+                # New delete markers stack in a SURVIVING pool — stamped
+                # into a draining pool they would land outside the
+                # migration snapshot and silently vanish.
+                return self.pools[self._pool_for_new()].delete_object(
+                    bucket, object_, opts)
+            # Version destruction applies to EVERY pool holding a copy:
+            # during a drain the same version can exist in both source
+            # and destination, and deleting only one resurrects it.
+            deleted = None
+            last: Exception = ObjectNotFound(bucket, object_)
+            for i in self._pool_order():
+                try:
+                    deleted = self.pools[i].delete_object(bucket, object_,
+                                                          opts)
+                except _MISSES as e:
+                    last = e
+            if deleted is None:
+                raise last
+            return deleted
         # Delete markers must land in the pool that holds the key
         # (reference DeleteObject pool lookup); a plain missing key
         # surfaces from the first pool's semantics.
@@ -188,6 +240,62 @@ class ServerPools:
         if idx is None:
             idx = 0
         return self.pools[idx].delete_object(bucket, object_, opts)
+
+    # -- decommission --------------------------------------------------
+
+    def start_decommission(self, pool_idx: int, checkpoint_every=None):
+        """Begin draining pool `pool_idx` into the others (reference:
+        cmd/erasure-server-pool-decom.go StartDecommission)."""
+        from minio_tpu.object import decom
+        if self._decom is not None and \
+                self._decom.state.get("status") == "draining" and \
+                not self._decom.wait(timeout=0):
+            raise decom.DecomError("a decommission is already running")
+        kw = {} if checkpoint_every is None else \
+            {"checkpoint_every": checkpoint_every}
+        self._decom = decom.Decommission(self, pool_idx, **kw)
+        self._decom.start()
+        return self._decom
+
+    def resume_decommission(self):
+        """Boot-time resume: if a persisted drain never completed, pick
+        it up from its checkpoint. Returns the driver or None. The
+        drained pool is located by its drive-endpoint SIGNATURE, never
+        by stored index — after the operator removes the pool, indices
+        shift and a stale index would poison a live pool."""
+        from minio_tpu.object import decom
+        state = decom.load_state(self)
+        if not state:
+            return None
+        idx = decom.find_pool_by_signature(self, state.get("pool_sig", ""))
+        if idx is None:
+            # The drained pool is gone from the topology: the
+            # decommission's purpose is fulfilled; nothing to resume
+            # or exclude.
+            return None
+        if state.get("status") not in ("draining", "failed"):
+            # complete: keep the drained pool out of placement until
+            # the operator drops it from the topology.
+            if state.get("status") == "complete":
+                self.decommissioning.add(idx)
+            return None
+        state["status"] = "draining"
+        state["pool"] = idx
+        self._decom = decom.Decommission(self, idx, state=state)
+        self._decom.start()
+        return self._decom
+
+    def decommission_status(self):
+        from minio_tpu.object import decom
+        if self._decom is not None:
+            return dict(self._decom.state)
+        state = decom.load_state(self)
+        return dict(state) if state else None
+
+    def cancel_decommission(self):
+        """Pause the active drain (checkpointed; resumable)."""
+        if self._decom is not None:
+            self._decom.stop()
 
     # -- multipart -----------------------------------------------------
 

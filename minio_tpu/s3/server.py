@@ -2044,11 +2044,21 @@ def _make_handler(server: S3Server):
                 secret = server.credentials.secret_for(access_key)
                 if secret is None:
                     raise S3Error("InvalidAccessKeyId")
-                skey = sigv4.signing_key(secret, cred.date, cred.region)
+                skey = sigv4.signing_key(secret, cred.date, cred.region,
+                                         cred.service)
                 want = _hmac.new(skey, policy_b64.encode(),
                                  hashlib.sha256).hexdigest()
                 if not _hmac.compare_digest(want, sig):
                     raise S3Error("SignatureDoesNotMatch")
+                # STS keys must present their session token in the form
+                # (same invariant as header-authorized requests).
+                if server.credentials.iam is not None:
+                    tok = server.credentials.iam.session_token_for(
+                        access_key)
+                    if tok is not None and \
+                            fields.get("x-amz-security-token", "") != tok:
+                        raise S3Error("AccessDenied",
+                                      "invalid session token")
                 try:
                     pol = _json.loads(base64.b64decode(policy_b64))
                 except ValueError:
@@ -2392,6 +2402,28 @@ def _make_handler(server: S3Server):
                 if server.peer_notify is not None:
                     server.peer_notify("config")
                 return ok({"applied": applied})
+
+            # Pool decommission (reference: cmd/admin-handlers-pools.go).
+            if op == "decommission" and method == "POST":
+                ol = server.object_layer
+                if not hasattr(ol, "start_decommission"):
+                    raise S3Error("NotImplemented", "single-pool layout")
+                from minio_tpu.object.decom import DecomError
+                try:
+                    ol.start_decommission(int(q1.get("pool", "-1")))
+                except (DecomError, ValueError) as e:
+                    raise S3Error("InvalidArgument", str(e)) from None
+                return ok()
+            if op == "decommission-status" and method == "GET":
+                fn = getattr(server.object_layer, "decommission_status",
+                             None)
+                return ok(fn() if fn else None)
+            if op == "decommission-cancel" and method == "POST":
+                fn = getattr(server.object_layer, "cancel_decommission",
+                             None)
+                if fn:
+                    fn()
+                return ok()
 
             # Replication target management needs no IAM store.
             if op == "set-remote-target" and method == "PUT":

@@ -389,7 +389,8 @@ class ChunkedPayloadReader:
         self._auth = auth
         self._verify = verify_signatures
         self._seed_key = signing_key(secret, auth.credential.date,
-                                     auth.credential.region)
+                                     auth.credential.region,
+                                     auth.credential.service)
         self._prev_sig = auth.signature
         self._scope = auth.credential.scope()
         self._buf = bytearray()
@@ -496,7 +497,8 @@ def decode_chunked_payload(body: bytes, auth: ParsedAuth, secret: str,
     """
     out = bytearray()
     pos = 0
-    seed_key = signing_key(secret, auth.credential.date, auth.credential.region)
+    seed_key = signing_key(secret, auth.credential.date,
+                           auth.credential.region, auth.credential.service)
     prev_sig = auth.signature
     scope = auth.credential.scope()
     while True:

@@ -263,6 +263,16 @@ def main(argv=None) -> int:
                 pass
 
     layer = ServerPools(pools)
+    # Resume an interrupted pool decommission from its checkpoint
+    # (reference: pools.Init resuming persisted decom state).
+    if len(pools) > 1:
+        try:
+            if layer.resume_decommission() is not None:
+                print("resuming interrupted pool decommission",
+                      flush=True)
+        except Exception as e:  # noqa: BLE001 - decom must not block boot
+            print(f"WARN: decommission resume failed: {e}",
+                  file=sys.stderr)
     # Background data scanner: usage accounting, 1/1024 deep-heal
     # sampling, replaced-drive format restore (reference:
     # cmd/data-scanner.go's scanner loop).
