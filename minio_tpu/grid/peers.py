@@ -21,6 +21,7 @@ RELOAD_HANDLER = "peer.reload"
 KIND_IAM = "iam"
 KIND_BUCKET_META = "bucket-meta"
 KIND_CONFIG = "config"
+KIND_DECOM = "decom"
 
 
 class PeerNotifier:
@@ -76,6 +77,16 @@ def make_reload_handler(iam=None, object_layer=None,
                 apply_config()
             except Exception:  # noqa: BLE001 - bad config must not kill RPC
                 pass
+        elif kind == KIND_DECOM and object_layer is not None:
+            # A drain started/finished on another node: re-sync this
+            # node's pool placement exclusions from persisted state.
+            sync = getattr(object_layer, "sync_decommission_markers",
+                           None)
+            if sync is not None:
+                try:
+                    sync()
+                except Exception:  # noqa: BLE001 - next boot re-syncs
+                    pass
         return "ok"
 
     return handler

@@ -72,9 +72,14 @@ def _state_disks(pools_layer, skip_idx: int):
     raise DecomError("cannot decommission the only pool")
 
 
-def load_state(pools_layer) -> Optional[dict]:
-    """Quorum-read the decom state document from any pool (None when no
-    decommission was ever started)."""
+def load_doc(pools_layer) -> dict:
+    """The decommission document: every drain's record keyed by pool
+    SIGNATURE, monotonically revisioned (sequential decommissions must
+    not shadow each other's records — a single per-drain doc left a
+    stale copy on the earlier drain's destination that could win the
+    read after a restart). Picks the highest-revision copy across
+    pools."""
+    best: Optional[dict] = None
     for p in pools_layer.pools:
         votes: dict[bytes, int] = {}
         for s in p.sets:
@@ -84,18 +89,35 @@ def load_state(pools_layer) -> Optional[dict]:
                     votes[blob] = votes.get(blob, 0) + 1
                 except Exception:  # noqa: BLE001 - absent / offline
                     continue
-        if votes:
-            blob = max(votes.items(), key=lambda kv: kv[1])[0]
-            try:
-                return json.loads(blob)
-            except ValueError:
-                continue
-    return None
+        if not votes:
+            continue
+        blob = max(votes.items(), key=lambda kv: kv[1])[0]
+        try:
+            doc = json.loads(blob)
+        except ValueError:
+            continue
+        if isinstance(doc, dict) and "records" in doc and \
+                (best is None or doc.get("rev", 0) > best.get("rev", 0)):
+            best = doc
+    return best if best is not None else {"records": {}, "rev": 0}
 
 
-def _save_state(pools_layer, state: dict) -> None:
-    blob = json.dumps(state, sort_keys=True).encode()
-    disks = _state_disks(pools_layer, state["pool"])
+def load_state(pools_layer) -> Optional[dict]:
+    """The most recent drain's record (None when none was ever
+    started) — the admin-status and test surface."""
+    records = load_doc(pools_layer).get("records", {})
+    if not records:
+        return None
+    return max(records.values(), key=lambda r: r.get("started_ns", 0))
+
+
+def _write_doc(pools_layer, doc: dict, skip_idx: int,
+               scrub: bool = False) -> None:
+    """Quorum-write the document to the first surviving pool; `scrub`
+    deletes stale copies on other pools (needed once per drain — the
+    doc carries EVERY record, so scrubbed pools lose nothing)."""
+    blob = json.dumps(doc, sort_keys=True).encode()
+    disks = _state_disks(pools_layer, skip_idx)
     ok = 0
     for d in disks:
         try:
@@ -105,6 +127,25 @@ def _save_state(pools_layer, state: dict) -> None:
             continue
     if ok < len(disks) // 2 + 1:
         raise DecomError("could not persist decommission state to a quorum")
+    if scrub:
+        keep = {id(d) for d in disks}
+        for p in pools_layer.pools:
+            for s in p.sets:
+                for d in s.disks:
+                    if id(d) in keep:
+                        continue
+                    try:
+                        d.delete(SYS_VOL, DECOM_PATH)
+                    except Exception:  # noqa: BLE001 - absent / offline
+                        pass
+
+
+def _save_state(pools_layer, state: dict) -> None:
+    """Load-upsert-write for callers without a cached doc."""
+    doc = load_doc(pools_layer)
+    doc["records"][state["pool_sig"]] = state
+    doc["rev"] = doc.get("rev", 0) + 1
+    _write_doc(pools_layer, doc, state["pool"], scrub=True)
 
 
 class Decommission:
@@ -115,8 +156,13 @@ class Decommission:
                  checkpoint_every: int = CHECKPOINT_EVERY):
         if not 0 <= pool_idx < len(pools_layer.pools):
             raise DecomError(f"no pool {pool_idx}")
-        if len(pools_layer.pools) < 2:
-            raise DecomError("cannot decommission the only pool")
+        survivors = [i for i in range(len(pools_layer.pools))
+                     if i != pool_idx
+                     and i not in pools_layer.decommissioning]
+        if not survivors:
+            # Draining the last non-draining pool would wedge every
+            # write in the cluster with nowhere to place objects.
+            raise DecomError("no surviving pool to drain into")
         self.layer = pools_layer
         self.pool_idx = pool_idx
         self.checkpoint_every = checkpoint_every
@@ -129,12 +175,38 @@ class Decommission:
         }
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # The decom document, loaded once: checkpoints must not pay a
+        # cluster-wide read + scrub every few objects on the hot path.
+        self._doc: Optional[dict] = None
 
     # -- control ---------------------------------------------------------
 
+    def _notify_peers(self) -> None:
+        """Status transitions fan out so peer nodes re-sync their
+        placement-exclusion sets immediately (reference: decom updates
+        ride the notification system too); checkpoint saves don't —
+        they change no placement decision."""
+        cb = getattr(self.layer, "on_decom_change", None)
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 - fan-out must not fail drain
+                pass
+
+    def _persist(self, scrub: bool = False) -> None:
+        """Write progress using the driver's cached document — the
+        checkpoint hot path must not re-read every drive in the
+        cluster (the doc is only mutated by the single active drain)."""
+        if self._doc is None:
+            self._doc = load_doc(self.layer)
+        self._doc["records"][self.state["pool_sig"]] = self.state
+        self._doc["rev"] = self._doc.get("rev", 0) + 1
+        _write_doc(self.layer, self._doc, self.pool_idx, scrub=scrub)
+
     def start(self) -> None:
         self.layer.decommissioning.add(self.pool_idx)
-        _save_state(self.layer, self.state)
+        self._persist(scrub=True)
+        self._notify_peers()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"decom-pool{self.pool_idx}")
         self._thread.start()
@@ -149,7 +221,7 @@ class Decommission:
             self._thread.join(timeout=30)
         if self.state.get("status") == "draining":
             try:
-                _save_state(self.layer, self.state)
+                self._persist()
             except DecomError:
                 pass
 
@@ -168,7 +240,7 @@ class Decommission:
             self.state["status"] = "failed"
             self.state["error"] = str(e)
             try:
-                _save_state(self.layer, self.state)
+                self._persist()
             except DecomError:
                 pass
 
@@ -205,7 +277,7 @@ class Decommission:
                     since_ckpt += 1
                     if since_ckpt >= self.checkpoint_every:
                         since_ckpt = 0
-                        _save_state(self.layer, self.state)
+                        self._persist()
                 if not page.is_truncated:
                     break
                 marker = page.next_marker
@@ -213,13 +285,14 @@ class Decommission:
                 return
             self.state["bucket"] = bucket
             self.state["marker"] = ""
-            _save_state(self.layer, self.state)
+            self._persist()
         if self.state["failed"]:
             self.state["status"] = "failed"
         else:
             self.state["status"] = "complete"
             self.state["finished_ns"] = time.time_ns()
-        _save_state(self.layer, self.state)
+        self._persist()
+        self._notify_peers()
 
     def _migrate_key(self, src_pool, bucket: str, key: str) -> None:
         """Move one key's whole version stack.
@@ -240,26 +313,34 @@ class Decommission:
                                             MethodNotAllowed,
                                             ObjectNotFound, VersionNotFound)
         src_set = src_pool.set_for(key)
-        dst_set = self.layer.pools[self._dst_idx()].set_for(key)
+        # Destination pinning: if a SURVIVING pool already holds this
+        # key (e.g. a concurrent overwrite placed a new version there),
+        # the old versions must join that same stack — a free-space
+        # choice could split the key across two pools, and pool-ordered
+        # reads would then shadow the newer write.
+        from minio_tpu.object.types import MethodNotAllowed as _MNA
+        dst_idx = None
+        for i in self.layer._pool_order():
+            if i == self.pool_idx or i in self.layer.decommissioning:
+                continue
+            try:
+                self.layer.pools[i].get_object_info(bucket, key)
+                dst_idx = i
+                break
+            except _MNA:
+                dst_idx = i             # delete marker: key lives here
+                break
+            except Exception:  # noqa: BLE001 - not in this pool
+                continue
+        if dst_idx is None:
+            dst_idx = self._dst_idx()
+        dst_set = self.layer.pools[dst_idx].set_for(key)
         for _attempt in range(5):
             try:
                 versions = src_set.list_versions_all(bucket, key)
             except ObjectNotFound:
                 return                  # deleted mid-walk: nothing to do
             for fi in sorted(versions, key=lambda f: -f.mod_time):
-                if not fi.version_id:
-                    # Null-version care: a concurrent overwrite during
-                    # the drain placed a NEWER null version in the
-                    # destination; restoring the old one would replace
-                    # it. Only restore when ours is the newest known.
-                    try:
-                        cur_dst = dst_set.list_versions_all(bucket, key)
-                        if any(v.version_id == "" and
-                               v.mod_time >= fi.mod_time
-                               for v in cur_dst):
-                            continue
-                    except ObjectNotFound:
-                        pass
                 data = None
                 if not fi.deleted:
                     try:
@@ -269,7 +350,12 @@ class Decommission:
                     except (VersionNotFound, MethodNotAllowed,
                             ObjectNotFound):
                         continue        # pruned mid-walk
-                dst_set.restore_version(bucket, key, fi, data)
+                # skip_if_newer_null: a concurrent unversioned
+                # overwrite placed a NEWER null version in the
+                # destination; the check runs inside restore_version's
+                # key lock so the decision and the write are atomic.
+                dst_set.restore_version(bucket, key, fi, data,
+                                        skip_if_newer_null=True)
             with src_set.ns.write(bucket, key):
                 try:
                     cur = src_set.list_versions_all(bucket, key)

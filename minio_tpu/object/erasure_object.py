@@ -639,7 +639,8 @@ class ErasureSet:
                           actual_size=len(data))
 
     def restore_version(self, bucket: str, object_: str, src_fi,
-                        data: Optional[bytes]) -> None:
+                        data: Optional[bytes],
+                        skip_if_newer_null: bool = False) -> None:
         """Write one version copied from ANOTHER erasure set into this
         set's geometry — the decommission/rebalance transfer primitive
         (reference: cmd/erasure-server-pool-decom.go decommissionObject
@@ -654,13 +655,32 @@ class ErasureSet:
         differ from the source's."""
         self._check_bucket(bucket)
         n = len(self.disks)
+
+        def newer_null_exists() -> bool:
+            """Under the key lock: is there already a null version at
+            least as new as the one being restored? There is only ONE
+            null slot per key — restoring an old null (data OR marker)
+            over a newer concurrently-written one would lose an
+            acknowledged write."""
+            if not skip_if_newer_null or src_fi.version_id:
+                return False
+            try:
+                return any(v.version_id == "" and
+                           v.mod_time >= src_fi.mod_time
+                           for v in self.list_versions_all(bucket, object_))
+            except ObjectNotFound:
+                return False
+
         if src_fi.deleted:
             fi = FileInfo(volume=bucket, name=object_,
                           version_id=src_fi.version_id, deleted=True,
                           mod_time=src_fi.mod_time)
-            _, errors = self._fanout(
-                [lambda d=d: d.write_metadata(bucket, object_, fi)
-                 for d in self.disks])
+            with self.ns.write(bucket, object_):
+                if newer_null_exists():
+                    return
+                _, errors = self._fanout(
+                    [lambda d=d: d.write_metadata(bucket, object_, fi)
+                     for d in self.disks])
             if sum(e is None for e in errors) < n // 2 + 1:
                 raise WriteQuorumError(bucket, object_)
             return
@@ -703,6 +723,11 @@ class ErasureSet:
             d.rename_data(SYS_VOL, staging, fi, bucket, object_)
 
         with self.ns.write(bucket, object_):
+            if newer_null_exists():
+                self._fanout([lambda d=d: _swallow(
+                    lambda: d.delete(SYS_VOL, staging, recursive=True))
+                    for d in self.disks])
+                return
             _, errors = self._fanout(
                 [lambda i=i: write_one(i) for i in range(n)])
         ok = sum(e is None for e in errors)

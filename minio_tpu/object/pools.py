@@ -44,6 +44,9 @@ class ServerPools:
         # destination copy during a drain.
         self.decommissioning: set[int] = set()
         self._decom = None             # active Decommission driver
+        # Peer fan-out hook fired on drain status transitions so other
+        # nodes re-sync their exclusion sets (grid.peers).
+        self.on_decom_change = None
 
     # -- placement -----------------------------------------------------
 
@@ -214,11 +217,12 @@ class ServerPools:
         if self.decommissioning:
             marker = opts.versioned and not opts.version_id
             if marker:
-                # New delete markers stack in a SURVIVING pool — stamped
-                # into a draining pool they would land outside the
-                # migration snapshot and silently vanish.
-                return self.pools[self._pool_for_new()].delete_object(
-                    bucket, object_, opts)
+                # Markers stack where a write would land: the pool that
+                # owns the key, or a survivor when the owner is draining
+                # (stamped into a draining pool the marker would land
+                # outside the migration snapshot and silently vanish).
+                return self.pools[self._put_pool(bucket, object_)] \
+                    .delete_object(bucket, object_, opts)
             # Version destruction applies to EVERY pool holding a copy:
             # during a drain the same version can exist in both source
             # and destination, and deleting only one resurrects it.
@@ -257,33 +261,42 @@ class ServerPools:
         self._decom.start()
         return self._decom
 
-    def resume_decommission(self):
-        """Boot-time resume: if a persisted drain never completed, pick
-        it up from its checkpoint. Returns the driver or None. The
-        drained pool is located by its drive-endpoint SIGNATURE, never
-        by stored index — after the operator removes the pool, indices
-        shift and a stale index would poison a live pool."""
+    def sync_decommission_markers(self) -> None:
+        """Re-read the persisted decommission document and update this
+        node's placement-exclusion set — the receiving half of the
+        peer control plane (a drain started on another node must stop
+        THIS node from placing new objects in the draining pool). Does
+        NOT start a drain worker; exactly one node runs the walk."""
         from minio_tpu.object import decom
-        state = decom.load_state(self)
-        if not state:
-            return None
-        idx = decom.find_pool_by_signature(self, state.get("pool_sig", ""))
-        if idx is None:
-            # The drained pool is gone from the topology: the
-            # decommission's purpose is fulfilled; nothing to resume
-            # or exclude.
-            return None
-        if state.get("status") not in ("draining", "failed"):
-            # complete: keep the drained pool out of placement until
-            # the operator drops it from the topology.
-            if state.get("status") == "complete":
+        for sig, rec in decom.load_doc(self).get("records", {}).items():
+            idx = decom.find_pool_by_signature(self, sig)
+            if idx is not None and rec.get("status") in (
+                    "draining", "failed", "complete"):
                 self.decommissioning.add(idx)
-            return None
-        state["status"] = "draining"
-        state["pool"] = idx
-        self._decom = decom.Decommission(self, idx, state=state)
-        self._decom.start()
-        return self._decom
+
+    def resume_decommission(self):
+        """Boot-time resume: pick an unfinished drain up, re-walking
+        from the START when the previous run recorded failures (the
+        migrate is idempotent, and a failed key would otherwise be
+        checkpointed past forever). Pools are located by drive-endpoint
+        SIGNATURE, never by stored index — after the operator removes
+        the drained pool, indices shift and a stale index would poison
+        a live pool. Returns the driver or None."""
+        from minio_tpu.object import decom
+        self.sync_decommission_markers()
+        for sig, rec in decom.load_doc(self).get("records", {}).items():
+            idx = decom.find_pool_by_signature(self, sig)
+            if idx is None or rec.get("status") not in ("draining",
+                                                        "failed"):
+                continue
+            if rec.get("status") == "failed" or rec.get("failed"):
+                rec.update(bucket="", marker="", failed=0)
+            rec["status"] = "draining"
+            rec["pool"] = idx
+            self._decom = decom.Decommission(self, idx, state=rec)
+            self._decom.start()
+            return self._decom
+        return None
 
     def decommission_status(self):
         from minio_tpu.object import decom

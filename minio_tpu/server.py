@@ -317,6 +317,7 @@ def main(argv=None) -> int:
         layer.on_bucket_meta_change = \
             lambda bucket: peer_notifier.broadcast("bucket-meta",
                                                    bucket=bucket)
+        layer.on_decom_change = lambda: peer_notifier.broadcast("decom")
     if args.audit_webhook:
         from minio_tpu.s3.trace import AuditLogger
         srv.audit = AuditLogger(args.audit_webhook)

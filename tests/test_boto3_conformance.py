@@ -1,0 +1,154 @@
+"""External-SDK conformance: drive a live server with boto3 (the Mint
+analogue, SURVEY §4.5). Wire-level behaviors a hand-rolled client can't
+catch — SDK header casing, XML namespace strictness, 100-continue,
+checksum/retry behavior — surface here.
+
+The whole module SKIPS when boto3 is absent (this CI image has no
+network and no bundled SDK); any environment with boto3 runs it as-is.
+"""
+
+import os
+
+import pytest
+
+boto3 = pytest.importorskip("boto3")
+from botocore.client import Config  # noqa: E402
+from botocore.exceptions import ClientError  # noqa: E402
+
+from minio_tpu.object.erasure_object import ErasureSet
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.local import LocalStorage
+
+
+@pytest.fixture(scope="module")
+def srv(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("botodrv")
+    disks = [LocalStorage(str(tmp / f"d{i}")) for i in range(4)]
+    server = S3Server(ErasureSet(disks), address="127.0.0.1:0")
+    server.start()
+    yield server
+    server.stop()
+
+
+@pytest.fixture(scope="module")
+def s3(srv):
+    return boto3.client(
+        "s3", endpoint_url=f"http://{srv.address}",
+        aws_access_key_id="minioadmin",
+        aws_secret_access_key="minioadmin",
+        region_name="us-east-1",
+        config=Config(s3={"addressing_style": "path"},
+                      retries={"max_attempts": 2}))
+
+
+BUCKET = "sdkbkt"
+
+
+def test_bucket_and_object_crud(s3):
+    s3.create_bucket(Bucket=BUCKET)
+    body = os.urandom(128_000)
+    put = s3.put_object(Bucket=BUCKET, Key="crud/obj", Body=body,
+                        ContentType="application/x-test",
+                        Metadata={"owner": "sdk"})
+    assert put["ResponseMetadata"]["HTTPStatusCode"] == 200
+    got = s3.get_object(Bucket=BUCKET, Key="crud/obj")
+    assert got["Body"].read() == body
+    assert got["ContentType"] == "application/x-test"
+    assert got["Metadata"].get("owner") == "sdk"
+    head = s3.head_object(Bucket=BUCKET, Key="crud/obj")
+    assert head["ContentLength"] == len(body)
+    assert head["ETag"] == put["ETag"]
+    s3.delete_object(Bucket=BUCKET, Key="crud/obj")
+    with pytest.raises(ClientError) as ei:
+        s3.head_object(Bucket=BUCKET, Key="crud/obj")
+    assert ei.value.response["ResponseMetadata"]["HTTPStatusCode"] == 404
+
+
+def test_ranged_get_and_conditional(s3):
+    body = os.urandom(64_000)
+    put = s3.put_object(Bucket=BUCKET, Key="ranged", Body=body)
+    got = s3.get_object(Bucket=BUCKET, Key="ranged",
+                        Range="bytes=1000-1999")
+    assert got["Body"].read() == body[1000:2000]
+    assert got["ResponseMetadata"]["HTTPStatusCode"] == 206
+    got = s3.get_object(Bucket=BUCKET, Key="ranged",
+                        IfMatch=put["ETag"])
+    assert got["Body"].read() == body
+    with pytest.raises(ClientError) as ei:
+        s3.get_object(Bucket=BUCKET, Key="ranged", IfMatch='"nope"')
+    assert ei.value.response["ResponseMetadata"]["HTTPStatusCode"] == 412
+
+
+def test_multipart_upload(s3):
+    mp = s3.create_multipart_upload(Bucket=BUCKET, Key="mp/big")
+    uid = mp["UploadId"]
+    parts = []
+    chunks = [os.urandom(5 << 20), os.urandom(5 << 20), os.urandom(3000)]
+    for i, c in enumerate(chunks, start=1):
+        r = s3.upload_part(Bucket=BUCKET, Key="mp/big", UploadId=uid,
+                           PartNumber=i, Body=c)
+        parts.append({"PartNumber": i, "ETag": r["ETag"]})
+    done = s3.complete_multipart_upload(
+        Bucket=BUCKET, Key="mp/big", UploadId=uid,
+        MultipartUpload={"Parts": parts})
+    assert done["ETag"].strip('"').endswith("-3")
+    got = s3.get_object(Bucket=BUCKET, Key="mp/big")
+    assert got["Body"].read() == b"".join(chunks)
+
+
+def test_presigned_urls(s3):
+    import urllib.request
+    body = b"presigned payload"
+    s3.put_object(Bucket=BUCKET, Key="pres", Body=body)
+    url = s3.generate_presigned_url(
+        "get_object", Params={"Bucket": BUCKET, "Key": "pres"},
+        ExpiresIn=300)
+    with urllib.request.urlopen(url) as r:
+        assert r.read() == body
+
+
+def test_tagging_and_copy(s3):
+    s3.put_object(Bucket=BUCKET, Key="src", Body=b"copy me",
+                  Tagging="team=eng&tier=gold")
+    tags = s3.get_object_tagging(Bucket=BUCKET, Key="src")
+    assert {t["Key"]: t["Value"] for t in tags["TagSet"]} == \
+        {"team": "eng", "tier": "gold"}
+    s3.copy_object(Bucket=BUCKET, Key="dst",
+                   CopySource={"Bucket": BUCKET, "Key": "src"})
+    assert s3.get_object(Bucket=BUCKET,
+                         Key="dst")["Body"].read() == b"copy me"
+
+
+def test_listing_pagination(s3):
+    for i in range(12):
+        s3.put_object(Bucket=BUCKET, Key=f"page/{i:03d}", Body=b"x")
+    keys = []
+    token = None
+    while True:
+        kw = {"Bucket": BUCKET, "Prefix": "page/", "MaxKeys": 5}
+        if token:
+            kw["ContinuationToken"] = token
+        page = s3.list_objects_v2(**kw)
+        keys.extend(o["Key"] for o in page.get("Contents", []))
+        if not page.get("IsTruncated"):
+            break
+        token = page["NextContinuationToken"]
+    assert keys == [f"page/{i:03d}" for i in range(12)]
+
+
+def test_versioning_and_batch_delete(s3):
+    s3.put_bucket_versioning(
+        Bucket=BUCKET,
+        VersioningConfiguration={"Status": "Enabled"})
+    v1 = s3.put_object(Bucket=BUCKET, Key="ver", Body=b"one")
+    v2 = s3.put_object(Bucket=BUCKET, Key="ver", Body=b"two")
+    assert v1["VersionId"] != v2["VersionId"]
+    got = s3.get_object(Bucket=BUCKET, Key="ver",
+                        VersionId=v1["VersionId"])
+    assert got["Body"].read() == b"one"
+    listing = s3.list_object_versions(Bucket=BUCKET, Prefix="ver")
+    assert len(listing.get("Versions", [])) == 2
+    out = s3.delete_objects(Bucket=BUCKET, Delete={"Objects": [
+        {"Key": "ver", "VersionId": v1["VersionId"]},
+        {"Key": "ver", "VersionId": v2["VersionId"]}]})
+    assert len(out.get("Deleted", [])) == 2 and not out.get("Errors")

@@ -78,6 +78,16 @@ def test_decommission_drains_pool_preserving_everything(layer):
     # New writes land in surviving pools only.
     layer.put_object("db", "after", b"post-drain")
     assert _pool_is_empty(layer.pools[0], "db")
+    # A fresh layer over the same drives (restart / peer node) learns
+    # the completed drain from persisted state and keeps the pool
+    # excluded; nothing resumes.
+    layer3 = ServerPools(list(layer.pools))
+    assert layer3.resume_decommission() is None
+    assert 0 in layer3.decommissioning
+    # The peer-sync entry point alone also suffices.
+    layer4 = ServerPools(list(layer.pools))
+    layer4.sync_decommission_markers()
+    assert 0 in layer4.decommissioning
 
 
 def test_decommission_preserves_multipart_parts_and_etag(layer):

@@ -290,3 +290,64 @@ def test_post_policy_expired_rejected(srv):
     fields = _signed_policy_fields("uploads/", "conf", expire_mins=-10)
     st, body = _post_form(srv.address, "conf", fields, b"data")
     assert st == 403, body
+
+
+# ---------------------------------------------------------------------------
+# SDK wire behaviors (what boto3/aws-sdk actually put on the socket)
+# ---------------------------------------------------------------------------
+
+def test_expect_100_continue_put(srv, cli):
+    """AWS SDKs send `Expect: 100-continue` on PUTs and wait for the
+    interim response before the body; the server must answer it and
+    then accept the payload (reference: Go's net/http does this
+    transparently; BaseHTTPRequestHandler must too)."""
+    assert cli.request("PUT", "/conf100")[0] == 200
+    body = os.urandom(50_000)
+    st, _, b = cli.request("PUT", "/conf100/exp", body=body,
+                           headers={"Expect": "100-continue"})
+    assert st == 200, b
+    st, _, got = cli.request("GET", "/conf100/exp")
+    assert st == 200 and got == body
+
+
+def test_keep_alive_connection_reuse(srv):
+    """SDKs pipeline many requests over one pooled connection; each
+    response's framing must leave the socket clean for the next
+    request (Content-Length exact, bodies fully drained)."""
+    cli = S3Client(srv.address)
+    assert cli.request("PUT", "/confka")[0] == 200
+    conn = http.client.HTTPConnection(*srv.address.rsplit(":", 1),
+                                      timeout=15)
+    try:
+        for i in range(6):
+            body = f"ka-{i}".encode() * 100
+            # Sign each request independently but send on ONE socket.
+            import urllib.parse as _up
+            now = datetime.datetime.now(datetime.timezone.utc)
+            amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+            scope = f"{amz_date[:8]}/us-east-1/s3/aws4_request"
+            path = f"/confka/k{i}"
+            ph = hashlib.sha256(body).hexdigest()
+            hdrs = {"host": srv.address, "x-amz-date": amz_date,
+                    "x-amz-content-sha256": ph}
+            signed = sorted(hdrs)
+            canon = sigv4.canonical_request("PUT", path, {}, hdrs,
+                                            signed, ph)
+            sts = sigv4.string_to_sign(amz_date, scope, canon)
+            key = sigv4.signing_key("minioadmin", amz_date[:8],
+                                    "us-east-1")
+            sig = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+            hdrs["Authorization"] = (
+                f"{sigv4.ALGORITHM} Credential=minioadmin/{scope}, "
+                f"SignedHeaders={';'.join(signed)}, Signature={sig}")
+            conn.request("PUT", path, body=body, headers=hdrs)
+            r = conn.getresponse()
+            r.read()
+            assert r.status == 200
+        # All six landed through the one connection.
+        c2 = S3Client(srv.address)
+        for i in range(6):
+            st, _, got = c2.request("GET", f"/confka/k{i}")
+            assert st == 200 and got == f"ka-{i}".encode() * 100
+    finally:
+        conn.close()
