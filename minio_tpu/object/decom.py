@@ -64,8 +64,15 @@ def find_pool_by_signature(pools_layer, sig: str):
 
 
 def _state_disks(pools_layer, skip_idx: int):
-    """Drives of the FIRST surviving pool — the state must not live on
-    the pool being removed."""
+    """Drives of the first LIVE surviving pool — the state must not
+    live on the pool being removed, NOR on a previously drained pool
+    (its removal would take the active drain's only record with it)."""
+    decom = getattr(pools_layer, "decommissioning", set())
+    for i, p in enumerate(pools_layer.pools):
+        if i != skip_idx and i not in decom:
+            return [d for s in p.sets for d in s.disks]
+    # Fallback (e.g. status queries after every other pool completed):
+    # any pool other than the drained one.
     for i, p in enumerate(pools_layer.pools):
         if i != skip_idx:
             return [d for s in p.sets for d in s.disks]
@@ -318,21 +325,9 @@ class Decommission:
         # the old versions must join that same stack — a free-space
         # choice could split the key across two pools, and pool-ordered
         # reads would then shadow the newer write.
-        from minio_tpu.object.types import MethodNotAllowed as _MNA
-        dst_idx = None
-        for i in self.layer._pool_order():
-            if i == self.pool_idx or i in self.layer.decommissioning:
-                continue
-            try:
-                self.layer.pools[i].get_object_info(bucket, key)
-                dst_idx = i
-                break
-            except _MNA:
-                dst_idx = i             # delete marker: key lives here
-                break
-            except Exception:  # noqa: BLE001 - not in this pool
-                continue
-        if dst_idx is None:
+        dst_idx = self.layer._pool_of_existing(bucket, key)
+        if dst_idx is None or dst_idx == self.pool_idx or \
+                dst_idx in self.layer.decommissioning:
             dst_idx = self._dst_idx()
         dst_set = self.layer.pools[dst_idx].set_for(key)
         for _attempt in range(5):
@@ -340,9 +335,13 @@ class Decommission:
                 versions = src_set.list_versions_all(bucket, key)
             except ObjectNotFound:
                 return                  # deleted mid-walk: nothing to do
+            from minio_tpu.object.tier import META_TIER
             for fi in sorted(versions, key=lambda f: -f.mod_time):
                 data = None
-                if not fi.deleted:
+                tiered = bool((fi.metadata or {}).get(META_TIER))
+                if not fi.deleted and not tiered:
+                    # Tiered versions migrate pointer-only — their
+                    # data stays in the warm tier.
                     try:
                         _, data = src_set.get_object(
                             bucket, key,

@@ -137,6 +137,9 @@ class ErasureSet:
         self.ns = NSLockMap()
         self._mrf = None
         self._mrf_lock = __import__("threading").Lock()
+        # Warm-tier registry (object/tier.TierRegistry); None = no
+        # tiering configured. Set at boot, shared across sets.
+        self.tiers = None
 
     @property
     def mrf(self):
@@ -684,6 +687,27 @@ class ErasureSet:
             if sum(e is None for e in errors) < n // 2 + 1:
                 raise WriteQuorumError(bucket, object_)
             return
+        from minio_tpu.object.tier import META_TIER
+        if (src_fi.metadata or {}).get(META_TIER):
+            # Transitioned version: the DATA lives in its warm tier;
+            # only the metadata pointer migrates (re-encoding would
+            # duplicate the tier copy locally and shadow nothing).
+            fi = FileInfo(
+                volume=bucket, name=object_,
+                version_id=src_fi.version_id, deleted=False,
+                mod_time=src_fi.mod_time, size=src_fi.size,
+                metadata=dict(src_fi.metadata),
+                parts=[dataclasses.replace(p)
+                       for p in (src_fi.parts or [])])
+            with self.ns.write(bucket, object_):
+                if newer_null_exists():
+                    return
+                _, errors = self._fanout(
+                    [lambda d=d: d.write_metadata(bucket, object_, fi)
+                     for d in self.disks])
+            if sum(e is None for e in errors) < n // 2 + 1:
+                raise WriteQuorumError(bucket, object_)
+            return
         m = self.default_parity
         k = n - m
         write_quorum = k + (1 if k == m else 0)
@@ -1000,6 +1024,10 @@ class ErasureSet:
     def _iter_payload(self, bucket: str, object_: str, fi: FileInfo,
                       fis: list, offset: int, length: int):
         """Yield [offset, offset+length) as block-aligned windows."""
+        tb = self._tier_read(fi, offset, length)
+        if tb is not None:
+            yield tb
+            return
         parts = fi.parts or [ObjectPartInfo(number=1, size=fi.size,
                                             actual_size=fi.size)]
         cum = 0
@@ -1022,6 +1050,23 @@ class ErasureSet:
             if cum >= offset + length:
                 break
 
+    def _tier_read(self, fi: FileInfo, offset: int,
+                   length: int) -> Optional[bytes]:
+        """Transitioned version? Fetch the stored byte range from its
+        warm tier (reference: getTransitionedObjectReader,
+        cmd/bucket-lifecycle.go); None for local versions."""
+        from minio_tpu.object import tier as tier_mod
+        name = (fi.metadata or {}).get(tier_mod.META_TIER)
+        if not name:
+            return None
+        if self.tiers is None:
+            raise StorageError(
+                f"version is tiered to {name!r} but no tier registry "
+                "is configured")
+        backend = self.tiers.get(name)
+        return backend.get(fi.metadata[tier_mod.META_TIER_KEY],
+                           offset, length)
+
     def _read_payload(self, bucket: str, object_: str, fi: FileInfo,
                       fis: list, offset: int, length: int) -> bytes:
         """Read [offset, offset+length) across the object's parts.
@@ -1030,6 +1075,9 @@ class ErasureSet:
         files (reference: multipart parts keep their own erasure framing,
         cmd/erasure-object.go per-part loop at :368-387); single-put
         objects are the one-part special case."""
+        tb = self._tier_read(fi, offset, length)
+        if tb is not None:
+            return tb
         parts = fi.parts or [ObjectPartInfo(number=1, size=fi.size,
                                             actual_size=fi.size)]
         out = bytearray()
@@ -1255,11 +1303,115 @@ class ErasureSet:
         return self.update_version_metadata(bucket, object_, version_id,
                                             mutate)
 
+    def transition_version(self, bucket: str, object_: str,
+                           version_id: str, tier_name: str) -> None:
+        """Move one version's DATA to a warm tier, leaving its metadata
+        local with a pointer (reference: transitionObject,
+        cmd/bucket-lifecycle.go). The stored byte stream ships verbatim
+        (SSE/compression transforms stay intact), so reads through
+        _tier_read are byte-identical to local reads."""
+        from minio_tpu.object import tier as tier_mod
+        if self.tiers is None:
+            raise StorageError("no tier registry configured")
+        backend = self.tiers.get(tier_name)    # resolve before touching
+        self._check_bucket(bucket)
+        # Phase 1 — read + upload WITHOUT the key lock: shipping a
+        # large object to a remote tier can take minutes, and holding
+        # ns.write through it would LockTimeout every client operation
+        # on the key. (Memory is O(object) for the upload buffer — a
+        # v1 bound; the reference streams.)
+        with self.ns.read(bucket, object_):
+            fis, errors = self._read_version_all(bucket, object_,
+                                                 version_id,
+                                                 read_data=True)
+            n = len(self.disks)
+            quorum = n // 2 + 1
+            fi, idxs = self._quorum_fileinfo(fis, quorum)
+            if fi is None:
+                raise ObjectNotFound(bucket, object_)
+            if fi.deleted or fi.metadata.get(tier_mod.META_TIER):
+                return                    # marker / already transitioned
+            data = self._read_payload(bucket, object_, fi,
+                                      fis, 0, fi.size)
+        remote_key = tier_mod.tier_object_key(
+            "", bucket, object_, fi.version_id).lstrip("/")
+        backend.put(remote_key, data)
+        # Phase 2 — commit the pointer under the lock, re-validating
+        # that the version is still the one we uploaded (an overwrite
+        # or delete during the upload orphans our tier copy: remove it
+        # and bail; the next scanner cycle re-evaluates).
+        with self.ns.write(bucket, object_):
+            fis2, _ = self._read_version_all(bucket, object_, version_id,
+                                             read_data=True)
+            fi2, idxs2 = self._quorum_fileinfo(fis2, quorum)
+            if fi2 is None or fi2.deleted or fi2.mod_time != fi.mod_time \
+                    or fi2.metadata.get(tier_mod.META_TIER):
+                backend.remove(remote_key)
+                return
+            new_meta = dict(fi2.metadata)
+            new_meta[tier_mod.META_TIER] = tier_name
+            new_meta[tier_mod.META_TIER_KEY] = remote_key
+            new_meta[tier_mod.META_TIER_SIZE] = str(len(data))
+            agree = set(idxs2)
+
+            def rewrite_one(i: int):
+                dfi = fis2[i]
+                self.disks[i].write_metadata(
+                    bucket, object_,
+                    dataclasses.replace(dfi, metadata=dict(new_meta),
+                                        inline_data=None))
+                # The local shard files are now garbage: reclaim.
+                if dfi.data_dir:
+                    _swallow(lambda: self.disks[i].delete(
+                        bucket, f"{object_}/{dfi.data_dir}",
+                        recursive=True))
+
+            _, werrs = self._fanout(
+                [(lambda i=i: rewrite_one(i)) if i in agree else None
+                 for i in range(n)])
+            ok = sum(1 for i in agree if werrs[i] is None)
+            if ok < quorum:
+                # The tier copy exists but the pointer didn't commit:
+                # remove the orphan and fail (next cycle retries).
+                backend.remove(remote_key)
+                raise WriteQuorumError(bucket, object_)
+            if len(agree) < n:
+                self.mrf.enqueue(bucket, object_, fi.version_id)
+
+    def _tier_cleanup(self, bucket: str, object_: str,
+                      version_id: str) -> None:
+        """Before destroying a version: if it was transitioned, remove
+        the tier copy (reference: free-version deletion sweeps the
+        remote object). Best-effort — an orphaned tier object wastes
+        space but breaks nothing."""
+        if self.tiers is None:
+            return
+        from minio_tpu.object import tier as tier_mod
+        for d in self.disks:
+            try:
+                fi = d.read_version(bucket, object_, version_id)
+            except Exception:  # noqa: BLE001 - try another drive
+                continue
+            name = (fi.metadata or {}).get(tier_mod.META_TIER)
+            if name:
+                try:
+                    self.tiers.get(name).remove(
+                        fi.metadata[tier_mod.META_TIER_KEY])
+                except Exception:  # noqa: BLE001 - orphan tolerated
+                    pass
+            return
+
     def delete_object(self, bucket: str, object_: str,
                       opts: Optional[DeleteOptions] = None) -> DeletedObject:
         opts = opts or DeleteOptions()
         self._check_bucket(bucket)
         with self.ns.write(bucket, object_):
+            if opts.version_id or not opts.versioned:
+                # Version destruction (not marker stacking): reclaim a
+                # transitioned version's tier copy. Lives HERE, not in
+                # _delete_object_locked — decommission's internal
+                # deletes migrate the pointer and must keep the blob.
+                self._tier_cleanup(bucket, object_, opts.version_id)
             return self._delete_object_locked(bucket, object_, opts)
 
     def _delete_object_locked(self, bucket: str, object_: str,

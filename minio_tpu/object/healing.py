@@ -124,6 +124,13 @@ def _heal_object_locked(es, bucket: str, object_: str, version_id: str,
     if fi.deleted:
         # Delete markers heal by metadata replication only.
         return _heal_metadata_only(es, bucket, object_, fi, fis, errors)
+    from minio_tpu.object.tier import META_TIER
+    if (fi.metadata or {}).get(META_TIER):
+        # Transitioned versions hold no local data — their shard files
+        # were reclaimed at transition; only the metadata pointer
+        # replicates (treating the absent data files as damage would
+        # 'reconstruct' garbage or purge a healthy version).
+        return _heal_metadata_only(es, bucket, object_, fi, fis, errors)
 
     from minio_tpu.storage.meta import ObjectPartInfo
     k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks

@@ -35,6 +35,13 @@ class Rule:
     expiration_date: float = 0.0          # epoch seconds
     expire_delete_marker: bool = False
     noncurrent_days: int = 0
+    # Transition: move data to a named warm tier after an age or at a
+    # date (reference: lifecycle.Transition, StorageClass = tier name).
+    transition_days: int = 0
+    transition_date: float = 0.0          # epoch seconds
+    transition_tier: str = ""
+    noncurrent_transition_days: int = 0
+    noncurrent_transition_tier: str = ""
 
 
 def _text(el, name: str) -> str:
@@ -94,6 +101,46 @@ def parse_lifecycle(xml: bytes | str) -> list[Rule]:
                 except ValueError:
                     raise LifecycleError(
                         f"bad NoncurrentDays {nd!r}") from None
+        tr = _find(rel, "Transition")
+        if tr is not None:
+            tier = _text(tr, "StorageClass")
+            days = _text(tr, "Days")
+            date = _text(tr, "Date")
+            if not tier:
+                raise LifecycleError("Transition needs StorageClass")
+            if date:
+                try:
+                    dt = datetime.datetime.fromisoformat(
+                        date.replace("Z", "+00:00"))
+                    if dt.tzinfo is None:
+                        dt = dt.replace(tzinfo=datetime.timezone.utc)
+                    r.transition_date = dt.timestamp()
+                except ValueError:
+                    raise LifecycleError(
+                        f"bad Transition Date {date!r}") from None
+            try:
+                r.transition_days = int(days or "0")
+            except ValueError:
+                raise LifecycleError(f"bad Transition Days {days!r}") \
+                    from None
+            if r.transition_days < 0:
+                raise LifecycleError("Transition Days must be >= 0")
+            r.transition_tier = tier
+        ntr = _find(rel, "NoncurrentVersionTransition")
+        if ntr is not None:
+            tier = _text(ntr, "StorageClass")
+            days = _text(ntr, "NoncurrentDays")
+            if not tier:
+                raise LifecycleError(
+                    "NoncurrentVersionTransition needs StorageClass")
+            try:
+                r.noncurrent_transition_days = int(days or "0")
+            except ValueError:
+                raise LifecycleError(
+                    f"bad NoncurrentDays {days!r}") from None
+            if r.noncurrent_transition_days < 0:
+                raise LifecycleError("NoncurrentDays must be >= 0")
+            r.noncurrent_transition_tier = tier
         rules.append(r)
     if not rules:
         raise LifecycleError("lifecycle configuration has no rules")
@@ -102,9 +149,17 @@ def parse_lifecycle(xml: bytes | str) -> list[Rule]:
 
 @dataclasses.dataclass
 class Action:
-    kind: str           # "expire_latest" | "delete_version" | "drop_marker"
+    # "expire_latest" | "delete_version" | "drop_marker" | "transition"
+    kind: str
     version_id: str = ""
     rule_id: str = ""
+    tier: str = ""
+
+
+def _tiered(v) -> bool:
+    """Already transitioned? (metadata carries the tier pointer)."""
+    from minio_tpu.object.tier import META_TIER
+    return bool((getattr(v, "metadata", None) or {}).get(META_TIER))
 
 
 def evaluate(rules: Sequence[Rule], key: str, versions,
@@ -129,11 +184,30 @@ def evaluate(rules: Sequence[Rule], key: str, versions,
                       (r.expiration_date and now >= r.expiration_date)
             if expired:
                 actions.append(Action("expire_latest", rule_id=r.rule_id))
+            elif r.transition_tier and not _tiered(latest):
+                due = now >= r.transition_date if r.transition_date \
+                    else latest_age > r.transition_days * _DAY
+                if due:
+                    actions.append(Action("transition",
+                                          version_id=latest.version_id,
+                                          rule_id=r.rule_id,
+                                          tier=r.transition_tier))
         elif r.expire_delete_marker and len(versions) == 1:
             # Lone delete marker left behind after its versions expired.
             actions.append(Action("drop_marker",
                                   version_id=latest.version_id,
                                   rule_id=r.rule_id))
+        if r.noncurrent_transition_tier:
+            for newer, v in zip(versions, versions[1:]):
+                if v.deleted or _tiered(v):
+                    continue
+                noncurrent_since = newer.mod_time / 1e9
+                if now - noncurrent_since > \
+                        r.noncurrent_transition_days * _DAY:
+                    actions.append(Action(
+                        "transition", version_id=v.version_id,
+                        rule_id=r.rule_id,
+                        tier=r.noncurrent_transition_tier))
         if r.noncurrent_days:
             # A version becomes noncurrent when the next-newer version
             # supersedes it; its age counts from that moment.
@@ -216,6 +290,12 @@ def make_scanner_hook(now_fn=None):
                         continue
                     es.delete_object(bucket, key, DeleteOptions(
                         version_id=a.version_id, versioned=versioned))
+                elif a.kind == "transition":
+                    # WORM versions may still transition (the data
+                    # remains readable; only its location changes) —
+                    # the reference transitions locked objects too.
+                    es.transition_version(bucket, key, a.version_id,
+                                          a.tier)
             except Exception:  # noqa: BLE001 - next cycle retries
                 continue
     return hook

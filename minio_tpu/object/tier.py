@@ -1,0 +1,216 @@
+"""Warm tiers: remote targets for ILM transitions.
+
+The analogue of the reference's tiering stack (cmd/warm-backend.go:39,
+cmd/tier.go, cmd/warm-backend-s3.go / -minio.go): named warm backends
+persist in a quorum-replicated config document; lifecycle Transition
+rules move an object's DATA to its tier while the version's metadata
+(etag, user metadata, SSE params) stays local with a pointer; reads
+stream through the tier transparently; deleting the version removes
+the tier copy.
+
+Backends:
+- "fs": a local directory (tests; single-node cold storage).
+- "s3": any S3-compatible endpoint via the internal RemoteS3 client —
+  pointing one minio_tpu cluster's cold tier at another is the
+  reference's warm-backend-minio shape.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from minio_tpu.storage.local import SYS_VOL
+
+TIERS_PATH = "config/tiers.json"
+
+# Internal version-metadata keys marking a transitioned version
+# (reference: xl.meta transition fields, cmd/xl-storage-format-v2.go).
+META_TIER = "x-internal-tier-name"
+META_TIER_KEY = "x-internal-tier-key"
+META_TIER_SIZE = "x-internal-tier-size"   # stored size in the tier
+
+
+class TierError(Exception):
+    pass
+
+
+class FSWarmBackend:
+    """Directory-backed tier."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+
+    def put(self, key: str, data: bytes) -> None:
+        full = os.path.join(self.path, key)
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        tmp = full + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, full)
+
+    def get(self, key: str, offset: int = 0,
+            length: int = -1) -> bytes:
+        try:
+            with open(os.path.join(self.path, key), "rb") as f:
+                f.seek(offset)
+                return f.read() if length < 0 else f.read(length)
+        except FileNotFoundError:
+            raise TierError(f"tier object {key!r} missing") from None
+
+    def remove(self, key: str) -> None:
+        try:
+            os.remove(os.path.join(self.path, key))
+        except FileNotFoundError:
+            pass
+
+
+class S3WarmBackend:
+    """S3-compatible remote tier via the internal SigV4 client."""
+
+    def __init__(self, endpoint: str, access_key: str, secret_key: str,
+                 bucket: str, prefix: str = ""):
+        from minio_tpu.s3.client import RemoteS3
+        self.remote = RemoteS3(endpoint, access_key, secret_key)
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+
+    def _key(self, key: str) -> str:
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    def put(self, key: str, data: bytes) -> None:
+        self.remote.put_object(self.bucket, self._key(key), data)
+
+    def get(self, key: str, offset: int = 0, length: int = -1) -> bytes:
+        from minio_tpu.s3.client import S3ClientError
+        headers = {}
+        if offset or length >= 0:
+            end = "" if length < 0 else str(offset + length - 1)
+            headers["Range"] = f"bytes={offset}-{end}"
+        try:
+            st, _, body = self.remote.request(
+                "GET", f"/{self.bucket}/{self._key(key)}", headers=headers)
+        except S3ClientError as e:
+            raise TierError(f"tier read failed: {e}") from None
+        if st not in (200, 206):
+            raise TierError(f"tier read failed: HTTP {st}")
+        return body
+
+    def remove(self, key: str) -> None:
+        try:
+            self.remote.delete_object(self.bucket, self._key(key))
+        except Exception:  # noqa: BLE001 - best-effort cleanup
+            pass
+
+
+def _build(cfg: dict):
+    t = cfg.get("type", "")
+    if t == "fs":
+        return FSWarmBackend(cfg["path"])
+    if t == "s3":
+        return S3WarmBackend(cfg["endpoint"], cfg["accessKey"],
+                             cfg["secretKey"], cfg["bucket"],
+                             cfg.get("prefix", ""))
+    raise TierError(f"unknown tier type {t!r}")
+
+
+class TierRegistry:
+    """Named tiers, quorum-persisted on the first pool's drives
+    (reference: tier-config.bin via TierConfigMgr)."""
+
+    _TTL = 5.0
+
+    def __init__(self, sets):
+        self._sets = list(sets)
+        self._mu = threading.RLock()
+        self._cfgs: dict[str, dict] = {}
+        self._built: dict[str, object] = {}
+        self._loaded_at = 0.0
+        self._load()
+
+    def _disks(self):
+        return [d for es in self._sets for d in es.disks]
+
+    def _load(self) -> None:
+        votes: dict[bytes, int] = {}
+        for d in self._disks():
+            try:
+                blob = d.read_all(SYS_VOL, TIERS_PATH)
+                votes[blob] = votes.get(blob, 0) + 1
+            except Exception:  # noqa: BLE001 - absent / offline
+                continue
+        if votes:
+            blob = max(votes.items(), key=lambda kv: kv[1])[0]
+            try:
+                doc = json.loads(blob)
+                if isinstance(doc, dict):
+                    self._cfgs = doc
+                    self._built.clear()
+            except ValueError:
+                pass
+        self._loaded_at = time.monotonic()
+
+    def _save(self) -> None:
+        blob = json.dumps(self._cfgs, sort_keys=True).encode()
+        ok = 0
+        for d in self._disks():
+            try:
+                d.write_all(SYS_VOL, TIERS_PATH, blob)
+                ok += 1
+            except Exception:  # noqa: BLE001 - offline drive
+                continue
+        if ok < len(self._disks()) // 2 + 1:
+            raise TierError("could not persist tier config to a quorum")
+
+    def _refresh(self) -> None:
+        if time.monotonic() - self._loaded_at > self._TTL:
+            self._load()
+
+    def add(self, name: str, cfg: dict) -> None:
+        if not name or not name.isalnum():
+            raise TierError("tier name must be alphanumeric")
+        _build(cfg)                     # validate before storing
+        with self._mu:
+            self._cfgs[name] = dict(cfg)
+            self._built.pop(name, None)
+            self._save()
+
+    def remove(self, name: str) -> None:
+        with self._mu:
+            if self._cfgs.pop(name, None) is None:
+                raise TierError(f"no such tier {name!r}")
+            self._built.pop(name, None)
+            self._save()
+
+    def list(self) -> dict:
+        with self._mu:
+            self._refresh()
+            out = {}
+            for name, cfg in self._cfgs.items():
+                c = dict(cfg)
+                c.pop("secretKey", None)   # never echo secrets
+                out[name] = c
+            return out
+
+    def get(self, name: str):
+        with self._mu:
+            self._refresh()
+            b = self._built.get(name)
+            if b is None:
+                cfg = self._cfgs.get(name)
+                if cfg is None:
+                    raise TierError(f"no such tier {name!r}")
+                b = self._built[name] = _build(cfg)
+            return b
+
+
+def tier_object_key(deployment_id: str, bucket: str, key: str,
+                    version_id: str) -> str:
+    """Remote name for a transitioned version — unique per version so
+    overwrites never collide in the tier."""
+    vid = version_id or "null"
+    return f"{deployment_id}/{bucket}/{key}/{vid}"

@@ -137,6 +137,9 @@ class S3Server:
         # the distributed boot (grid.peers.PeerNotifier.broadcast);
         # None on single-node deployments.
         self.peer_notify = None
+        # Warm-tier registry (object/tier.TierRegistry), created on
+        # first admin use or at boot.
+        self.tiers = None
 
     @property
     def address(self) -> str:
@@ -1133,6 +1136,25 @@ def _make_handler(server: S3Server):
             out = olock.default_retention_meta(cfg, now)
             out.update(explicit)
             return out
+
+        def _tier_registry(self):
+            """The server's tier registry, created on first use and
+            attached to every erasure set (the read/transition paths
+            resolve backends through set.tiers)."""
+            if server.tiers is None:
+                from minio_tpu.object.tier import TierRegistry
+                ol = server.object_layer
+                if hasattr(ol, "pools"):
+                    reg_sets = ol.pools[0].sets
+                    all_sets = [s for p in ol.pools for s in p.sets]
+                elif hasattr(ol, "sets"):
+                    reg_sets = all_sets = ol.sets
+                else:
+                    reg_sets = all_sets = [ol]
+                server.tiers = TierRegistry(reg_sets)
+                for s in all_sets:
+                    s.tiers = server.tiers
+            return server.tiers
 
         def _can_bypass_governance(self, bucket, key, h) -> bool:
             """Governance bypass needs BOTH the explicit header and the
@@ -2402,6 +2424,26 @@ def _make_handler(server: S3Server):
                 if server.peer_notify is not None:
                     server.peer_notify("config")
                 return ok({"applied": applied})
+
+            # Warm-tier management (reference: cmd/admin-handlers-tiers).
+            if op in ("add-tier", "remove-tier", "list-tiers"):
+                from minio_tpu.object.tier import TierError
+                reg = self._tier_registry()
+                try:
+                    if op == "add-tier" and method == "PUT":
+                        doc = _json.loads(body)
+                        reg.add(doc.get("name", ""), doc.get("config", {}))
+                        return ok()
+                    if op == "remove-tier" and method == "DELETE":
+                        reg.remove(q1.get("name", ""))
+                        return ok()
+                    if op == "list-tiers" and method == "GET":
+                        return ok(reg.list())
+                except TierError as e:
+                    raise S3Error("InvalidArgument", str(e)) from None
+                except ValueError:
+                    raise S3Error("MalformedXML") from None
+                raise S3Error("MethodNotAllowed")
 
             # Pool decommission (reference: cmd/admin-handlers-pools.go).
             if op == "decommission" and method == "POST":

@@ -292,6 +292,12 @@ def main(argv=None) -> int:
     creds = Credentials()
     creds.iam = IAMSys(pools[0].sets, creds.access_key, creds.secret_key)
     srv = S3Server(layer, address=args.address, credentials=creds)
+    # Warm tiers: registry on pool 0's drives, resolved by every set's
+    # read/transition paths (reference: globalTierConfigMgr).
+    from minio_tpu.object.tier import TierRegistry
+    srv.tiers = TierRegistry(pools[0].sets)
+    for s in all_sets:
+        s.tiers = srv.tiers
     srv.compression = args.compression
     # Persisted config overrides flags (the flags seed first boot).
     from minio_tpu.s3 import config as cfg_mod

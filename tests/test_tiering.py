@@ -1,0 +1,316 @@
+"""ILM transitions + warm tiers (reference: cmd/warm-backend.go,
+cmd/tier.go, lifecycle Transition in cmd/bucket-lifecycle.go)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from minio_tpu.object import tier as tier_mod
+from minio_tpu.object.erasure_object import ErasureSet
+from minio_tpu.object.lifecycle import make_scanner_hook, parse_lifecycle
+from minio_tpu.object.scanner import Scanner
+from minio_tpu.object.tier import (FSWarmBackend, S3WarmBackend, TierError,
+                                   TierRegistry)
+from minio_tpu.object.types import GetOptions, PutOptions
+from minio_tpu.storage.local import LocalStorage
+from tests.s3client import S3Client
+
+
+def _es(tmp_path, name="es"):
+    disks = [LocalStorage(str(tmp_path / name / f"d{i}")) for i in range(4)]
+    return ErasureSet(disks)
+
+
+# ---------------------------------------------------------------------------
+# backends + registry
+# ---------------------------------------------------------------------------
+
+def test_fs_backend_round_trip(tmp_path):
+    b = FSWarmBackend(str(tmp_path / "cold"))
+    b.put("a/b/obj", b"tiered bytes")
+    assert b.get("a/b/obj") == b"tiered bytes"
+    assert b.get("a/b/obj", offset=2, length=4) == b"ered"
+    b.remove("a/b/obj")
+    with pytest.raises(TierError):
+        b.get("a/b/obj")
+    b.remove("a/b/obj")                     # idempotent
+
+
+def test_registry_persistence_and_secrets(tmp_path):
+    es = _es(tmp_path)
+    reg = TierRegistry([es])
+    reg.add("COLD", {"type": "fs", "path": str(tmp_path / "cold")})
+    with pytest.raises(TierError):
+        reg.add("bad name!", {"type": "fs", "path": "/x"})
+    with pytest.raises(TierError):
+        reg.add("NOPE", {"type": "warp"})
+    # A second registry over the same drives sees the tier.
+    reg2 = TierRegistry([es])
+    assert "COLD" in reg2.list()
+    assert reg2.get("COLD") is not None
+    # S3 tier secrets never echo in listings.
+    reg.add("REMOTE", {"type": "s3", "endpoint": "127.0.0.1:1",
+                       "accessKey": "ak", "secretKey": "SECRET",
+                       "bucket": "cold"})
+    assert "secretKey" not in reg.list()["REMOTE"]
+    reg.remove("REMOTE")
+    with pytest.raises(TierError):
+        reg.remove("REMOTE")
+
+
+def test_lifecycle_parses_transitions():
+    rules = parse_lifecycle(
+        b"<LifecycleConfiguration><Rule><ID>t</ID>"
+        b"<Status>Enabled</Status><Filter><Prefix>logs/</Prefix></Filter>"
+        b"<Transition><Days>30</Days><StorageClass>COLD</StorageClass>"
+        b"</Transition>"
+        b"<NoncurrentVersionTransition><NoncurrentDays>7</NoncurrentDays>"
+        b"<StorageClass>COLD</StorageClass>"
+        b"</NoncurrentVersionTransition>"
+        b"</Rule></LifecycleConfiguration>")
+    assert rules[0].transition_days == 30
+    assert rules[0].transition_tier == "COLD"
+    assert rules[0].noncurrent_transition_days == 7
+
+
+# ---------------------------------------------------------------------------
+# object-layer transition + read-through
+# ---------------------------------------------------------------------------
+
+LC_TRANSITION = (b'<LifecycleConfiguration><Rule><ID>t</ID>'
+                 b'<Status>Enabled</Status>'
+                 b'<Filter><Prefix></Prefix></Filter>'
+                 b'<Transition><Days>1</Days>'
+                 b'<StorageClass>COLD</StorageClass></Transition>'
+                 b'</Rule></LifecycleConfiguration>')
+
+
+@pytest.fixture
+def tiered_es(tmp_path):
+    es = _es(tmp_path)
+    es.make_bucket("tb")
+    reg = TierRegistry([es])
+    reg.add("COLD", {"type": "fs", "path": str(tmp_path / "cold")})
+    es.tiers = reg
+    meta = es.get_bucket_meta("tb")
+    meta["config:lifecycle"] = LC_TRANSITION.decode()
+    es.set_bucket_meta("tb", meta)
+    return es
+
+
+def test_scanner_transitions_and_reads_through(tiered_es, tmp_path):
+    es = tiered_es
+    body = os.urandom(3 << 20)       # multi-block, non-inline
+    es.put_object("tb", "logs/app", body,
+                  PutOptions(user_metadata={"app": "x"}, tags="env=prod"))
+    info0 = es.get_object_info("tb", "logs/app")
+
+    future = time.time() + 2 * 86400
+    sc = Scanner([es], throttle=0)
+    sc.on_object.append(make_scanner_hook(now_fn=lambda: future))
+    sc.scan_cycle()
+
+    # Metadata stays local, carries the pointer; data left the drives.
+    info = es.get_object_info("tb", "logs/app")
+    assert info.internal_metadata.get(tier_mod.META_TIER) == "COLD"
+    assert info.etag == info0.etag
+    assert info.user_metadata.get("app") == "x"
+    for d in es.disks:
+        fi = d.read_version("tb", "logs/app")
+        assert not d.exists("tb", f"logs/app/{fi.data_dir}") \
+            if hasattr(d, "exists") else True
+    # The tier holds the stored stream.
+    cold_files = []
+    for root, _, files in os.walk(tmp_path / "cold"):
+        cold_files += [os.path.join(root, f) for f in files]
+    assert len(cold_files) == 1
+    # Reads are byte-identical, full and ranged.
+    _, got = es.get_object("tb", "logs/app")
+    assert got == body
+    _, got = es.get_object("tb", "logs/app",
+                           GetOptions(offset=1 << 20, length=4096))
+    assert got == body[1 << 20:(1 << 20) + 4096]
+    info2, chunks = es.get_object_stream("tb", "logs/app", GetOptions())
+    assert b"".join(chunks) == body
+    # A second scan cycle must NOT re-transition (idempotent).
+    sc.scan_cycle()
+    assert len([f for r, _, fs in os.walk(tmp_path / "cold")
+                for f in fs]) == 1
+
+
+def test_deleting_transitioned_version_removes_tier_copy(tiered_es,
+                                                         tmp_path):
+    es = tiered_es
+    es.put_object("tb", "gone", os.urandom(100_000))
+    future = time.time() + 2 * 86400
+    sc = Scanner([es], throttle=0)
+    sc.on_object.append(make_scanner_hook(now_fn=lambda: future))
+    # First cycle expires nothing (no Expiration rule) but transitions.
+    sc.scan_cycle()
+    info = es.get_object_info("tb", "gone")
+    assert info.internal_metadata.get(tier_mod.META_TIER) == "COLD"
+    from minio_tpu.object.types import DeleteOptions
+    es.delete_object("tb", "gone", DeleteOptions())
+    # The tier copy is gone too (no orphans).
+    leftovers = [f for r, _, fs in os.walk(tmp_path / "cold") for f in fs
+                 if "gone" in r or "gone" in f]
+    assert not leftovers
+
+
+def test_transition_to_s3_backend_via_live_server(tmp_path):
+    """Dogfood: one cluster's COLD tier is ANOTHER minio_tpu server
+    reached over S3 — the reference's warm-backend-minio shape."""
+    from minio_tpu.s3.server import S3Server
+    cold_disks = [LocalStorage(str(tmp_path / "colddrv" / f"d{i}"))
+                  for i in range(4)]
+    cold_srv = S3Server(ErasureSet(cold_disks), address="127.0.0.1:0")
+    cold_srv.start()
+    try:
+        cold_cli = S3Client(cold_srv.address)
+        assert cold_cli.request("PUT", "/coldbkt")[0] == 200
+
+        es = _es(tmp_path, "hot")
+        es.make_bucket("tb")
+        reg = TierRegistry([es])
+        reg.add("COLD", {"type": "s3",
+                         "endpoint": cold_srv.address,
+                         "accessKey": "minioadmin",
+                         "secretKey": "minioadmin",
+                         "bucket": "coldbkt", "prefix": "tiered"})
+        es.tiers = reg
+        meta = es.get_bucket_meta("tb")
+        meta["config:lifecycle"] = LC_TRANSITION.decode()
+        es.set_bucket_meta("tb", meta)
+
+        body = os.urandom(300_000)
+        es.put_object("tb", "doc", body)
+        future = time.time() + 2 * 86400
+        sc = Scanner([es], throttle=0)
+        sc.on_object.append(make_scanner_hook(now_fn=lambda: future))
+        sc.scan_cycle()
+
+        info = es.get_object_info("tb", "doc")
+        assert info.internal_metadata.get(tier_mod.META_TIER) == "COLD"
+        _, got = es.get_object("tb", "doc")
+        assert got == body
+        _, got = es.get_object("tb", "doc",
+                               GetOptions(offset=1000, length=2000))
+        assert got == body[1000:3000]
+        # The cold cluster physically holds it.
+        st, _, listing = cold_cli.request("GET", "/coldbkt",
+                                          query={"prefix": "tiered/"})
+        assert st == 200 and b"doc" in listing
+    finally:
+        cold_srv.stop()
+
+
+def test_drop_marker_never_fires_on_live_version():
+    """Regression: a rule with ExpiredObjectDeleteMarker must not emit
+    drop_marker for a LIVE lone version (an elif once rebound to the
+    wrong if during the transition-rule insert, destroying live data)."""
+    import dataclasses as dc
+    from minio_tpu.object.lifecycle import Rule, evaluate
+
+    @dc.dataclass
+    class V:
+        mod_time: int
+        deleted: bool
+        version_id: str
+        metadata: dict = dc.field(default_factory=dict)
+
+    r = Rule(rule_id="m", expire_delete_marker=True,
+             noncurrent_transition_days=1,
+             noncurrent_transition_tier="COLD")
+    live = [V(mod_time=time.time_ns(), deleted=False, version_id="v1")]
+    assert evaluate([r], "k", live) == []
+    # And it still fires on an actual lone marker.
+    marker = [V(mod_time=1, deleted=True, version_id="m1")]
+    acts = evaluate([r], "k", marker)
+    assert [a.kind for a in acts] == ["drop_marker"]
+
+
+def test_decommission_migrates_tier_pointer_not_blob(tmp_path):
+    """Draining a pool with transitioned versions moves the POINTER;
+    the warm-tier blob survives and the migrated copy reads through."""
+    from minio_tpu.object.pools import ServerPools
+    from minio_tpu.object.sets import ErasureSets
+
+    def pool(name):
+        disks = [LocalStorage(str(tmp_path / name / f"d{i}"))
+                 for i in range(4)]
+        return ErasureSets(
+            [ErasureSet(disks)],
+            deployment_id="00000000-0000-0000-0000-00000000dec1")
+
+    p0, p1 = pool("p0"), pool("p1")
+    layer = ServerPools([p0, p1])
+    layer.make_bucket("tb")
+    reg = TierRegistry(p0.sets)
+    for p in (p0, p1):
+        for s in p.sets:
+            s.tiers = reg
+    reg.add("COLD", {"type": "fs", "path": str(tmp_path / "cold")})
+    meta = layer.get_bucket_meta("tb")
+    meta["config:lifecycle"] = LC_TRANSITION.decode()
+    layer.set_bucket_meta("tb", meta)
+
+    body = os.urandom(200_000)
+    p0.put_object("tb", "doc", body)
+    future = time.time() + 2 * 86400
+    sc = Scanner(p0.sets, throttle=0)
+    sc.on_object.append(make_scanner_hook(now_fn=lambda: future))
+    sc.scan_cycle()
+    assert p0.get_object_info("tb", "doc").internal_metadata.get(
+        tier_mod.META_TIER) == "COLD"
+
+    d = layer.start_decommission(0)
+    assert d.wait(60)
+    assert layer.decommission_status()["status"] == "complete"
+    info, got = layer.get_object("tb", "doc")
+    assert got == body
+    assert info.internal_metadata.get(tier_mod.META_TIER) == "COLD"
+    # The blob is still in the tier (pointer migrated, data did not).
+    blobs = [f for r, _, fs in os.walk(tmp_path / "cold") for f in fs]
+    assert len(blobs) == 1
+    # Deleting the migrated copy reclaims the blob.
+    from minio_tpu.object.types import DeleteOptions
+    layer.delete_object("tb", "doc", DeleteOptions())
+    blobs = [f for r, _, fs in os.walk(tmp_path / "cold") for f in fs]
+    assert not blobs
+
+
+# ---------------------------------------------------------------------------
+# admin API
+# ---------------------------------------------------------------------------
+
+def test_admin_tier_management(tmp_path):
+    from minio_tpu.s3.server import S3Server
+    disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    srv = S3Server(ErasureSet(disks), address="127.0.0.1:0")
+    srv.start()
+    try:
+        cli = S3Client(srv.address)
+        st, _, b = cli.request("PUT", "/minio/admin/v3/add-tier",
+                               body=json.dumps({
+                                   "name": "GLACIER",
+                                   "config": {"type": "fs",
+                                              "path": str(tmp_path /
+                                                          "glacier")}
+                               }).encode())
+        assert st == 200, b
+        st, _, b = cli.request("GET", "/minio/admin/v3/list-tiers")
+        assert st == 200 and b"GLACIER" in b
+        st, _, b = cli.request("PUT", "/minio/admin/v3/add-tier",
+                               body=json.dumps({
+                                   "name": "BAD",
+                                   "config": {"type": "nope"}}).encode())
+        assert st == 400
+        st, _, b = cli.request("DELETE", "/minio/admin/v3/remove-tier",
+                               query={"name": "GLACIER"})
+        assert st == 200, b
+        st, _, b = cli.request("GET", "/minio/admin/v3/list-tiers")
+        assert st == 200 and b"GLACIER" not in b
+    finally:
+        srv.stop()
