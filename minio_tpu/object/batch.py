@@ -1,0 +1,312 @@
+"""Batch jobs: bulk replicate / expire over the object namespace.
+
+The analogue of the reference's batch framework
+(cmd/batch-handlers.go:1879, cmd/batch-expire.go, docs' mc batch):
+an admin submits a job document; a background worker walks the source
+namespace applying the job's filters, processing each matched object
+(copy to a local or remote target, or delete), with checkpointed
+progress persisted on the first pool's drives so an interrupted job
+resumes at boot exactly where it stopped.
+
+Job document (JSON; the reference uses YAML — same fields):
+    {"type": "replicate",
+     "source": {"bucket": "b", "prefix": "p/"},
+     "target": {"bucket": "dst",                 # local copy
+                "endpoint": "host:port",         # or remote S3
+                "accessKey": "...", "secretKey": "...", "prefix": ""},
+     "filters": {"createdBefore": iso, "createdAfter": iso,
+                 "tags": {"k": "v"}}}
+    {"type": "expire", "source": {...}, "filters": {...}}
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import threading
+import time
+from typing import Optional
+
+from minio_tpu.storage.local import SYS_VOL
+
+BATCH_DIR = "config/batch"
+CHECKPOINT_EVERY = 64
+
+
+class BatchError(Exception):
+    pass
+
+
+def _parse_time(s: str) -> float:
+    try:
+        dt = datetime.datetime.fromisoformat(s.replace("Z", "+00:00"))
+    except (ValueError, TypeError):
+        raise BatchError(f"bad timestamp {s!r}") from None
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=datetime.timezone.utc)
+    return dt.timestamp()
+
+
+def validate_job(spec: dict) -> dict:
+    """Normalize + validate a job document (raises BatchError)."""
+    jtype = spec.get("type", "")
+    if jtype not in ("replicate", "expire"):
+        raise BatchError(f"unknown job type {jtype!r}")
+    src = spec.get("source") or {}
+    if not src.get("bucket"):
+        raise BatchError("source.bucket is required")
+    if jtype == "replicate":
+        tgt = spec.get("target") or {}
+        if not tgt.get("bucket"):
+            raise BatchError("target.bucket is required")
+        if tgt.get("endpoint") and not (tgt.get("accessKey")
+                                        and tgt.get("secretKey")):
+            raise BatchError("remote target needs accessKey/secretKey")
+        if not tgt.get("endpoint") and \
+                tgt["bucket"] == src["bucket"] and \
+                (tgt.get("prefix", "") == "" and
+                 not src.get("prefix", "")):
+            raise BatchError("local copy onto itself")
+    filters = spec.get("filters") or {}
+    for k in ("createdBefore", "createdAfter"):
+        if filters.get(k):
+            _parse_time(filters[k])
+    return spec
+
+
+def _match(info, filters: dict) -> bool:
+    if filters.get("createdBefore") and \
+            info.mod_time / 1e9 >= _parse_time(filters["createdBefore"]):
+        return False
+    if filters.get("createdAfter") and \
+            info.mod_time / 1e9 <= _parse_time(filters["createdAfter"]):
+        return False
+    want_tags = filters.get("tags") or {}
+    if want_tags:
+        import urllib.parse
+        have = dict(urllib.parse.parse_qsl(info.user_tags or ""))
+        for k, v in want_tags.items():
+            if have.get(k) != v:
+                return False
+    return True
+
+
+class BatchJobs:
+    """Job registry + workers over one object layer."""
+
+    def __init__(self, object_layer, sets,
+                 checkpoint_every: int = CHECKPOINT_EVERY):
+        self.layer = object_layer
+        self._sets = list(sets)
+        self.checkpoint_every = checkpoint_every
+        self._mu = threading.Lock()
+        self._running: dict[str, threading.Thread] = {}
+        self._stops: dict[str, threading.Event] = {}
+
+    # -- persistence -----------------------------------------------------
+
+    def _disks(self):
+        return [d for es in self._sets for d in es.disks]
+
+    def _save(self, state: dict) -> None:
+        blob = json.dumps(state, sort_keys=True).encode()
+        path = f"{BATCH_DIR}/{state['id']}.json"
+        ok = 0
+        for d in self._disks():
+            try:
+                d.write_all(SYS_VOL, path, blob)
+                ok += 1
+            except Exception:  # noqa: BLE001 - offline drive
+                continue
+        if ok < len(self._disks()) // 2 + 1:
+            raise BatchError("could not persist job state to a quorum")
+
+    def _load(self, job_id: str) -> Optional[dict]:
+        votes: dict[bytes, int] = {}
+        for d in self._disks():
+            try:
+                blob = d.read_all(SYS_VOL, f"{BATCH_DIR}/{job_id}.json")
+                votes[blob] = votes.get(blob, 0) + 1
+            except Exception:  # noqa: BLE001 - absent / offline
+                continue
+        if not votes:
+            return None
+        try:
+            return json.loads(max(votes.items(), key=lambda kv: kv[1])[0])
+        except ValueError:
+            return None
+
+    def list_jobs(self) -> list[dict]:
+        ids = set()
+        for d in self._disks():
+            try:
+                for name in d.list_dir(SYS_VOL, BATCH_DIR):
+                    if name.endswith(".json"):
+                        ids.add(name[:-len(".json")])
+            except Exception:  # noqa: BLE001 - absent / offline
+                continue
+        out = []
+        for jid in sorted(ids):
+            st = self.status(jid)
+            if st:
+                out.append(st)
+        return out
+
+    def status(self, job_id: str) -> Optional[dict]:
+        st = self._load(job_id)
+        if st:
+            # Never echo remote credentials back through admin APIs.
+            tgt = (st.get("spec") or {}).get("target")
+            if tgt:
+                tgt.pop("secretKey", None)
+        return st
+
+    # -- control ---------------------------------------------------------
+
+    def start(self, spec: dict) -> str:
+        from minio_tpu.storage.meta import new_uuid
+        validate_job(spec)
+        self.layer.get_bucket_info(spec["source"]["bucket"])
+        job_id = new_uuid()[:16]
+        state = {"id": job_id, "spec": spec, "status": "running",
+                 "started_ns": time.time_ns(),
+                 "marker": "", "processed": 0, "failed": 0}
+        self._save(state)
+        self._spawn(state)
+        return job_id
+
+    def resume_all(self) -> int:
+        """Boot-time: restart every job that was mid-run."""
+        n = 0
+        for st in self.list_jobs():
+            if st.get("status") == "running" and \
+                    st["id"] not in self._running:
+                full = self._load(st["id"])   # status() strips secrets
+                if full:
+                    self._spawn(full)
+                    n += 1
+        return n
+
+    def cancel(self, job_id: str) -> None:
+        """Stop a job. With a live worker, the WORKER persists the
+        cancelled status on exit (single writer — persisting here would
+        race its checkpoint saves and could be clobbered back to
+        'running'); without one (crashed node), persist directly."""
+        st = self._load(job_id)
+        if st is None:
+            raise BatchError(f"no such job {job_id!r}")
+        ev = self._stops.get(job_id)
+        t = self._running.get(job_id)
+        if ev is not None and t is not None and t.is_alive():
+            ev.set()
+            return
+        if st.get("status") == "running":
+            st["status"] = "cancelled"
+            self._save(st)
+
+    def wait(self, job_id: str, timeout: float = 300) -> bool:
+        t = self._running.get(job_id)
+        if t is not None:
+            t.join(timeout)
+            return not t.is_alive()
+        return True
+
+    def _spawn(self, state: dict) -> None:
+        ev = threading.Event()
+        t = threading.Thread(target=self._run, args=(state, ev),
+                             daemon=True, name=f"batch-{state['id']}")
+        with self._mu:
+            self._stops[state["id"]] = ev
+            self._running[state["id"]] = t
+        t.start()
+
+    # -- execution -------------------------------------------------------
+
+    def _run(self, state: dict, stop: threading.Event) -> None:
+        try:
+            self._walk(state, stop)
+        except Exception as e:  # noqa: BLE001 - recorded, resumable
+            state["status"] = "failed"
+            state["error"] = str(e)
+            try:
+                self._save(state)
+            except BatchError:
+                pass
+
+    def _walk(self, state: dict, stop: threading.Event) -> None:
+        spec = state["spec"]
+        src = spec["source"]
+        filters = spec.get("filters") or {}
+        marker = state.get("marker", "")
+        since_ckpt = 0
+        from minio_tpu.object.types import (MethodNotAllowed,
+                                            ObjectNotFound)
+        while not stop.is_set():
+            page = self.layer.list_objects(
+                src["bucket"], prefix=src.get("prefix", ""),
+                marker=marker, max_keys=256)
+            for o in page.objects:
+                if stop.is_set():
+                    break
+                try:
+                    info = self.layer.get_object_info(src["bucket"],
+                                                      o.name)
+                    if _match(info, filters):
+                        self._process(spec, src["bucket"], o.name)
+                        state["processed"] += 1
+                except (ObjectNotFound, MethodNotAllowed):
+                    # Gone (or marker-topped) since the listing — the
+                    # normal case when a crash-resume re-walks keys an
+                    # expire job already deleted. A skip, NOT a failure.
+                    pass
+                except Exception as e:  # noqa: BLE001 - keep going
+                    state["failed"] += 1
+                    state["last_error"] = f"{o.name}: {e}"
+                state["marker"] = o.name
+                since_ckpt += 1
+                if since_ckpt >= self.checkpoint_every:
+                    since_ckpt = 0
+                    self._save(state)
+            if not page.is_truncated:
+                break
+            marker = page.next_marker
+        if stop.is_set():
+            # Single writer for the final status: the worker records
+            # the cancellation (cancel() only signals).
+            state["status"] = "cancelled"
+            self._save(state)
+            return
+        state["status"] = "complete" if not state["failed"] else "failed"
+        state["finished_ns"] = time.time_ns()
+        self._save(state)
+
+    def _process(self, spec: dict, bucket: str, key: str) -> None:
+        from minio_tpu.object.types import (DeleteOptions, GetOptions,
+                                            PutOptions)
+        if spec["type"] == "expire":
+            versioned = bool(self.layer.get_bucket_meta(bucket)
+                             .get("versioning"))
+            self.layer.delete_object(bucket, key,
+                                     DeleteOptions(versioned=versioned))
+            return
+        tgt = spec["target"]
+        info, data = self.layer.get_object(bucket, key, GetOptions())
+        dst_key = tgt.get("prefix", "") + key
+        if tgt.get("endpoint"):
+            from minio_tpu.s3.client import RemoteS3
+            headers = {}
+            if info.content_type:
+                headers["content-type"] = info.content_type
+            for mk, mv in info.user_metadata.items():
+                headers[f"x-amz-meta-{mk}"] = mv
+            RemoteS3(tgt["endpoint"], tgt["accessKey"],
+                     tgt["secretKey"]).put_object(
+                tgt["bucket"], dst_key, data, headers=headers)
+            return
+        opts = PutOptions(
+            versioned=bool(self.layer.get_bucket_meta(tgt["bucket"])
+                           .get("versioning")),
+            user_metadata=dict(info.user_metadata),
+            content_type=info.content_type,
+            tags=info.user_tags)
+        self.layer.put_object(tgt["bucket"], dst_key, data, opts)

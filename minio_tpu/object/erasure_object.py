@@ -1341,12 +1341,21 @@ class ErasureSet:
         # or delete during the upload orphans our tier copy: remove it
         # and bail; the next scanner cycle re-evaluates).
         with self.ns.write(bucket, object_):
+            # read_data=False: only metadata decides the commit; the
+            # data was uploaded in phase 1 and must not be re-read
+            # under the exclusive lock.
             fis2, _ = self._read_version_all(bucket, object_, version_id,
-                                             read_data=True)
+                                             read_data=False)
             fi2, idxs2 = self._quorum_fileinfo(fis2, quorum)
             if fi2 is None or fi2.deleted or fi2.mod_time != fi.mod_time \
                     or fi2.metadata.get(tier_mod.META_TIER):
-                backend.remove(remote_key)
+                # A concurrent transition may have committed a pointer
+                # to the SAME deterministic remote key — removing it
+                # would destroy the winner's blob. Only reclaim when
+                # nothing references our upload.
+                if fi2 is None or fi2.metadata.get(
+                        tier_mod.META_TIER_KEY) != remote_key:
+                    backend.remove(remote_key)
                 return
             new_meta = dict(fi2.metadata)
             new_meta[tier_mod.META_TIER] = tier_name
@@ -1378,14 +1387,13 @@ class ErasureSet:
             if len(agree) < n:
                 self.mrf.enqueue(bucket, object_, fi.version_id)
 
-    def _tier_cleanup(self, bucket: str, object_: str,
-                      version_id: str) -> None:
-        """Before destroying a version: if it was transitioned, remove
-        the tier copy (reference: free-version deletion sweeps the
-        remote object). Best-effort — an orphaned tier object wastes
-        space but breaks nothing."""
+    def _tier_pointer(self, bucket: str, object_: str,
+                      version_id: str) -> Optional[tuple[str, str]]:
+        """(tier name, remote key) when the version was transitioned,
+        else None — read BEFORE deletion (the pointer dies with the
+        metadata) but acted on only AFTER the delete succeeds."""
         if self.tiers is None:
-            return
+            return None
         from minio_tpu.object import tier as tier_mod
         for d in self.disks:
             try:
@@ -1394,25 +1402,33 @@ class ErasureSet:
                 continue
             name = (fi.metadata or {}).get(tier_mod.META_TIER)
             if name:
-                try:
-                    self.tiers.get(name).remove(
-                        fi.metadata[tier_mod.META_TIER_KEY])
-                except Exception:  # noqa: BLE001 - orphan tolerated
-                    pass
-            return
+                return name, fi.metadata.get(tier_mod.META_TIER_KEY, "")
+            return None
+        return None
 
     def delete_object(self, bucket: str, object_: str,
                       opts: Optional[DeleteOptions] = None) -> DeletedObject:
         opts = opts or DeleteOptions()
         self._check_bucket(bucket)
         with self.ns.write(bucket, object_):
+            ptr = None
             if opts.version_id or not opts.versioned:
-                # Version destruction (not marker stacking): reclaim a
-                # transitioned version's tier copy. Lives HERE, not in
-                # _delete_object_locked — decommission's internal
-                # deletes migrate the pointer and must keep the blob.
-                self._tier_cleanup(bucket, object_, opts.version_id)
-            return self._delete_object_locked(bucket, object_, opts)
+                # Version destruction (not marker stacking): note a
+                # transitioned version's tier pointer now; the blob is
+                # reclaimed only AFTER the delete commits (removing it
+                # first would lose the data if the delete then fails
+                # quorum). Lives HERE, not in _delete_object_locked —
+                # decommission's internal deletes migrate the pointer
+                # and must keep the blob.
+                ptr = self._tier_pointer(bucket, object_, opts.version_id)
+            result = self._delete_object_locked(bucket, object_, opts)
+            if ptr is not None:
+                name, remote_key = ptr
+                try:
+                    self.tiers.get(name).remove(remote_key)
+                except Exception:  # noqa: BLE001 - orphan tolerated
+                    pass
+            return result
 
     def _delete_object_locked(self, bucket: str, object_: str,
                               opts: DeleteOptions) -> DeletedObject:

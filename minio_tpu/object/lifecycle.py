@@ -108,6 +108,10 @@ def parse_lifecycle(xml: bytes | str) -> list[Rule]:
             date = _text(tr, "Date")
             if not tier:
                 raise LifecycleError("Transition needs StorageClass")
+            if not days and not date:
+                # A bare StorageClass would otherwise default to
+                # Days=0 and ship EVERYTHING on the next scan.
+                raise LifecycleError("Transition needs Days or Date")
             if date:
                 try:
                     dt = datetime.datetime.fromisoformat(

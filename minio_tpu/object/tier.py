@@ -129,6 +129,7 @@ class TierRegistry:
         self._mu = threading.RLock()
         self._cfgs: dict[str, dict] = {}
         self._built: dict[str, object] = {}
+        self._raw: bytes = b""
         self._loaded_at = 0.0
         self._load()
 
@@ -145,13 +146,18 @@ class TierRegistry:
                 continue
         if votes:
             blob = max(votes.items(), key=lambda kv: kv[1])[0]
-            try:
-                doc = json.loads(blob)
-                if isinstance(doc, dict):
-                    self._cfgs = doc
-                    self._built.clear()
-            except ValueError:
-                pass
+            if blob != self._raw:
+                # Only an actual change invalidates the built-backend
+                # cache — get() sits on every tiered GET, and churning
+                # clients on unchanged config would cost every reader.
+                try:
+                    doc = json.loads(blob)
+                    if isinstance(doc, dict):
+                        self._cfgs = doc
+                        self._built.clear()
+                        self._raw = blob
+                except ValueError:
+                    pass
         self._loaded_at = time.monotonic()
 
     def _save(self) -> None:
@@ -165,6 +171,7 @@ class TierRegistry:
                 continue
         if ok < len(self._disks()) // 2 + 1:
             raise TierError("could not persist tier config to a quorum")
+        self._raw = blob
 
     def _refresh(self) -> None:
         if time.monotonic() - self._loaded_at > self._TTL:

@@ -140,6 +140,8 @@ class S3Server:
         # Warm-tier registry (object/tier.TierRegistry), created on
         # first admin use or at boot.
         self.tiers = None
+        # Batch-job manager (object/batch.BatchJobs), ditto.
+        self.batch = None
 
     @property
     def address(self) -> str:
@@ -1136,6 +1138,19 @@ def _make_handler(server: S3Server):
             out = olock.default_retention_meta(cfg, now)
             out.update(explicit)
             return out
+
+        def _batch_jobs(self):
+            if server.batch is None:
+                from minio_tpu.object.batch import BatchJobs
+                ol = server.object_layer
+                if hasattr(ol, "pools"):
+                    sets = ol.pools[0].sets
+                elif hasattr(ol, "sets"):
+                    sets = ol.sets
+                else:
+                    sets = [ol]
+                server.batch = BatchJobs(ol, sets)
+            return server.batch
 
         def _tier_registry(self):
             """The server's tier registry, created on first use and
@@ -2425,6 +2440,31 @@ def _make_handler(server: S3Server):
                     server.peer_notify("config")
                 return ok({"applied": applied})
 
+            # Batch jobs (reference: cmd/batch-handlers.go).
+            if op in ("start-batch-job", "batch-job-status",
+                      "list-batch-jobs", "cancel-batch-job"):
+                from minio_tpu.object.batch import BatchError
+                mgr = self._batch_jobs()
+                try:
+                    if op == "start-batch-job" and method == "POST":
+                        return ok({"id": mgr.start(_json.loads(body))})
+                    if op == "batch-job-status" and method == "GET":
+                        st2 = mgr.status(q1.get("id", ""))
+                        if st2 is None:
+                            raise S3Error("InvalidArgument",
+                                          "no such job")
+                        return ok(st2)
+                    if op == "list-batch-jobs" and method == "GET":
+                        return ok(mgr.list_jobs())
+                    if op == "cancel-batch-job" and method == "POST":
+                        mgr.cancel(q1.get("id", ""))
+                        return ok()
+                except BatchError as e:
+                    raise S3Error("InvalidArgument", str(e)) from None
+                except ValueError:
+                    raise S3Error("MalformedXML") from None
+                raise S3Error("MethodNotAllowed")
+
             # Warm-tier management (reference: cmd/admin-handlers-tiers).
             if op in ("add-tier", "remove-tier", "list-tiers"):
                 from minio_tpu.object.tier import TierError
@@ -2435,7 +2475,21 @@ def _make_handler(server: S3Server):
                         reg.add(doc.get("name", ""), doc.get("config", {}))
                         return ok()
                     if op == "remove-tier" and method == "DELETE":
-                        reg.remove(q1.get("name", ""))
+                        name = q1.get("name", "")
+                        # In-use guard: a lifecycle rule referencing
+                        # the tier means transitions (and transitioned
+                        # versions) depend on it; removal would make
+                        # their data unreachable in one call.
+                        needle = f">{name}</StorageClass>"
+                        for bi in server.object_layer.list_buckets():
+                            doc = server.object_layer.get_bucket_meta(
+                                bi.name).get("config:lifecycle", "")
+                            if needle in doc:
+                                raise S3Error(
+                                    "InvalidArgument",
+                                    f"tier {name!r} is referenced by "
+                                    f"bucket {bi.name!r}'s lifecycle")
+                        reg.remove(name)
                         return ok()
                     if op == "list-tiers" and method == "GET":
                         return ok(reg.list())

@@ -298,6 +298,17 @@ def main(argv=None) -> int:
     srv.tiers = TierRegistry(pools[0].sets)
     for s in all_sets:
         s.tiers = srv.tiers
+    # Batch jobs: resume any that a crash or restart interrupted
+    # (reference: batch jobs survive restarts via their checkpoints).
+    from minio_tpu.object.batch import BatchJobs
+    srv.batch = BatchJobs(layer, pools[0].sets)
+    try:
+        resumed = srv.batch.resume_all()
+        if resumed:
+            print(f"resumed {resumed} interrupted batch job(s)",
+                  flush=True)
+    except Exception as e:  # noqa: BLE001 - batch must not block boot
+        print(f"WARN: batch resume failed: {e}", file=sys.stderr)
     srv.compression = args.compression
     # Persisted config overrides flags (the flags seed first boot).
     from minio_tpu.s3 import config as cfg_mod
