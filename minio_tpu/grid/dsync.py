@@ -26,6 +26,79 @@ from minio_tpu.object.nslock import LockTimeout
 LOCK_TTL = 30.0
 REFRESH_INTERVAL = 8.0
 
+# Shared worker pools and a single refresher servicing every held lock:
+# at production concurrency the old thread-per-locker-per-round +
+# thread-per-held-lock shape was pathological (round 2/3 advisor
+# finding). TWO pools, because refresh tasks BLOCK waiting on their
+# fan-out futures: if both ran on one bounded pool, enough held locks
+# would occupy every worker with refresh tasks whose nested RPCs could
+# never get a thread — all futures time out and every healthy lock
+# spuriously reports quorum loss. Fan-out RPCs are leaf tasks on their
+# own pool, so they always drain.
+_rpc_pool = None
+_refresh_pool = None
+_pool_mu = threading.Lock()
+
+
+def _shared_rpc_pool():
+    global _rpc_pool
+    with _pool_mu:
+        if _rpc_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            _rpc_pool = ThreadPoolExecutor(max_workers=32,
+                                           thread_name_prefix="dsync-rpc")
+        return _rpc_pool
+
+
+def _shared_refresh_pool():
+    global _refresh_pool
+    with _pool_mu:
+        if _refresh_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            _refresh_pool = ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="dsync-refresh")
+        return _refresh_pool
+
+
+class _RefreshDaemon:
+    """ONE background thread scheduling refreshes for every held
+    DRWMutex (instead of one thread per held lock). Individual refresh
+    rounds run concurrently on the refresh pool so one slow peer cannot
+    starve the other locks' refresh deadlines."""
+
+    _instance = None
+    _imu = threading.Lock()
+
+    @classmethod
+    def get(cls) -> "_RefreshDaemon":
+        with cls._imu:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._locks: set = set()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="dsync-refreshd")
+        self._thread.start()
+
+    def register(self, m: "DRWMutex") -> None:
+        with self._mu:
+            self._locks.add(m)
+
+    def unregister(self, m: "DRWMutex") -> None:
+        with self._mu:
+            self._locks.discard(m)
+
+    def _loop(self) -> None:
+        while True:
+            time.sleep(REFRESH_INTERVAL)
+            with self._mu:
+                held = list(self._locks)
+            for m in held:
+                _shared_refresh_pool().submit(m._refresh_once)
+
 
 class LockServer:
     """Per-node lock table with TTL expiry."""
@@ -159,7 +232,6 @@ class DRWMutex:
         self._write = False
         self._held = False
         self._stop_refresh = threading.Event()
-        self._refresher: Optional[threading.Thread] = None
 
     def _quorum(self, write: bool) -> int:
         # Read quorum must overlap every possible write quorum:
@@ -169,8 +241,6 @@ class DRWMutex:
         return n // 2 + 1 if write else n - n // 2
 
     def _fanout(self, op: str, write: bool) -> int:
-        ok = 0
-        threads = []
         results = [False] * len(self.lockers)
 
         def run(i, lk):
@@ -178,12 +248,15 @@ class DRWMutex:
                 results[i] = getattr(lk, op)(self.resource, self.uid, write)
             except Exception:  # noqa: BLE001 - dead locker == vote lost
                 results[i] = False
-        for i, lk in enumerate(self.lockers):
-            t = threading.Thread(target=run, args=(i, lk), daemon=True)
-            t.start()
-            threads.append(t)
-        for t in threads:
-            t.join(timeout=6.0)
+        pool = _shared_rpc_pool()
+        futs = [pool.submit(run, i, lk)
+                for i, lk in enumerate(self.lockers)]
+        deadline = time.monotonic() + 6.0
+        for f in futs:
+            try:
+                f.result(timeout=max(0.0, deadline - time.monotonic()))
+            except Exception:  # noqa: BLE001 - timeout == vote lost
+                pass
         return sum(results)
 
     def lock(self, write: bool = True, timeout: float = 60.0) -> bool:
@@ -208,30 +281,29 @@ class DRWMutex:
             return
         self._held = False
         self._stop_refresh.set()
-        if self._refresher is not None:
-            self._refresher.join(timeout=1.0)
+        _RefreshDaemon.get().unregister(self)
         self._fanout("unlock", self._write)
 
     def _start_refresh(self) -> None:
         self._stop_refresh.clear()
-        self._refresher = threading.Thread(target=self._refresh_loop,
-                                           daemon=True)
-        self._refresher.start()
+        _RefreshDaemon.get().register(self)
 
-    def _refresh_loop(self) -> None:
-        quorum = self._quorum(self._write)
-        while not self._stop_refresh.wait(REFRESH_INTERVAL):
-            if self._fanout("refresh", self._write) < quorum:
-                # Quorum lost (network partition, peer restarts): the
-                # holder must stop trusting its lock (reference loss
-                # callback cancels the op's context).
-                self._held = False
-                if self.on_lost is not None:
-                    try:
-                        self.on_lost()
-                    except Exception:  # noqa: BLE001
-                        pass
-                return
+    def _refresh_once(self) -> None:
+        """One refresh round, driven by the shared daemon."""
+        if self._stop_refresh.is_set() or not self._held:
+            _RefreshDaemon.get().unregister(self)
+            return
+        if self._fanout("refresh", self._write) < self._quorum(self._write):
+            # Quorum lost (network partition, peer restarts): the
+            # holder must stop trusting its lock (reference loss
+            # callback cancels the op's context).
+            self._held = False
+            _RefreshDaemon.get().unregister(self)
+            if self.on_lost is not None:
+                try:
+                    self.on_lost()
+                except Exception:  # noqa: BLE001
+                    pass
 
 
 class DistNSLock:

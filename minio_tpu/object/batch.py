@@ -62,11 +62,14 @@ def validate_job(spec: dict) -> dict:
         if tgt.get("endpoint") and not (tgt.get("accessKey")
                                         and tgt.get("secretKey")):
             raise BatchError("remote target needs accessKey/secretKey")
-        if not tgt.get("endpoint") and \
-                tgt["bucket"] == src["bucket"] and \
-                (tgt.get("prefix", "") == "" and
-                 not src.get("prefix", "")):
-            raise BatchError("local copy onto itself")
+        if not tgt.get("endpoint") and tgt["bucket"] == src["bucket"] \
+                and tgt.get("prefix", "").startswith(
+                    src.get("prefix", "")):
+            # Copies landing inside the source listing range would be
+            # re-listed and re-copied — unbounded recursive
+            # amplification (x/k -> x/x/k -> ...), never terminating.
+            raise BatchError("target prefix lies inside the source "
+                             "listing range (recursive copy)")
     filters = spec.get("filters") or {}
     for k in ("createdBefore", "createdAfter"):
         if filters.get(k):
@@ -74,18 +77,30 @@ def validate_job(spec: dict) -> dict:
     return spec
 
 
-def _match(info, filters: dict) -> bool:
-    if filters.get("createdBefore") and \
-            info.mod_time / 1e9 >= _parse_time(filters["createdBefore"]):
+def _compile_filters(filters: dict) -> dict:
+    """Parse filter constants ONCE per job — the walk evaluates them
+    per object, and re-parsing timestamps millions of times is pure
+    waste on the bulk path."""
+    return {
+        "before": _parse_time(filters["createdBefore"])
+        if filters.get("createdBefore") else None,
+        "after": _parse_time(filters["createdAfter"])
+        if filters.get("createdAfter") else None,
+        "tags": dict(filters.get("tags") or {}),
+    }
+
+
+def _match(info, compiled: dict) -> bool:
+    if compiled["before"] is not None and \
+            info.mod_time / 1e9 >= compiled["before"]:
         return False
-    if filters.get("createdAfter") and \
-            info.mod_time / 1e9 <= _parse_time(filters["createdAfter"]):
+    if compiled["after"] is not None and \
+            info.mod_time / 1e9 <= compiled["after"]:
         return False
-    want_tags = filters.get("tags") or {}
-    if want_tags:
+    if compiled["tags"]:
         import urllib.parse
         have = dict(urllib.parse.parse_qsl(info.user_tags or ""))
-        for k, v in want_tags.items():
+        for k, v in compiled["tags"].items():
             if have.get(k) != v:
                 return False
     return True
@@ -232,11 +247,18 @@ class BatchJobs:
                 self._save(state)
             except BatchError:
                 pass
+        finally:
+            # Finished workers prune their registry entries — a long-
+            # lived server running periodic jobs must not accumulate
+            # dead Thread/Event objects without bound.
+            with self._mu:
+                self._running.pop(state["id"], None)
+                self._stops.pop(state["id"], None)
 
     def _walk(self, state: dict, stop: threading.Event) -> None:
         spec = state["spec"]
         src = spec["source"]
-        filters = spec.get("filters") or {}
+        filters = _compile_filters(spec.get("filters") or {})
         marker = state.get("marker", "")
         since_ckpt = 0
         from minio_tpu.object.types import (MethodNotAllowed,

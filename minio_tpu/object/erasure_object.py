@@ -1351,9 +1351,12 @@ class ErasureSet:
                     or fi2.metadata.get(tier_mod.META_TIER):
                 # A concurrent transition may have committed a pointer
                 # to the SAME deterministic remote key — removing it
-                # would destroy the winner's blob. Only reclaim when
-                # nothing references our upload.
-                if fi2 is None or fi2.metadata.get(
+                # would destroy the winner's blob. Reclaim only when a
+                # READABLE version provably does not reference our
+                # upload; fi2 None (transient quorum loss) proves
+                # nothing, and an orphaned blob is the tolerable
+                # failure mode.
+                if fi2 is not None and fi2.metadata.get(
                         tier_mod.META_TIER_KEY) != remote_key:
                     backend.remove(remote_key)
                 return

@@ -1043,51 +1043,31 @@ def _make_handler(server: S3Server):
         def _select_object(self, bucket, key, query, body):
             """POST ?select&select-type=2 — SQL over one object
             (reference: internal/s3select; the SelectObjectContent API).
-            The full object materializes for evaluation (v1)."""
+            Records STREAM through the engine in O(record) memory; the
+            SSE/compression transforms reuse the GET path's plaintext
+            chunk generators, version-pinned so params and data come
+            from one snapshot."""
             from minio_tpu.s3select import SelectError, run_select
             h = self._headers_lower()
             vid = query.get("versionId", [""])[0]
-            # ONE read: info and bytes come from the same snapshot, so
-            # the SSE branch can never decrypt with stale params.
-            info, data = server.object_layer.get_object(
+            # ONE open: the stream's own info decides the transform
+            # branch, so an unversioned-bucket overwrite between
+            # info-read and data-read can never feed ciphertext or
+            # compressed bytes to the parser.
+            info, chunks = server.object_layer.get_object_stream(
                 bucket, key, GetOptions(version_id=vid))
-            if info.internal_metadata.get("x-internal-sse-alg"):
+            imeta = info.internal_metadata
+            if imeta.get("x-internal-sse-alg"):
+                chunks.close()
                 self._sse_check_head(h, info)
-                from minio_tpu.crypto import sse as sse_mod
-                from minio_tpu.crypto.dare import decrypt_packages
-                try:
-                    customer = sse_mod.parse_sse_c(h)
-                    data_key, nonce = sse_mod.decrypt_params(
-                        bucket, key, info.internal_metadata, server.kms,
-                        customer)
-                except sse_mod.SSEError as e:
-                    raise S3Error(e.code, str(e)) from None
-                if info.internal_metadata.get(sse_mod.META_MULTIPART) \
-                        and info.parts:
-                    # Per-part DARE streams decrypt independently,
-                    # each under its own stored base nonce.
-                    import base64 as _b64
-                    out, off = [], 0
-                    for p in info.parts:
-                        pn = _b64.b64decode(p.nonce) if p.nonce else nonce
-                        out.append(b"".join(decrypt_packages(
-                            iter([data[off:off + p.size]]),
-                            sse_mod.part_key(data_key, p.number), pn,
-                            0, 0, p.actual_size)))
-                        off += p.size
-                    data = b"".join(out)
-                else:
-                    data = b"".join(decrypt_packages(
-                        iter([data]), data_key, nonce, 0, 0, info.size))
-            elif info.internal_metadata.get("x-internal-comp"):
-                from minio_tpu.crypto import compress as comp
-                try:
-                    data = comp.decompress_range(
-                        data, info.internal_metadata, 0, info.size)
-                except comp.CompressionError as e:
-                    raise S3Error("InternalError", str(e)) from None
+                info, chunks, _, _ = self._get_encrypted(
+                    bucket, key, vid or info.version_id, None, h, info)
+            elif imeta.get("x-internal-comp"):
+                chunks.close()
+                info, chunks, _, _ = self._get_compressed(
+                    bucket, key, vid or info.version_id, None, info)
             try:
-                resp = run_select(data, body)
+                resp = run_select(chunks, body)
             except SelectError as e:
                 raise S3Error("InvalidArgument", str(e)) from None
             self._send(200, resp,
@@ -2479,12 +2459,23 @@ def _make_handler(server: S3Server):
                         # In-use guard: a lifecycle rule referencing
                         # the tier means transitions (and transitioned
                         # versions) depend on it; removal would make
-                        # their data unreachable in one call.
-                        needle = f">{name}</StorageClass>"
+                        # their data unreachable in one call. Parsed,
+                        # not substring-matched — namespaced or
+                        # whitespace-styled XML must not slip past.
+                        from minio_tpu.object.lifecycle import (
+                            LifecycleError, parse_lifecycle)
                         for bi in server.object_layer.list_buckets():
                             doc = server.object_layer.get_bucket_meta(
                                 bi.name).get("config:lifecycle", "")
-                            if needle in doc:
+                            if not doc:
+                                continue
+                            try:
+                                rules = parse_lifecycle(doc)
+                            except LifecycleError:
+                                continue
+                            if any(name in (r.transition_tier,
+                                            r.noncurrent_transition_tier)
+                                   for r in rules):
                                 raise S3Error(
                                     "InvalidArgument",
                                     f"tier {name!r} is referenced by "

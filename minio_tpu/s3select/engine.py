@@ -66,9 +66,31 @@ def parse_select_request(body: bytes) -> dict:
     return req
 
 
-def _iter_csv(data: bytes, opts: dict):
-    text = io.StringIO(data.decode("utf-8", "replace"))
-    reader = csv.reader(text, delimiter=opts.get("delimiter", ","),
+def _lines(chunks):
+    """Byte chunks -> decoded text lines, O(line) memory (UTF-8
+    sequences split across chunk boundaries decode correctly via the
+    incremental decoder)."""
+    import codecs
+    dec = codecs.getincrementaldecoder("utf-8")("replace")
+    carry = ""
+    for c in chunks:
+        carry += dec.decode(c)
+        while True:
+            i = carry.find("\n")
+            if i < 0:
+                break
+            yield carry[:i + 1]
+            carry = carry[i + 1:]
+    carry += dec.decode(b"", True)
+    if carry:
+        yield carry
+
+
+def _iter_csv(chunks, opts: dict):
+    # csv.reader over a LINE iterator handles quoted newlines by
+    # pulling further lines itself — records stream in O(record).
+    reader = csv.reader(_lines(chunks),
+                        delimiter=opts.get("delimiter", ","),
                         quotechar=opts.get("quote", '"'))
     header_mode = opts.get("header", "NONE")
     headers = None
@@ -96,8 +118,8 @@ def _iter_csv(data: bytes, opts: dict):
         yield row
 
 
-def _iter_json(data: bytes):
-    for line in data.splitlines():
+def _iter_json(chunks):
+    for line in _lines(chunks):
         line = line.strip()
         if not line:
             continue
@@ -129,51 +151,95 @@ def _serialize(rows: list, out_opts: dict, field_order) -> bytes:
     return buf.getvalue().encode()
 
 
-def run_select(body: bytes, request_xml: bytes) -> bytes:
-    """Execute a Select request against object bytes; returns the full
-    event-stream response (Records + Stats + End)."""
+class _CountingChunks:
+    """Wrap a chunk source, tracking bytes consumed (Stats frame)."""
+
+    def __init__(self, source):
+        self._source = iter([source]) if isinstance(source, (bytes,
+                                                             bytearray)) \
+            else iter(source)
+        self.total = 0
+
+    def __iter__(self):
+        for c in self._source:
+            self.total += len(c)
+            yield c
+
+    def close(self):
+        close = getattr(self._source, "close", None)
+        if close is not None:
+            close()
+
+
+def run_select(body, request_xml: bytes) -> bytes:
+    """Execute a Select request against object content — bytes or an
+    ITERATOR of chunks (records stream in O(record) memory; the
+    reference streams the same way, internal/s3select). Returns the
+    event-stream response (Records + Stats + End); the response itself
+    is the result set, typically far smaller than the input."""
     req = parse_select_request(request_xml)
     try:
         query = parse_select(req["expression"])
     except SQLError as e:
         raise SelectError(str(e)) from None
 
-    rows_iter = _iter_csv(body, req["input"]) \
-        if req["input"]["format"] == "csv" else _iter_json(body)
+    counter = _CountingChunks(body)
+    try:
+        rows_iter = _iter_csv(counter, req["input"]) \
+            if req["input"]["format"] == "csv" else _iter_json(counter)
 
-    matched = []
-    count = 0
-    for row in rows_iter:
-        # LIMIT bounds OUTPUT records: an aggregate emits one record,
-        # so COUNT(*) scans everything regardless of LIMIT.
-        if not query.count_star and query.limit is not None \
-                and len(matched) >= query.limit:
-            break
-        if query.where is not None:
-            try:
-                # Three-valued logic: only TRUE keeps the row (NULL and
-                # FALSE both drop it).
-                keep = query.where.eval(row) is True
-            except Exception:  # noqa: BLE001 - bad row never kills the scan
-                keep = False
-            if not keep:
-                continue
+        field_order = [alias for _, alias in query.columns] \
+            if query.columns else None
+        out = bytearray()
+        pending: list = []
+        pending_bytes = 0
+        returned = 0
+        count = 0
+        emitted = 0
+        # Flush Records frames at ~128 KiB like the reference's writer.
+        step = 128 * 1024
+
+        def flush():
+            nonlocal pending, pending_bytes, returned
+            if not pending:
+                return
+            payload = _serialize(pending, req["output"], field_order)
+            returned += len(payload)
+            for off in range(0, len(payload), step):
+                out.extend(eventstream.records_message(
+                    payload[off:off + step]))
+            pending = []
+            pending_bytes = 0
+
+        for row in rows_iter:
+            # LIMIT bounds OUTPUT records: an aggregate emits one
+            # record, so COUNT(*) scans everything regardless of LIMIT.
+            if not query.count_star and query.limit is not None \
+                    and emitted >= query.limit:
+                break
+            if query.where is not None:
+                try:
+                    # Three-valued logic: only TRUE keeps the row (NULL
+                    # and FALSE both drop it).
+                    keep = query.where.eval(row) is True
+                except Exception:  # noqa: BLE001 - bad row never kills scan
+                    keep = False
+                if not keep:
+                    continue
+            if query.count_star:
+                count += 1
+            else:
+                pending.append(_project(query, row))
+                emitted += 1
+                pending_bytes += sum(len(str(v)) for v in row.values())
+                if pending_bytes >= step:
+                    flush()
         if query.count_star:
-            count += 1
-        else:
-            matched.append(_project(query, row))
-
-    if query.count_star:
-        matched = [{"_1": count}]
-    field_order = [alias for _, alias in query.columns] \
-        if query.columns else None
-
-    payload = _serialize(matched, req["output"], field_order)
-    out = bytearray()
-    # Chunk Records frames at ~128 KiB like the reference's writer.
-    step = 128 * 1024
-    for off in range(0, len(payload), step):
-        out += eventstream.records_message(payload[off:off + step])
-    out += eventstream.stats_message(len(body), len(body), len(payload))
-    out += eventstream.end_message()
-    return bytes(out)
+            pending = [{"_1": count}]
+        flush()
+        out.extend(eventstream.stats_message(counter.total, counter.total,
+                                             returned))
+        out.extend(eventstream.end_message())
+        return bytes(out)
+    finally:
+        counter.close()
