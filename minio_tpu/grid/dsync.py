@@ -56,7 +56,7 @@ def _shared_refresh_pool():
         if _refresh_pool is None:
             from concurrent.futures import ThreadPoolExecutor
             _refresh_pool = ThreadPoolExecutor(
-                max_workers=8, thread_name_prefix="dsync-refresh")
+                max_workers=32, thread_name_prefix="dsync-refresh")
         return _refresh_pool
 
 
@@ -97,7 +97,13 @@ class _RefreshDaemon:
             with self._mu:
                 held = list(self._locks)
             for m in held:
-                _shared_refresh_pool().submit(m._refresh_once)
+                # Dedup in-flight rounds: a slow peer must not let the
+                # queue back up past LOCK_TTL (an un-refreshed server
+                # entry expires and hands the lock to someone else
+                # while this holder still trusts it).
+                if not getattr(m, "_refresh_inflight", False):
+                    m._refresh_inflight = True
+                    _shared_refresh_pool().submit(m._refresh_once)
 
 
 class LockServer:
@@ -290,6 +296,12 @@ class DRWMutex:
 
     def _refresh_once(self) -> None:
         """One refresh round, driven by the shared daemon."""
+        try:
+            self._refresh_round()
+        finally:
+            self._refresh_inflight = False
+
+    def _refresh_round(self) -> None:
         if self._stop_refresh.is_set() or not self._held:
             _RefreshDaemon.get().unregister(self)
             return

@@ -1,0 +1,232 @@
+"""Site replication: active-active mirroring across clusters.
+
+The analogue of the reference's site replication
+(cmd/site-replication.go): a set of peer sites is registered once;
+from then on bucket creations/deletions, the complete bucket-metadata
+document (policy, versioning, lifecycle, object-lock, tagging, ...),
+and object writes/deletes mirror to every peer automatically —
+active-active, with replica markers breaking the ping-pong loop
+(a change received FROM a site never re-replicates back out).
+
+v1 scope: buckets + bucket metadata + objects + delete markers.
+SSE-encrypted objects do not replicate (their keys bind to one
+cluster, same as bucket replication v1); IAM replication is not wired.
+Registering sites bootstraps existing buckets + their metadata to the
+peers; existing OBJECTS are not backfilled (run a batch replicate job
+per bucket for that).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from typing import Optional
+
+from minio_tpu.storage.local import SYS_VOL
+
+SITE_PATH = "config/site-replication.json"
+
+# Header a site-replicated BUCKET operation carries so the receiving
+# site applies it without re-replicating (objects reuse the existing
+# x-amz-meta-mtpu-replica marker).
+H_SITE_REPLICA = "x-mtpu-site-replica"
+
+
+class SiteError(Exception):
+    pass
+
+
+def load_config(sets) -> Optional[dict]:
+    votes: dict[bytes, int] = {}
+    for es in sets:
+        for d in es.disks:
+            try:
+                blob = d.read_all(SYS_VOL, SITE_PATH)
+                votes[blob] = votes.get(blob, 0) + 1
+            except Exception:  # noqa: BLE001 - absent / offline
+                continue
+    if not votes:
+        return None
+    try:
+        doc = json.loads(max(votes.items(), key=lambda kv: kv[1])[0])
+        return doc if isinstance(doc, dict) and doc.get("peers") else None
+    except ValueError:
+        return None
+
+
+class SiteReplicator:
+    """Fan-out worker mirroring changes to every peer site."""
+
+    _RETRIES = 3
+
+    def __init__(self, object_layer, sets, config: dict,
+                 workers: int = 2):
+        self.layer = object_layer
+        self._sets = list(sets)
+        self.config = dict(config)
+        self._q: queue.Queue = queue.Queue(maxsize=10_000)
+        self._stop = threading.Event()
+        self.queued = self.completed = self.failed = 0
+        self._threads = [threading.Thread(target=self._run, daemon=True,
+                                          name=f"site-repl-{i}")
+                         for i in range(workers)]
+        for t in self._threads:
+            t.start()
+
+    # -- config ----------------------------------------------------------
+
+    @staticmethod
+    def validate(config: dict) -> dict:
+        peers = config.get("peers") or []
+        if not peers:
+            raise SiteError("at least one peer site required")
+        names = set()
+        for p in peers:
+            for f in ("name", "endpoint", "accessKey", "secretKey"):
+                if not p.get(f):
+                    raise SiteError(f"peer missing {f!r}")
+            if p["name"] in names:
+                raise SiteError(f"duplicate peer name {p['name']!r}")
+            names.add(p["name"])
+        return config
+
+    def save(self) -> None:
+        blob = json.dumps(self.config, sort_keys=True).encode()
+        disks = [d for es in self._sets for d in es.disks]
+        ok = 0
+        for d in disks:
+            try:
+                d.write_all(SYS_VOL, SITE_PATH, blob)
+                ok += 1
+            except Exception:  # noqa: BLE001 - offline drive
+                continue
+        if ok < len(disks) // 2 + 1:
+            raise SiteError("could not persist site config to a quorum")
+
+    def info(self) -> dict:
+        peers = []
+        for p in self.config.get("peers", []):
+            q = dict(p)
+            q.pop("secretKey", None)      # never echo credentials
+            peers.append(q)
+        return {"name": self.config.get("name", ""), "peers": peers,
+                "queued": self.queued, "completed": self.completed,
+                "failed": self.failed}
+
+    def _clients(self):
+        from minio_tpu.s3.client import RemoteS3
+        for p in self.config.get("peers", []):
+            yield p["name"], RemoteS3(p["endpoint"], p["accessKey"],
+                                      p["secretKey"])
+
+    # -- ingestion -------------------------------------------------------
+
+    def enqueue(self, kind: str, bucket: str, key: str = "",
+                version_id: str = "") -> None:
+        try:
+            self._q.put_nowait((kind, bucket, key, version_id, 0))
+            self.queued += 1
+        except queue.Full:
+            self.failed += 1
+
+    def bootstrap(self) -> None:
+        """One-time sync at registration: existing buckets and their
+        metadata documents reach every peer (objects are not
+        backfilled — the reference offers resync separately)."""
+        for b in self.layer.list_buckets():
+            self.enqueue("bucket-make", b.name)
+            self.enqueue("bucket-meta", b.name)
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._q.unfinished_tasks == 0:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    # -- delivery --------------------------------------------------------
+
+    def _deliver(self, kind: str, bucket: str, key: str,
+                 version_id: str) -> None:
+        from minio_tpu.s3.client import S3ClientError
+        for name, client in self._clients():
+            if kind == "bucket-make":
+                st, _, body = client.request(
+                    "PUT", f"/{bucket}", headers={H_SITE_REPLICA: "true"})
+                if st not in (200, 409):   # exists on peer: converged
+                    raise SiteError(f"{name}: mkbucket HTTP {st}")
+            elif kind == "bucket-delete":
+                st, _, _ = client.request(
+                    "DELETE", f"/{bucket}",
+                    headers={H_SITE_REPLICA: "true"})
+                if st not in (204, 404):
+                    raise SiteError(f"{name}: rmbucket HTTP {st}")
+            elif kind == "bucket-meta":
+                meta = self.layer.get_bucket_meta(bucket)
+                st, _, _ = client.request(
+                    "PUT", "/minio/admin/v3/site-import-bucket-meta",
+                    query={"bucket": bucket},
+                    body=json.dumps(meta).encode())
+                if st != 200:
+                    raise SiteError(f"{name}: meta import HTTP {st}")
+            elif kind == "put":
+                self._deliver_put(name, client, bucket, key, version_id)
+            elif kind == "delete":
+                # The replica marker rides the delete too — without it
+                # the receiving site mirrors the delete back and the
+                # pair ping-pongs forever (stacking a new delete marker
+                # per bounce on versioned buckets).
+                st, _, _ = client.request(
+                    "DELETE", f"/{bucket}/{key}",
+                    headers={H_SITE_REPLICA: "true"})
+                if st not in (200, 204, 404):
+                    raise SiteError(f"{name}: delete HTTP {st}")
+
+    def _deliver_put(self, name, client, bucket, key, version_id) -> None:
+        from minio_tpu.object.types import GetOptions
+        info, body = self.layer.get_object(
+            bucket, key, GetOptions(version_id=version_id))
+        if info.internal_metadata.get("x-internal-sse-alg"):
+            return                       # SSE stays home (v1)
+        if info.internal_metadata.get("x-internal-comp"):
+            from minio_tpu.crypto import compress as comp
+            body = comp.decompress_range(body, info.internal_metadata,
+                                         0, info.size)
+        headers = {f"x-amz-meta-{k}": v
+                   for k, v in info.user_metadata.items()}
+        if info.content_type:
+            headers["Content-Type"] = info.content_type
+        if info.user_tags:
+            headers["x-amz-tagging"] = info.user_tags
+        headers["x-amz-meta-mtpu-replica"] = "true"
+        client.put_object(bucket, key, body, headers=headers)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                kind, bucket, key, vid, attempt = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                self._deliver(kind, bucket, key, vid)
+                self.completed += 1
+            except Exception:  # noqa: BLE001 - retry then count failed
+                if attempt + 1 < self._RETRIES and not self._stop.is_set():
+                    time.sleep(min(0.2 * 2 ** attempt, 5.0))
+                    try:
+                        self._q.put_nowait((kind, bucket, key, vid,
+                                            attempt + 1))
+                    except queue.Full:
+                        self.failed += 1
+                else:
+                    self.failed += 1
+            finally:
+                self._q.task_done()

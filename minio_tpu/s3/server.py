@@ -142,6 +142,9 @@ class S3Server:
         self.tiers = None
         # Batch-job manager (object/batch.BatchJobs), ditto.
         self.batch = None
+        # Site replicator (replication/site.SiteReplicator); None until
+        # sites are registered.
+        self.site = None
 
     @property
     def address(self) -> str:
@@ -689,12 +692,14 @@ def _make_handler(server: S3Server):
                     meta = ol.get_bucket_meta(bucket)
                     meta[meta_key] = body.decode("utf-8", "replace")
                     ol.set_bucket_meta(bucket, meta)
+                self._site_enqueue("bucket-meta", bucket)
                 return self._send(200)
             if method == "DELETE":
                 with server.bucket_meta_lock:
                     meta = ol.get_bucket_meta(bucket)
                     if meta.pop(meta_key, None) is not None:
                         ol.set_bucket_meta(bucket, meta)
+                self._site_enqueue("bucket-meta", bucket)
                 return self._send(204)
             stored = ol.get_bucket_meta(bucket).get(meta_key)
             if stored is None:
@@ -728,6 +733,7 @@ def _make_handler(server: S3Server):
                     return self._put_versioning(bucket, body)
                 _validate_bucket_name(bucket)
                 ol.make_bucket(bucket)
+                self._site_enqueue("bucket-make", bucket)
                 if self._headers_lower().get(
                         "x-amz-bucket-object-lock-enabled", "").lower() \
                         == "true":
@@ -741,12 +747,14 @@ def _make_handler(server: S3Server):
                         meta["versioning"] = True
                         meta[olock.BUCKET_META_KEY] = {"enabled": True}
                         ol.set_bucket_meta(bucket, meta)
+                    self._site_enqueue("bucket-meta", bucket)
                 return self._send(200, headers={"Location": f"/{bucket}"})
             if method == "HEAD":
                 ol.get_bucket_info(bucket)
                 return self._send(200)
             if method == "DELETE":
                 ol.delete_bucket(bucket)
+                self._site_enqueue("bucket-delete", bucket)
                 return self._send(204)
             if method == "POST" and "delete" in query:
                 return self._delete_objects(bucket, body)
@@ -798,6 +806,7 @@ def _make_handler(server: S3Server):
                 meta["versioning"] = True
                 meta[olock.BUCKET_META_KEY] = cfg
                 ol.set_bucket_meta(bucket, meta)
+            self._site_enqueue("bucket-meta", bucket)
             return self._send(200)
 
         def _list_versions(self, bucket, query):
@@ -899,6 +908,7 @@ def _make_handler(server: S3Server):
                                   "object lock requires versioning",
                                   bucket=bucket)
                 setter(bucket, status == "Enabled")
+            self._site_enqueue("bucket-meta", bucket)
             self._send(200)
 
         def _list_objects(self, bucket, query):
@@ -981,6 +991,10 @@ def _make_handler(server: S3Server):
                     deleted = server.object_layer.delete_object(
                         bucket, key,
                         DeleteOptions(version_id=vid, versioned=versioned))
+                    if not vid:
+                        # Bulk deletes mirror to peer sites like single
+                        # DELETEs (version-targeted prunes stay local).
+                        self._site_enqueue("delete", bucket, key)
                     self._notify(
                         "s3:ObjectRemoved:DeleteMarkerCreated"
                         if deleted.delete_marker
@@ -1051,9 +1065,10 @@ def _make_handler(server: S3Server):
             h = self._headers_lower()
             vid = query.get("versionId", [""])[0]
             # ONE open: the stream's own info decides the transform
-            # branch, so an unversioned-bucket overwrite between
-            # info-read and data-read can never feed ciphertext or
-            # compressed bytes to the parser.
+            # branch. Version-pinned buckets are fully race-free; on
+            # unversioned buckets the transform re-open below keeps
+            # the same small overwrite window the plain GET path has
+            # (and the reference shares).
             info, chunks = server.object_layer.get_object_stream(
                 bucket, key, GetOptions(version_id=vid))
             imeta = info.internal_metadata
@@ -1118,6 +1133,25 @@ def _make_handler(server: S3Server):
             out = olock.default_retention_meta(cfg, now)
             out.update(explicit)
             return out
+
+        def _site_enqueue(self, kind, bucket, key="", vid=""):
+            """Mirror a change to peer sites — unless the change ITSELF
+            arrived from a site (replica markers break the ping-pong)."""
+            if server.site is None:
+                return
+            from minio_tpu.replication.site import H_SITE_REPLICA
+            h = self._headers_lower()
+            if h.get(H_SITE_REPLICA) or "x-amz-meta-mtpu-replica" in h:
+                return
+            server.site.enqueue(kind, bucket, key, vid)
+
+        def _layer_sets(self):
+            ol = server.object_layer
+            if hasattr(ol, "pools"):
+                return ol.pools[0].sets
+            if hasattr(ol, "sets"):
+                return ol.sets
+            return [ol]
 
         def _batch_jobs(self):
             if server.batch is None:
@@ -1415,6 +1449,7 @@ def _make_handler(server: S3Server):
                 bucket, key, uid, parts)
             self._replicate_after_write(bucket, key, info.version_id,
                                         self._headers_lower())
+            self._site_enqueue("put", bucket, key, info.version_id)
             self._notify("s3:ObjectCreated:CompleteMultipartUpload",
                          bucket, key, size=info.size, etag=info.etag,
                          version_id=info.version_id)
@@ -1492,6 +1527,7 @@ def _make_handler(server: S3Server):
             info = server.object_layer.put_object(
                 bucket, key, out_payload, opts)
             self._replicate_after_write(bucket, key, info.version_id, h)
+            self._site_enqueue("put", bucket, key, info.version_id)
             self._notify("s3:ObjectCreated:Copy", bucket, key,
                          size=len(payload), etag=info.etag,
                          version_id=info.version_id)
@@ -1556,6 +1592,7 @@ def _make_handler(server: S3Server):
             if replicate:
                 server.replicator.enqueue(bucket, key, info.version_id,
                                           "put")
+            self._site_enqueue("put", bucket, key, info.version_id)
             self._notify("s3:ObjectCreated:Put", bucket, key,
                          size=plain_size, etag=info.etag,
                          version_id=info.version_id)
@@ -2154,6 +2191,7 @@ def _make_handler(server: S3Server):
                 opts)
             info = server.object_layer.put_object(bucket, key,
                                                   post_payload, opts)
+            self._site_enqueue("put", bucket, key, info.version_id)
             self._notify("s3:ObjectCreated:Post", bucket, key,
                          size=len(file_data), etag=info.etag,
                          version_id=info.version_id)
@@ -2420,6 +2458,62 @@ def _make_handler(server: S3Server):
                     server.peer_notify("config")
                 return ok({"applied": applied})
 
+            # Site replication (reference: cmd/site-replication.go).
+            if op in ("site-replication-add", "site-replication-info",
+                      "site-replication-remove",
+                      "site-import-bucket-meta"):
+                from minio_tpu.replication.site import (SiteError,
+                                                        SiteReplicator)
+                try:
+                    if op == "site-replication-add" and method == "POST":
+                        cfg = SiteReplicator.validate(_json.loads(body))
+                        new_site = SiteReplicator(
+                            server.object_layer, self._layer_sets(), cfg)
+                        try:
+                            # Persist BEFORE arming: a failed save must
+                            # not leave an active replicator running a
+                            # config a restart will silently drop.
+                            new_site.save()
+                        except SiteError:
+                            new_site.stop()
+                            raise
+                        if server.site is not None:
+                            server.site.stop()
+                        server.site = new_site
+                        server.site.bootstrap()
+                        return ok()
+                    if op == "site-replication-info" and method == "GET":
+                        return ok(server.site.info()
+                                  if server.site else None)
+                    if op == "site-replication-remove" and \
+                            method == "POST":
+                        if server.site is not None:
+                            server.site.stop()
+                            server.site.config = {"peers": []}
+                            server.site.save()
+                            server.site = None
+                        return ok()
+                    if op == "site-import-bucket-meta" and method == "PUT":
+                        # Receiving side of a peer's bucket-meta push:
+                        # applied directly (never re-broadcast).
+                        bkt = q1.get("bucket", "")
+                        meta = _json.loads(body)
+                        if not isinstance(meta, dict):
+                            raise S3Error("InvalidArgument", "bad meta")
+                        from minio_tpu.object.types import BucketExists
+                        try:
+                            server.object_layer.make_bucket(bkt)
+                        except BucketExists:
+                            pass
+                        with server.bucket_meta_lock:
+                            server.object_layer.set_bucket_meta(bkt, meta)
+                        return ok()
+                except SiteError as e:
+                    raise S3Error("InvalidArgument", str(e)) from None
+                except ValueError:
+                    raise S3Error("MalformedXML") from None
+                raise S3Error("MethodNotAllowed")
+
             # Batch jobs (reference: cmd/batch-handlers.go).
             if op in ("start-batch-job", "batch-job-status",
                       "list-batch-jobs", "cancel-batch-job"):
@@ -2614,6 +2708,8 @@ def _make_handler(server: S3Server):
                     server.replicator.should_replicate(bucket, key,
                                                        delete=True):
                 server.replicator.enqueue(bucket, key, op="delete")
+            if not vid:
+                self._site_enqueue("delete", bucket, key)
             self._notify("s3:ObjectRemoved:DeleteMarkerCreated"
                          if deleted.delete_marker
                          else "s3:ObjectRemoved:Delete", bucket, key,

@@ -177,14 +177,15 @@ def run_select(body, request_xml: bytes) -> bytes:
     reference streams the same way, internal/s3select). Returns the
     event-stream response (Records + Stats + End); the response itself
     is the result set, typically far smaller than the input."""
-    req = parse_select_request(request_xml)
-    try:
-        query = parse_select(req["expression"])
-    except SQLError as e:
-        raise SelectError(str(e)) from None
-
     counter = _CountingChunks(body)
     try:
+        # Request parsing INSIDE the try: a malformed request must
+        # still close the caller's object stream.
+        req = parse_select_request(request_xml)
+        try:
+            query = parse_select(req["expression"])
+        except SQLError as e:
+            raise SelectError(str(e)) from None
         rows_iter = _iter_csv(counter, req["input"]) \
             if req["input"]["format"] == "csv" else _iter_json(counter)
 

@@ -298,6 +298,14 @@ def main(argv=None) -> int:
     srv.tiers = TierRegistry(pools[0].sets)
     for s in all_sets:
         s.tiers = srv.tiers
+    # Site replication: re-arm a persisted peer registry
+    # (reference: site replication config survives restarts).
+    from minio_tpu.replication.site import SiteReplicator, load_config
+    site_cfg = load_config(pools[0].sets)
+    if site_cfg:
+        srv.site = SiteReplicator(layer, pools[0].sets, site_cfg)
+        print(f"site replication armed "
+              f"({len(site_cfg.get('peers', []))} peers)", flush=True)
     # Batch jobs: resume any that a crash or restart interrupted
     # (reference: batch jobs survive restarts via their checkpoints).
     from minio_tpu.object.batch import BatchJobs

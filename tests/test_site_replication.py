@@ -1,0 +1,153 @@
+"""Site replication: active-active mirroring across clusters
+(reference: cmd/site-replication.go)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from minio_tpu.object.erasure_object import ErasureSet
+from minio_tpu.replication.site import SiteError, SiteReplicator
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.local import LocalStorage
+from tests.s3client import S3Client
+
+
+def test_validate_config():
+    with pytest.raises(SiteError):
+        SiteReplicator.validate({"peers": []})
+    with pytest.raises(SiteError):
+        SiteReplicator.validate({"peers": [{"name": "b",
+                                            "endpoint": "h:1"}]})
+    with pytest.raises(SiteError):
+        SiteReplicator.validate({"peers": [
+            {"name": "x", "endpoint": "h:1", "accessKey": "a",
+             "secretKey": "s"},
+            {"name": "x", "endpoint": "h:2", "accessKey": "a",
+             "secretKey": "s"}]})
+
+
+@pytest.fixture
+def two_sites(tmp_path):
+    servers = []
+    for name in ("east", "west"):
+        disks = [LocalStorage(str(tmp_path / name / f"d{i}"))
+                 for i in range(4)]
+        srv = S3Server(ErasureSet(disks), address="127.0.0.1:0")
+        srv.start()
+        servers.append(srv)
+    yield servers
+    for s in servers:
+        if s.site is not None:
+            s.site.stop()
+        s.stop()
+
+
+def _link(a, b):
+    """Register each server as the other's peer (active-active)."""
+    for srv, peer, pname in ((a, b, "west"), (b, a, "east")):
+        cli = S3Client(srv.address)
+        st, _, body = cli.request(
+            "POST", "/minio/admin/v3/site-replication-add",
+            body=json.dumps({"name": pname + "-local", "peers": [
+                {"name": pname, "endpoint": peer.address,
+                 "accessKey": "minioadmin",
+                 "secretKey": "minioadmin"}]}).encode())
+        assert st == 200, body
+
+
+def _wait(cond, timeout=20):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def test_active_active_buckets_objects_metadata(two_sites):
+    east, west = two_sites
+    ec, wc = S3Client(east.address), S3Client(west.address)
+    _link(east, west)
+
+    # Bucket created on east appears on west.
+    assert ec.request("PUT", "/mirror")[0] == 200
+    assert _wait(lambda: wc.request("HEAD", "/mirror")[0] == 200)
+    # Object PUT on east reads on west, metadata and tags intact.
+    body = os.urandom(50_000)
+    assert ec.request("PUT", "/mirror/doc", body=body, headers={
+        "x-amz-meta-origin": "east",
+        "x-amz-tagging": "zone=a"})[0] == 200
+    assert _wait(lambda: wc.request("GET", "/mirror/doc")[0] == 200)
+    st, h, got = wc.request("GET", "/mirror/doc")
+    assert got == body
+    assert h.get("x-amz-meta-origin") == "east"
+    # ...and the reverse direction (active-active, no ping-pong: the
+    # replica marker stops the copy from bouncing back).
+    body2 = os.urandom(10_000)
+    assert wc.request("PUT", "/mirror/back", body=body2)[0] == 200
+    assert _wait(lambda: ec.request("GET", "/mirror/back")[0] == 200)
+    st, _, got = ec.request("GET", "/mirror/back")
+    assert got == body2
+    east.site.drain()
+    west.site.drain()
+    assert east.site.info()["failed"] == 0
+    assert west.site.info()["failed"] == 0
+
+    # Bucket POLICY mirrors (whole metadata document).
+    pol = {"Statement": [{"Effect": "Allow", "Principal": "*",
+                          "Action": ["s3:GetObject"],
+                          "Resource": ["arn:aws:s3:::mirror/*"]}]}
+    assert ec.request("PUT", "/mirror", query={"policy": ""},
+                      body=json.dumps(pol).encode())[0] == 200
+    assert _wait(lambda: wc.request(
+        "GET", "/mirror", query={"policy": ""})[0] == 200)
+    # Versioning toggle mirrors too.
+    assert ec.request(
+        "PUT", "/mirror", query={"versioning": ""},
+        body=b"<VersioningConfiguration><Status>Enabled</Status>"
+             b"</VersioningConfiguration>")[0] == 200
+    assert _wait(lambda: b"Enabled" in wc.request(
+        "GET", "/mirror", query={"versioning": ""})[2])
+
+    # Deletes mirror (marker semantics on the far side).
+    assert ec.request("DELETE", "/mirror/doc")[0] == 204
+    assert _wait(lambda: wc.request("GET", "/mirror/doc")[0] == 404)
+    # No delete ping-pong: after the queues quiesce, each side holds
+    # exactly ONE delete marker for the key (a missing replica marker
+    # on deletes once bounced markers between sites forever).
+    east.site.drain()
+    west.site.drain()
+    time.sleep(0.5)
+    east.site.drain()
+    west.site.drain()
+    for cli in (ec, wc):
+        st, _, listing = cli.request("GET", "/mirror",
+                                     query={"versions": "",
+                                            "prefix": "doc"})
+        assert st == 200
+        assert listing.count(b"<DeleteMarker>") == 1, listing
+
+
+def test_bootstrap_syncs_existing_buckets(two_sites):
+    east, west = two_sites
+    ec, wc = S3Client(east.address), S3Client(west.address)
+    # Buckets that existed BEFORE registration flow at bootstrap.
+    assert ec.request("PUT", "/oldbkt")[0] == 200
+    assert ec.request("PUT", "/oldbkt", query={"tagging": ""},
+                      body=b"<Tagging><TagSet><Tag><Key>team</Key>"
+                           b"<Value>sre</Value></Tag></TagSet></Tagging>"
+                      )[0] == 200
+    _link(east, west)
+    assert _wait(lambda: wc.request("HEAD", "/oldbkt")[0] == 200)
+    assert _wait(lambda: b"sre" in wc.request(
+        "GET", "/oldbkt", query={"tagging": ""})[2])
+    # Info reports peers without secrets.
+    st, _, b = ec.request("GET", "/minio/admin/v3/site-replication-info")
+    assert st == 200 and b"west" in b and b"secretKey" not in b
+    # Remove tears it down.
+    assert ec.request("POST",
+                      "/minio/admin/v3/site-replication-remove")[0] == 200
+    st, _, b = ec.request("GET", "/minio/admin/v3/site-replication-info")
+    assert st == 200 and b in (b"", b"null")
