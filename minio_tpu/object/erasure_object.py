@@ -140,6 +140,9 @@ class ErasureSet:
         # Warm-tier registry (object/tier.TierRegistry); None = no
         # tiering configured. Set at boot, shared across sets.
         self.tiers = None
+        # Listing page cache with write invalidation (metacache).
+        from minio_tpu.object.metacache import MetaCache
+        self.metacache = MetaCache()
 
     @property
     def mrf(self):
@@ -273,6 +276,7 @@ class ErasureSet:
         # Drop bucket metadata so a recreated bucket starts fresh
         # (versioning state must not survive deletion).
         self.invalidate_bucket_meta(bucket)
+        self.metacache.drop_bucket(bucket)
         self._fanout([lambda d=d: _swallow(
             lambda: d.delete(SYS_VOL, f"buckets/{bucket}", recursive=True))
             for d in self.disks])
@@ -634,6 +638,7 @@ class ErasureSet:
             # drives that missed the write (reference MRF hook,
             # cmd/erasure-object.go:1556-1594).
             self.mrf.enqueue(bucket, object_, version_id)
+        self.metacache.bump(bucket)
         return ObjectInfo(bucket=bucket, name=object_, mod_time=mod_time,
                           size=len(data), etag=etag,
                           content_type=opts.content_type,
@@ -686,6 +691,7 @@ class ErasureSet:
                      for d in self.disks])
             if sum(e is None for e in errors) < n // 2 + 1:
                 raise WriteQuorumError(bucket, object_)
+            self.metacache.bump(bucket)
             return
         from minio_tpu.object.tier import META_TIER
         if (src_fi.metadata or {}).get(META_TIER):
@@ -707,6 +713,7 @@ class ErasureSet:
                      for d in self.disks])
             if sum(e is None for e in errors) < n // 2 + 1:
                 raise WriteQuorumError(bucket, object_)
+            self.metacache.bump(bucket)
             return
         m = self.default_parity
         k = n - m
@@ -762,6 +769,7 @@ class ErasureSet:
             raise WriteQuorumError(bucket, object_)
         if ok < n:
             self.mrf.enqueue(bucket, object_, src_fi.version_id)
+        self.metacache.bump(bucket)
 
     # ------------------------------------------------------------------
     # Streaming PutObject (O(window) memory)
@@ -927,6 +935,7 @@ class ErasureSet:
         if laggards:
             cleanup_staging(laggards)
             self.mrf.enqueue(bucket, object_, version_id)
+        self.metacache.bump(bucket)
         return ObjectInfo(bucket=bucket, name=object_, mod_time=mod_time,
                           size=size, etag=etag,
                           content_type=opts.content_type,
@@ -1285,6 +1294,7 @@ class ErasureSet:
                 # Drives outside the agreeing set are stale/missing:
                 # background heal brings them (and the update) over.
                 self.mrf.enqueue(bucket, object_, fi.version_id)
+        self.metacache.bump(bucket)
         meta = dict(fi.metadata)
         mutate(meta)
         return self._to_object_info(bucket, object_,
@@ -1448,6 +1458,7 @@ class ErasureSet:
                  for d in self.disks])
             if sum(e is None for e in errors) < write_quorum:
                 raise WriteQuorumError(bucket, object_)
+            self.metacache.bump(bucket)
             return DeletedObject(object_name=object_, delete_marker=True,
                                  delete_marker_version_id=marker_vid)
 
@@ -1463,6 +1474,7 @@ class ErasureSet:
             # A drive missed the delete: repair so listings/reads cannot
             # resurrect the version from the stale copy.
             self.mrf.enqueue(bucket, object_, opts.version_id)
+        self.metacache.bump(bucket)
         return DeletedObject(object_name=object_, version_id=opts.version_id)
 
     def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
@@ -1480,6 +1492,17 @@ class ErasureSet:
 
         self._check_bucket(bucket)
         max_keys = max(1, min(max_keys, 1000))
+        # Metacache: an identical listing against an unchanged bucket
+        # serves from the page cache instead of re-walking a drive
+        # majority (generation-stamped — any write invalidates).
+        # Pages are cached as-returned; callers treat listings as
+        # read-only.
+        cache_key = (bucket, prefix, marker, delimiter, max_keys,
+                     include_versions)
+        cached = self.metacache.get(bucket, cache_key)
+        if cached is not None:
+            return cached
+        walk_gen = self.metacache.generation(bucket)
         base_dir = ""
         if "/" in prefix:
             base_dir = prefix.rsplit("/", 1)[0]
@@ -1588,6 +1611,7 @@ class ErasureSet:
                 info.objects.append(self._to_object_info(bucket, path, fi))
             last_added = path
         info.prefixes = sorted(seen_prefixes)
+        self.metacache.put(bucket, cache_key, info, gen=walk_gen)
         return info
 
     def list_versions_all(self, bucket: str, object_: str) -> list[FileInfo]:

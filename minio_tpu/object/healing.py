@@ -103,6 +103,7 @@ def _heal_object_locked(es, bucket: str, object_: str, version_id: str,
                 (lambda i=i: _purge_version(es.disks[i], bucket, object_,
                                             fis[i].version_id))
                 if i in stale else None for i in range(n)])
+            es.metacache.bump(bucket)
         result = HealResult(bucket=bucket, object=object_,
                             version_id=version_id)
         result.before = [DRIVE_STATE_OUTDATED if i in stale

@@ -1,0 +1,84 @@
+"""Metacache: shared listing-page cache with write invalidation.
+
+The analogue (scoped down) of the reference's metacache
+(cmd/metacache.go:55-70, cmd/metacache-set.go:700): the reference
+persists listing walk streams and shares them between concurrent
+listers; here, resolved listing PAGES are cached in a bounded LRU keyed
+by the exact listing parameters and stamped with the bucket's mutation
+GENERATION — any object write/delete in the bucket bumps the
+generation, so a cached page can never serve names or metadata from
+before a change (correctness first; the win is the common hot pattern
+of dashboards and SDKs re-issuing identical listings against a quiet
+bucket, which previously re-walked a drive majority every time).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+
+class MetaCache:
+    """Per-erasure-set listing page cache.
+
+    Generation bumps catch every mutation made through THIS process's
+    set object; in distributed mode a peer node writes shard files over
+    the storage RPC without touching this layer, so a short TTL bounds
+    cross-node staleness (the same 2 s contract the bucket-metadata and
+    IAM caches use)."""
+
+    MAX_PAGES = 256
+    TTL = 2.0
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._gen: dict[str, int] = {}           # bucket -> generation
+        self._pages: OrderedDict = OrderedDict()  # key -> (gen, ts, page)
+        self.hits = 0
+        self.misses = 0
+
+    def generation(self, bucket: str) -> int:
+        with self._mu:
+            return self._gen.get(bucket, 0)
+
+    def bump(self, bucket: str) -> None:
+        """Any namespace mutation in the bucket invalidates every
+        cached page for it (lazily, via the generation stamp)."""
+        with self._mu:
+            self._gen[bucket] = self._gen.get(bucket, 0) + 1
+
+    def get(self, bucket: str, key: tuple):
+        import time
+        with self._mu:
+            hit = self._pages.get(key)
+            if hit is None or hit[0] != self._gen.get(bucket, 0) or \
+                    time.monotonic() - hit[1] > self.TTL:
+                self.misses += 1
+                return None
+            self._pages.move_to_end(key)
+            self.hits += 1
+            return hit[2]
+
+    def put(self, bucket: str, key: tuple, page,
+            gen: int = -1) -> None:
+        """`gen`: the generation read BEFORE the walk began. A write
+        concurrent with the walk bumps past it, so the page stores with
+        the stale stamp and the next get() misses — stamping the
+        CURRENT generation would mark a possibly-incomplete page
+        fresh."""
+        import time
+        with self._mu:
+            if gen < 0:
+                gen = self._gen.get(bucket, 0)
+            self._pages[key] = (gen, time.monotonic(), page)
+            self._pages.move_to_end(key)
+            while len(self._pages) > self.MAX_PAGES:
+                self._pages.popitem(last=False)
+
+    def drop_bucket(self, bucket: str) -> None:
+        """Bucket deletion: the generation map must not pin memory for
+        names that no longer exist."""
+        with self._mu:
+            self._gen.pop(bucket, None)
+            self._pages = OrderedDict(
+                (k, v) for k, v in self._pages.items() if k[0] != bucket)

@@ -370,6 +370,7 @@ def complete_multipart_upload(es, bucket: str, object_: str, upload_id: str,
     es._fanout([lambda d=d: _try(lambda: d.delete(eo.SYS_VOL, updir,
                                                   recursive=True))
                 for d in es.disks])
+    es.metacache.bump(bucket)
     return ObjectInfo(bucket=bucket, name=object_, mod_time=mod_time,
                       size=total, etag=etag,
                       content_type=rec.get("content_type", ""),

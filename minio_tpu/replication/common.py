@@ -1,0 +1,45 @@
+"""Shared delivery helpers for bucket- and site-replication workers.
+
+One implementation of "push this object's latest bytes + metadata to a
+remote S3 endpoint" — the SSE gate, decompression, header rebuild, and
+replica marker live HERE so a fix reaches both engines.
+"""
+
+from __future__ import annotations
+
+
+class DeliveryError(Exception):
+    pass
+
+
+def push_object(object_layer, client, bucket: str, key: str,
+                version_id: str, target_bucket: str,
+                skip_sse: bool = False) -> bool:
+    """Replicate one version to `client` (a RemoteS3). Returns False
+    when the object is SSE-encrypted and skip_sse is set (encrypted
+    objects do not replicate in v1 — their keys bind to one cluster);
+    raises DeliveryError for it otherwise."""
+    from minio_tpu.object.types import GetOptions
+    info, body = object_layer.get_object(
+        bucket, key, GetOptions(version_id=version_id))
+    if info.internal_metadata.get("x-internal-sse-alg"):
+        if skip_sse:
+            return False
+        raise DeliveryError("SSE objects do not replicate in v1")
+    if info.internal_metadata.get("x-internal-comp"):
+        # The stored stream is compressed: replicate PLAINTEXT (the
+        # target applies its own transforms).
+        from minio_tpu.crypto import compress as comp
+        body = comp.decompress_range(body, info.internal_metadata,
+                                     0, info.size)
+    headers = {f"x-amz-meta-{k}": v
+               for k, v in info.user_metadata.items()}
+    if info.content_type:
+        headers["Content-Type"] = info.content_type
+    if info.user_tags:
+        headers["x-amz-tagging"] = info.user_tags
+    # Mark the replica so the far side can tell it apart (and never
+    # replicates it back — the ping-pong breaker).
+    headers["x-amz-meta-mtpu-replica"] = "true"
+    client.put_object(target_bucket, key, body, headers=headers)
+    return True

@@ -169,27 +169,12 @@ class ReplicationEngine:
         if target is None:
             raise ReplicationError("no remote target")
         client, tbucket = target
-        from minio_tpu.object.types import GetOptions
-        info, body = self.object_layer.get_object(
-            bucket, key, GetOptions(version_id=version_id))
-        if info.internal_metadata.get("x-internal-sse-alg"):
-            raise ReplicationError("SSE objects do not replicate in v1")
-        if info.internal_metadata.get("x-internal-comp"):
-            # The stored stream is compressed: replicate PLAINTEXT (the
-            # target applies its own transforms).
-            from minio_tpu.crypto import compress as comp
-            body = comp.decompress_range(body, info.internal_metadata,
-                                         0, info.size)
-        headers = {f"x-amz-meta-{k}": v
-                   for k, v in info.user_metadata.items()}
-        if info.content_type:
-            headers["Content-Type"] = info.content_type
-        if info.user_tags:
-            headers["x-amz-tagging"] = info.user_tags
-        # Mark the replica so the far side can tell it apart (the
-        # reference sets X-Amz-Meta replication markers similarly).
-        headers["x-amz-meta-mtpu-replica"] = "true"
-        client.put_object(tbucket, key, body, headers=headers)
+        from minio_tpu.replication.common import DeliveryError, push_object
+        try:
+            push_object(self.object_layer, client, bucket, key,
+                        version_id, tbucket)
+        except DeliveryError as e:
+            raise ReplicationError(str(e)) from None
 
     def _replicate_delete(self, bucket, key) -> None:
         target = self.target_for(bucket)

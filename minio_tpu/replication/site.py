@@ -126,7 +126,11 @@ class SiteReplicator:
     def enqueue(self, kind: str, bucket: str, key: str = "",
                 version_id: str = "") -> None:
         try:
-            self._q.put_nowait((kind, bucket, key, version_id, 0))
+            # The trailing set tracks which peers already received this
+            # change — retries only touch the peers that failed
+            # (re-delivering to a versioned peer would stack duplicate
+            # versions per retry).
+            self._q.put_nowait((kind, bucket, key, version_id, 0, set()))
             self.queued += 1
         except queue.Full:
             self.failed += 1
@@ -155,75 +159,76 @@ class SiteReplicator:
     # -- delivery --------------------------------------------------------
 
     def _deliver(self, kind: str, bucket: str, key: str,
-                 version_id: str) -> None:
-        from minio_tpu.s3.client import S3ClientError
+                 version_id: str, done: set) -> None:
+        """Fan one change out to every peer NOT already in `done`,
+        recording successes there — a retry must only touch the peers
+        that failed (re-delivering to a versioned peer would stack a
+        duplicate version or delete marker per attempt) and must still
+        reach peers listed after an earlier failure."""
+        failures = []
         for name, client in self._clients():
-            if kind == "bucket-make":
-                st, _, body = client.request(
-                    "PUT", f"/{bucket}", headers={H_SITE_REPLICA: "true"})
-                if st not in (200, 409):   # exists on peer: converged
-                    raise SiteError(f"{name}: mkbucket HTTP {st}")
-            elif kind == "bucket-delete":
-                st, _, _ = client.request(
-                    "DELETE", f"/{bucket}",
-                    headers={H_SITE_REPLICA: "true"})
-                if st not in (204, 404):
-                    raise SiteError(f"{name}: rmbucket HTTP {st}")
-            elif kind == "bucket-meta":
-                meta = self.layer.get_bucket_meta(bucket)
-                st, _, _ = client.request(
-                    "PUT", "/minio/admin/v3/site-import-bucket-meta",
-                    query={"bucket": bucket},
-                    body=json.dumps(meta).encode())
-                if st != 200:
-                    raise SiteError(f"{name}: meta import HTTP {st}")
-            elif kind == "put":
-                self._deliver_put(name, client, bucket, key, version_id)
-            elif kind == "delete":
-                # The replica marker rides the delete too — without it
-                # the receiving site mirrors the delete back and the
-                # pair ping-pongs forever (stacking a new delete marker
-                # per bounce on versioned buckets).
-                st, _, _ = client.request(
-                    "DELETE", f"/{bucket}/{key}",
-                    headers={H_SITE_REPLICA: "true"})
-                if st not in (200, 204, 404):
-                    raise SiteError(f"{name}: delete HTTP {st}")
+            if name in done:
+                continue
+            try:
+                self._deliver_one(kind, bucket, key, version_id, name,
+                                  client)
+                done.add(name)
+            except Exception as e:  # noqa: BLE001 - recorded per peer
+                failures.append(f"{name}: {e}")
+        if failures:
+            raise SiteError("; ".join(failures))
 
-    def _deliver_put(self, name, client, bucket, key, version_id) -> None:
-        from minio_tpu.object.types import GetOptions
-        info, body = self.layer.get_object(
-            bucket, key, GetOptions(version_id=version_id))
-        if info.internal_metadata.get("x-internal-sse-alg"):
-            return                       # SSE stays home (v1)
-        if info.internal_metadata.get("x-internal-comp"):
-            from minio_tpu.crypto import compress as comp
-            body = comp.decompress_range(body, info.internal_metadata,
-                                         0, info.size)
-        headers = {f"x-amz-meta-{k}": v
-                   for k, v in info.user_metadata.items()}
-        if info.content_type:
-            headers["Content-Type"] = info.content_type
-        if info.user_tags:
-            headers["x-amz-tagging"] = info.user_tags
-        headers["x-amz-meta-mtpu-replica"] = "true"
-        client.put_object(bucket, key, body, headers=headers)
+    def _deliver_one(self, kind, bucket, key, version_id, name,
+                     client) -> None:
+        if kind == "bucket-make":
+            st, _, _ = client.request(
+                "PUT", f"/{bucket}", headers={H_SITE_REPLICA: "true"})
+            if st not in (200, 409):   # exists on peer: converged
+                raise SiteError(f"mkbucket HTTP {st}")
+        elif kind == "bucket-delete":
+            st, _, _ = client.request(
+                "DELETE", f"/{bucket}", headers={H_SITE_REPLICA: "true"})
+            if st not in (204, 404):
+                raise SiteError(f"rmbucket HTTP {st}")
+        elif kind == "bucket-meta":
+            meta = self.layer.get_bucket_meta(bucket)
+            st, _, _ = client.request(
+                "PUT", "/minio/admin/v3/site-import-bucket-meta",
+                query={"bucket": bucket},
+                body=json.dumps(meta).encode())
+            if st != 200:
+                raise SiteError(f"meta import HTTP {st}")
+        elif kind == "put":
+            from minio_tpu.replication.common import push_object
+            push_object(self.layer, client, bucket, key, version_id,
+                        bucket, skip_sse=True)
+        elif kind == "delete":
+            # The replica marker rides the delete too — without it the
+            # receiving site mirrors the delete back and the pair
+            # ping-pongs forever (stacking a new delete marker per
+            # bounce on versioned buckets).
+            st, _, _ = client.request(
+                "DELETE", f"/{bucket}/{key}",
+                headers={H_SITE_REPLICA: "true"})
+            if st not in (200, 204, 404):
+                raise SiteError(f"delete HTTP {st}")
 
     def _run(self) -> None:
         while not self._stop.is_set():
             try:
-                kind, bucket, key, vid, attempt = self._q.get(timeout=0.2)
+                kind, bucket, key, vid, attempt, done = \
+                    self._q.get(timeout=0.2)
             except queue.Empty:
                 continue
             try:
-                self._deliver(kind, bucket, key, vid)
+                self._deliver(kind, bucket, key, vid, done)
                 self.completed += 1
             except Exception:  # noqa: BLE001 - retry then count failed
                 if attempt + 1 < self._RETRIES and not self._stop.is_set():
                     time.sleep(min(0.2 * 2 ** attempt, 5.0))
                     try:
                         self._q.put_nowait((kind, bucket, key, vid,
-                                            attempt + 1))
+                                            attempt + 1, done))
                     except queue.Full:
                         self.failed += 1
                 else:

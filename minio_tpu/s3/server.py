@@ -2488,9 +2488,19 @@ def _make_handler(server: S3Server):
                     if op == "site-replication-remove" and \
                             method == "POST":
                         if server.site is not None:
-                            server.site.stop()
+                            # Persist the removal BEFORE stopping: if
+                            # the save fails quorum, the replicator
+                            # keeps running its (intact) config rather
+                            # than leaving a dead replicator armed and
+                            # an on-disk config that re-arms at boot.
+                            old_cfg = dict(server.site.config)
                             server.site.config = {"peers": []}
-                            server.site.save()
+                            try:
+                                server.site.save()
+                            except SiteError:
+                                server.site.config = old_cfg
+                                raise
+                            server.site.stop()
                             server.site = None
                         return ok()
                     if op == "site-import-bucket-meta" and method == "PUT":

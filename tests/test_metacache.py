@@ -1,0 +1,62 @@
+"""Metacache: listing pages served from cache on quiet buckets, every
+write invalidating instantly (reference: cmd/metacache.go, scoped to a
+generation-stamped page cache)."""
+
+import os
+
+import pytest
+
+from minio_tpu.object.erasure_object import ErasureSet
+from minio_tpu.object.types import DeleteOptions, ObjectNotFound
+from minio_tpu.storage.local import LocalStorage
+
+
+@pytest.fixture
+def es(tmp_path):
+    disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    s = ErasureSet(disks)
+    s.make_bucket("mcb")
+    return s
+
+
+def test_repeat_listing_hits_cache(es):
+    for i in range(5):
+        es.put_object("mcb", f"k{i}", b"x")
+    first = es.list_objects("mcb", prefix="k")
+    assert es.metacache.hits == 0
+    again = es.list_objects("mcb", prefix="k")
+    assert es.metacache.hits == 1
+    assert [o.name for o in again.objects] == \
+        [o.name for o in first.objects]
+    # Different parameters are different pages.
+    es.list_objects("mcb", prefix="k", max_keys=2)
+    assert es.metacache.hits == 1
+
+
+def test_writes_invalidate_immediately(es):
+    es.put_object("mcb", "a", b"1")
+    assert [o.name for o in es.list_objects("mcb").objects] == ["a"]
+    # A PUT after the cached page must be visible on the very next
+    # listing — no TTL windows for same-process writes.
+    es.put_object("mcb", "b", b"2")
+    assert [o.name for o in es.list_objects("mcb").objects] == ["a", "b"]
+    es.delete_object("mcb", "a", DeleteOptions())
+    assert [o.name for o in es.list_objects("mcb").objects] == ["b"]
+    # Metadata updates (tags show in some listings) invalidate too.
+    es.list_objects("mcb")
+    es.update_object_tags("mcb", "b", "", "team=x")
+    hits_before = es.metacache.hits
+    es.list_objects("mcb")
+    assert es.metacache.hits == hits_before  # miss: page recomputed
+
+
+def test_multipart_and_bucket_delete_invalidate(es, tmp_path):
+    uid = es.new_multipart_upload("mcb", "mp")
+    es.list_objects("mcb")                       # prime the cache
+    e1 = es.put_object_part("mcb", "mp", uid, 1, os.urandom(1000)).etag
+    es.complete_multipart_upload("mcb", "mp", uid, [(1, e1)])
+    assert "mp" in [o.name for o in es.list_objects("mcb").objects]
+    es.delete_object("mcb", "mp", DeleteOptions())
+    es.delete_bucket("mcb")
+    with pytest.raises(Exception):
+        es.list_objects("mcb")
