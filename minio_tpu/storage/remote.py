@@ -125,6 +125,13 @@ class RemoteStorage:
 
     # -- shard files (bulk; chunked over the mux) ----------------------
 
+    # Credit window: chunks in flight per transfer. Bounds the frames a
+    # bulk sender can queue ahead of lock traffic (the reference's grid
+    # uses credit-based flow control on its bulk streams) while
+    # overlapping the per-chunk round-trip latency that a strict
+    # stop-and-wait pays in full.
+    WINDOW = 4
+
     def create_file(self, volume: str, path: str, data) -> None:
         if not isinstance(data, (bytes, bytearray, memoryview)):
             data = b"".join(data)
@@ -133,9 +140,39 @@ class RemoteStorage:
             self._call("create_file", volume, path, data)
             return
         # Chunked upload: stage under a transfer id, commit on finish.
+        # Chunks carry their OFFSET so the windowed sends may complete
+        # out of order on the receiver. WINDOW worker threads drain an
+        # offset queue (not a thread per chunk — a 1 GiB shard would
+        # otherwise create ~1024 short-lived threads).
+        import queue as queue_mod
+        import threading
         xfer = self._call("create_begin", volume, path)
+        offsets: "queue_mod.Queue" = queue_mod.Queue()
         for off in range(0, len(data), CHUNK):
-            self._call("create_chunk", xfer, data[off:off + CHUNK])
+            offsets.put(off)
+        errors: list = []
+
+        def worker() -> None:
+            while not errors:
+                try:
+                    off = offsets.get_nowait()
+                except queue_mod.Empty:
+                    return
+                try:
+                    self._call("create_chunk", xfer, off,
+                               data[off:off + CHUNK])
+                except Exception as e:  # noqa: BLE001 - surfaced below
+                    errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(min(self.WINDOW, offsets.qsize()))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
         self._call("create_commit", xfer)
 
     def read_file(self, volume: str, path: str, offset: int = 0,
@@ -333,21 +370,37 @@ class StorageRPCService:
         xfer = new_uuid()
         tmp = d._tmp_path()
         os.makedirs(os.path.dirname(tmp), exist_ok=True)
+        import threading as _threading
         with self._xfer_mu:
             self._xfers[xfer] = {"disk": d, "vol": vol, "path": path,
                                  "tmp": tmp, "f": open(tmp, "wb"),
+                                 "mu": _threading.Lock(),
                                  "touched": time.monotonic()}
         return xfer
 
     def _create_chunk(self, payload):
-        xfer, data = payload["a"]
+        # (xfer, offset, data): offset-addressed so the sender's credit
+        # window may deliver chunks out of order; the 2-tuple
+        # (xfer, data) append form is also accepted. NOTE: the grid
+        # wire protocol carries no cross-version compatibility
+        # contract — every node in a deployment runs the same build
+        # (same as the reference's internal REST APIs).
+        args = payload["a"]
+        if len(args) == 3:
+            xfer, off, data = args
+        else:
+            xfer, data = args
+            off = None
         with self._xfer_mu:
             st = self._xfers.get(xfer)
             if st is not None:
                 st["touched"] = time.monotonic()
         if st is None:
             raise StorageError(f"no such transfer {xfer}")
-        st["f"].write(data)
+        with st["mu"]:
+            if off is not None:
+                st["f"].seek(off)
+            st["f"].write(data)
 
     def _create_commit(self, payload):
         (xfer,) = payload["a"]

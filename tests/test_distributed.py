@@ -510,3 +510,16 @@ def test_three_node_cluster_kill_and_heal(tmp_path):
                 p.send_signal(signal.SIGKILL)
             except OSError:
                 pass
+
+
+def test_remote_bulk_windowed_chunks(remote_drive):
+    """Windowed (credit-limited) chunk uploads reassemble byte-identical
+    regardless of arrival order, including odd sizes straddling chunk
+    boundaries."""
+    local, rem = remote_drive
+    rem.make_vol("wv")
+    for size in (4 * (1 << 20) + 17, 12 * (1 << 20) + 3):
+        blob = os.urandom(size)
+        rem.create_file("wv", f"big-{size}", blob)
+        assert rem.read_file("wv", f"big-{size}") == blob
+        assert local.read_file("wv", f"big-{size}") == blob
