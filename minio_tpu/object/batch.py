@@ -117,6 +117,7 @@ class BatchJobs:
         self._mu = threading.Lock()
         self._running: dict[str, threading.Thread] = {}
         self._stops: dict[str, threading.Event] = {}
+        self._shutdown = False
 
     # -- persistence -----------------------------------------------------
 
@@ -219,6 +220,20 @@ class BatchJobs:
             st["status"] = "cancelled"
             self._save(st)
 
+    def shutdown(self) -> None:
+        """Server shutdown: stop every worker WITHOUT changing job
+        statuses — interrupted jobs stay 'running' on disk so the next
+        boot resumes them from their checkpoints (cancel() is the
+        user-intent path that persists 'cancelled')."""
+        self._shutdown = True
+        with self._mu:
+            events = list(self._stops.values())
+            threads = list(self._running.values())
+        for ev in events:
+            ev.set()
+        for t in threads:
+            t.join(timeout=10)
+
     def wait(self, job_id: str, timeout: float = 300) -> bool:
         t = self._running.get(job_id)
         if t is not None:
@@ -294,8 +309,11 @@ class BatchJobs:
             marker = page.next_marker
         if stop.is_set():
             # Single writer for the final status: the worker records
-            # the cancellation (cancel() only signals).
-            state["status"] = "cancelled"
+            # the outcome (cancel() only signals). A server SHUTDOWN
+            # keeps the job 'running' on disk — the next boot resumes
+            # it; only a user cancel persists 'cancelled'.
+            if not self._shutdown:
+                state["status"] = "cancelled"
             self._save(state)
             return
         state["status"] = "complete" if not state["failed"] else "failed"

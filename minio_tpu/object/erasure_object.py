@@ -144,14 +144,32 @@ class ErasureSet:
         from minio_tpu.object.metacache import MetaCache
         self.metacache = MetaCache()
 
+    def close(self) -> None:
+        """Release the set's background resources (fan-out executor,
+        MRF worker). Repeated boot/stop cycles — sidecars, tests —
+        would otherwise leak 8+ threads per lifecycle (caught by the
+        leak harness, tests/test_leak_race.py). Under _mrf_lock with a
+        closed sentinel: a racing lazy `mrf` access must not start a
+        fresh worker after close() looked."""
+        with self._mrf_lock:
+            self._mrf_closed = True
+            if self._mrf is not None:
+                self._mrf.stop()
+        self.pool.shutdown(wait=False)
+
     @property
     def mrf(self):
-        """Lazy MRF heal queue (background worker starts on first use)."""
+        """Lazy MRF heal queue (background worker starts on first use).
+        After close(), enqueues go to a stopped queue (accepted but not
+        worked — the set is going away) instead of starting a worker."""
         if self._mrf is None:
             with self._mrf_lock:
                 if self._mrf is None:
                     from minio_tpu.object.healing import MRFQueue
-                    self._mrf = MRFQueue(self)
+                    q = MRFQueue(self)
+                    if getattr(self, "_mrf_closed", False):
+                        q.stop()
+                    self._mrf = q
         return self._mrf
 
     # -- healing entry points ------------------------------------------

@@ -167,6 +167,10 @@ class ServerPools:
         for p in self.pools:
             p.invalidate_bucket_meta(bucket)
 
+    def close(self) -> None:
+        for p in self.pools:
+            p.close()
+
     def bucket_versioning(self, bucket: str) -> bool:
         return bool(self.get_bucket_meta(bucket).get("versioning"))
 

@@ -159,6 +159,10 @@ class ErasureSets:
         for s in self.sets:
             s.invalidate_bucket_meta(bucket)
 
+    def close(self) -> None:
+        for s in self.sets:
+            s.close()
+
     def bucket_versioning(self, bucket: str) -> bool:
         return bool(self.get_bucket_meta(bucket).get("versioning"))
 

@@ -159,6 +159,28 @@ class S3Server:
     def stop(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
+        # Drain in-flight handler threads before tearing down anything
+        # they use (shutdown() only stops the accept loop; an accepted
+        # large PUT must finish cleanly, not 500 on a closed executor).
+        for t in list(getattr(self.httpd, "_threads", None) or []):
+            t.join(timeout=10)
+        # Workers that consume the object layer stop BEFORE the layer
+        # closes — a replication/notification worker mid-delivery must
+        # not hit a shut-down executor (and their threads must not
+        # outlive the server: the leak harness counts them).
+        if self.site is not None:
+            self.site.stop()
+        if self.replicator is not None:
+            self.replicator.stop()
+        if self.notifier is not None:
+            stop = getattr(self.notifier, "stop", None)
+            if stop is not None:
+                stop()
+        if self.batch is not None:
+            self.batch.shutdown()
+        close = getattr(self.object_layer, "close", None)
+        if close is not None:
+            close()
 
 
 def _make_handler(server: S3Server):

@@ -188,3 +188,24 @@ def test_select_streaming_matches_buffered():
             yield data[off:off + 7]
 
     assert run_select(chunks(), req) == whole
+
+
+def test_select_parquet():
+    pa = pytest.importorskip("pyarrow")
+    import io as _io
+    import pyarrow.parquet as pq
+    table = pa.table({"name": ["ada", "bob", "cara", None],
+                      "dept": ["eng", "sales", "eng", "eng"],
+                      "salary": [120, 90, 130, 50]})
+    buf = _io.BytesIO()
+    pq.write_table(table, buf)
+    req = (b"<SelectObjectContentRequest>"
+           b"<Expression>SELECT name FROM s3object WHERE dept = 'eng' "
+           b"AND salary &gt; 100</Expression>"
+           b"<ExpressionType>SQL</ExpressionType>"
+           b"<InputSerialization><Parquet/></InputSerialization>"
+           b"<OutputSerialization><CSV/></OutputSerialization>"
+           b"</SelectObjectContentRequest>")
+    resp = run_select(buf.getvalue(), req)
+    rows = _records(resp).decode().strip().splitlines()
+    assert rows == ["ada", "cara"]
