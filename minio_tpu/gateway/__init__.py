@@ -1,0 +1,4 @@
+"""Protocol gateways re-exposing the object layer (FTP; the reference
+also ships SFTP, which needs an SSH stack this image doesn't carry)."""
+
+from minio_tpu.gateway.ftp import FTPGateway  # noqa: F401

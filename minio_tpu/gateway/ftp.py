@@ -1,0 +1,406 @@
+"""FTP gateway: the object namespace over RFC 959.
+
+The analogue of the reference's FTP server (cmd/ftp-server.go, which
+wraps an FTP library around the object layer): implemented from the
+socket up — control-connection command loop, passive-mode data
+connections, and the object-layer bridge. The namespace maps the S3
+world the way the reference does: the root directory lists buckets,
+`/bucket/key...` paths are objects.
+
+Supported: USER/PASS (verified against the same credential resolver
+the S3 API uses, with per-command IAM authorization), SYST, FEAT,
+TYPE, PWD, CWD/CDUP, PASV/EPSV, LIST/NLST, RETR, STOR, DELE, SIZE,
+MKD, RMD, NOOP, QUIT. Transfers are binary; active mode (PORT) is not
+offered (NATs broke it decades ago; the reference's library also
+prefers passive).
+"""
+
+from __future__ import annotations
+
+import posixpath
+import socket
+import socketserver
+import threading
+from typing import Optional
+
+
+# STOR buffers in memory (FTP sends no size upfront, and the object
+# layer's streaming path needs one); cap it hard. Large uploads belong
+# on the S3 API, which streams in O(window).
+STOR_MAX_BYTES = 512 * 1024 * 1024
+
+
+class FTPGateway:
+    """FTP server bridging to an object layer + credential resolver."""
+
+    def __init__(self, object_layer, credentials,
+                 address: str = "127.0.0.1:0",
+                 passive_host: Optional[str] = None):
+        self.object_layer = object_layer
+        self.credentials = credentials
+        host, _, port = address.rpartition(":")
+        gateway = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                _Session(gateway, self).run()
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self.server = Server((host or "127.0.0.1", int(port)), Handler)
+        self.passive_host = passive_host or self.server.server_address[0]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        h, p = self.server.server_address[:2]
+        return f"{h}:{p}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True, name="ftp-gateway")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+
+class _Session:
+    """One control connection."""
+
+    def __init__(self, gw: FTPGateway, rh):
+        self.gw = gw
+        self.rh = rh
+        self.user = ""
+        self.authed = False
+        self.cwd = "/"
+        self.type = "I"
+        self._pasv: Optional[socket.socket] = None
+
+    # -- plumbing --------------------------------------------------------
+
+    def send(self, line: str) -> None:
+        self.rh.wfile.write((line + "\r\n").encode())
+
+    def run(self) -> None:
+        self.send("220 minio-tpu FTP gateway ready")
+        try:
+            while True:
+                raw = self.rh.rfile.readline()
+                if not raw:
+                    return
+                line = raw.decode("utf-8", "replace").rstrip("\r\n")
+                if not line:
+                    continue
+                cmd, _, arg = line.partition(" ")
+                cmd = cmd.upper()
+                handler = getattr(self, f"cmd_{cmd.lower()}", None)
+                try:
+                    if handler is None:
+                        self.send("502 command not implemented")
+                    elif cmd in ("USER", "PASS", "QUIT", "SYST", "FEAT",
+                                 "NOOP") or self.authed:
+                        if handler(arg) is False:
+                            return
+                    else:
+                        self.send("530 please login with USER and PASS")
+                except _FTPError as e:
+                    self.send(str(e))
+                except Exception as e:  # noqa: BLE001 - session survives
+                    self.send(f"451 local error: {e}")
+        finally:
+            self._close_pasv()
+
+    def _close_pasv(self) -> None:
+        if self._pasv is not None:
+            try:
+                self._pasv.close()
+            except OSError:
+                pass
+            self._pasv = None
+
+    def _data_conn(self) -> socket.socket:
+        if self._pasv is None:
+            raise _FTPError("425 use PASV first")
+        listener, self._pasv = self._pasv, None
+        listener.settimeout(30)
+        try:
+            conn, _ = listener.accept()
+            return conn
+        finally:
+            listener.close()
+
+    # -- namespace helpers ----------------------------------------------
+
+    def _resolve(self, arg: str) -> str:
+        path = arg if arg.startswith("/") else \
+            posixpath.join(self.cwd, arg)
+        path = posixpath.normpath(path)
+        if path in (".", "/"):
+            return "/"
+        # normpath never leaves a trailing slash; reject escapes.
+        if ".." in path.split("/"):
+            raise _FTPError("550 bad path")
+        return path
+
+    def _split(self, path: str) -> tuple[str, str]:
+        parts = path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = parts[1] if len(parts) > 1 else ""
+        return bucket, key
+
+    def _allowed(self, action: str, resource: str) -> None:
+        if not self.gw.credentials.is_allowed(self.user, action, resource):
+            raise _FTPError("550 permission denied")
+
+    # -- auth ------------------------------------------------------------
+
+    def cmd_user(self, arg):
+        self.user = arg.strip()
+        self.send("331 password required")
+
+    def cmd_pass(self, arg):
+        secret = self.gw.credentials.secret_for(self.user)
+        if secret is None or secret != arg:
+            self.authed = False
+            self.send("530 login incorrect")
+            return
+        self.authed = True
+        self.send("230 login successful")
+
+    def cmd_quit(self, arg):
+        self.send("221 goodbye")
+        return False
+
+    # -- session state ---------------------------------------------------
+
+    def cmd_syst(self, arg):
+        self.send("215 UNIX Type: L8")
+
+    def cmd_feat(self, arg):
+        self.rh.wfile.write(b"211-features\r\n SIZE\r\n EPSV\r\n"
+                            b" UTF8\r\n211 end\r\n")
+
+    def cmd_noop(self, arg):
+        self.send("200 ok")
+
+    def cmd_type(self, arg):
+        self.type = (arg or "I").upper()
+        self.send("200 type set")
+
+    def cmd_pwd(self, arg):
+        self.send(f'257 "{self.cwd}"')
+
+    def cmd_cwd(self, arg):
+        path = self._resolve(arg)
+        if path != "/":
+            bucket, key = self._split(path)
+            try:
+                self.gw.object_layer.get_bucket_info(bucket)
+            except Exception:  # noqa: BLE001 - absent bucket
+                raise _FTPError("550 no such directory") from None
+        self.cwd = path
+        self.send("250 directory changed")
+
+    def cmd_cdup(self, arg):
+        self.cwd = posixpath.dirname(self.cwd) or "/"
+        self.send("250 directory changed")
+
+    # -- passive data ----------------------------------------------------
+
+    def _open_pasv(self) -> tuple[str, int]:
+        self._close_pasv()
+        # Advertise the address the CLIENT reached us on (the control
+        # connection's local interface): a 0.0.0.0 bind must never be
+        # advertised — it is unconnectable. An explicit passive_host
+        # override (NAT) wins.
+        ctl_host = self.rh.connection.getsockname()[0]
+        bind_host = self.gw.server.server_address[0]
+        s = socket.socket()
+        s.bind((bind_host, 0))
+        s.listen(1)
+        self._pasv = s
+        host = self.gw.passive_host
+        if host in ("0.0.0.0", "", "::"):
+            host = ctl_host
+        return host, s.getsockname()[1]
+
+    def cmd_pasv(self, arg):
+        host, port = self._open_pasv()
+        h = host.replace(".", ",")
+        self.send(f"227 entering passive mode "
+                  f"({h},{port >> 8},{port & 0xFF})")
+
+    def cmd_epsv(self, arg):
+        _, port = self._open_pasv()
+        self.send(f"229 entering extended passive mode (|||{port}|)")
+
+    # -- listings --------------------------------------------------------
+
+    def _entries(self, path: str):
+        """(name, is_dir, size) entries for `path`."""
+        ol = self.gw.object_layer
+        if path == "/":
+            self._allowed("s3:ListAllMyBuckets", "*")
+            return [(b.name, True, 0) for b in ol.list_buckets()]
+        bucket, key = self._split(path)
+        self._allowed("s3:ListBucket", bucket)
+        prefix = key + "/" if key else ""
+        out = []
+        marker = ""
+        # Follow pagination: a truncation-blind listing would make FTP
+        # sync tools conclude objects past entry 1000 don't exist.
+        # Bounded at 100k entries per listing as an abuse stop.
+        while len(out) < 100_000:
+            page = ol.list_objects(bucket, prefix=prefix, delimiter="/",
+                                   marker=marker, max_keys=1000)
+            for p in page.prefixes:
+                out.append((p[len(prefix):].rstrip("/"), True, 0))
+            for o in page.objects:
+                out.append((o.name[len(prefix):], False, o.size))
+            if not page.is_truncated:
+                break
+            marker = page.next_marker
+        return out
+
+    @staticmethod
+    def _strip_flags(arg: str) -> str:
+        """Drop leading `-x` option words ('LIST -al path'); a plain
+        lstrip over a character set would eat path letters."""
+        words = arg.split()
+        while words and words[0].startswith("-"):
+            words.pop(0)
+        return " ".join(words)
+
+    def cmd_list(self, arg):
+        path = self._resolve(self._strip_flags(arg))
+        entries = self._entries(path)
+        conn = self._data_conn()
+        self.send("150 listing")
+        try:
+            for name, is_dir, size in entries:
+                kind = "d" if is_dir else "-"
+                conn.sendall(
+                    f"{kind}rw-r--r-- 1 s3 s3 {size:>12} Jan  1 00:00 "
+                    f"{name}\r\n".encode())
+        finally:
+            conn.close()
+        self.send("226 done")
+
+    def cmd_nlst(self, arg):
+        path = self._resolve(arg)
+        entries = self._entries(path)
+        conn = self._data_conn()
+        self.send("150 listing")
+        try:
+            for name, _, _ in entries:
+                conn.sendall((name + "\r\n").encode())
+        finally:
+            conn.close()
+        self.send("226 done")
+
+    # -- transfers -------------------------------------------------------
+
+    def cmd_retr(self, arg):
+        from minio_tpu.object.types import GetOptions
+        bucket, key = self._split(self._resolve(arg))
+        if not key:
+            raise _FTPError("550 not a file")
+        self._allowed("s3:GetObject", f"{bucket}/{key}")
+        try:
+            _, chunks = self.gw.object_layer.get_object_stream(
+                bucket, key, GetOptions())
+        except Exception:  # noqa: BLE001 - absent object
+            raise _FTPError("550 no such file") from None
+        conn = self._data_conn()
+        self.send("150 opening data connection")
+        try:
+            for chunk in chunks:
+                conn.sendall(chunk)
+        finally:
+            conn.close()
+        self.send("226 transfer complete")
+
+    def cmd_stor(self, arg):
+        from minio_tpu.object.types import PutOptions
+        bucket, key = self._split(self._resolve(arg))
+        if not key:
+            raise _FTPError("550 not a file")
+        self._allowed("s3:PutObject", f"{bucket}/{key}")
+        conn = self._data_conn()
+        self.send("150 ready for data")
+        chunks = []
+        total = 0
+        try:
+            while True:
+                b = conn.recv(1 << 16)
+                if not b:
+                    break
+                total += len(b)
+                if total > STOR_MAX_BYTES:
+                    raise _FTPError("552 upload exceeds the FTP "
+                                    "gateway's size limit (use the S3 "
+                                    "API for large objects)")
+                chunks.append(b)
+        finally:
+            conn.close()
+        versioned = bool(self.gw.object_layer.get_bucket_meta(bucket)
+                         .get("versioning"))
+        self.gw.object_layer.put_object(bucket, key, b"".join(chunks),
+                                        PutOptions(versioned=versioned))
+        self.send("226 transfer complete")
+
+    def cmd_dele(self, arg):
+        from minio_tpu.object.types import DeleteOptions
+        bucket, key = self._split(self._resolve(arg))
+        if not key:
+            raise _FTPError("550 not a file")
+        self._allowed("s3:DeleteObject", f"{bucket}/{key}")
+        versioned = bool(self.gw.object_layer.get_bucket_meta(bucket)
+                         .get("versioning"))
+        self.gw.object_layer.delete_object(
+            bucket, key, DeleteOptions(versioned=versioned))
+        self.send("250 deleted")
+
+    def cmd_size(self, arg):
+        from minio_tpu.object.types import GetOptions
+        bucket, key = self._split(self._resolve(arg))
+        if not key:
+            raise _FTPError("550 not a file")
+        self._allowed("s3:GetObject", f"{bucket}/{key}")
+        try:
+            info = self.gw.object_layer.get_object_info(bucket, key,
+                                                        GetOptions())
+        except Exception:  # noqa: BLE001 - absent object
+            raise _FTPError("550 no such file") from None
+        self.send(f"213 {info.size}")
+
+    def cmd_mkd(self, arg):
+        path = self._resolve(arg)
+        bucket, key = self._split(path)
+        if key:
+            # Keys are created implicitly by STOR; directories within a
+            # bucket need no materialization in an object namespace.
+            self.send(f'257 "{path}"')
+            return
+        self._allowed("s3:CreateBucket", bucket)
+        self.gw.object_layer.make_bucket(bucket)
+        self.send(f'257 "{path}"')
+
+    def cmd_rmd(self, arg):
+        bucket, key = self._split(self._resolve(arg))
+        if key:
+            raise _FTPError("550 only buckets can be removed")
+        self._allowed("s3:DeleteBucket", bucket)
+        try:
+            self.gw.object_layer.delete_bucket(bucket)
+        except Exception as e:  # noqa: BLE001 - not empty / absent
+            raise _FTPError(f"550 {e}") from None
+        self.send("250 removed")
+
+
+class _FTPError(Exception):
+    """str(self) is the full FTP response line."""

@@ -145,6 +145,9 @@ class S3Server:
         # Site replicator (replication/site.SiteReplicator); None until
         # sites are registered.
         self.site = None
+        # In-flight request count (stop() drains to zero before
+        # closing the layer).
+        self._inflight = 0
 
     @property
     def address(self) -> str:
@@ -159,11 +162,14 @@ class S3Server:
     def stop(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
-        # Drain in-flight handler threads before tearing down anything
-        # they use (shutdown() only stops the accept loop; an accepted
-        # large PUT must finish cleanly, not 500 on a closed executor).
-        for t in list(getattr(self.httpd, "_threads", None) or []):
-            t.join(timeout=10)
+        # Drain in-flight requests before tearing down anything they
+        # use (shutdown() only stops the accept loop; an accepted large
+        # PUT must finish cleanly, not 500 on a closed executor).
+        # Counted explicitly: socketserver does NOT track daemon
+        # handler threads (_Threads.append returns early for them).
+        deadline = _time_mod.monotonic() + 10
+        while self._inflight > 0 and _time_mod.monotonic() < deadline:
+            _time_mod.sleep(0.05)
         # Workers that consume the object layer stop BEFORE the layer
         # closes — a replication/notification worker mid-delivery must
         # not hit a shut-down executor (and their threads must not
@@ -412,9 +418,11 @@ def _make_handler(server: S3Server):
             self._sent_bytes = 0
             self._auth_key = ""
             t0 = _time_mod.perf_counter()
+            server._inflight += 1
             try:
                 self._route_inner(method, raw_path, query, bucket, key)
             finally:
+                server._inflight -= 1
                 try:
                     rx = int(self.headers.get("Content-Length") or 0)
                 except ValueError:

@@ -59,6 +59,9 @@ def main(argv=None) -> int:
     ap.add_argument("--compression", action="store_true",
                     help="transparently compress eligible objects "
                          "(text-like extensions/content types)")
+    ap.add_argument("--ftp-address", default="",
+                    help="also serve the namespace over FTP at "
+                         "host:port (reference: --ftp)")
     ap.add_argument("drives", nargs="+",
                     help="drive dirs or http://host:port/path endpoints; "
                          "`{1...N}` ellipses expand, and each ellipses "
@@ -367,6 +370,12 @@ def main(argv=None) -> int:
         srv.notifier = EventNotifier(
             layer, store,
             targets=[WebhookTarget("webhook", args.notify_webhook)])
+    ftp = None
+    if args.ftp_address:
+        from minio_tpu.gateway import FTPGateway
+        ftp = FTPGateway(layer, creds, address=args.ftp_address)
+        ftp.start()
+        print(f"minio-tpu serving FTP on {ftp.address}", flush=True)
     print(f"minio-tpu serving S3 on {srv.address} "
           f"({len(pools)} pools, {n_sets} sets, {n_drives} drives, "
           f"{'distributed, ' if distributed else ''}"
@@ -377,6 +386,10 @@ def main(argv=None) -> int:
             time.sleep(3600)
     except KeyboardInterrupt:
         scanner.stop()
+        if ftp is not None:
+            # Gateways stop BEFORE the S3 server closes the object
+            # layer (their in-flight transfers use it).
+            ftp.stop()
         srv.stop()
         if grid_srv is not None:
             grid_srv.stop()

@@ -1,0 +1,99 @@
+"""FTP gateway over the object layer, driven by the STDLIB ftplib
+client — a real external FTP implementation, not a hand-rolled peer
+(reference: cmd/ftp-server.go)."""
+
+import ftplib
+import io
+import os
+
+import pytest
+
+from minio_tpu.gateway import FTPGateway
+from minio_tpu.iam import IAMSys
+from minio_tpu.object.erasure_object import ErasureSet
+from minio_tpu.s3.server import Credentials
+from minio_tpu.storage.local import LocalStorage
+
+
+@pytest.fixture(scope="module")
+def gw(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("ftpdrv")
+    disks = [LocalStorage(str(tmp / f"d{i}")) for i in range(4)]
+    es = ErasureSet(disks)
+    creds = Credentials("minioadmin", "minioadmin")
+    creds.iam = IAMSys([es], "minioadmin", "minioadmin")
+    creds.iam.add_user("reader", "readersecret")
+    creds.iam.attach_policy("reader", ["readonly"])
+    g = FTPGateway(es, creds, address="127.0.0.1:0")
+    g.start()
+    yield g
+    g.stop()
+    es.close()
+
+
+def _client(gw, user="minioadmin", pw="minioadmin"):
+    host, _, port = gw.address.rpartition(":")
+    c = ftplib.FTP()
+    c.connect(host, int(port), timeout=15)
+    c.login(user, pw)
+    return c
+
+def test_login_and_bad_credentials(gw):
+    c = _client(gw)
+    assert "UNIX" in c.sendcmd("SYST")
+    c.quit()
+    with pytest.raises(ftplib.error_perm):
+        _client(gw, pw="wrong")
+
+
+def test_full_file_lifecycle(gw):
+    c = _client(gw)
+    c.mkd("/ftpbkt")
+    assert "ftpbkt" in c.nlst("/")
+    body = os.urandom(300_000)
+    c.storbinary("STOR /ftpbkt/dir/file.bin", io.BytesIO(body))
+    # Listing with directories (common prefixes) and files.
+    assert c.nlst("/ftpbkt") == ["dir"]
+    c.cwd("/ftpbkt/dir")
+    assert c.pwd() == "/ftpbkt/dir"
+    assert c.nlst() == ["file.bin"]
+    assert c.size("/ftpbkt/dir/file.bin") == len(body)
+    out = io.BytesIO()
+    c.retrbinary("RETR /ftpbkt/dir/file.bin", out.write)
+    assert out.getvalue() == body
+    # LIST format parses as a directory listing.
+    lines = []
+    c.retrlines("LIST /ftpbkt/dir", lines.append)
+    assert any("file.bin" in ln for ln in lines)
+    c.delete("/ftpbkt/dir/file.bin")
+    with pytest.raises(ftplib.error_perm):
+        c.size("/ftpbkt/dir/file.bin")
+    c.rmd("/ftpbkt")
+    assert "ftpbkt" not in c.nlst("/")
+    c.quit()
+
+
+def test_iam_enforced_over_ftp(gw):
+    root = _client(gw)
+    root.mkd("/ftpauth")
+    root.storbinary("STOR /ftpauth/doc", io.BytesIO(b"ftp data"))
+    reader = _client(gw, user="reader", pw="readersecret")
+    out = io.BytesIO()
+    reader.retrbinary("RETR /ftpauth/doc", out.write)
+    assert out.getvalue() == b"ftp data"
+    # readonly: no writes, no deletes, no bucket removal.
+    with pytest.raises(ftplib.error_perm):
+        reader.storbinary("STOR /ftpauth/nope", io.BytesIO(b"x"))
+    with pytest.raises(ftplib.error_perm):
+        reader.delete("/ftpauth/doc")
+    with pytest.raises(ftplib.error_perm):
+        reader.rmd("/ftpauth")
+    reader.quit()
+    root.quit()
+
+
+def test_path_escape_rejected(gw):
+    c = _client(gw)
+    with pytest.raises(ftplib.error_perm):
+        c.size("/../etc/passwd")
+    c.quit()
