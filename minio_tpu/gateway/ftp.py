@@ -43,7 +43,13 @@ class FTPGateway:
 
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
-                _Session(gateway, self).run()
+                with gateway._sessions_mu:
+                    gateway._sessions += 1
+                try:
+                    _Session(gateway, self).run()
+                finally:
+                    with gateway._sessions_mu:
+                        gateway._sessions -= 1
 
         class Server(socketserver.ThreadingTCPServer):
             daemon_threads = True
@@ -52,6 +58,8 @@ class FTPGateway:
         self.server = Server((host or "127.0.0.1", int(port)), Handler)
         self.passive_host = passive_host or self.server.server_address[0]
         self._thread: Optional[threading.Thread] = None
+        self._sessions = 0
+        self._sessions_mu = threading.Lock()
 
     @property
     def address(self) -> str:
@@ -64,8 +72,15 @@ class FTPGateway:
         self._thread.start()
 
     def stop(self) -> None:
+        """Stop accepting, then drain in-flight sessions briefly — the
+        caller closes the object layer next, and an active transfer
+        must not hit a shut-down executor."""
+        import time as _t
         self.server.shutdown()
         self.server.server_close()
+        deadline = _t.monotonic() + 10
+        while self._sessions > 0 and _t.monotonic() < deadline:
+            _t.sleep(0.05)
 
 
 class _Session:
@@ -129,6 +144,10 @@ class _Session:
         listener.settimeout(30)
         try:
             conn, _ = listener.accept()
+            # Accepted sockets do NOT inherit the listener's timeout:
+            # without one, a silent client pins this session thread
+            # (and any buffered upload bytes) forever.
+            conn.settimeout(120)
             return conn
         finally:
             listener.close()
@@ -138,12 +157,13 @@ class _Session:
     def _resolve(self, arg: str) -> str:
         path = arg if arg.startswith("/") else \
             posixpath.join(self.cwd, arg)
+        # normpath on an ABSOLUTE path resolves every ".." within the
+        # virtual root — "/../etc" becomes "/etc", i.e. bucket "etc".
+        # Nothing here ever touches the host filesystem; paths only
+        # ever name buckets and keys.
         path = posixpath.normpath(path)
         if path in (".", "/"):
             return "/"
-        # normpath never leaves a trailing slash; reject escapes.
-        if ".." in path.split("/"):
-            raise _FTPError("550 bad path")
         return path
 
     def _split(self, path: str) -> tuple[str, str]:
@@ -159,7 +179,11 @@ class _Session:
     # -- auth ------------------------------------------------------------
 
     def cmd_user(self, arg):
+        # Switching users DE-authenticates: keeping authed=True here
+        # would let any logged-in session assume root by sending
+        # "USER minioadmin" with no password.
         self.user = arg.strip()
+        self.authed = False
         self.send("331 password required")
 
     def cmd_pass(self, arg):

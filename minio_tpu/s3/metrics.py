@@ -36,7 +36,7 @@ class Metrics:
 
     # -- rendering -------------------------------------------------------
 
-    def render(self, object_layer=None, scanner=None) -> str:
+    def render(self, object_layer=None, scanner=None, server=None) -> str:
         lines: list[str] = []
 
         def metric(name, help_, type_, samples):
@@ -117,6 +117,67 @@ class Metrics:
             metric("minio_tpu_capacity_raw_free_bytes",
                    "Raw free capacity across online drives", "gauge",
                    [({}, free_cap)])
+            # Metacache effectiveness across the layer's sets.
+            hits = misses = 0
+            for s in layer_sets(object_layer):
+                mc = getattr(s, "metacache", None)
+                if mc is not None:
+                    hits += mc.hits
+                    misses += mc.misses
+            metric("minio_tpu_metacache_hits_total",
+                   "Listing pages served from cache", "counter",
+                   [({}, hits)])
+            metric("minio_tpu_metacache_misses_total",
+                   "Listing pages that required a drive walk", "counter",
+                   [({}, misses)])
+
+        if server is not None:
+            repl = getattr(server, "replicator", None)
+            if repl is not None:
+                metric("minio_tpu_replication_queued_total",
+                       "Bucket-replication tasks enqueued", "counter",
+                       [({}, repl.queued)])
+                metric("minio_tpu_replication_completed_total",
+                       "Bucket-replication tasks delivered", "counter",
+                       [({}, repl.completed)])
+                metric("minio_tpu_replication_failed_total",
+                       "Bucket-replication tasks failed", "counter",
+                       [({}, repl.failed)])
+            site = getattr(server, "site", None)
+            if site is not None:
+                metric("minio_tpu_site_replication_queued_total",
+                       "Site-replication tasks enqueued", "counter",
+                       [({}, site.queued)])
+                metric("minio_tpu_site_replication_completed_total",
+                       "Site-replication tasks delivered", "counter",
+                       [({}, site.completed)])
+                metric("minio_tpu_site_replication_failed_total",
+                       "Site-replication tasks failed", "counter",
+                       [({}, site.failed)])
+            batch = getattr(server, "batch", None)
+            if batch is not None:
+                jobs = batch.list_jobs()
+                by_status: dict[str, int] = {}
+                for j in jobs:
+                    st = j.get("status", "unknown")
+                    by_status[st] = by_status.get(st, 0) + 1
+                metric("minio_tpu_batch_jobs",
+                       "Batch jobs by status", "gauge",
+                       [({"status": s2}, v)
+                        for s2, v in sorted(by_status.items())])
+            decom_status = getattr(server.object_layer,
+                                   "decommission_status", None) \
+                if getattr(server, "object_layer", None) is not None \
+                else None
+            if decom_status is not None:
+                st = decom_status()
+                if st:
+                    metric("minio_tpu_decommission_migrated_total",
+                           "Objects migrated by the active/last drain",
+                           "counter", [({}, st.get("migrated", 0))])
+                    metric("minio_tpu_decommission_failed_total",
+                           "Objects the drain failed to migrate",
+                           "counter", [({}, st.get("failed", 0))])
 
         return "\n".join(lines) + "\n"
 

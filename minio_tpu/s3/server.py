@@ -146,8 +146,11 @@ class S3Server:
         # sites are registered.
         self.site = None
         # In-flight request count (stop() drains to zero before
-        # closing the layer).
+        # closing the layer). Guarded: bare += across handler threads
+        # can lose updates and either close the layer under a live
+        # request or burn the full drain deadline.
         self._inflight = 0
+        self._inflight_mu = threading.Lock()
 
     @property
     def address(self) -> str:
@@ -418,11 +421,13 @@ def _make_handler(server: S3Server):
             self._sent_bytes = 0
             self._auth_key = ""
             t0 = _time_mod.perf_counter()
-            server._inflight += 1
+            with server._inflight_mu:
+                server._inflight += 1
             try:
                 self._route_inner(method, raw_path, query, bucket, key)
             finally:
-                server._inflight -= 1
+                with server._inflight_mu:
+                    server._inflight -= 1
                 try:
                     rx = int(self.headers.get("Content-Length") or 0)
                 except ValueError:
@@ -456,7 +461,8 @@ def _make_handler(server: S3Server):
                     text = server.metrics.render(
                         object_layer=server.object_layer,
                         scanner=getattr(server.object_layer, "scanner",
-                                        None))
+                                        None),
+                        server=server)
                     return self._send(200, text.encode(),
                                       content_type="text/plain; "
                                       "version=0.0.4")
@@ -758,6 +764,8 @@ def _make_handler(server: S3Server):
                                                body)
             if "object-lock" in query:
                 return self._object_lock_config(method, bucket, body)
+            if "acl" in query:
+                return self._acl(method, bucket, "", body)
             if method == "PUT":
                 if "versioning" in query:
                     return self._put_versioning(bucket, body)
@@ -809,6 +817,49 @@ def _make_handler(server: S3Server):
             from minio_tpu.object import objectlock as olock
             return server.object_layer.get_bucket_meta(bucket).get(
                 olock.BUCKET_META_KEY) or {}
+
+        def _acl(self, method, bucket, key, body):
+            """GET/PUT ?acl — the MinIO-parity ACL surface (reference:
+            cmd/acl-handlers.go): ACLs are a legacy AWS mechanism; only
+            'private' exists, GET always answers the owner's
+            FULL_CONTROL, and any attempt to grant something else is
+            refused (policies are the real authorization surface)."""
+            if not key:
+                server.object_layer.get_bucket_info(bucket)
+            if method == "GET":
+                root = ET.Element("AccessControlPolicy", xmlns=XMLNS)
+                owner = _el(root, "Owner")
+                _el(owner, "ID", "minio-tpu")
+                _el(owner, "DisplayName", "minio-tpu")
+                grants = _el(root, "AccessControlList")
+                g = _el(grants, "Grant")
+                grantee = _el(g, "Grantee")
+                grantee.set("xmlns:xsi",
+                            "http://www.w3.org/2001/XMLSchema-instance")
+                grantee.set("xsi:type", "CanonicalUser")
+                _el(grantee, "ID", "minio-tpu")
+                _el(g, "Permission", "FULL_CONTROL")
+                return self._send(200, _xml(root))
+            if method != "PUT":
+                raise S3Error("MethodNotAllowed")
+            h = self._headers_lower()
+            canned = h.get("x-amz-acl", "")
+            if canned and canned != "private":
+                raise S3Error("NotImplemented",
+                              "only the 'private' canned ACL exists; "
+                              "use bucket policies")
+            if body:
+                try:
+                    root = ET.fromstring(body)
+                except ET.ParseError:
+                    raise S3Error("MalformedACLError") from None
+                perms = [e.text for e in root.iter()
+                         if e.tag.endswith("Permission")]
+                if any(p != "FULL_CONTROL" for p in perms):
+                    raise S3Error("NotImplemented",
+                                  "only FULL_CONTROL grants exist; use "
+                                  "bucket policies")
+            return self._send(200)
 
         def _object_lock_config(self, method, bucket, body):
             """GET/PUT ?object-lock (reference: cmd/bucket-handlers.go
@@ -1070,6 +1121,14 @@ def _make_handler(server: S3Server):
             if "tagging" in query:
                 return self._object_tagging(method, bucket, key, query,
                                             payload)
+            if "acl" in query:
+                body_acl = payload.read_all() if method == "PUT" and \
+                    payload is not None else b""
+                server.object_layer.get_object_info(
+                    bucket, key,
+                    GetOptions(version_id=query.get("versionId",
+                                                    [""])[0]))
+                return self._acl(method, bucket, key, body_acl)
             if "retention" in query:
                 return self._object_retention(method, bucket, key, query,
                                               payload)
@@ -2824,6 +2883,9 @@ def _required_permissions(method: str, bucket: str, key: str, query: dict,
         if "object-lock" in query:
             verb = "Put" if method == "PUT" else "Get"
             return [(f"s3:{verb}BucketObjectLockConfiguration", bucket)]
+        if "acl" in query:
+            verb = "Put" if method == "PUT" else "Get"
+            return [(f"s3:{verb}BucketAcl", bucket)]
         if method == "PUT":
             perms.append(("s3:PutBucketVersioning", bucket)
                          if "versioning" in query
@@ -2855,6 +2917,9 @@ def _required_permissions(method: str, bucket: str, key: str, query: dict,
             method, "Get")
         perms.append((f"s3:{verb}ObjectTagging", res))
         return perms
+    if "acl" in query:
+        verb = "Put" if method == "PUT" else "Get"
+        return [(f"s3:{verb}ObjectAcl", res)]
     if "retention" in query:
         verb = "Put" if method == "PUT" else "Get"
         return [(f"s3:{verb}ObjectRetention", res)]

@@ -92,8 +92,25 @@ def test_iam_enforced_over_ftp(gw):
     root.quit()
 
 
-def test_path_escape_rejected(gw):
+def test_path_escape_confined_to_namespace(gw):
+    """`..` segments normalize WITHIN the virtual root: /../etc/passwd
+    names bucket 'etc', key 'passwd' — never the host filesystem — and
+    a missing bucket answers 550."""
     c = _client(gw)
     with pytest.raises(ftplib.error_perm):
         c.size("/../etc/passwd")
+    # CWD above the root clamps to the root.
+    c.cwd("/")
+    c.sendcmd("CDUP")
+    assert c.pwd() == "/"
+    c.quit()
+
+
+def test_user_switch_deauthenticates(gw):
+    """Regression: USER after login must drop authentication — a
+    reader could otherwise become root by naming it without PASS."""
+    c = _client(gw, user="reader", pw="readersecret")
+    c.sendcmd("USER minioadmin")          # 331, not logged in
+    with pytest.raises(ftplib.error_perm):
+        c.mkd("/escalated")               # 530 until PASS succeeds
     c.quit()

@@ -351,3 +351,32 @@ def test_keep_alive_connection_reuse(srv):
             assert st == 200 and got == f"ka-{i}".encode() * 100
     finally:
         conn.close()
+
+
+def test_acl_surface(cli):
+    """MinIO-parity ACLs (reference: cmd/acl-handlers.go): GET always
+    answers the owner's FULL_CONTROL; only 'private' can be PUT;
+    everything else points at bucket policies."""
+    assert cli.request("PUT", "/aclbkt")[0] == 200
+    assert cli.request("PUT", "/aclbkt/obj", body=b"a")[0] == 200
+    for path in ("/aclbkt", "/aclbkt/obj"):
+        st, _, b = cli.request("GET", path, query={"acl": ""})
+        assert st == 200 and b"FULL_CONTROL" in b and b"Owner" in b
+    # Canned private is accepted; anything else refused.
+    assert cli.request("PUT", "/aclbkt", query={"acl": ""},
+                       headers={"x-amz-acl": "private"})[0] == 200
+    st, _, b = cli.request("PUT", "/aclbkt", query={"acl": ""},
+                           headers={"x-amz-acl": "public-read"})
+    assert st == 501, b
+    st, _, b = cli.request("PUT", "/aclbkt/obj", query={"acl": ""},
+                           headers={"x-amz-acl": "public-read"})
+    assert st == 501, b
+    # A grant body naming anything beyond FULL_CONTROL is refused.
+    bad = (b'<AccessControlPolicy><AccessControlList><Grant>'
+           b'<Permission>READ</Permission></Grant>'
+           b'</AccessControlList></AccessControlPolicy>')
+    st, _, b = cli.request("PUT", "/aclbkt", query={"acl": ""}, body=bad)
+    assert st == 501, b
+    # ACL of a missing object is a 404, not an empty grant set.
+    st, _, _ = cli.request("GET", "/aclbkt/ghost", query={"acl": ""})
+    assert st == 404
