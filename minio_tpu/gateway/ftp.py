@@ -43,8 +43,6 @@ class FTPGateway:
 
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
-                with gateway._sessions_mu:
-                    gateway._sessions += 1
                 try:
                     _Session(gateway, self).run()
                 finally:
@@ -54,6 +52,19 @@ class FTPGateway:
         class Server(socketserver.ThreadingTCPServer):
             daemon_threads = True
             allow_reuse_address = True
+
+            def process_request(self, request, client_address):
+                # Count in the ACCEPT path, not the handler thread:
+                # stop()'s drain must never observe zero while an
+                # accepted connection's handler is still unscheduled.
+                with gateway._sessions_mu:
+                    gateway._sessions += 1
+                try:
+                    super().process_request(request, client_address)
+                except Exception:
+                    with gateway._sessions_mu:
+                        gateway._sessions -= 1
+                    raise
 
         self.server = Server((host or "127.0.0.1", int(port)), Handler)
         self.passive_host = passive_host or self.server.server_address[0]

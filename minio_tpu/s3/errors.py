@@ -53,6 +53,7 @@ _CATALOG = {
     "InvalidBucketState": (409, "The request is not valid with the current state of the bucket."),
     "NoSuchObjectLockConfiguration": (404, "The specified object does not have an ObjectLock configuration."),
     "MalformedACLError": (400, "The ACL that you provided was not well formed or did not validate against our published schema."),
+    "XAmzContentChecksumMismatch": (400, "The provided checksum does not match the computed checksum."),
     "InvalidRetentionDate": (400, "Date must be provided in ISO 8601 format."),
 }
 

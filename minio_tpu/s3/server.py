@@ -818,6 +818,50 @@ def _make_handler(server: S3Server):
             return server.object_layer.get_bucket_meta(bucket).get(
                 olock.BUCKET_META_KEY) or {}
 
+        def _object_attributes(self, bucket, key, query):
+            """GET ?attributes — GetObjectAttributes (reference:
+            cmd/object-handlers.go GetObjectAttributesHandler): the
+            caller names the attributes it wants in
+            x-amz-object-attributes."""
+            h = self._headers_lower()
+            wanted = {w.strip() for w in
+                      h.get("x-amz-object-attributes", "").split(",")
+                      if w.strip()}
+            if not wanted:
+                raise S3Error("InvalidArgument",
+                              "x-amz-object-attributes is required")
+            vid = query.get("versionId", [""])[0]
+            info = server.object_layer.get_object_info(
+                bucket, key, GetOptions(version_id=vid))
+            root = ET.Element("GetObjectAttributesOutput", xmlns=XMLNS)
+            if "ETag" in wanted:
+                _el(root, "ETag", info.etag)
+            if "Checksum" in wanted:
+                from minio_tpu.s3 import checksum as ck
+                stored = ck.response_headers(info.internal_metadata)
+                if stored:
+                    ce = _el(root, "Checksum")
+                    for hname, v in stored.items():
+                        algo = hname[len(ck.H_PREFIX):]
+                        _el(ce, f"Checksum{algo.upper()}", v)
+            if "ObjectParts" in wanted and info.parts and \
+                    len(info.parts) > 1:
+                pe = _el(root, "ObjectParts")
+                _el(pe, "TotalPartsCount", len(info.parts))
+                _el(pe, "IsTruncated", "false")
+                for p in info.parts:
+                    part = _el(pe, "Part")
+                    _el(part, "PartNumber", p.number)
+                    _el(part, "Size", p.actual_size)
+            if "StorageClass" in wanted:
+                _el(root, "StorageClass", info.storage_class or "STANDARD")
+            if "ObjectSize" in wanted:
+                _el(root, "ObjectSize", info.size)
+            headers = {"Last-Modified": _rfc1123(info.mod_time)}
+            if info.version_id:
+                headers["x-amz-version-id"] = info.version_id
+            return self._send(200, _xml(root), headers=headers)
+
         def _acl(self, method, bucket, key, body):
             """GET/PUT ?acl — the MinIO-parity ACL surface (reference:
             cmd/acl-handlers.go): ACLs are a legacy AWS mechanism; only
@@ -1121,6 +1165,8 @@ def _make_handler(server: S3Server):
             if "tagging" in query:
                 return self._object_tagging(method, bucket, key, query,
                                             payload)
+            if method == "GET" and "attributes" in query:
+                return self._object_attributes(bucket, key, query)
             if "acl" in query:
                 body_acl = payload.read_all() if method == "PUT" and \
                     payload is not None else b""
@@ -1512,12 +1558,18 @@ def _make_handler(server: S3Server):
                 _el(root, "ETag", f'"{part.etag}"')
                 _el(root, "LastModified", _iso8601(part.mod_time))
                 return self._send(200, _xml(root), headers=sse_hdrs)
+            # Per-part checksums (boto3 >= 1.36 declares one on every
+            # UploadPart by default): verified before commit; composite
+            # object-level checksums are not assembled in v1.
+            ck_opts = PutOptions()
+            payload, ck_hdrs = self._apply_checksums(payload, h, ck_opts)
             payload, actual, pnonce, sse_hdrs = self._part_sse_wrap(
                 bucket, key, uid, part_num, payload, h)
             part = server.object_layer.put_object_part(
                 bucket, key, uid, part_num, payload, actual_size=actual,
                 nonce=pnonce)
-            self._send(200, headers={"ETag": f'"{part.etag}"', **sse_hdrs})
+            self._send(200, headers={"ETag": f'"{part.etag}"', **sse_hdrs,
+                                     **ck_hdrs})
 
         def _complete_multipart(self, bucket, key, query, body):
             uid = query["uploadId"][0]
@@ -1660,6 +1712,8 @@ def _make_handler(server: S3Server):
                 tags=h.get("x-amz-tagging", ""))
             opts.internal_metadata.update(
                 self._object_lock_put_meta(bucket, h))
+            payload, checksum_hdrs = self._apply_checksums(payload, h,
+                                                           opts)
             plain_size = payload.size
             payload, sse_headers = self._apply_sse(bucket, key, payload,
                                                    h, opts)
@@ -1685,7 +1739,8 @@ def _make_handler(server: S3Server):
             self._notify("s3:ObjectCreated:Put", bucket, key,
                          size=plain_size, etag=info.etag,
                          version_id=info.version_id)
-            headers = {"ETag": f'"{info.etag}"', **sse_headers}
+            headers = {"ETag": f'"{info.etag}"', **sse_headers,
+                       **checksum_hdrs}
             if info.version_id:
                 headers["x-amz-version-id"] = info.version_id
             self._send(200, headers=headers)
@@ -1710,6 +1765,46 @@ def _make_handler(server: S3Server):
             except Exception:  # noqa: BLE001 - stamping is advisory
                 pass
             r.enqueue(bucket, key, version_id, "put")
+
+        def _apply_checksums(self, payload, h, opts):
+            """Wrap the LOGICAL payload in checksum computation when
+            the request declares x-amz-checksum-* values (headers, or
+            aws-chunked trailers — the SDK default). Verification runs
+            in the payload's finish hook, i.e. before commit; verified
+            values land in internal metadata. Returns (payload,
+            response-header dict that fills in post-verify)."""
+            from minio_tpu.s3 import checksum as ck
+            try:
+                declared = dict(ck.declared_algos(h))
+                t_algos = ck.trailer_algos(h)
+            except ck.ChecksumError as e:
+                raise S3Error(e.code, str(e)) from None
+            algos = sorted(set(declared) | set(t_algos))
+            if not algos:
+                return payload, {}
+            raw = getattr(payload, "_reader", None)   # trailer source
+            reader = ck.ChecksumingReader(payload, algos)
+            hdrs: dict = {}
+
+            def fin():
+                # Zero-byte bodies: the outer payload finishes without
+                # ever pulling the inner one, whose own finish parses
+                # the trailers — drive it explicitly (idempotent for
+                # non-empty bodies, whose finish already ran).
+                payload.read(1)
+                expected = dict(declared)
+                trailers = getattr(raw, "trailers", {}) or {}
+                for a in t_algos:
+                    expected.setdefault(a,
+                                        trailers.get(ck.H_PREFIX + a))
+                try:
+                    meta = ck.verify_and_meta(reader, expected)
+                except ck.ChecksumError as e:
+                    raise S3Error(e.code, str(e)) from None
+                opts.internal_metadata.update(meta)
+                hdrs.update(ck.response_headers(meta))
+
+            return Payload(reader, payload.size, finish=fin), hdrs
 
         def _apply_sse(self, bucket, key, payload, h, opts):
             """Wrap a put payload in DARE encryption when the request
@@ -2091,6 +2186,10 @@ def _make_handler(server: S3Server):
             headers.update(self._sse_response_headers(h, info))
             from minio_tpu.object import objectlock as olock
             headers.update(olock.meta_to_headers(info.internal_metadata))
+            if h.get("x-amz-checksum-mode", "").upper() == "ENABLED":
+                from minio_tpu.s3 import checksum as ck
+                headers.update(ck.response_headers(
+                    info.internal_metadata))
             repl = info.internal_metadata.get("x-internal-repl-status")
             if repl:
                 headers["x-amz-replication-status"] = repl
@@ -2920,6 +3019,12 @@ def _required_permissions(method: str, bucket: str, key: str, query: dict,
     if "acl" in query:
         verb = "Put" if method == "PUT" else "Get"
         return [(f"s3:{verb}ObjectAcl", res)]
+    if "attributes" in query and method == "GET":
+        # Attribute reads are data-class access; gating on the broad
+        # GetObject(Version) keeps canned readonly policies working.
+        return [("s3:GetObjectVersion"
+                 if query.get("versionId", [""])[0] else "s3:GetObject",
+                 res)]
     if "retention" in query:
         verb = "Put" if method == "PUT" else "Get"
         return [(f"s3:{verb}ObjectRetention", res)]

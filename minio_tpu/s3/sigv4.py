@@ -396,6 +396,7 @@ class ChunkedPayloadReader:
         self._buf = bytearray()
         self._chunk = memoryview(b"")
         self._done = False
+        self.trailers: dict[str, str] = {}
 
     # -- buffered raw access -------------------------------------------
 
@@ -475,15 +476,36 @@ class ChunkedPayloadReader:
 
     def finalize(self) -> None:
         """Consume the 0-chunk + trailers; any further data chunk means
-        the body was longer than the declared decoded length."""
+        the body was longer than the declared decoded length. Trailer
+        lines PARSE into self.trailers (modern SDKs ship their default
+        upload checksums here, x-amz-checksum-crc32 et al.) instead of
+        being drained blind."""
         while not self._done:
             self._next_frame()
             if self._chunk:
                 raise SigError("IncompleteBody",
                                "body exceeds decoded content length")
-        # Drain trailer lines so keep-alive sees a clean boundary.
-        while self._raw.read(self._FILL):
-            pass
+        self.trailers: dict[str, str] = {}
+        # Trailer section: `name:value\r\n` lines, then the
+        # x-amz-trailer-signature line (signed mode), then the final
+        # blank. Buffered remains first, then the raw tail.
+        while True:
+            nl = self._buf.find(b"\r\n")
+            if nl < 0:
+                data = self._raw.read(self._FILL)
+                if not data:
+                    break
+                self._buf += data
+                continue
+            line = bytes(self._buf[:nl])
+            del self._buf[:nl + 2]
+            if not line:
+                continue
+            name, sep, value = line.partition(b":")
+            if sep:
+                self.trailers[name.decode("latin-1").strip().lower()] = \
+                    value.decode("latin-1").strip()
+        # Anything after a blank line was drained by the loop above.
 
 
 def decode_chunked_payload(body: bytes, auth: ParsedAuth, secret: str,

@@ -1,0 +1,212 @@
+"""S3 additional checksums: header + trailer declaration, verification
+before commit, storage, checksum-mode retrieval, GetObjectAttributes
+(reference: internal/hash/checksum.go, cmd/object-handlers.go)."""
+
+import base64
+import datetime
+import hashlib
+import hmac
+import http.client
+import struct
+import zlib
+
+import os
+
+import pytest
+
+from minio_tpu.object.erasure_object import ErasureSet
+from minio_tpu.s3 import sigv4
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.local import LocalStorage
+from tests.s3client import S3Client
+
+
+def _crc32_b64(data: bytes) -> str:
+    return base64.b64encode(struct.pack(">I", zlib.crc32(data))).decode()
+
+
+def _sha256_b64(data: bytes) -> str:
+    return base64.b64encode(hashlib.sha256(data).digest()).decode()
+
+
+@pytest.fixture(scope="module")
+def srv(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("ckdrv")
+    disks = [LocalStorage(str(tmp / f"d{i}")) for i in range(4)]
+    server = S3Server(ErasureSet(disks), address="127.0.0.1:0")
+    server.start()
+    yield server
+    server.stop()
+
+
+@pytest.fixture(scope="module")
+def cli(srv):
+    c = S3Client(srv.address)
+    assert c.request("PUT", "/ckbkt")[0] == 200
+    return c
+
+
+def test_header_checksum_verified_and_stored(cli):
+    body = os.urandom(50_000)
+    st, h, b = cli.request("PUT", "/ckbkt/good", body=body, headers={
+        "x-amz-checksum-crc32": _crc32_b64(body),
+        "x-amz-checksum-sha256": _sha256_b64(body)})
+    assert st == 200, b
+    assert h.get("x-amz-checksum-crc32") == _crc32_b64(body)
+    # Returned only when the caller asks (AWS checksum-mode semantics).
+    st, h, _ = cli.request("HEAD", "/ckbkt/good")
+    assert "x-amz-checksum-crc32" not in h
+    st, h, _ = cli.request("HEAD", "/ckbkt/good",
+                           headers={"x-amz-checksum-mode": "ENABLED"})
+    assert h.get("x-amz-checksum-crc32") == _crc32_b64(body)
+    assert h.get("x-amz-checksum-sha256") == _sha256_b64(body)
+
+
+def test_wrong_checksum_rejected_before_commit(cli):
+    body = b"checksummed payload"
+    st, _, b = cli.request("PUT", "/ckbkt/bad", body=body, headers={
+        "x-amz-checksum-crc32": _crc32_b64(b"different")})
+    assert st == 400 and b"XAmzContentChecksumMismatch" in b
+    assert cli.request("GET", "/ckbkt/bad")[0] == 404
+    # Unsupported algorithms are refused, never silently unverified.
+    st, _, b = cli.request("PUT", "/ckbkt/bad", body=body, headers={
+        "x-amz-checksum-crc32c": "AAAAAA=="})
+    assert st == 501, b
+
+
+def test_trailer_checksum_sdk_shape(srv):
+    """The boto3-default upload shape: aws-chunked with an UNSIGNED
+    payload trailer carrying x-amz-checksum-crc32."""
+    body = os.urandom(150_000)
+    trailer_val = _crc32_b64(body)
+    chunks = bytearray()
+    step = 64 * 1024
+    for off in range(0, len(body), step):
+        part = body[off:off + step]
+        chunks += f"{len(part):x}\r\n".encode() + part + b"\r\n"
+    chunks += b"0\r\n"
+    chunks += f"x-amz-checksum-crc32:{trailer_val}\r\n\r\n".encode()
+
+    now = datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    scope = f"{amz_date[:8]}/us-east-1/s3/aws4_request"
+    path = "/ckbkt/trailered"
+    payload_hash = sigv4.STREAMING_UNSIGNED_TRAILER
+    headers = {
+        "host": srv.address,
+        "x-amz-date": amz_date,
+        "x-amz-content-sha256": payload_hash,
+        "x-amz-decoded-content-length": str(len(body)),
+        "x-amz-trailer": "x-amz-checksum-crc32",
+        "content-encoding": "aws-chunked",
+        "content-length": str(len(chunks)),
+    }
+    signed = sorted(headers)
+    canon = sigv4.canonical_request("PUT", path, {}, headers, signed,
+                                   payload_hash)
+    sts = sigv4.string_to_sign(amz_date, scope, canon)
+    key = sigv4.signing_key("minioadmin", amz_date[:8], "us-east-1")
+    sig = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+    headers["Authorization"] = (
+        f"{sigv4.ALGORITHM} Credential=minioadmin/{scope}, "
+        f"SignedHeaders={';'.join(signed)}, Signature={sig}")
+
+    conn = http.client.HTTPConnection(*srv.address.rsplit(":", 1),
+                                      timeout=30)
+    try:
+        conn.request("PUT", path, body=bytes(chunks), headers=headers)
+        r = conn.getresponse()
+        resp = r.read()
+        assert r.status == 200, resp
+        assert r.headers.get("x-amz-checksum-crc32") == trailer_val
+    finally:
+        conn.close()
+    cli = S3Client(srv.address)
+    st, h, got = cli.request("GET", "/ckbkt/trailered",
+                             headers={"x-amz-checksum-mode": "ENABLED"})
+    assert st == 200 and got == body
+    assert h.get("x-amz-checksum-crc32") == trailer_val
+
+
+def test_get_object_attributes(cli):
+    body = os.urandom(30_000)
+    st, h, _ = cli.request("PUT", "/ckbkt/attrs", body=body, headers={
+        "x-amz-checksum-sha256": _sha256_b64(body)})
+    etag = h["ETag"].strip('"')
+    st, _, xml = cli.request(
+        "GET", "/ckbkt/attrs", query={"attributes": ""},
+        headers={"x-amz-object-attributes":
+                 "ETag,Checksum,ObjectSize,StorageClass"})
+    assert st == 200, xml
+    assert f"<ETag>{etag}</ETag>".encode() in xml
+    assert f"<ObjectSize>{len(body)}</ObjectSize>".encode() in xml
+    assert b"STANDARD" in xml
+    assert _sha256_b64(body).encode() in xml
+    # Missing the attribute list is a 400, not an empty answer.
+    st, _, _ = cli.request("GET", "/ckbkt/attrs", query={"attributes": ""})
+    assert st == 400
+
+
+def test_zero_byte_trailer_upload(srv):
+    """Regression: an EMPTY body with a checksum trailer (what modern
+    SDKs send for zero-byte objects) must verify and commit — the
+    trailer parse must run even though the payload never streams."""
+    trailer_val = _crc32_b64(b"")
+    chunks = b"0\r\n" + \
+        f"x-amz-checksum-crc32:{trailer_val}\r\n\r\n".encode()
+    now = datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    scope = f"{amz_date[:8]}/us-east-1/s3/aws4_request"
+    path = "/ckbkt/empty"
+    payload_hash = sigv4.STREAMING_UNSIGNED_TRAILER
+    headers = {
+        "host": srv.address, "x-amz-date": amz_date,
+        "x-amz-content-sha256": payload_hash,
+        "x-amz-decoded-content-length": "0",
+        "x-amz-trailer": "x-amz-checksum-crc32",
+        "content-encoding": "aws-chunked",
+        "content-length": str(len(chunks)),
+    }
+    signed = sorted(headers)
+    canon = sigv4.canonical_request("PUT", path, {}, headers, signed,
+                                   payload_hash)
+    sts = sigv4.string_to_sign(amz_date, scope, canon)
+    key = sigv4.signing_key("minioadmin", amz_date[:8], "us-east-1")
+    sig = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+    headers["Authorization"] = (
+        f"{sigv4.ALGORITHM} Credential=minioadmin/{scope}, "
+        f"SignedHeaders={';'.join(signed)}, Signature={sig}")
+    conn = http.client.HTTPConnection(*srv.address.rsplit(":", 1),
+                                      timeout=30)
+    try:
+        conn.request("PUT", path, body=chunks, headers=headers)
+        r = conn.getresponse()
+        resp = r.read()
+        assert r.status == 200, resp
+    finally:
+        conn.close()
+    cli = S3Client(srv.address)
+    st, h, got = cli.request("GET", "/ckbkt/empty",
+                             headers={"x-amz-checksum-mode": "ENABLED"})
+    assert st == 200 and got == b""
+    assert h.get("x-amz-checksum-crc32") == trailer_val
+
+
+def test_upload_part_checksum_verified(cli):
+    st, _, body = cli.request("POST", "/ckbkt/mpc", query={"uploads": ""})
+    assert st == 200
+    uid = body.split(b"<UploadId>")[1].split(b"</UploadId>")[0].decode()
+    part = os.urandom(100_000)
+    st, h, b = cli.request("PUT", "/ckbkt/mpc",
+                           query={"partNumber": "1", "uploadId": uid},
+                           body=part,
+                           headers={"x-amz-checksum-crc32":
+                                    _crc32_b64(part)})
+    assert st == 200, b
+    assert h.get("x-amz-checksum-crc32") == _crc32_b64(part)
+    st, _, b = cli.request("PUT", "/ckbkt/mpc",
+                           query={"partNumber": "2", "uploadId": uid},
+                           body=part,
+                           headers={"x-amz-checksum-crc32":
+                                    _crc32_b64(b"corrupt")})
+    assert st == 400 and b"XAmzContentChecksumMismatch" in b
