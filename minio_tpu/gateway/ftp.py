@@ -35,9 +35,13 @@ class FTPGateway:
 
     def __init__(self, object_layer, credentials,
                  address: str = "127.0.0.1:0",
-                 passive_host: Optional[str] = None):
+                 passive_host: Optional[str] = None, kms=None):
+        from minio_tpu.crypto.kms import KMS
         self.object_layer = object_layer
         self.credentials = credentials
+        # Same sealing key as the S3 front end: RETR must decrypt what
+        # the S3 API encrypted, STOR must honor bucket default SSE.
+        self.kms = kms if kms is not None else KMS.from_env()
         host, _, port = address.rpartition(":")
         gateway = self
 
@@ -198,8 +202,10 @@ class _Session:
         self.send("331 password required")
 
     def cmd_pass(self, arg):
+        import hmac as _hmac
         secret = self.gw.credentials.secret_for(self.user)
-        if secret is None or secret != arg:
+        if secret is None or not _hmac.compare_digest(secret.encode(),
+                                                      arg.encode()):
             self.authed = False
             self.send("530 login incorrect")
             return
@@ -340,14 +346,23 @@ class _Session:
     # -- transfers -------------------------------------------------------
 
     def cmd_retr(self, arg):
-        from minio_tpu.object.types import GetOptions
+        from minio_tpu.crypto.sse import SSEError
+        from minio_tpu.object import transform
         bucket, key = self._split(self._resolve(arg))
         if not key:
             raise _FTPError("550 not a file")
         self._allowed("s3:GetObject", f"{bucket}/{key}")
         try:
-            _, chunks = self.gw.object_layer.get_object_stream(
-                bucket, key, GetOptions())
+            # The shared transform seam: SSE-S3 decrypts, compressed
+            # objects decompress — RETR always sends LOGICAL bytes
+            # (matching what SIZE/LIST report). SSE-C objects need a
+            # client-held key FTP cannot carry: refuse, don't leak
+            # ciphertext.
+            _, chunks = transform.plaintext_stream(
+                self.gw.object_layer, self.gw.kms, bucket, key)
+        except SSEError:
+            raise _FTPError("550 object requires SSE-C key headers; "
+                            "use the S3 API") from None
         except Exception:  # noqa: BLE001 - absent object
             raise _FTPError("550 no such file") from None
         conn = self._data_conn()
@@ -382,10 +397,22 @@ class _Session:
                 chunks.append(b)
         finally:
             conn.close()
+        from minio_tpu.crypto.sse import SSEError
+        from minio_tpu.object import transform
+        from minio_tpu.utils.streams import Payload
         versioned = bool(self.gw.object_layer.get_bucket_meta(bucket)
                          .get("versioning"))
-        self.gw.object_layer.put_object(bucket, key, b"".join(chunks),
-                                        PutOptions(versioned=versioned))
+        opts = PutOptions(versioned=versioned)
+        # Bucket default encryption applies to every writer, FTP
+        # included — storing plaintext in a bucket whose config demands
+        # SSE would silently break its compliance posture.
+        try:
+            payload, _ = transform.sse_payload(
+                self.gw.object_layer, self.gw.kms, bucket, key,
+                Payload.wrap(b"".join(chunks)), opts)
+        except SSEError as e:
+            raise _FTPError(f"550 {e}") from None
+        self.gw.object_layer.put_object(bucket, key, payload, opts)
         self.send("226 transfer complete")
 
     def cmd_dele(self, arg):

@@ -1,0 +1,224 @@
+"""Plaintext-space read/write transforms over the object layer.
+
+The reference routes every front end (S3 handlers, FTP/SFTP servers,
+Select, replication) through one object-API layer that applies the
+stored-representation transforms — SSE decryption (cmd/encryption-v1.go)
+and transparent decompression (cmd/object-api-utils.go) — so a gateway
+can never leak DARE ciphertext or compressed bytes to a client. This
+module is that seam here: the S3 server's GET path and the FTP gateway
+both resolve logical bytes through these functions.
+
+All functions raise the crypto-layer errors (`sse.SSEError`,
+`compress.CompressionError`); callers translate to their protocol's
+error surface (S3Error / FTP 550).
+"""
+
+from __future__ import annotations
+
+from minio_tpu.object.types import GetOptions
+
+
+def resolve_range(spec, size: int):
+    """Parsed Range spec -> (start, length) against a logical size."""
+    from minio_tpu.object.erasure_object import _resolve_range
+    return _resolve_range(spec, size, "", "")
+
+
+def sse_check_head(h: dict, info) -> None:
+    """HEAD/GET of an SSE-C object requires the matching key."""
+    from minio_tpu.crypto import sse as sse_mod
+    alg = info.internal_metadata.get(sse_mod.META_ALG, "")
+    if alg != sse_mod.ALG_SSE_C:
+        return
+    customer = sse_mod.parse_sse_c(h)
+    if customer is None:
+        raise sse_mod.SSEError("InvalidRequest",
+                               "object is SSE-C encrypted; key headers "
+                               "required")
+    if customer[1] != info.internal_metadata.get(sse_mod.META_KEY_MD5):
+        raise sse_mod.SSEError("AccessDenied", "wrong SSE-C key")
+
+
+def get_compressed(ol, bucket, key, vid, spec, info):
+    """Ranged read of a compressed object: fetch the covering stored
+    blocks, decompress, trim to the plaintext range. Returns
+    (info, chunks, start, length)."""
+    from minio_tpu.crypto import compress as comp
+    start, length = (resolve_range(spec, info.size)
+                     if spec else (0, info.size))
+    info.range_start, info.range_length = start, length
+    if length <= 0 or info.size == 0:
+        return info, (b for b in ()), start, max(length, 0)
+    imeta = info.internal_metadata
+    lo, ln = comp.stored_range(imeta, start, length)
+    pin = vid or info.version_id
+    _, stored = ol.get_object(
+        bucket, key, GetOptions(version_id=pin, offset=lo, length=ln))
+    plain = comp.decompress_range(stored, imeta, start, length,
+                                  stored_base=lo)
+    # Generator (not iter([...])): GET handlers' finally call
+    # chunks.close().
+    return info, (c for c in (plain,)), start, length
+
+
+def get_encrypted(ol, kms, bucket, key, vid, spec, h, info):
+    """Ranged decrypting GET: map the plaintext range onto
+    package-aligned ciphertext, stream, decrypt, trim. An SSE multipart
+    object is a sequence of independent per-part DARE streams
+    (reference: cmd/encryption-v1.go:643 part-boundary decryption); a
+    single PUT is one stream. Returns (info, chunks, start, length)."""
+    from minio_tpu.crypto import sse as sse_mod
+    from minio_tpu.crypto.dare import (PACKAGE_SIZE, decrypt_packages,
+                                       encrypt_stream_size, package_range)
+    customer = sse_mod.parse_sse_c(h)
+    data_key, nonce = sse_mod.decrypt_params(
+        bucket, key, info.internal_metadata, kms, customer)
+    start, length = (resolve_range(spec, info.size)
+                     if spec else (0, info.size))
+    info.range_start, info.range_length = start, length
+    if length <= 0 or info.size == 0:
+        return info, (b for b in ()), start, max(length, 0)
+    if info.internal_metadata.get(sse_mod.META_MULTIPART) and info.parts:
+        gen = decrypt_parts_gen(ol, bucket, key, vid or info.version_id,
+                                info, data_key, nonce, start, length)
+        return info, gen, start, length
+    first, c_off, c_len = package_range(start, length)
+    c_size = encrypt_stream_size(info.size)
+    c_len = min(c_len, c_size - c_off)
+    _, raw = ol.get_object_stream(
+        bucket, key, GetOptions(version_id=vid, offset=c_off,
+                                length=c_len))
+    chunks = decrypt_packages(raw, data_key, nonce, first,
+                              start - first * PACKAGE_SIZE, length)
+    return info, chunks, start, length
+
+
+def decrypt_parts_gen(ol, bucket, key, vid, info, data_key, nonce,
+                      start, length):
+    """Plaintext range [start, start+length) across per-part DARE
+    streams. Part boundaries in the STORED stream are the summed
+    ciphertext part sizes; in the plaintext space the summed logical
+    sizes. The whole covering stored range is fetched in ONE
+    get_object_stream call — the per-part slices are contiguous (first
+    part reads to its stored end, middles whole, last from its start),
+    and a single read means a single version resolution, so a concurrent
+    overwrite in an unversioned bucket cannot interleave versions
+    mid-response. Each part decrypts under its derived key and its own
+    stored base nonce."""
+    import base64 as _b64
+
+    from minio_tpu.crypto import sse as sse_mod
+    from minio_tpu.crypto.dare import (PACKAGE_SIZE, decrypt_packages,
+                                       package_range)
+    # Plan: (part, first_seq, skip, plain_len, stored_lo, stored_len)
+    plan = []
+    pos, remaining = start, length
+    plain_off = stored_off = 0
+    for p in info.parts:
+        if remaining <= 0:
+            break
+        if pos >= plain_off + p.actual_size:
+            plain_off += p.actual_size
+            stored_off += p.size
+            continue
+        in_off = pos - plain_off
+        in_len = min(remaining, p.actual_size - in_off)
+        first, c_off, c_len = package_range(in_off, in_len)
+        c_len = min(c_len, p.size - c_off)
+        plan.append((p, first, in_off - first * PACKAGE_SIZE,
+                     in_len, stored_off + c_off, c_len))
+        pos += in_len
+        remaining -= in_len
+        plain_off += p.actual_size
+        stored_off += p.size
+    if not plan:
+        return
+    lo = plan[0][4]
+    hi = plan[-1][4] + plan[-1][5]
+    _, raw = ol.get_object_stream(
+        bucket, key, GetOptions(version_id=vid, offset=lo,
+                                length=hi - lo))
+    carry = bytearray()
+    raw_iter = iter(raw)
+
+    def take(n):
+        """Yield exactly n bytes from the shared stored stream."""
+        nonlocal carry
+        while n > 0:
+            if carry:
+                chunk = bytes(carry[:n])
+                del carry[:len(chunk)]
+            else:
+                try:
+                    chunk = next(raw_iter)
+                except StopIteration:
+                    return       # decryptor reports the shortfall
+                if len(chunk) > n:
+                    carry.extend(chunk[n:])
+                    chunk = chunk[:n]
+            n -= len(chunk)
+            yield chunk
+
+    try:
+        for p, first, skip, plain_len, _s_lo, s_len in plan:
+            part_nonce = _b64.b64decode(p.nonce) if p.nonce else nonce
+            yield from decrypt_packages(
+                take(s_len), sse_mod.part_key(data_key, p.number),
+                part_nonce, first, skip, plain_len)
+    finally:
+        close = getattr(raw, "close", None)
+        if close is not None:
+            close()
+
+
+def plaintext_stream(ol, kms, bucket, key, vid="", h=None):
+    """(info, chunks) for the object's LOGICAL bytes, whatever its
+    stored representation — the one entry point for gateways that have
+    no transform headers of their own (FTP, SFTP). SSE-C objects raise
+    SSEError (the server holds no key for them).
+
+    The transform re-open is pinned to the version the first open
+    resolved; in UNVERSIONED buckets there is no version to pin, so a
+    concurrent overwrite between the two reads can tear — the same
+    small window the S3 GET path (and the reference) accepts there."""
+    h = h or {}
+    info, chunks = ol.get_object_stream(bucket, key,
+                                        GetOptions(version_id=vid))
+    imeta = info.internal_metadata
+    if imeta.get("x-internal-sse-alg"):
+        chunks.close()
+        sse_check_head(h, info)
+        info, chunks, _, _ = get_encrypted(
+            ol, kms, bucket, key, vid or info.version_id, None, h, info)
+    elif imeta.get("x-internal-comp"):
+        chunks.close()
+        info, chunks, _, _ = get_compressed(
+            ol, bucket, key, vid or info.version_id, None, info)
+    return info, chunks
+
+
+def sse_payload(ol, kms, bucket, key, payload, opts, h=None):
+    """Wrap a put payload in DARE encryption when the request headers
+    (SSE-C / SSE-S3) or the bucket's default-encryption config ask for
+    it — the single put-side SSE seam for every writer (reference:
+    cmd/bucket-encryption.go consulted by the object API layer, not
+    just the S3 handler). Returns (payload, response headers)."""
+    from minio_tpu.crypto import EncryptingPayload, encrypt_stream_size
+    from minio_tpu.crypto import sse as sse_mod
+    from minio_tpu.utils.streams import Payload
+    h = h or {}
+    customer = sse_mod.parse_sse_c(h)
+    if customer is None:
+        enc_cfg = ol.get_bucket_meta(bucket).get("config:encryption")
+        if not sse_mod.wants_sse_s3(h, enc_cfg):
+            return payload, {}
+    payload = Payload.wrap(payload)
+    data_key, nonce, imeta = sse_mod.encrypt_metadata(
+        bucket, key, payload.size, kms, customer)
+    opts.internal_metadata.update(imeta)
+    enc = EncryptingPayload(payload, data_key, nonce)
+    out = Payload(enc, encrypt_stream_size(payload.size))
+    if customer is not None:
+        return out, {sse_mod.H_C_ALG: "AES256",
+                     sse_mod.H_C_MD5: customer[1]}
+    return out, {sse_mod.H_SSE: "AES256"}

@@ -72,6 +72,18 @@ def declared_algos(h: dict) -> list[tuple[str, str]]:
     return out
 
 
+def single_algo(declared: dict, t_algos: list) -> list:
+    """The single algorithm a request may declare, combining header and
+    trailer declarations; S3 answers InvalidRequest when a request
+    declares more than one (rather than verifying them all)."""
+    algos = set(declared) | set(t_algos)
+    if len(algos) > 1:
+        raise ChecksumError("InvalidRequest",
+                            "only one checksum algorithm may be "
+                            "declared per request")
+    return sorted(algos)
+
+
 def trailer_algos(h: dict) -> list[str]:
     """Checksum algorithms announced in x-amz-trailer."""
     out = []

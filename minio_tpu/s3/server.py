@@ -1777,9 +1777,9 @@ def _make_handler(server: S3Server):
             try:
                 declared = dict(ck.declared_algos(h))
                 t_algos = ck.trailer_algos(h)
+                algos = ck.single_algo(declared, t_algos)
             except ck.ChecksumError as e:
                 raise S3Error(e.code, str(e)) from None
-            algos = sorted(set(declared) | set(t_algos))
             if not algos:
                 return payload, {}
             raw = getattr(payload, "_reader", None)   # trailer source
@@ -1809,32 +1809,16 @@ def _make_handler(server: S3Server):
         def _apply_sse(self, bucket, key, payload, h, opts):
             """Wrap a put payload in DARE encryption when the request
             (SSE-C / SSE-S3 headers) or the bucket's default encryption
-            config asks for it. Returns (payload, response headers)."""
-            from minio_tpu.crypto import (EncryptingPayload,
-                                          encrypt_stream_size)
+            config asks for it (shared put-side seam:
+            object/transform.py). Returns (payload, response headers)."""
             from minio_tpu.crypto import sse as sse_mod
+            from minio_tpu.object import transform
             try:
-                customer = sse_mod.parse_sse_c(h)
-                enc_cfg = None
-                if customer is None:
-                    # Propagate metadata read failures: a swallowed
-                    # error here would store plaintext in a bucket
-                    # whose default demands encryption.
-                    enc_cfg = server.object_layer.get_bucket_meta(
-                        bucket).get("config:encryption")
-                    if not sse_mod.wants_sse_s3(h, enc_cfg):
-                        return payload, {}
-                data_key, nonce, imeta = sse_mod.encrypt_metadata(
-                    bucket, key, payload.size, server.kms, customer)
+                return transform.sse_payload(server.object_layer,
+                                             server.kms, bucket, key,
+                                             payload, opts, h)
             except sse_mod.SSEError as e:
                 raise S3Error(e.code, str(e)) from None
-            opts.internal_metadata.update(imeta)
-            enc = EncryptingPayload(payload, data_key, nonce)
-            out = Payload(enc, encrypt_stream_size(payload.size))
-            if customer is not None:
-                return out, {sse_mod.H_C_ALG: "AES256",
-                             sse_mod.H_C_MD5: customer[1]}
-            return out, {sse_mod.H_SSE: "AES256"}
 
         def _apply_compression(self, key, payload, opts):
             """Compress eligible buffered-size plaintext objects
@@ -1860,28 +1844,16 @@ def _make_handler(server: S3Server):
             return Payload.wrap(stored)
 
         def _get_compressed(self, bucket, key, vid, spec, info):
-            """Ranged read of a compressed object: fetch the covering
-            stored blocks, decompress, trim to the plaintext range."""
+            """Ranged read of a compressed object (shared transform
+            seam: object/transform.py)."""
             from minio_tpu.crypto import compress as comp
-            start, length = (_resolve_head_range(spec, info.size)
-                             if spec else (0, info.size))
-            info.range_start, info.range_length = start, length
-            if length <= 0 or info.size == 0:
-                return info, (b for b in ()), start, max(length, 0)
-            imeta = info.internal_metadata
-            lo, ln = comp.stored_range(imeta, start, length)
-            pin = vid or info.version_id
-            _, stored = server.object_layer.get_object(
-                bucket, key, GetOptions(version_id=pin, offset=lo,
-                                        length=ln))
+            from minio_tpu.object import transform
             try:
-                plain = comp.decompress_range(stored, imeta, start,
-                                              length, stored_base=lo)
+                return transform.get_compressed(server.object_layer,
+                                                bucket, key, vid, spec,
+                                                info)
             except comp.CompressionError as e:
                 raise S3Error("InternalError", str(e)) from None
-            # Generator (not iter([...])): the GET handler's finally
-            # calls chunks.close().
-            return info, (c for c in (plain,)), start, length
 
         def _sse_response_headers(self, h, info) -> dict:
             from minio_tpu.crypto import sse as sse_mod
@@ -1898,20 +1870,11 @@ def _make_handler(server: S3Server):
         def _sse_check_head(self, h, info):
             """HEAD/GET of an SSE-C object requires the matching key."""
             from minio_tpu.crypto import sse as sse_mod
-            alg = info.internal_metadata.get(sse_mod.META_ALG, "")
-            if alg != sse_mod.ALG_SSE_C:
-                return
+            from minio_tpu.object import transform
             try:
-                customer = sse_mod.parse_sse_c(h)
+                transform.sse_check_head(h, info)
             except sse_mod.SSEError as e:
                 raise S3Error(e.code, str(e)) from None
-            if customer is None:
-                raise S3Error("InvalidRequest",
-                              "object is SSE-C encrypted; key headers "
-                              "required")
-            if customer[1] != info.internal_metadata.get(
-                    sse_mod.META_KEY_MD5):
-                raise S3Error("AccessDenied", "wrong SSE-C key")
 
         def _read_source_plain(self, sbucket, skey, src_vid, spec, h):
             """Copy-source fetch in PLAINTEXT space: decrypts SSE
@@ -1945,123 +1908,16 @@ def _make_handler(server: S3Server):
             return sinfo, b"".join(chunks)
 
         def _get_encrypted(self, bucket, key, vid, spec, h, info):
-            """Ranged decrypting GET: map the plaintext range onto
-            package-aligned ciphertext, stream, decrypt, trim. An SSE
-            multipart object is a sequence of independent per-part DARE
-            streams (reference: cmd/encryption-v1.go:643 part-boundary
-            decryption); a single PUT is one stream."""
+            """Ranged decrypting GET (shared transform seam:
+            object/transform.py; reference: cmd/encryption-v1.go:643)."""
             from minio_tpu.crypto import sse as sse_mod
-            from minio_tpu.crypto.dare import (PACKAGE_SIZE,
-                                               decrypt_packages,
-                                               encrypt_stream_size,
-                                               package_range)
+            from minio_tpu.object import transform
             try:
-                customer = sse_mod.parse_sse_c(h)
-                data_key, nonce = sse_mod.decrypt_params(
-                    bucket, key, info.internal_metadata, server.kms,
-                    customer)
+                return transform.get_encrypted(server.object_layer,
+                                               server.kms, bucket, key,
+                                               vid, spec, h, info)
             except sse_mod.SSEError as e:
                 raise S3Error(e.code, str(e)) from None
-            start, length = (_resolve_head_range(spec, info.size)
-                             if spec else (0, info.size))
-            info.range_start, info.range_length = start, length
-            if length <= 0 or info.size == 0:
-                return info, (b for b in ()), start, max(length, 0)
-            if info.internal_metadata.get(sse_mod.META_MULTIPART) \
-                    and info.parts:
-                gen = self._decrypt_parts_gen(bucket, key,
-                                              vid or info.version_id,
-                                              info, data_key, nonce,
-                                              start, length)
-                return info, gen, start, length
-            first, c_off, c_len = package_range(start, length)
-            c_size = encrypt_stream_size(info.size)
-            c_len = min(c_len, c_size - c_off)
-            _, raw = server.object_layer.get_object_stream(
-                bucket, key, GetOptions(version_id=vid, offset=c_off,
-                                        length=c_len))
-            chunks = decrypt_packages(raw, data_key, nonce, first,
-                                      start - first * PACKAGE_SIZE, length)
-            return info, chunks, start, length
-
-        def _decrypt_parts_gen(self, bucket, key, vid, info, data_key,
-                               nonce, start, length):
-            """Plaintext range [start, start+length) across per-part
-            DARE streams. Part boundaries in the STORED stream are the
-            summed ciphertext part sizes; in the plaintext space the
-            summed logical sizes. The whole covering stored range is
-            fetched in ONE get_object_stream call — the per-part slices
-            are contiguous (first part reads to its stored end, middles
-            whole, last from its start), and a single read means a
-            single version resolution, so a concurrent overwrite in an
-            unversioned bucket cannot interleave versions mid-response.
-            Each part decrypts under its derived key and its own stored
-            base nonce."""
-            import base64 as _b64
-            from minio_tpu.crypto import sse as sse_mod
-            from minio_tpu.crypto.dare import (PACKAGE_SIZE,
-                                               decrypt_packages,
-                                               package_range)
-            # Plan: (part, first_seq, skip, plain_len, stored_lo, stored_len)
-            plan = []
-            pos, remaining = start, length
-            plain_off = stored_off = 0
-            for p in info.parts:
-                if remaining <= 0:
-                    break
-                if pos >= plain_off + p.actual_size:
-                    plain_off += p.actual_size
-                    stored_off += p.size
-                    continue
-                in_off = pos - plain_off
-                in_len = min(remaining, p.actual_size - in_off)
-                first, c_off, c_len = package_range(in_off, in_len)
-                c_len = min(c_len, p.size - c_off)
-                plan.append((p, first, in_off - first * PACKAGE_SIZE,
-                             in_len, stored_off + c_off, c_len))
-                pos += in_len
-                remaining -= in_len
-                plain_off += p.actual_size
-                stored_off += p.size
-            if not plan:
-                return
-            lo = plan[0][4]
-            hi = plan[-1][4] + plan[-1][5]
-            _, raw = server.object_layer.get_object_stream(
-                bucket, key, GetOptions(version_id=vid, offset=lo,
-                                        length=hi - lo))
-            carry = bytearray()
-            raw_iter = iter(raw)
-
-            def take(n):
-                """Yield exactly n bytes from the shared stored stream."""
-                nonlocal carry
-                while n > 0:
-                    if carry:
-                        chunk = bytes(carry[:n])
-                        del carry[:len(chunk)]
-                    else:
-                        try:
-                            chunk = next(raw_iter)
-                        except StopIteration:
-                            return       # decryptor reports the shortfall
-                        if len(chunk) > n:
-                            carry.extend(chunk[n:])
-                            chunk = chunk[:n]
-                    n -= len(chunk)
-                    yield chunk
-
-            try:
-                for p, first, skip, plain_len, _s_lo, s_len in plan:
-                    part_nonce = _b64.b64decode(p.nonce) if p.nonce \
-                        else nonce
-                    yield from decrypt_packages(
-                        take(s_len), sse_mod.part_key(data_key, p.number),
-                        part_nonce, first, skip, plain_len)
-            finally:
-                close = getattr(raw, "close", None)
-                if close is not None:
-                    close()
 
         def _check_conditions(self, h, info, for_read: bool,
                               prefix: str = "") -> bool:

@@ -446,7 +446,12 @@ class ChunkedPayloadReader:
         if self._verify and (
                 self._auth.payload_hash == STREAMING_PAYLOAD
                 or (self._auth.payload_hash == STREAMING_PAYLOAD_TRAILER
-                    and size > 0)):
+                    and (size > 0 or "chunk-signature=" in ext))):
+            # Signed-trailer mode: AWS signs the terminal 0-chunk too
+            # and the trailer signature chains off it (reference:
+            # cmd/streaming-signature-v4.go seedSignature update); a
+            # bare `0` final frame is tolerated — the chain then ends
+            # at the last data chunk.
             chunk_sig = ""
             for kv in ext.split(";"):
                 if kv.startswith("chunk-signature="):
@@ -489,6 +494,8 @@ class ChunkedPayloadReader:
         # Trailer section: `name:value\r\n` lines, then the
         # x-amz-trailer-signature line (signed mode), then the final
         # blank. Buffered remains first, then the raw tail.
+        trailer_raw = bytearray()       # lines as sent, '\n'-terminated
+        trailer_sig = ""
         while True:
             nl = self._buf.find(b"\r\n")
             if nl < 0:
@@ -502,10 +509,33 @@ class ChunkedPayloadReader:
             if not line:
                 continue
             name, sep, value = line.partition(b":")
-            if sep:
-                self.trailers[name.decode("latin-1").strip().lower()] = \
-                    value.decode("latin-1").strip()
+            if not sep:
+                continue
+            lname = name.decode("latin-1").strip().lower()
+            if lname == "x-amz-trailer-signature":
+                trailer_sig = value.decode("latin-1").strip()
+                continue
+            trailer_raw += line + b"\n"
+            self.trailers[lname] = value.decode("latin-1").strip()
         # Anything after a blank line was drained by the loop above.
+        # Signed-trailer mode authenticates the trailer section too
+        # (reference: cmd/streaming-signature-v4.go readTrailers):
+        # string-to-sign is AWS4-HMAC-SHA256-TRAILER over the hash of
+        # the '\n'-terminated trailer lines, chained off the last data
+        # chunk's signature. Without this check the declared trailing
+        # checksums would be attacker-tamperable.
+        if self._verify \
+                and self._auth.payload_hash == STREAMING_PAYLOAD_TRAILER \
+                and (self.trailers or trailer_sig):
+            sts = "\n".join([
+                "AWS4-HMAC-SHA256-TRAILER", self._auth.amz_date,
+                self._scope, self._prev_sig,
+                hashlib.sha256(bytes(trailer_raw)).hexdigest()])
+            want = hmac.new(self._seed_key, sts.encode(),
+                            hashlib.sha256).hexdigest()
+            if not hmac.compare_digest(want, trailer_sig):
+                raise SigError("SignatureDoesNotMatch",
+                               "trailer signature")
 
 
 def decode_chunked_payload(body: bytes, auth: ParsedAuth, secret: str,

@@ -27,10 +27,13 @@ class S3Client:
     def request(self, method: str, path: str, query: dict | None = None,
                 body: bytes = b"", headers: dict | None = None,
                 sign: bool = True, chunked: bool = False,
-                te_chunked: bool = False):
+                te_chunked: bool = False, trailers: dict | None = None,
+                corrupt_trailer_sig: bool = False):
         """te_chunked: send the (aws-chunked) body with HTTP
         Transfer-Encoding: chunked instead of Content-Length — the SDK
-        pattern for unknown-length streaming uploads."""
+        pattern for unknown-length streaming uploads. trailers (with
+        chunked=True): signed-trailer mode — append the trailer lines
+        and an x-amz-trailer-signature over them."""
         query = {k: [v] if isinstance(v, str) else v
                  for k, v in (query or {}).items()}
         headers = dict(headers or {})
@@ -41,9 +44,12 @@ class S3Client:
 
         send_headers = {"Host": self.address, "x-amz-date": amz_date}
         if chunked:
-            payload_hash = sigv4.STREAMING_PAYLOAD
+            payload_hash = sigv4.STREAMING_PAYLOAD if trailers is None \
+                else sigv4.STREAMING_PAYLOAD_TRAILER
             send_headers["content-encoding"] = "aws-chunked"
             send_headers["x-amz-decoded-content-length"] = str(len(body))
+            if trailers is not None:
+                send_headers["x-amz-trailer"] = ",".join(trailers)
         else:
             payload_hash = hashlib.sha256(body).hexdigest()
         send_headers["x-amz-content-sha256"] = payload_hash
@@ -63,7 +69,8 @@ class S3Client:
                 f"{sigv4.ALGORITHM} Credential={self.access_key}/{scope}, "
                 f"SignedHeaders={';'.join(signed)}, Signature={sig}")
             if chunked:
-                body = self._chunk_body(body, sig, amz_date, scope)
+                body = self._chunk_body(body, sig, amz_date, scope,
+                                        trailers, corrupt_trailer_sig)
 
         qs = urllib.parse.urlencode(
             [(k, v) for k, vs in query.items() for v in vs])
@@ -85,7 +92,8 @@ class S3Client:
             conn.close()
 
     def _chunk_body(self, body: bytes, seed_sig: str, amz_date: str,
-                    scope: str) -> bytes:
+                    scope: str, trailers: dict | None = None,
+                    corrupt_trailer_sig: bool = False) -> bytes:
         key = sigv4.signing_key(self.secret_key, scope.split("/")[0],
                                 self.region)
         out = bytearray()
@@ -99,6 +107,21 @@ class S3Client:
             out += f"{len(data):x};chunk-signature={sig}\r\n".encode()
             out += data + b"\r\n"
             prev = sig
+        if trailers is not None:
+            # AWS signed-trailer shape: trailer lines, then a signature
+            # over their '\n'-terminated forms chained off the final
+            # (0-byte) chunk's signature.
+            out = out[:-2]      # the 0-chunk has no trailing CRLF pair
+            raw = bytearray()
+            for name, value in trailers.items():
+                out += f"{name}:{value}\r\n".encode()
+                raw += f"{name}:{value}\n".encode()
+            sts = "\n".join(["AWS4-HMAC-SHA256-TRAILER", amz_date, scope,
+                             prev, hashlib.sha256(bytes(raw)).hexdigest()])
+            tsig = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+            if corrupt_trailer_sig:
+                tsig = ("0" * 63) + ("1" if tsig[63] != "1" else "2")
+            out += f"x-amz-trailer-signature:{tsig}\r\n\r\n".encode()
         return bytes(out)
 
     def presign(self, method: str, path: str, expires: int = 300) -> str:

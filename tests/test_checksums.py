@@ -49,17 +49,27 @@ def cli(srv):
 def test_header_checksum_verified_and_stored(cli):
     body = os.urandom(50_000)
     st, h, b = cli.request("PUT", "/ckbkt/good", body=body, headers={
-        "x-amz-checksum-crc32": _crc32_b64(body),
         "x-amz-checksum-sha256": _sha256_b64(body)})
     assert st == 200, b
-    assert h.get("x-amz-checksum-crc32") == _crc32_b64(body)
+    assert h.get("x-amz-checksum-sha256") == _sha256_b64(body)
     # Returned only when the caller asks (AWS checksum-mode semantics).
     st, h, _ = cli.request("HEAD", "/ckbkt/good")
-    assert "x-amz-checksum-crc32" not in h
+    assert "x-amz-checksum-sha256" not in h
     st, h, _ = cli.request("HEAD", "/ckbkt/good",
                            headers={"x-amz-checksum-mode": "ENABLED"})
-    assert h.get("x-amz-checksum-crc32") == _crc32_b64(body)
     assert h.get("x-amz-checksum-sha256") == _sha256_b64(body)
+
+
+def test_multiple_checksum_algorithms_rejected(cli):
+    """S3 answers InvalidRequest when a request declares more than one
+    checksum algorithm (advisor r4: verifying them all diverges from
+    conformance-sensitive clients)."""
+    body = b"two algos"
+    st, _, b = cli.request("PUT", "/ckbkt/two", body=body, headers={
+        "x-amz-checksum-crc32": _crc32_b64(body),
+        "x-amz-checksum-sha256": _sha256_b64(body)})
+    assert st == 400 and b"InvalidRequest" in b
+    assert cli.request("GET", "/ckbkt/two")[0] == 404
 
 
 def test_wrong_checksum_rejected_before_commit(cli):
@@ -72,6 +82,34 @@ def test_wrong_checksum_rejected_before_commit(cli):
     st, _, b = cli.request("PUT", "/ckbkt/bad", body=body, headers={
         "x-amz-checksum-crc32c": "AAAAAA=="})
     assert st == 501, b
+
+
+def test_signed_trailer_roundtrip(cli):
+    """STREAMING-AWS4-HMAC-SHA256-PAYLOAD-TRAILER: signed data chunks,
+    signed terminal 0-chunk, and an x-amz-trailer-signature over the
+    trailer lines — all verified server-side."""
+    body = os.urandom(100_000)
+    trailer_val = _crc32_b64(body)
+    st, h, b = cli.request(
+        "PUT", "/ckbkt/signed-trailer", body=body, chunked=True,
+        trailers={"x-amz-checksum-crc32": trailer_val})
+    assert st == 200, b
+    assert h.get("x-amz-checksum-crc32") == trailer_val
+    st, _, got = cli.request("GET", "/ckbkt/signed-trailer")
+    assert st == 200 and got == body
+
+
+def test_signed_trailer_tamper_rejected(cli):
+    """A wrong x-amz-trailer-signature fails the upload (advisor r4:
+    unauthenticated trailers let an on-path attacker strip or swap the
+    declared checksum)."""
+    body = os.urandom(80_000)
+    st, _, b = cli.request(
+        "PUT", "/ckbkt/tampered-trailer", body=body, chunked=True,
+        trailers={"x-amz-checksum-crc32": _crc32_b64(body)},
+        corrupt_trailer_sig=True)
+    assert st == 403 and b"SignatureDoesNotMatch" in b
+    assert cli.request("GET", "/ckbkt/tampered-trailer")[0] == 404
 
 
 def test_trailer_checksum_sdk_shape(srv):

@@ -24,7 +24,9 @@ def gw(tmp_path_factory):
     creds.iam = IAMSys([es], "minioadmin", "minioadmin")
     creds.iam.add_user("reader", "readersecret")
     creds.iam.attach_policy("reader", ["readonly"])
-    g = FTPGateway(es, creds, address="127.0.0.1:0")
+    from minio_tpu.crypto.kms import KMS
+    kms = KMS({"testkey": b"\x07" * 32}, "testkey")
+    g = FTPGateway(es, creds, address="127.0.0.1:0", kms=kms)
     g.start()
     yield g
     g.stop()
@@ -103,6 +105,76 @@ def test_path_escape_confined_to_namespace(gw):
     c.cwd("/")
     c.sendcmd("CDUP")
     assert c.pwd() == "/"
+    c.quit()
+
+
+def test_stor_honors_bucket_default_sse(gw):
+    """A bucket whose default-encryption config demands SSE must not
+    store FTP uploads as plaintext — and RETR must decrypt, so both
+    directions ride the shared transform seam (advisor r4 medium)."""
+    from minio_tpu.object.types import GetOptions
+    c = _client(gw)
+    c.mkd("/ftpsse")
+    ol = gw.object_layer
+    meta = ol.get_bucket_meta("ftpsse")
+    meta["config:encryption"] = "AES256"
+    ol.set_bucket_meta("ftpsse", meta)
+    body = os.urandom(200_000)
+    c.storbinary("STOR /ftpsse/secret.bin", io.BytesIO(body))
+    info = ol.get_object_info("ftpsse", "secret.bin", GetOptions())
+    assert info.internal_metadata.get("x-internal-sse-alg") == "SSE-S3"
+    assert info.size == len(body)           # logical size
+    _, stored = ol.get_object("ftpsse", "secret.bin", GetOptions())
+    assert stored != body                   # at rest: DARE ciphertext
+    assert c.size("/ftpsse/secret.bin") == len(body)
+    out = io.BytesIO()
+    c.retrbinary("RETR /ftpsse/secret.bin", out.write)
+    assert out.getvalue() == body           # on the wire: plaintext
+    c.quit()
+
+
+def test_retr_decompresses(gw):
+    """RETR of a transparently-compressed object sends logical bytes,
+    not the stored zlib blocks."""
+    from minio_tpu.crypto import compress as comp
+    from minio_tpu.object.types import PutOptions
+    c = _client(gw)
+    c.mkd("/ftpcomp")
+    body = b"compress me " * 20_000
+    stored, meta = comp.compress(body)
+    opts = PutOptions()
+    opts.internal_metadata.update(meta)
+    gw.object_layer.put_object("ftpcomp", "blob", stored, opts)
+    assert c.size("/ftpcomp/blob") == len(body)
+    out = io.BytesIO()
+    c.retrbinary("RETR /ftpcomp/blob", out.write)
+    assert out.getvalue() == body
+    c.quit()
+
+
+def test_retr_sse_c_refused(gw):
+    """SSE-C objects need a client-held key FTP cannot carry: RETR
+    answers 550 instead of leaking ciphertext."""
+    from minio_tpu.crypto import EncryptingPayload, encrypt_stream_size
+    from minio_tpu.crypto import sse as sse_mod
+    from minio_tpu.object.types import PutOptions
+    from minio_tpu.utils.streams import Payload
+    c = _client(gw)
+    c.mkd("/ftpssec")
+    body = os.urandom(50_000)
+    customer_key = b"\x21" * 32
+    import base64
+    import hashlib
+    md5 = base64.b64encode(hashlib.md5(customer_key).digest()).decode()
+    data_key, nonce, imeta = sse_mod.encrypt_metadata(
+        "ftpssec", "locked", len(body), gw.kms, (customer_key, md5))
+    opts = PutOptions()
+    opts.internal_metadata.update(imeta)
+    enc = Payload(EncryptingPayload(Payload.wrap(body), data_key, nonce),
+                  encrypt_stream_size(len(body)))
+    gw.object_layer.put_object("ftpssec", "locked", enc, opts)
+    with pytest.raises(ftplib.error_perm):
+        c.retrbinary("RETR /ftpssec/locked", lambda b: None)
     c.quit()
 
 
