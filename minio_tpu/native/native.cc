@@ -19,6 +19,13 @@
 #include <cstring>
 #include <mutex>
 
+#if defined(__AVX2__) || (defined(__GFNI__) && defined(__AVX512F__))
+#include <immintrin.h>
+#endif
+#if defined(__GFNI__) && defined(__AVX512F__) && defined(__AVX512BW__)
+#define MTPU_GFNI 1
+#endif
+
 extern "C" {
 
 // ---------------------------------------------------------------------------
@@ -128,6 +135,58 @@ inline void Finalize256(HHState* s, uint64_t hash[4]) {
       s->v0[2] + s->mul0[2], &hash[3], &hash[2]);
 }
 
+#ifdef __AVX2__
+// The 4-lane HighwayHash state vectorizes exactly onto 256-bit
+// registers: each of v0/v1/mul0/mul1 is one __m256i, the 32->64 bit
+// lane multiplies are VPMULUDQ, and the zipper-merge byte permutation
+// (which scalar code spells as mask-and-shift soup) is one VPSHUFB per
+// 128-bit pair — the same mapping the reference's assembly dependency
+// (github.com/minio/highwayhash AVX2 path) exploits. Bulk packets run
+// vectorized; the ragged remainder and finalization spill to the
+// byte-identical scalar state.
+inline __m256i HHZipper(__m256i x) {
+  const __m256i kMask = _mm256_setr_epi8(
+      3, 12, 2, 5, 14, 1, 15, 0, 11, 4, 10, 13, 9, 6, 8, 7,
+      3, 12, 2, 5, 14, 1, 15, 0, 11, 4, 10, 13, 9, 6, 8, 7);
+  return _mm256_shuffle_epi8(x, kMask);
+}
+
+struct HHVec {
+  __m256i v0, v1, mul0, mul1;
+};
+
+inline void UpdateVec(__m256i lanes, HHVec* s) {
+  s->v1 = _mm256_add_epi64(s->v1, _mm256_add_epi64(s->mul0, lanes));
+  s->mul0 = _mm256_xor_si256(
+      s->mul0, _mm256_mul_epu32(s->v1, _mm256_srli_epi64(s->v0, 32)));
+  s->v0 = _mm256_add_epi64(s->v0, s->mul1);
+  s->mul1 = _mm256_xor_si256(
+      s->mul1, _mm256_mul_epu32(s->v0, _mm256_srli_epi64(s->v1, 32)));
+  s->v0 = _mm256_add_epi64(s->v0, HHZipper(s->v1));
+  s->v1 = _mm256_add_epi64(s->v1, HHZipper(s->v0));
+}
+
+inline void BulkPackets(const uint8_t* data, size_t full, HHState* s) {
+  HHVec v;
+  v.v0 = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(s->v0));
+  v.v1 = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(s->v1));
+  v.mul0 = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(s->mul0));
+  v.mul1 = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(s->mul1));
+  for (size_t i = 0; i < full; ++i)
+    UpdateVec(_mm256_loadu_si256(
+                  reinterpret_cast<const __m256i*>(data + 32 * i)),
+              &v);
+  _mm256_storeu_si256(reinterpret_cast<__m256i*>(s->v0), v.v0);
+  _mm256_storeu_si256(reinterpret_cast<__m256i*>(s->v1), v.v1);
+  _mm256_storeu_si256(reinterpret_cast<__m256i*>(s->mul0), v.mul0);
+  _mm256_storeu_si256(reinterpret_cast<__m256i*>(s->mul1), v.mul1);
+}
+#else
+inline void BulkPackets(const uint8_t* data, size_t full, HHState* s) {
+  for (size_t i = 0; i < full; ++i) UpdatePacket(data + 32 * i, s);
+}
+#endif  // __AVX2__
+
 }  // namespace
 
 void mtpu_hh256(const uint8_t* key32, const uint8_t* data, size_t len,
@@ -137,7 +196,7 @@ void mtpu_hh256(const uint8_t* key32, const uint8_t* data, size_t len,
   HHState s;
   Reset(key, &s);
   size_t full = len / 32;
-  for (size_t i = 0; i < full; ++i) UpdatePacket(data + 32 * i, &s);
+  BulkPackets(data, full, &s);
   if (len % 32) UpdateRemainder(data + 32 * full, len % 32, &s);
   uint64_t hash[4];
   Finalize256(&s, hash);
@@ -249,10 +308,101 @@ void GfInit() {
 }
 }  // namespace
 
+#ifdef MTPU_GFNI
+namespace {
+
+// GF2P8AFFINEQB computes, per byte x of src: out bit i =
+// parity(A.byte[7-i] & x) (+ imm bit). Multiplication by a constant c
+// in ANY GF(2^8) representation is GF(2)-linear, so an 8x8 bit matrix
+// whose column j is the byte c*x^j (field poly 0x11d here, NOT the
+// instruction's native AES poly) implements mul-by-c exactly — the
+// same trick the reference's dependency uses for its GFNI kernels
+// (klauspost/reedsolomon galois_amd64). Row i of the matrix (bit i of
+// every column) lands in qword byte 7-i.
+uint64_t kGfAffine[256];
+bool kGfniOk = false;
+std::once_flag kAffineOnce;
+
+void AffineInit() {
+  std::call_once(kAffineOnce, [] {
+    GfInit();
+    for (int c = 0; c < 256; ++c) {
+      uint64_t m = 0;
+      for (int j = 0; j < 8; ++j) {
+        const uint8_t col = c ? kGfMul[c][1 << j] : 0;  // c * x^j
+        for (int i = 0; i < 8; ++i)
+          if (col & (1 << i)) m |= 1ULL << ((7 - i) * 8 + j);
+      }
+      kGfAffine[c] = m;
+    }
+    // Trust nothing about bit-order conventions: validate the packed
+    // matrices against the multiplication table with the instruction
+    // itself before enabling the fast path.
+    alignas(64) uint8_t x[64], got[64];
+    for (int t = 0; t < 64; ++t) x[t] = uint8_t(4 * t + 3);
+    kGfniOk = true;
+    for (int c = 0; c < 256 && kGfniOk; c += 17) {
+      __m512i vx = _mm512_load_si512(reinterpret_cast<const void*>(x));
+      __m512i va = _mm512_set1_epi64(int64_t(kGfAffine[c]));
+      _mm512_store_si512(reinterpret_cast<void*>(got),
+                         _mm512_gf2p8affine_epi64_epi8(vx, va, 0));
+      for (int t = 0; t < 64; ++t)
+        if (got[t] != kGfMul[c][x[t]]) { kGfniOk = false; break; }
+    }
+  });
+}
+
+}  // namespace
+#endif  // MTPU_GFNI
+
 void mtpu_gf_apply(const uint8_t* matrix, size_t r, size_t k,
                    const uint8_t* shards, size_t stride, size_t len,
                    uint8_t* out, size_t out_stride) {
   GfInit();
+#ifdef MTPU_GFNI
+  AffineInit();
+  if (kGfniOk) {
+    // Coefficient classification and affine-matrix broadcasts are
+    // loop-invariant per output row; hoist them so the 64-byte inner
+    // loop is loads + affine + xor only (char aliasing otherwise stops
+    // the compiler from hoisting past the output stores).
+    enum : uint8_t { kSkip, kXor, kAffine };
+    uint8_t cls[64];
+    __m512i aff[64];
+    for (size_t i = 0; i < r; ++i) {
+      const size_t kk = k > 64 ? 64 : k;
+      for (size_t j = 0; j < kk; ++j) {
+        const uint8_t c = matrix[i * k + j];
+        cls[j] = c == 0 ? kSkip : (c == 1 ? kXor : kAffine);
+        aff[j] = _mm512_set1_epi64(int64_t(kGfAffine[c]));
+      }
+      uint8_t* dst = out + i * out_stride;
+      size_t t = 0;
+      if (k <= 64) {
+        for (; t + 64 <= len; t += 64) {
+          __m512i acc = _mm512_setzero_si512();
+          for (size_t j = 0; j < k; ++j) {
+            if (cls[j] == kSkip) continue;
+            __m512i x = _mm512_loadu_si512(
+                reinterpret_cast<const void*>(shards + j * stride + t));
+            acc = _mm512_xor_si512(
+                acc, cls[j] == kXor
+                         ? x
+                         : _mm512_gf2p8affine_epi64_epi8(x, aff[j], 0));
+          }
+          _mm512_storeu_si512(reinterpret_cast<void*>(dst + t), acc);
+        }
+      }
+      for (; t < len; ++t) {
+        uint8_t acc = 0;
+        for (size_t j = 0; j < k; ++j)
+          acc ^= kGfMul[matrix[i * k + j]][shards[j * stride + t]];
+        dst[t] = acc;
+      }
+    }
+    return;
+  }
+#endif  // MTPU_GFNI
   for (size_t i = 0; i < r; ++i) {
     uint8_t* dst = out + i * out_stride;
     std::memset(dst, 0, len);

@@ -355,13 +355,14 @@ class ErasureSet:
         control plane calls this when another node rewrites bucket
         metadata, so policy/versioning changes take effect here
         immediately instead of after the TTL."""
-        cache = getattr(self, "_bmeta_cache", None)
-        if cache is None:
-            return
-        if bucket:
-            cache.pop(bucket, None)
-        else:
-            cache.clear()
+        for cache in (getattr(self, "_bmeta_cache", None),
+                      getattr(self, "_bexists_cache", None)):
+            if cache is None:
+                continue
+            if bucket:
+                cache.pop(bucket, None)
+            else:
+                cache.clear()
 
     def bucket_versioning(self, bucket: str) -> bool:
         return bool(self.get_bucket_meta(bucket).get("versioning"))
@@ -372,12 +373,25 @@ class ErasureSet:
         self.set_bucket_meta(bucket, meta)
 
     def _check_bucket(self, bucket: str) -> None:
+        """Bucket existence, positive-cached for the metadata TTL: the
+        reference answers this from its in-memory bucket metadata system
+        rather than statting every drive per request — a per-PUT
+        n-drive stat fan-out costs more than the GF encode. Deletions
+        invalidate via invalidate_bucket_meta (local and peer paths)."""
+        import time as _time
         if bucket in _RESERVED_BUCKETS:
             raise BucketNotFound(bucket)
+        cache = getattr(self, "_bexists_cache", None)
+        if cache is None:
+            cache = self._bexists_cache = {}
+        deadline = cache.get(bucket)
+        if deadline is not None and _time.monotonic() < deadline:
+            return
         results, _ = self._fanout(
             [lambda d=d: d.stat_vol(bucket) for d in self.disks])
         if not any(r is not None for r in results):
             raise BucketNotFound(bucket)
+        cache[bucket] = _time.monotonic() + self._BUCKET_META_TTL
 
     # ------------------------------------------------------------------
     # quorum metadata

@@ -38,6 +38,15 @@ META_FILE = "xl.meta"
 TMP_DIR = "tmp"
 FORMAT_FILE = "format.json"
 
+# Directory-entry fsync after rename commits. The reference syncs file
+# CONTENTS (Fdatasync, cmd/xl-storage.go:2195) on every commit but syncs
+# the parent directory only when MINIO_FS_OSYNC is set
+# (cmd/common-main.go:745 defaults it off; cmd/xl-storage.go:1557
+# globalSync) — on a journaling filesystem the rename itself orders with
+# the journal, and a dir fsync per write costs more than the whole GF
+# encode. Same default, same opt-in, here.
+FS_OSYNC = os.environ.get("MTPU_FS_OSYNC", "").lower() in ("1", "on", "true")
+
 
 class StorageError(Exception):
     pass
@@ -193,18 +202,30 @@ class LocalStorage:
             pass
 
     def _atomic_write(self, dest: str, data: bytes) -> None:
-        """tmp + fsync + rename: the crash-consistency primitive."""
+        """tmp + fdatasync + rename: the crash-consistency primitive.
+
+        Directories are created on demand (ENOENT retry) rather than
+        with an unconditional makedirs pair — two mkdir walks per
+        commit cost real time on the hot path, and a hot-replaced
+        drive's missing staging tree is the rare case, not the common
+        one."""
         tmp = self._tmp_path()
-        # A hot-replaced drive may lack the staging tree; recreate it
-        # rather than failing heal/writes on the fresh drive.
-        os.makedirs(os.path.dirname(tmp), exist_ok=True)
-        os.makedirs(os.path.dirname(dest), exist_ok=True)
-        with open(tmp, "wb") as f:
+        try:
+            f = open(tmp, "wb")
+        except FileNotFoundError:
+            os.makedirs(os.path.dirname(tmp), exist_ok=True)
+            f = open(tmp, "wb")
+        with f:
             f.write(data)
             f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, dest)
-        self._fsync_dir(os.path.dirname(dest))
+            os.fdatasync(f.fileno())
+        try:
+            os.replace(tmp, dest)
+        except FileNotFoundError:
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            os.replace(tmp, dest)
+        if FS_OSYNC:
+            self._fsync_dir(os.path.dirname(dest))
 
     # ------------------------------------------------------------------
     # volumes
@@ -291,7 +312,8 @@ class LocalStorage:
     # ------------------------------------------------------------------
 
     def create_file(self, volume: str, path: str, data: bytes | Iterator[bytes]) -> None:
-        """Write a shard file with fsync (callers pass bitrot-framed bytes)."""
+        """Write a shard file with fdatasync (callers pass bitrot-framed
+        bytes; reference: cmd/xl-storage.go:2195 Fdatasync)."""
         dest = self._obj_dir(volume, path)
         os.makedirs(os.path.dirname(dest), exist_ok=True)
         with open(dest, "wb") as f:
@@ -301,7 +323,7 @@ class LocalStorage:
                 for chunk in data:
                     f.write(chunk)
             f.flush()
-            os.fsync(f.fileno())
+            os.fdatasync(f.fileno())
 
     def read_file(self, volume: str, path: str, offset: int = 0,
                   length: int = -1) -> bytes:
