@@ -155,6 +155,96 @@ def _save_state(pools_layer, state: dict) -> None:
     _write_doc(pools_layer, doc, state["pool"], scrub=True)
 
 
+def migrate_key(layer, src_idx: int, bucket: str, key: str,
+                pick_dst) -> None:
+    """Move one key's whole version stack out of pool `src_idx` — the
+    transfer primitive shared by decommission and rebalance.
+
+    Shape: snapshot → restore (no locks held across sets — in
+    distributed mode src and dst share the cluster-wide per-key
+    lock resource, so nesting them would deadlock) → verify +
+    clean up under the source key lock. Versions restore NEWEST
+    FIRST so the destination's latest-version resolution (markers
+    included) is correct at every intermediate step. Inside the
+    locked verify, versions that were deleted during the copy are
+    removed from the destination too (the API routes version
+    deletes to every pool while a drain runs), so an acknowledged
+    delete can never resurrect; the source copies are destroyed
+    only after everything landed — reads never see the key absent.
+
+    pick_dst() chooses the destination pool index when no existing
+    stack pins one.
+    """
+    from minio_tpu.object.types import (DeleteOptions, GetOptions,
+                                        MethodNotAllowed,
+                                        ObjectNotFound, VersionNotFound)
+    src_set = layer.pools[src_idx].set_for(key)
+    # Destination pinning: if another eligible pool already holds this
+    # key (e.g. a concurrent overwrite placed a new version there),
+    # the old versions must join that same stack — a free-space
+    # choice could split the key across two pools, and pool-ordered
+    # reads would then shadow the newer write.
+    dst_idx = layer._pool_of_existing(bucket, key)
+    if dst_idx is None or dst_idx == src_idx or \
+            dst_idx in layer.decommissioning:
+        dst_idx = pick_dst()
+    dst_set = layer.pools[dst_idx].set_for(key)
+    for _attempt in range(5):
+        try:
+            versions = src_set.list_versions_all(bucket, key)
+        except ObjectNotFound:
+            return                  # deleted mid-walk: nothing to do
+        from minio_tpu.object.tier import META_TIER
+        for fi in sorted(versions, key=lambda f: -f.mod_time):
+            data = None
+            tiered = bool((fi.metadata or {}).get(META_TIER))
+            if not fi.deleted and not tiered:
+                # Tiered versions migrate pointer-only — their
+                # data stays in the warm tier.
+                try:
+                    _, data = src_set.get_object(
+                        bucket, key,
+                        GetOptions(version_id=fi.version_id))
+                except (VersionNotFound, MethodNotAllowed,
+                        ObjectNotFound):
+                    continue        # pruned mid-walk
+            # skip_if_newer_null: a concurrent unversioned
+            # overwrite placed a NEWER null version in the
+            # destination; the check runs inside restore_version's
+            # key lock so the decision and the write are atomic.
+            dst_set.restore_version(bucket, key, fi, data,
+                                    skip_if_newer_null=True)
+        with src_set.ns.write(bucket, key):
+            try:
+                cur = src_set.list_versions_all(bucket, key)
+            except ObjectNotFound:
+                cur = []
+            snap_ids = {v.version_id for v in versions}
+            cur_ids = {v.version_id for v in cur}
+            if not cur_ids <= snap_ids:
+                continue            # stack changed mid-copy: redo
+            for vid in snap_ids - cur_ids:
+                # Deleted from the source while we copied: the
+                # restored destination copy must go too (unlocked
+                # internal — this thread holds the key lock).
+                try:
+                    dst_set._delete_object_locked(
+                        bucket, key, DeleteOptions(
+                            version_id=vid, versioned=False))
+                except (ObjectNotFound, VersionNotFound):
+                    pass
+            for fi in cur:
+                try:
+                    src_set._delete_object_locked(
+                        bucket, key, DeleteOptions(
+                            version_id=fi.version_id,
+                            versioned=False))
+                except (ObjectNotFound, VersionNotFound):
+                    pass
+            return
+    raise DecomError(f"{bucket}/{key}: version stack kept changing")
+
+
 class Decommission:
     """One pool-drain driver (start fresh or resume from a checkpoint)."""
 
@@ -302,88 +392,7 @@ class Decommission:
         self._notify_peers()
 
     def _migrate_key(self, src_pool, bucket: str, key: str) -> None:
-        """Move one key's whole version stack.
-
-        Shape: snapshot → restore (no locks held across sets — in
-        distributed mode src and dst share the cluster-wide per-key
-        lock resource, so nesting them would deadlock) → verify +
-        clean up under the source key lock. Versions restore NEWEST
-        FIRST so the destination's latest-version resolution (markers
-        included) is correct at every intermediate step. Inside the
-        locked verify, versions that were deleted during the copy are
-        removed from the destination too (the API routes version
-        deletes to every pool while a drain runs), so an acknowledged
-        delete can never resurrect; the source copies are destroyed
-        only after everything landed — reads never see the key absent.
-        """
-        from minio_tpu.object.types import (DeleteOptions, GetOptions,
-                                            MethodNotAllowed,
-                                            ObjectNotFound, VersionNotFound)
-        src_set = src_pool.set_for(key)
-        # Destination pinning: if a SURVIVING pool already holds this
-        # key (e.g. a concurrent overwrite placed a new version there),
-        # the old versions must join that same stack — a free-space
-        # choice could split the key across two pools, and pool-ordered
-        # reads would then shadow the newer write.
-        dst_idx = self.layer._pool_of_existing(bucket, key)
-        if dst_idx is None or dst_idx == self.pool_idx or \
-                dst_idx in self.layer.decommissioning:
-            dst_idx = self._dst_idx()
-        dst_set = self.layer.pools[dst_idx].set_for(key)
-        for _attempt in range(5):
-            try:
-                versions = src_set.list_versions_all(bucket, key)
-            except ObjectNotFound:
-                return                  # deleted mid-walk: nothing to do
-            from minio_tpu.object.tier import META_TIER
-            for fi in sorted(versions, key=lambda f: -f.mod_time):
-                data = None
-                tiered = bool((fi.metadata or {}).get(META_TIER))
-                if not fi.deleted and not tiered:
-                    # Tiered versions migrate pointer-only — their
-                    # data stays in the warm tier.
-                    try:
-                        _, data = src_set.get_object(
-                            bucket, key,
-                            GetOptions(version_id=fi.version_id))
-                    except (VersionNotFound, MethodNotAllowed,
-                            ObjectNotFound):
-                        continue        # pruned mid-walk
-                # skip_if_newer_null: a concurrent unversioned
-                # overwrite placed a NEWER null version in the
-                # destination; the check runs inside restore_version's
-                # key lock so the decision and the write are atomic.
-                dst_set.restore_version(bucket, key, fi, data,
-                                        skip_if_newer_null=True)
-            with src_set.ns.write(bucket, key):
-                try:
-                    cur = src_set.list_versions_all(bucket, key)
-                except ObjectNotFound:
-                    cur = []
-                snap_ids = {v.version_id for v in versions}
-                cur_ids = {v.version_id for v in cur}
-                if not cur_ids <= snap_ids:
-                    continue            # stack changed mid-copy: redo
-                for vid in snap_ids - cur_ids:
-                    # Deleted from the source while we copied: the
-                    # restored destination copy must go too (unlocked
-                    # internal — this thread holds the key lock).
-                    try:
-                        dst_set._delete_object_locked(
-                            bucket, key, DeleteOptions(
-                                version_id=vid, versioned=False))
-                    except (ObjectNotFound, VersionNotFound):
-                        pass
-                for fi in cur:
-                    try:
-                        src_set._delete_object_locked(
-                            bucket, key, DeleteOptions(
-                                version_id=fi.version_id,
-                                versioned=False))
-                    except (ObjectNotFound, VersionNotFound):
-                        pass
-                return
-        raise DecomError(f"{bucket}/{key}: version stack kept changing")
+        migrate_key(self.layer, self.pool_idx, bucket, key, self._dst_idx)
 
     def _dst_idx(self) -> int:
         """Surviving pool with the most free space (the reference picks

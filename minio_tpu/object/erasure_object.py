@@ -25,6 +25,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import hashlib
+import threading
 import zlib
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Sequence
@@ -86,6 +87,40 @@ def _framer_for(k: int, m: int):
     layout in one device pipeline — ops/hh_device.make_encode_framer)."""
     from minio_tpu.ops.hh_device import make_encode_framer
     return make_encode_framer(_parity_matrix(k, m))
+
+
+def _host_rows(k: int, m: int, stacked: np.ndarray) -> list[list]:
+    """Host-codec equivalent of the fused framer's rows: per-drive
+    lists over erasure blocks of (digest, block) piece tuples. Used as
+    the stripe batcher's fallback (and its calibration rival)."""
+    from minio_tpu.erasure.codec import _HOST
+    b, _, shard = stacked.shape
+    n = k + m
+    if m:
+        flat = np.ascontiguousarray(stacked.transpose(1, 0, 2)) \
+            .reshape(k, b * shard)
+        parity = np.asarray(_HOST.apply_matrix(_parity_matrix(k, m),
+                                               flat)) \
+            .reshape(m, b, shard).transpose(1, 0, 2)
+    else:
+        parity = np.zeros((b, 0, shard), dtype=np.uint8)
+    blocks = np.concatenate([stacked, parity], axis=1)   # [B, n, S]
+    digs = bitrot.hash_blocks_many(
+        bitrot.DEFAULT_ALGORITHM, blocks.reshape(b * n, shard)) \
+        .reshape(b, n, 32)
+    return [[(digs[bi, i], blocks[bi, i]) for bi in range(b)]
+            for i in range(n)]
+
+
+@functools.lru_cache(maxsize=64)
+def _batcher_for(k: int, m: int):
+    """Cross-request stripe batcher for one EC config: coalesces
+    concurrent PUT windows into one device step when the measured
+    device round trip beats the host codec (ops/batcher.py)."""
+    from minio_tpu.ops.batcher import StripeBatcher
+    return StripeBatcher(_framer_for(k, m),
+                         functools.partial(_host_rows, k, m),
+                         min_device_blocks=MIN_DEVICE_BLOCKS)
 
 
 def default_parity(set_size: int) -> int:
@@ -367,9 +402,14 @@ class ErasureSet:
     def bucket_versioning(self, bucket: str) -> bool:
         return bool(self.get_bucket_meta(bucket).get("versioning"))
 
-    def set_bucket_versioning(self, bucket: str, enabled: bool) -> None:
+    def set_bucket_versioning(self, bucket: str, status) -> None:
+        """status: True/"Enabled", "Suspended", or False (off).
+        Suspension is a distinct state (null-versionId writes replace
+        the null version; Enabled-era versions survive) — both keys
+        are managed here so every caller keeps them consistent."""
         meta = self.get_bucket_meta(bucket)
-        meta["versioning"] = bool(enabled)
+        meta["versioning"] = status is True or status == "Enabled"
+        meta["versioning-suspended"] = status == "Suspended"
         self.set_bucket_meta(bucket, meta)
 
     def _check_bucket(self, bucket: str) -> None:
@@ -434,6 +474,23 @@ class ErasureSet:
         n = len(self.disks)
         if not_found > n // 2:
             self._check_bucket(bucket)
+            # Dangling-object GC (reference: cmd/erasure-object.go:484
+            # deleteIfDangling): a MINORITY of drives still carries
+            # metadata for a key the majority definitively lacks —
+            # the leftover of a failed write. Reap it so it can neither
+            # resurrect via heal nor haunt listings. Only when every
+            # non-holding drive answered a clean not-found: a transient
+            # IO error could mean the metadata majority is merely
+            # unreachable. The reap itself runs ASYNC under the key's
+            # write lock with a re-read (this read path may hold the
+            # read lock, and an unlocked delete would race an in-flight
+            # PUT commit fan-out into destroying fresh shards).
+            holders = [i for i, fi in enumerate(fis) if fi is not None]
+            definitive = not_found + len(holders) == n
+            if holders and definitive and not version_id:
+                threading.Thread(
+                    target=self._reap_dangling, args=(bucket, object_),
+                    daemon=True, name="dangling-gc").start()
             raise ObjectNotFound(bucket, object_)
         if version_gone > n // 2:
             raise VersionNotFound(bucket, object_)
@@ -448,6 +505,29 @@ class ErasureSet:
         if fi is None:
             raise ReadQuorumError(bucket, object_)
         return fi, fis, errors
+
+    def _reap_dangling(self, bucket: str, object_: str) -> None:
+        """Destroy a dangling minority version stack — re-verified
+        under the key's WRITE lock so a concurrent PUT commit (which
+        also holds it) can never lose freshly-written shards to the
+        reaper."""
+        try:
+            with self.ns.write(bucket, object_):
+                fis, errors = self._read_version_all(bucket, object_, "")
+                n = len(self.disks)
+                not_found = sum(isinstance(e, FileNotFoundErr)
+                                for e in errors)
+                holders = [i for i, fi in enumerate(fis)
+                           if fi is not None]
+                if holders and not_found + len(holders) == n \
+                        and not_found > n // 2:
+                    self._fanout([
+                        lambda d=self.disks[i]: _swallow(
+                            lambda: d.delete(bucket, object_,
+                                             recursive=True))
+                        for i in holders])
+        except Exception:  # noqa: BLE001 - GC is best-effort
+            pass
 
     # ------------------------------------------------------------------
     # encode helpers (the TPU-batched data path)
@@ -519,11 +599,12 @@ class ErasureSet:
         block is framed on the host. Everywhere else this is the
         host/XLA batched path (byte-identical output).
 
-        pad_blocks: if set, the device batch is zero-padded up to this
-        many blocks (pad frames are sliced off) so the streaming window
-        loop keeps ONE compiled shape regardless of the last window's
-        block count.
+        pad_blocks: retained for call-site compatibility; batch-shape
+        stability is now the stripe batcher's job (it pads coalesced
+        batches to fixed buckets, so compiled shapes stay bounded no
+        matter how requests interleave).
         """
+        del pad_blocks
         e = self._erasure(k, m)
         n = k + m
         total = len(data)
@@ -534,13 +615,14 @@ class ErasureSet:
         # Honor the set's injected backend seam: the fused framer runs
         # only when this set was explicitly configured with a device
         # backend (server --ec-backend tpu/auto), so host/mock backends
-        # see every encode, same as the tail path below.
-        # Small PUTs stay on the host codec: a sub-batch dispatch cannot
-        # fill the device's 1024-stream vector tiles and pays the full
-        # host<->device round-trip latency for one object — the same
-        # reason the reference keeps small IO on the calling goroutine.
-        # 8 blocks * k shards is the point where batching starts to win.
-        use_device = (full >= MIN_DEVICE_BLOCKS and m > 0 and _on_tpu()
+        # see every encode, same as the tail path below. Eligible full
+        # blocks route through the cross-request stripe batcher: windows
+        # from concurrent PUTs coalesce into ONE device step (the batch
+        # dim = stripes from many requests) when the batcher's measured
+        # calibration says the device link wins; otherwise — including
+        # a lone PUT with nobody to batch with — the host codec runs
+        # with zero added latency (ops/batcher.py).
+        use_device = (full >= 1 and m > 0 and _on_tpu()
                       and hasattr(self.backend, "apply_matrix_device")
                       and BLOCK_SIZE % k == 0 and shard_size % 1024 == 0)
         if not use_device:
@@ -549,13 +631,8 @@ class ErasureSet:
         chunks: list[list] = [[] for _ in range(n)]
         buf = np.frombuffer(data, dtype=np.uint8, count=full * BLOCK_SIZE)
         stacked = buf.reshape(full, k, shard_size)
-        if pad_blocks and full < pad_blocks:
-            padded = np.zeros((pad_blocks, k, shard_size), dtype=np.uint8)
-            padded[:full] = stacked
-            stacked = padded
-        rows = _framer_for(k, m)(stacked)
-        # rows[i] = per-block (digest, block) piece tuples; pad blocks
-        # are whole trailing tuples, so trimming is list slicing. The
+        rows = _batcher_for(k, m).frame(stacked)
+        # rows[i] = per-block (digest, block) piece tuples. The
         # `hash || block` on-disk frame is assembled by the writer from
         # the pieces (reference cmd/bitrot-streaming.go:44-75 likewise
         # writes hash then block; no interleaved buffer ever exists).
@@ -1457,7 +1534,10 @@ class ErasureSet:
         self._check_bucket(bucket)
         with self.ns.write(bucket, object_):
             ptr = None
-            if opts.version_id or not opts.versioned:
+            if (opts.version_id or not opts.versioned) \
+                    and not opts.null_marker:
+                # (null_marker stacks a marker — the latest version
+                # SURVIVES, so its warm-tier blob must too.)
                 # Version destruction (not marker stacking): note a
                 # transitioned version's tier pointer now; the blob is
                 # reclaimed only AFTER the delete commits (removing it
@@ -1480,9 +1560,13 @@ class ErasureSet:
         n = len(self.disks)
         write_quorum = n // 2 + 1
 
-        if opts.versioned and not opts.version_id:
+        if (opts.versioned or opts.null_marker) and not opts.version_id:
             # Versioned delete without a version: write a delete marker.
-            marker_vid = new_uuid()
+            # Suspended buckets stamp the NULL versionId instead of a
+            # fresh one — write_metadata's add_version then REPLACES
+            # the previous null version, exactly AWS's suspended-state
+            # semantics (any Enabled-era versions stay untouched).
+            marker_vid = "" if opts.null_marker else new_uuid()
             fi = FileInfo(volume=bucket, name=object_, version_id=marker_vid,
                           deleted=True, mod_time=now_ns())
             _, errors = self._fanout(
@@ -1492,7 +1576,7 @@ class ErasureSet:
                 raise WriteQuorumError(bucket, object_)
             self.metacache.bump(bucket)
             return DeletedObject(object_name=object_, delete_marker=True,
-                                 delete_marker_version_id=marker_vid)
+                                 delete_marker_version_id=marker_vid or "null")
 
         _, errors = self._fanout(
             [lambda d=d: d.delete_version(bucket, object_, opts.version_id)

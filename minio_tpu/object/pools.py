@@ -174,9 +174,14 @@ class ServerPools:
     def bucket_versioning(self, bucket: str) -> bool:
         return bool(self.get_bucket_meta(bucket).get("versioning"))
 
-    def set_bucket_versioning(self, bucket: str, enabled: bool) -> None:
+    def set_bucket_versioning(self, bucket: str, status) -> None:
+        """status: True/"Enabled", "Suspended", or False (off).
+        Suspension is a distinct state (null-versionId writes replace
+        the null version; Enabled-era versions survive) — both keys
+        are managed here so every caller keeps them consistent."""
         meta = self.get_bucket_meta(bucket)
-        meta["versioning"] = bool(enabled)
+        meta["versioning"] = status is True or status == "Enabled"
+        meta["versioning-suspended"] = status == "Suspended"
         self.set_bucket_meta(bucket, meta)
 
     # -- objects -------------------------------------------------------
@@ -219,7 +224,8 @@ class ServerPools:
         from minio_tpu.object.types import DeleteOptions
         opts = opts or DeleteOptions()
         if self.decommissioning:
-            marker = opts.versioned and not opts.version_id
+            marker = (opts.versioned or opts.null_marker) \
+                and not opts.version_id
             if marker:
                 # Markers stack where a write would land: the pool that
                 # owns the key, or a survivor when the owner is draining
@@ -313,6 +319,76 @@ class ServerPools:
         """Pause the active drain (checkpointed; resumable)."""
         if self._decom is not None:
             self._decom.stop()
+
+    # -- rebalance -----------------------------------------------------
+
+    def _rebalance_lock(self):
+        import threading
+        lock = getattr(self, "_rebal_mu", None)
+        if lock is None:
+            lock = self._rebal_mu = threading.Lock()
+        return lock
+
+    def start_rebalance(self, checkpoint_every=None):
+        """Begin draining overfilled pools toward the cluster average
+        (reference: cmd/erasure-server-pool-rebalance.go
+        rebalanceStart). Check-and-create under a lock: two concurrent
+        admin starts must not race two drivers onto one state file."""
+        from minio_tpu.object import rebalance
+        kw = {} if checkpoint_every is None else \
+            {"checkpoint_every": checkpoint_every}
+        with self._rebalance_lock():
+            rb = getattr(self, "_rebalance", None)
+            if rb is not None and rb.state.get("status") in (
+                    "planning", "rebalancing") and not rb.wait(timeout=0):
+                raise rebalance.RebalanceError(
+                    "a rebalance is already running")
+            self._rebalance = rebalance.Rebalance(self, **kw)
+            self._rebalance.start()
+            return self._rebalance
+
+    def resume_rebalance(self):
+        """Boot-time resume of an interrupted rebalance (the migrate is
+        idempotent, so re-walking from the checkpoint is safe). Returns
+        the driver or None."""
+        from minio_tpu.object import rebalance
+        state = rebalance.load_state(self)
+        if not state or state.get("status") not in ("planning",
+                                                    "rebalancing"):
+            return None
+        # A topology change invalidates per-pool indices; only resume a
+        # PLANNED state when the pool count still matches. A run killed
+        # mid-planning has no per-pool records yet — restart planning.
+        if state.get("status") == "planning" or \
+                len(state.get("pools", {})) != len(self.pools):
+            state = None
+        with self._rebalance_lock():
+            self._rebalance = rebalance.Rebalance(self, state=state)
+            self._rebalance.start()
+            return self._rebalance
+
+    def rebalance_status(self):
+        import json as _json
+        from minio_tpu.object import rebalance
+        rb = getattr(self, "_rebalance", None)
+        if rb is not None:
+            # Deep copy: the worker mutates nested per-pool dicts
+            # concurrently, and a shallow copy could change size under
+            # the admin handler's JSON serializer.
+            for _ in range(3):
+                try:
+                    return _json.loads(_json.dumps(rb.state))
+                except RuntimeError:
+                    continue
+            return {"status": rb.state.get("status", "rebalancing")}
+        state = rebalance.load_state(self)
+        return dict(state) if state else None
+
+    def stop_rebalance(self):
+        """Pause the active rebalance (checkpointed; resumable)."""
+        rb = getattr(self, "_rebalance", None)
+        if rb is not None:
+            rb.stop()
 
     # -- multipart -----------------------------------------------------
 

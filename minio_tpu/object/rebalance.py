@@ -1,0 +1,291 @@
+"""Pool rebalance: drain overfilled pools toward the cluster average.
+
+The analogue of the reference's erasure-server-pool rebalancing
+(cmd/erasure-server-pool-rebalance.go:100 rebalanceMeta + rebalanceStart
+/ rebalanceStatus / rebalanceStop admin verbs): decommission's other
+half. Where decommission empties a pool completely and takes it out of
+placement, rebalance keeps every pool in service and moves just enough
+objects from pools ABOVE the average fill fraction into the emptier
+ones that the cluster converges — the operation an operator runs after
+adding a new (empty) expansion pool.
+
+Mechanics shared with decommission (object/decom.py):
+- the per-key transfer primitive `migrate_key` (snapshot -> restore
+  newest-first -> locked verify/cleanup), so reads stay correct at
+  every moment and concurrent overwrites/deletes never resurrect;
+- checkpointed resume: progress (per-pool bucket/marker/bytes) persists
+  to a quorum of pool-0 drives every CHECKPOINT_EVERY keys; a killed
+  server resumes where it stopped (the reference persists
+  rebalanceMeta in .minio.sys/rebalance.meta the same way).
+
+Differences from decommission, matching the reference:
+- sources stay IN placement (new writes still follow most-free-space,
+  which naturally prefers the destinations);
+- each participating pool has its own walk state and byte target
+  (stop when the pool reaches the average), reference's per-pool
+  rebalance workers;
+- destinations exclude the other participating sources so bytes never
+  ping-pong between two overfilled pools.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional
+
+from minio_tpu.object.decom import migrate_key
+from minio_tpu.storage.local import SYS_VOL
+
+REBAL_PATH = "config/rebalance.json"
+CHECKPOINT_EVERY = 16
+# A pool participates when its used bytes exceed its capacity-weighted
+# share of the cluster's data by this RELATIVE margin (reference uses a
+# small hysteresis band too, so a balanced cluster is a no-op).
+# Relative to the pool's target usage — not to raw capacity — so the
+# criterion behaves the same for a 1 MiB test corpus and a 1 PiB one.
+THRESHOLD = 0.02
+
+
+class RebalanceError(Exception):
+    pass
+
+
+def bucket_used_bytes(layer, bucket: str) -> int:
+    """Sum of all version sizes in one bucket via a paged walk — the
+    shared accounting loop behind rebalance planning and quota
+    enforcement's live fallback."""
+    used = 0
+    marker = ""
+    while True:
+        page = layer.list_objects(bucket, marker=marker, max_keys=1000,
+                                  include_versions=True)
+        used += sum(o.size for o in page.objects)
+        if not page.is_truncated:
+            break
+        marker = page.next_marker
+    return used
+
+
+def pool_usage(pool) -> tuple[int, int]:
+    """(used_bytes, capacity_bytes) for one pool. Used bytes come from
+    walking the namespace (version stacks included) — the same
+    accounting the scanner keeps; capacity from the drives."""
+    used = sum(bucket_used_bytes(pool, b.name) for b in pool.list_buckets())
+    cap = 0
+    for s in pool.sets:
+        for d in s.disks:
+            try:
+                info = d.disk_info()
+                cap += info.total
+            except Exception:  # noqa: BLE001 - offline drive
+                pass
+    return used, cap
+
+
+def load_state(pools_layer) -> Optional[dict]:
+    """Highest-revision persisted rebalance state across pool-0 drives
+    (quorum-voted), or None."""
+    votes: dict[bytes, int] = {}
+    for s in pools_layer.pools[0].sets:
+        for d in s.disks:
+            try:
+                blob = d.read_all(SYS_VOL, REBAL_PATH)
+                votes[blob] = votes.get(blob, 0) + 1
+            except Exception:  # noqa: BLE001 - absent / offline
+                continue
+    best: Optional[dict] = None
+    for blob in votes:
+        try:
+            doc = json.loads(blob)
+        except ValueError:
+            continue
+        if isinstance(doc, dict) and "pools" in doc and \
+                (best is None or doc.get("rev", 0) > best.get("rev", 0)):
+            best = doc
+    return best
+
+
+class Rebalance:
+    """One cluster rebalance run (fresh or resumed)."""
+
+    def __init__(self, pools_layer, state: Optional[dict] = None,
+                 checkpoint_every: int = CHECKPOINT_EVERY,
+                 threshold: float = THRESHOLD):
+        if len(pools_layer.pools) < 2:
+            raise RebalanceError("rebalance needs at least two pools")
+        self.layer = pools_layer
+        self.checkpoint_every = checkpoint_every
+        self.threshold = threshold
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # Planning walks every pool's namespace for usage accounting —
+        # that happens in the background worker, NOT here: the admin
+        # start handler must return immediately on large clusters.
+        self.state = state or {"status": "planning",
+                               "started_ns": time.time_ns(),
+                               "pools": {}, "rev": 0}
+
+    # -- planning -------------------------------------------------------
+
+    def _plan(self) -> dict:
+        usages = [pool_usage(p) for p in self.layer.pools]
+        total_used = sum(u for u, _ in usages)
+        total_cap = sum(c for _, c in usages) or 1
+        avg = total_used / total_cap
+        pools = {}
+        for i, (used, cap) in enumerate(usages):
+            cap = cap or 1
+            # This pool's capacity-weighted share of the cluster data.
+            target_used = avg * cap
+            participating = used > target_used * (1 + self.threshold) \
+                and used > 0 and i not in self.layer.decommissioning
+            # Bytes this pool must shed to land on the average.
+            target = max(0, int(used - target_used)) if participating else 0
+            pools[str(i)] = {
+                "participating": participating,
+                "used": used, "capacity": cap,
+                "bytes_target": target, "bytes_moved": 0,
+                "bucket": "", "marker": "", "done": not participating,
+                "migrated": 0, "failed": 0,
+            }
+        return {"status": "rebalancing", "started_ns": time.time_ns(),
+                "pools": pools, "rev": 0}
+
+    # -- persistence ----------------------------------------------------
+
+    def _persist(self) -> None:
+        self.state["rev"] = self.state.get("rev", 0) + 1
+        blob = json.dumps(self.state, sort_keys=True).encode()
+        disks = [d for s in self.layer.pools[0].sets for d in s.disks]
+        ok = 0
+        for d in disks:
+            try:
+                d.write_all(SYS_VOL, REBAL_PATH, blob)
+                ok += 1
+            except Exception:  # noqa: BLE001 - offline drive
+                continue
+        if ok < len(disks) // 2 + 1:
+            raise RebalanceError("could not persist rebalance state")
+
+    # -- control --------------------------------------------------------
+
+    def start(self) -> None:
+        self._persist()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="rebalance")
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Pause (state stays 'rebalancing'; a resume continues from
+        the checkpoint)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+        if self.state.get("status") in ("planning", "rebalancing"):
+            try:
+                self._persist()
+            except RebalanceError:
+                pass
+
+    def wait(self, timeout: float = 300) -> bool:
+        if self._thread is not None:
+            self._thread.join(timeout)
+            return not self._thread.is_alive()
+        return True
+
+    # -- the walk -------------------------------------------------------
+
+    def _sources(self) -> list[int]:
+        return [int(i) for i, rec in self.state["pools"].items()
+                if rec["participating"] and not rec["done"]]
+
+    def _pick_dst(self, exclude: set[int]) -> int:
+        best, best_free = None, -1
+        for i, p in enumerate(self.layer.pools):
+            if i in exclude or i in self.layer.decommissioning:
+                continue
+            free = p.free_space()
+            if free > best_free:
+                best, best_free = i, free
+        if best is None:
+            raise RebalanceError("no destination pool available")
+        return best
+
+    def _run(self) -> None:
+        try:
+            if self.state.get("status") == "planning":
+                plan = self._plan()
+                plan["started_ns"] = self.state["started_ns"]
+                self.state.update(plan)
+                self._persist()
+            sources = set(self._sources())
+            for src in sorted(sources):
+                if self._stop.is_set():
+                    return
+                self._drain_pool(src, exclude=sources)
+            if self._stop.is_set():
+                return
+            failed = sum(r["failed"] for r in self.state["pools"].values())
+            self.state["status"] = "failed" if failed else "complete"
+            self.state["finished_ns"] = time.time_ns()
+            self._persist()
+        except Exception as e:  # noqa: BLE001 - recorded, resumable
+            self.state["status"] = "failed"
+            self.state["error"] = str(e)
+            try:
+                self._persist()
+            except RebalanceError:
+                pass
+
+    def _drain_pool(self, src: int, exclude: set[int]) -> None:
+        rec = self.state["pools"][str(src)]
+        pool = self.layer.pools[src]
+        since_ckpt = 0
+        buckets = sorted(b.name for b in pool.list_buckets())
+        start_bucket = rec.get("bucket", "")
+        for bucket in buckets:
+            if bucket < start_bucket:
+                continue
+            marker = rec.get("marker", "") if bucket == start_bucket else ""
+            while not self._stop.is_set():
+                page = pool.list_objects(bucket, marker=marker,
+                                         max_keys=256,
+                                         include_versions=True)
+                sizes: dict[str, int] = {}
+                for o in page.objects:
+                    sizes[o.name] = sizes.get(o.name, 0) + o.size
+                for key in sorted(sizes):
+                    if self._stop.is_set():
+                        return
+                    try:
+                        migrate_key(self.layer, src, bucket, key,
+                                    lambda: self._pick_dst(exclude))
+                        rec["migrated"] += 1
+                        rec["bytes_moved"] += sizes[key]
+                    except Exception as e:  # noqa: BLE001 - keep going
+                        rec["failed"] += 1
+                        rec["last_error"] = f"{bucket}/{key}: {e}"
+                    rec["bucket"] = bucket
+                    rec["marker"] = key
+                    since_ckpt += 1
+                    if since_ckpt >= self.checkpoint_every:
+                        since_ckpt = 0
+                        self._persist()
+                    if rec["bytes_moved"] >= rec["bytes_target"]:
+                        # Pool reached the average: done shedding.
+                        rec["done"] = True
+                        self._persist()
+                        return
+                if not page.is_truncated:
+                    break
+                marker = page.next_marker
+            if self._stop.is_set():
+                return
+            rec["bucket"] = bucket
+            rec["marker"] = ""
+            self._persist()
+        # Walked everything (targets were estimates): done either way.
+        rec["done"] = True
+        self._persist()

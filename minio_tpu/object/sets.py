@@ -166,9 +166,14 @@ class ErasureSets:
     def bucket_versioning(self, bucket: str) -> bool:
         return bool(self.get_bucket_meta(bucket).get("versioning"))
 
-    def set_bucket_versioning(self, bucket: str, enabled: bool) -> None:
+    def set_bucket_versioning(self, bucket: str, status) -> None:
+        """status: True/"Enabled", "Suspended", or False (off).
+        Suspension is a distinct state (null-versionId writes replace
+        the null version; Enabled-era versions survive) — both keys
+        are managed here so every caller keeps them consistent."""
         meta = self.get_bucket_meta(bucket)
-        meta["versioning"] = bool(enabled)
+        meta["versioning"] = status is True or status == "Enabled"
+        meta["versioning-suspended"] = status == "Suspended"
         self.set_bucket_meta(bucket, meta)
 
     # -- objects (route by key) ----------------------------------------

@@ -136,6 +136,12 @@ class GetOptions:
 class DeleteOptions:
     version_id: str = ""
     versioned: bool = False
+    # Versioning-SUSPENDED simple delete: write a delete marker with the
+    # null versionId, REPLACING any existing null version/marker —
+    # AWS's suspended-bucket semantics (reference:
+    # internal/bucket/versioning/versioning.go:36,76 treats Suspended
+    # as a distinct state, not versioning-off).
+    null_marker: bool = False
 
 
 @dataclasses.dataclass

@@ -1,0 +1,267 @@
+"""Cross-request stripe batching for the fused PUT pipeline.
+
+The blueprint's most TPU-native idea (BASELINE.json: "shard batches from
+parallelWriter ... are coalesced into HBM-resident tensors so a full
+erasure set's stripes encode in one pmap"): stripe windows from MANY
+concurrent PutObject calls coalesce into ONE device step — the batch
+dimension becomes "stripes from many requests" — and completions
+demultiplex back to the waiting writers. The reference's analogue is the
+opposite trade (each goroutine encodes its own blocks on its own core,
+cmd/erasure-encode.go:27 multiWriter); on a TPU the accelerator is one
+big shared core, so batching across requests is what fills it.
+
+Dispatch policy is MEASURED, not assumed: a one-time background probe
+times the device round trip (host->HBM transfer + fused kernel +
+readback) against the host codec for the same bytes. Where the device
+link is fast (PCIe-local TPU) batches beat the host and route to the
+device; where it is slow (e.g. a tunneled remote chip) everything stays
+on the host codec and the batcher degrades to a pass-through. A lone
+PUT with no concurrency never waits: frame() bypasses the queue
+entirely unless other requests are already in flight.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+# Batch-dim padding buckets: one compiled device shape per bucket, not
+# one per distinct concurrency level.
+_BUCKETS = (8, 16, 32, 64, 128, 256)
+# How long the first window of a burst waits for company.
+_MAX_WAIT_S = 0.002
+# Cap per dispatched device batch (VMEM/HBM bound upstream anyway).
+_MAX_BATCH_BLOCKS = 256
+
+
+def _bucket(n: int) -> int:
+    for b in _BUCKETS:
+        if n <= b:
+            return b
+    return _BUCKETS[-1]
+
+
+class _Pending:
+    __slots__ = ("stacked", "rows", "exc", "event")
+
+    def __init__(self, stacked: np.ndarray):
+        self.stacked = stacked
+        self.rows = None
+        self.exc: Optional[BaseException] = None
+        self.event = threading.Event()
+
+
+class StripeBatcher:
+    """Coalesces concurrent frame() calls of one EC config.
+
+    device_fn(stacked [B, k, L] u8) -> per-drive rows (the
+    make_encode_framer run() contract); host_fn(stacked) -> same rows
+    via the host codec. Both must be thread-safe.
+    """
+
+    def __init__(self, device_fn: Callable, host_fn: Callable,
+                 probe_fn: Optional[Callable] = None,
+                 min_device_blocks: int = 8,
+                 max_wait_s: float = _MAX_WAIT_S):
+        self._device_fn = device_fn
+        self._host_fn = host_fn
+        self._min_device_blocks = min_device_blocks
+        self._max_wait = max_wait_s
+        self._mu = threading.Condition()
+        self._pending: list[_Pending] = []
+        self._deadline = 0.0
+        self._inflight = 0          # frame() calls currently active
+        self._dispatcher: Optional[threading.Thread] = None
+        self._closed = False
+        # Calibration: None = unknown (host until probed), True/False.
+        self._device_ok: Optional[bool] = None
+        self._probe_fn = probe_fn
+        self._probe_started = False
+
+    # -- calibration ----------------------------------------------------
+
+    def _default_probe(self, sample: np.ndarray) -> bool:
+        """Time device vs host on one representative batch (the first
+        request's config, widened to a device-worthy block count);
+        True when the device round trip wins."""
+        stacked = np.zeros(
+            (_bucket(self._min_device_blocks),) + sample.shape[1:],
+            dtype=np.uint8)
+        try:
+            self._device_fn(stacked)           # compile
+            t0 = time.perf_counter()
+            self._device_fn(stacked)
+            t_dev = time.perf_counter() - t0
+        except Exception:  # noqa: BLE001 - no device -> host
+            return False
+        t0 = time.perf_counter()
+        self._host_fn(stacked)
+        t_host = time.perf_counter() - t0
+        return t_dev < t_host
+
+    def _ensure_probe(self, sample: np.ndarray) -> None:
+        with self._mu:
+            # Check-and-set under the lock: two first-users racing here
+            # would otherwise run two probes whose device/host timings
+            # pollute each other.
+            if self._probe_started:
+                return
+            self._probe_started = True
+
+        def probe():
+            try:
+                if self._probe_fn is not None:
+                    ok = bool(self._probe_fn())
+                else:
+                    ok = self._default_probe(sample)
+            except Exception:  # noqa: BLE001 - probe failure -> host
+                ok = False
+            with self._mu:
+                self._device_ok = ok
+
+        # Non-daemon: a daemon probe mid-device-call at interpreter
+        # exit aborts the process from inside the runtime (terminate
+        # without rethrow); joining at exit costs at most one compile.
+        threading.Thread(target=probe, daemon=False,
+                         name="stripe-batcher-probe").start()
+
+    # -- submission -----------------------------------------------------
+
+    def frame(self, stacked: np.ndarray):
+        """Frame one request's stripe window [B, k, L]; blocks until
+        the (possibly coalesced) result is ready. Returns per-drive
+        rows for exactly this window's blocks."""
+        big = stacked.shape[0] >= self._min_device_blocks
+        with self._mu:
+            self._inflight += 1
+            solo = self._inflight == 1 and not self._pending
+        try:
+            if big or not solo:
+                # Worth calibrating: either this window alone is
+                # device-sized, or there is company to coalesce with.
+                # (A lone small PUT never probes — the probe's device
+                # compile would steal host CPU from a workload that is
+                # not even a batching candidate.)
+                self._ensure_probe(stacked)
+            if solo:
+                if big and self._device_ok:
+                    # A single device-sized window (e.g. a streaming
+                    # PUT's 32-block window) needs no queue — dispatch
+                    # straight to the fused pipeline, padded to the
+                    # same fixed buckets as coalesced batches so a
+                    # ragged tail window can't compile a fresh shape.
+                    b = stacked.shape[0]
+                    pad = _bucket(b) - b
+                    if pad > 0:
+                        stacked = np.concatenate(
+                            [stacked,
+                             np.zeros((pad,) + stacked.shape[1:],
+                                      dtype=stacked.dtype)])
+                    rows = self._device_fn(stacked)
+                    return [drive[:b] for drive in rows] if pad > 0 \
+                        else rows
+                return self._host_fn(stacked)
+            if self._device_ok is not True:
+                return self._host_fn(stacked)
+            return self._enqueue(stacked)
+        finally:
+            with self._mu:
+                self._inflight -= 1
+
+    def _enqueue(self, stacked: np.ndarray):
+        p = _Pending(stacked)
+        with self._mu:
+            if not self._pending:
+                self._deadline = time.monotonic() + self._max_wait
+            self._pending.append(p)
+            # _dispatcher is cleared (under this lock) by the loop
+            # BEFORE it exits, so is_alive() can never claim a thread
+            # that has already decided to die with our entry unseen.
+            if self._dispatcher is None or not self._dispatcher.is_alive():
+                self._dispatcher = threading.Thread(
+                    target=self._dispatch_loop, daemon=True,
+                    name="stripe-batcher")
+                self._dispatcher.start()
+            # Always wake the dispatcher: if it is parked in its idle
+            # 0.2 s poll, an un-notified append would stretch the 2 ms
+            # coalescing window into a 200 ms latency spike.
+            self._mu.notify_all()
+        p.event.wait()
+        if p.exc is not None:
+            raise p.exc
+        return p.rows
+
+    # -- dispatch -------------------------------------------------------
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._mu:
+                while not self._pending and not self._closed:
+                    self._mu.wait(timeout=0.2)
+                    if not self._pending and self._inflight == 0:
+                        # Idle: clear the handle BEFORE dying (still
+                        # under the lock) so a racing _enqueue starts
+                        # a fresh dispatcher instead of trusting a
+                        # thread that will never look again.
+                        self._dispatcher = None
+                        return
+                if self._closed and not self._pending:
+                    self._dispatcher = None
+                    return
+                now = time.monotonic()
+                total = sum(e.stacked.shape[0] for e in self._pending)
+                if total < _MAX_BATCH_BLOCKS and now < self._deadline \
+                        and not self._closed:
+                    self._mu.wait(timeout=self._deadline - now)
+                    continue
+                # Drain at most one bucket's worth per dispatch; the
+                # remainder keeps its place for the next round (an
+                # unbounded drain could exceed the largest pad bucket).
+                batch, rest = [], []
+                taken = 0
+                for p in self._pending:
+                    c = p.stacked.shape[0]
+                    if batch and taken + c > _MAX_BATCH_BLOCKS:
+                        rest.append(p)
+                    else:
+                        batch.append(p)
+                        taken += c
+                self._pending = rest
+                if rest:
+                    self._deadline = now      # no extra wait for them
+            self._run_batch(batch)
+
+    def _run_batch(self, batch: list[_Pending]) -> None:
+        counts = [p.stacked.shape[0] for p in batch]
+        total = sum(counts)
+        try:
+            if total >= self._min_device_blocks and self._device_ok:
+                stacked = np.concatenate([p.stacked for p in batch]) \
+                    if len(batch) > 1 else batch[0].stacked
+                pad = max(0, _bucket(total) - total)
+                if pad:
+                    stacked = np.concatenate(
+                        [stacked, np.zeros((pad,) + stacked.shape[1:],
+                                           dtype=stacked.dtype)])
+                rows_all = self._device_fn(stacked)
+                off = 0
+                for p, c in zip(batch, counts):
+                    p.rows = [drive[off:off + c] for drive in rows_all]
+                    off += c
+            else:
+                for p in batch:
+                    p.rows = self._host_fn(p.stacked)
+        except BaseException as e:  # noqa: BLE001 - deliver to waiters
+            for p in batch:
+                p.exc = e
+        finally:
+            for p in batch:
+                p.event.set()
+
+    def close(self) -> None:
+        with self._mu:
+            self._closed = True
+            self._mu.notify_all()

@@ -791,9 +791,15 @@ def make_encode_framer(matrix: np.ndarray, mode: str = "auto"):
             data32 = jnp.asarray(data.view(np.uint32))
             parity, dig_d, dig_p = fused32(
                 data32, jnp.asarray(_init_smem_np(MAGIC_KEY)), pchunk)
-            parity = np.asarray(parity).view(np.uint8)   # [B, m, L]
-            dig_d = np.asarray(dig_d).view(np.uint8)     # [B, k, 32]
-            dig_p = np.asarray(dig_p).view(np.uint8)     # [B, m, 32]
+            # ascontiguousarray: device arrays can come back with a
+            # non-contiguous minor axis for some batch shapes, and
+            # .view of a wider dtype requires contiguity.
+            parity = np.ascontiguousarray(np.asarray(parity)) \
+                .view(np.uint8)                          # [B, m, L]
+            dig_d = np.ascontiguousarray(np.asarray(dig_d)) \
+                .view(np.uint8)                          # [B, k, 32]
+            dig_p = np.ascontiguousarray(np.asarray(dig_p)) \
+                .view(np.uint8)                          # [B, m, 32]
             return ([[(dig_d[bi, i], data[bi, i]) for bi in range(b)]
                      for i in range(k)]
                     + [[(dig_p[bi, j], parity[bi, j]) for bi in range(b)]

@@ -229,64 +229,100 @@ def _pallas_apply(bmat_plane: jax.Array, data: jax.Array, tile: int,
 # sublanes, so bitcasting u8 shards to the u32 words HighwayHash needs
 # is a ~35 GiB/s relayout — slower than the hash itself. This variant
 # keeps the WHOLE pipeline in u32 lanes: each lane holds 4 consecutive
-# shard bytes, the GF transform runs per byte-slot (same bit-matrix,
-# four slot dots share one MXU call), and the output is directly the
-# word layout the hash kernel consumes. Byte-identical to the u8 path.
+# shard bytes and the output is directly the word layout the hash
+# kernel consumes. Byte-identical to the u8 path.
+#
+# The kernel unpacks bits in the i8 DOMAIN: pltpu.bitcast reinterprets
+# the u32 tile as u8 rows in-register (row = 4*shard + byte_slot,
+# measured v5e layout), where each bit extraction is and/cmp/select on
+# (32, 128)-dense i8 vregs — 4x the elements per op of the old
+# u32-domain shift+mask unpack (which cost 64 VPU ops per word, the
+# kernel's former governor). The byte slots ride the ROW axis, so the
+# GF(2) matrix expands block-diagonally per slot (_prep8), and the
+# mod-2 repack is a slice/or tree straight out of the i32 accumulator —
+# measured faster than the weights-matmul repack here because it skips
+# the [r8, lanes] i32->i8 cast relayout. 131 -> ~170 GiB/s on v5e for
+# EC 8+4 on 1 MiB blocks.
 
-def _rs_kernel32(bmat_ref, wrep_ref, data_ref, out_ref):
+@functools.lru_cache(maxsize=4096)
+def _prep8_cached(key: bytes, r: int, k: int) -> np.ndarray:
+    """Plane-PAIR-packed block-diagonal bit matrix int8 [16*rp, 32k]
+    for the i8-row layout (rp = r rounded up to even so byte rows tile
+    in 8s): row a = p*4rp + 4*jr + slot carries bit planes 2p (weight
+    +1) and 2p+1 (weight -128) of output byte row 4*jr + slot; col =
+    b*4k + 4*i + slot. Packing two GF(2) planes per accumulator row —
+    recoverable because the +1 part sums to < 128 for k <= 15 — halves
+    the [rows, lanes] i32 accumulator, whose VMEM round-trip is the
+    kernel's real cost on v5e."""
+    matrix = np.frombuffer(key, dtype=np.uint8).reshape(r, k)
+    assert k <= 15, "plane-pair packing requires k <= 15"
+    bm = gf256.bit_matrix(matrix)          # [r8, k8]: row jr*8+c, col i*8+b
+    rp = r + (r & 1)
+    planes = np.zeros((8, 4 * rp, 32 * k), dtype=np.int32)
+    for c in range(8):
+        for jr in range(r):
+            for j in range(4):
+                for b in range(8):
+                    for i in range(k):
+                        planes[c, 4 * jr + j, b * 4 * k + 4 * i + j] = \
+                            bm[jr * 8 + c, i * 8 + b]
+    out = np.zeros((16 * rp, 32 * k), dtype=np.int32)
+    for p in range(4):
+        out[p * 4 * rp:(p + 1) * 4 * rp] = \
+            planes[2 * p] - 128 * planes[2 * p + 1]
+    return out.astype(np.int8)
+
+
+def _rs_kernel32(bmat_ref, data_ref, out_ref):
     """One (batch, lane-tile) cell on u32 lanes.
 
-    bmat_ref: int8 [r8, k8] PLANE-major (same matrix as _rs_kernel).
-    wrep_ref: int8 [r, r8] repack weights (_repack_weights).
+    bmat_ref: int8 [16*rp, 32k] pair-packed bit matrix (_prep8_cached).
     data_ref: uint32 [bb, k, TL4]; out_ref: uint32 [bb, r, TL4].
 
-    Bit b of byte-slot s of a u32 lane is just global bit 8s+b, so the
-    unpack extracts straight from the words — slots concatenate along
-    lanes and all four share one dot. Bits stay int32 until one late
-    cast and the repack is a weights matmul (see _rs_kernel's notes on
-    why both matter on v5e).
+    acc row (p, row4) = lo - 128*hi where lo/hi are the GF(2) dot sums
+    of planes 2p / 2p+1 (each in [0, 120]): lo parity = acc & 1 (the
+    -128*hi part is even), hi = (127 - acc) >> 7 exactly.
     """
-    k = data_ref.shape[1]
     r = out_ref.shape[1]
-    tl4 = data_ref.shape[2]
+    rp = bmat_ref.shape[0] // 16
+    r4 = 4 * rp
     for i in range(data_ref.shape[0]):
-        x = data_ref[i].astype(jnp.int32)      # [k, TL4]
-        slots = [jnp.concatenate([(x >> (8 * s + b)) & 1 for b in range(8)],
-                                 axis=0) for s in range(4)]
-        bits = jnp.concatenate(slots, axis=1).astype(jnp.int8)  # [k8, 4*TL4]
+        xb = pltpu.bitcast(data_ref[i], jnp.uint8)       # [4k, TL4]
+        bits = jnp.concatenate(
+            [jnp.where((xb & jnp.uint8(1 << b)) != 0,
+                       jnp.int8(1), jnp.int8(0)) for b in range(8)],
+            axis=0)                                      # [32k, TL4]
         acc = jax.lax.dot_general(
             bmat_ref[:], bits,
             dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32)  # [r8, 4*TL4]
-        accb = (acc & 1).astype(jnp.int8)
-        packed = jax.lax.dot_general(
-            wrep_ref[:], accb,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32)  # [r, 4*TL4] byte values
-        pu = packed.astype(jnp.uint32) & 0xFF
-        out_ref[i] = (pu[:, 0:tl4] | (pu[:, tl4:2 * tl4] << 8)
-                      | (pu[:, 2 * tl4:3 * tl4] << 16)
-                      | (pu[:, 3 * tl4:4 * tl4] << 24))
+            preferred_element_type=jnp.int32)            # [16rp, TL4]
+        packed = None
+        for p in range(4):
+            t = acc[p * r4:(p + 1) * r4]
+            lo = (t & 1) << (2 * p)
+            hi = (((127 - t) >> 7) & 1) << (2 * p + 1)
+            contrib = lo | hi
+            packed = contrib if packed is None else (packed | contrib)
+        words = pltpu.bitcast(packed.astype(jnp.uint8),
+                              jnp.uint32)                # [rp, TL4]
+        out_ref[i] = words[0:r]
 
 
-@functools.partial(jax.jit, static_argnames=("tile4", "bb", "interpret"))
-def _pallas_apply32(bmat_plane: jax.Array, data: jax.Array, tile4: int,
+@functools.partial(jax.jit,
+                   static_argnames=("r", "tile4", "bb", "interpret"))
+def _pallas_apply32(bmat8: jax.Array, data: jax.Array, r: int, tile4: int,
                     bb: int, interpret: bool = False) -> jax.Array:
-    """bmat_plane int8 [r8, k8], data uint32 [B, k, L4_padded]."""
+    """bmat8 int8 [16*rp, 32k] pair-packed (_prep8_cached), data uint32
+    [B, k, L4_padded]."""
     b, k, l4 = data.shape
-    r8 = bmat_plane.shape[0]
-    r = r8 // 8
     assert l4 % tile4 == 0, f"lane dim {l4} not a multiple of tile {tile4}"
     assert b % bb == 0, f"batch dim {b} not a multiple of {bb}"
     grid = (b // bb, l4 // tile4)
-    wrep = jnp.asarray(_repack_weights(r))
     return pl.pallas_call(
         _rs_kernel32,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((r8, k * 8), lambda ib, il: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((r, r8), lambda ib, il: (0, 0),
+            pl.BlockSpec(tuple(bmat8.shape), lambda ib, il: (0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((bb, k, tile4), lambda ib, il: (ib, 0, il),
                          memory_space=pltpu.VMEM),
@@ -295,7 +331,7 @@ def _pallas_apply32(bmat_plane: jax.Array, data: jax.Array, tile4: int,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((b, r, l4), jnp.uint32),
         interpret=interpret,
-    )(bmat_plane, wrep, data)
+    )(bmat8, data)
 
 
 def make_encoder32(matrix: np.ndarray, mode: str = "auto"):
@@ -307,9 +343,10 @@ def make_encoder32(matrix: np.ndarray, mode: str = "auto"):
     """
     matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
     r, k = matrix.shape
-    _, bm_plane = _prep(matrix)
     backend = DeviceBackend(mode)
-    if backend.mode == "xla":
+    if backend.mode == "xla" or k > 15:
+        # k > 15 would break the pair-packing overflow bound (never hit
+        # in practice: erasure sets cap at 16 drives with m >= 1).
         def run_xla(data):
             # Portable fallback: via the byte path.
             b, kk, l4 = data.shape
@@ -320,28 +357,31 @@ def make_encoder32(matrix: np.ndarray, mode: str = "auto"):
                 out.reshape(b, r, l4, 4), jnp.uint32)
         return run_xla
     interpret = backend._interpret
-    bmat = jnp.asarray(bm_plane)
+    bmat = jnp.asarray(_prep8_cached(matrix.tobytes(), r, k))
+    rp = r + (r & 1)
 
     def run(data):
         b, kk, l4 = data.shape
-        # VMEM per cell ~ bits i8 [k8, 4T] + acc i32 [r8, 4T] + io u32.
+        # VMEM per cell ~ bits i8 [32k, T] + acc i32 [16rp, T] + io u32
+        # (no double-buffer factor: the probe/retry loop below is the
+        # real enforcement and measured-best tiles sit near the cap).
         tile4 = 128
-        per_lane4 = k * 8 * 4 + r * 8 * 4 * 4 + (k + r) * 4
-        while tile4 < _TILE_L_MAX // 4 and tile4 * 2 * per_lane4 <= _VMEM_BUDGET \
+        per_lane4 = 32 * k + 16 * rp * 4 + (k + r) * 4 + 4 * rp
+        while tile4 < _TILE_L_MAX // 4 and tile4 * per_lane4 <= _VMEM_BUDGET \
                 and tile4 < l4:
             tile4 *= 2
-        bb = 2 if b % 2 == 0 else 1
+        bb = 1
         key = ("u32", k, r, bb)
         tile4 = min(tile4, _tile_cap.get(key, tile4))
         pad = (-l4) % tile4
         padded = jnp.pad(data, ((0, 0), (0, 0), (0, pad))) if pad else data
         if isinstance(data, jax.core.Tracer):
-            out = _pallas_apply32(bmat, padded, tile4=tile4, bb=bb,
+            out = _pallas_apply32(bmat, padded, r=r, tile4=tile4, bb=bb,
                                   interpret=interpret)
             return out[..., :l4] if pad else out
         while True:
             try:
-                out = _pallas_apply32(bmat, padded, tile4=tile4, bb=bb,
+                out = _pallas_apply32(bmat, padded, r=r, tile4=tile4, bb=bb,
                                       interpret=interpret)
                 if key + (tile4,) not in _tile_ok:
                     out.block_until_ready()

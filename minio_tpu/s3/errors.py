@@ -55,6 +55,8 @@ _CATALOG = {
     "MalformedACLError": (400, "The ACL that you provided was not well formed or did not validate against our published schema."),
     "XAmzContentChecksumMismatch": (400, "The provided checksum does not match the computed checksum."),
     "InvalidRetentionDate": (400, "Date must be provided in ISO 8601 format."),
+    "XMinioAdminBucketQuotaExceeded": (400, "Bucket quota exceeded"),
+    "XMinioAdminNoSuchQuotaConfiguration": (404, "The quota configuration does not exist"),
 }
 
 

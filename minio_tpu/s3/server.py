@@ -151,6 +151,13 @@ class S3Server:
         # request or burn the full drain deadline.
         self._inflight = 0
         self._inflight_mu = threading.Lock()
+        # Bucket-quota usage cache: bucket -> [stamp, bytes]. Seeded by
+        # a live walk (TTL'd), advanced by committed writes so quota
+        # enforcement reacts between scanner cycles (reference:
+        # cmd/bucket-quota.go enforces from the data-usage cache).
+        self.scanner = None
+        self._quota_usage: dict = {}
+        self._quota_mu = threading.Lock()
 
     @property
     def address(self) -> str:
@@ -1003,10 +1010,10 @@ def _make_handler(server: S3Server):
         def _get_versioning(self, bucket):
             ol = server.object_layer
             ol.get_bucket_info(bucket)
-            enabled = getattr(ol, "bucket_versioning", lambda b: False)(bucket)
+            state = _versioning_state(ol, bucket)
             root = ET.Element("VersioningConfiguration", xmlns=XMLNS)
-            if enabled:
-                _el(root, "Status", "Enabled")
+            if state:
+                _el(root, "Status", state)
             self._send(200, _xml(root))
 
         def _put_versioning(self, bucket, body):
@@ -1017,9 +1024,9 @@ def _make_handler(server: S3Server):
                     f"{{{XMLNS}}}Status") or ET.fromstring(body).findtext("Status")
             except ET.ParseError:
                 raise S3Error("MalformedXML") from None
-            setter = getattr(ol, "set_bucket_versioning", None)
-            if setter is None:
-                raise S3Error("NotImplemented")
+            if status not in ("Enabled", "Suspended"):
+                raise S3Error("MalformedXML",
+                              "Status must be Enabled or Suspended")
             with server.bucket_meta_lock:
                 # Lock-config check INSIDE the metadata lock: checked
                 # outside, a concurrent PutObjectLockConfiguration could
@@ -1032,7 +1039,15 @@ def _make_handler(server: S3Server):
                     raise S3Error("InvalidBucketState",
                                   "object lock requires versioning",
                                   bucket=bucket)
-                setter(bucket, status == "Enabled")
+                # Suspension is a distinct state, not versioning-off:
+                # null-versionId writes replace the null version while
+                # older real versions survive (reference:
+                # internal/bucket/versioning/versioning.go:36,76). The
+                # layer setter manages both meta keys consistently.
+                setter = getattr(ol, "set_bucket_versioning", None)
+                if setter is None:
+                    raise S3Error("NotImplemented")
+                setter(bucket, status)
             self._site_enqueue("bucket-meta", bucket)
             self._send(200)
 
@@ -1106,7 +1121,7 @@ def _make_handler(server: S3Server):
             quiet = (tree.findtext(f"{ns}Quiet") or
                      tree.findtext("Quiet") or "") == "true"
             root = ET.Element("DeleteResult", xmlns=XMLNS)
-            versioned = _versioned(server.object_layer, bucket)
+            state = _versioning_state(server.object_layer, bucket)
             h = self._headers_lower()
             for obj in objs[:1000]:
                 key = obj.findtext(f"{ns}Key") or obj.findtext("Key") or ""
@@ -1115,7 +1130,10 @@ def _make_handler(server: S3Server):
                     self._check_version_deletable(bucket, key, vid, h)
                     deleted = server.object_layer.delete_object(
                         bucket, key,
-                        DeleteOptions(version_id=vid, versioned=versioned))
+                        DeleteOptions(version_id=vid,
+                                      versioned=state == "Enabled",
+                                      null_marker=state == "Suspended"
+                                      and not vid))
                     if not vid:
                         # Bulk deletes mirror to peer sites like single
                         # DELETEs (version-targeted prunes stay local).
@@ -1533,6 +1551,8 @@ def _make_handler(server: S3Server):
             except (ValueError, KeyError):
                 raise S3Error("InvalidArgument") from None
             uid = query.get("uploadId", [""])[0]
+            if payload is not None:
+                self._check_quota(bucket, payload.size)
             if "x-amz-copy-source" in h:
                 # UploadPartCopy: source bytes become the part payload.
                 src = urllib.parse.unquote(h["x-amz-copy-source"]).lstrip("/")
@@ -1663,10 +1683,12 @@ def _make_handler(server: S3Server):
             # the default-retention rule like any other new version.
             opts.internal_metadata.update(
                 self._object_lock_put_meta(bucket, h))
+            self._check_quota(bucket, len(payload))
             out_payload, sse_headers = self._apply_sse(
                 bucket, key, Payload.wrap(payload), h, opts)
             info = server.object_layer.put_object(
                 bucket, key, out_payload, opts)
+            self._note_quota_write(bucket, len(payload))
             self._replicate_after_write(bucket, key, info.version_id, h)
             self._site_enqueue("put", bucket, key, info.version_id)
             self._notify("s3:ObjectCreated:Copy", bucket, key,
@@ -1712,6 +1734,7 @@ def _make_handler(server: S3Server):
                 tags=h.get("x-amz-tagging", ""))
             opts.internal_metadata.update(
                 self._object_lock_put_meta(bucket, h))
+            self._check_quota(bucket, payload.size)
             payload, checksum_hdrs = self._apply_checksums(payload, h,
                                                            opts)
             plain_size = payload.size
@@ -1732,6 +1755,7 @@ def _make_handler(server: S3Server):
                 from minio_tpu.replication import REPL_STATUS_KEY
                 opts.internal_metadata[REPL_STATUS_KEY] = "PENDING"
             info = server.object_layer.put_object(bucket, key, payload, opts)
+            self._note_quota_write(bucket, plain_size)
             if replicate:
                 server.replicator.enqueue(bucket, key, info.version_id,
                                           "put")
@@ -1765,6 +1789,72 @@ def _make_handler(server: S3Server):
             except Exception:  # noqa: BLE001 - stamping is advisory
                 pass
             r.enqueue(bucket, key, version_id, "put")
+
+        _QUOTA_TTL = 5.0
+
+        def _bucket_quota(self, bucket) -> int:
+            """Configured hard quota bytes (0 = none)."""
+            import json as _json
+            raw = server.object_layer.get_bucket_meta(bucket) \
+                .get("config:quota")
+            if not raw:
+                return 0
+            try:
+                cfg = _json.loads(raw) if isinstance(raw, str) else raw
+            except ValueError:
+                return 0
+            if cfg.get("quotatype", "hard") != "hard":
+                return 0
+            return int(cfg.get("quota") or 0)
+
+        def _bucket_usage_bytes(self, bucket) -> float:
+            """Current bucket size: the scanner's accounting when
+            available, else a TTL'd live walk; committed writes advance
+            the cached figure between refreshes (_note_quota_write).
+            Single-flight: exactly one thread refreshes an expired
+            entry — concurrent PUTs after TTL expiry must not each
+            repeat the O(objects) walk."""
+            now = _time_mod.monotonic()
+            with server._quota_mu:
+                ent = server._quota_usage.get(bucket)
+                if ent is not None and (now - ent[0] < self._QUOTA_TTL
+                                        or len(ent) > 2):
+                    return ent[1]       # fresh, or someone refreshing
+                if ent is None:
+                    ent = server._quota_usage[bucket] = [now, 0]
+                ent.append("refreshing")
+            size = ent[1]               # prior figure if refresh fails
+            try:
+                sc = server.scanner
+                if sc is not None and bucket in getattr(
+                        sc.usage, "buckets", {}):
+                    size = sc.usage.buckets[bucket].size
+                else:
+                    from minio_tpu.object.rebalance import \
+                        bucket_used_bytes
+                    size = bucket_used_bytes(server.object_layer, bucket)
+            finally:
+                with server._quota_mu:
+                    server._quota_usage[bucket] = [
+                        _time_mod.monotonic(), size]
+            return size
+
+        def _check_quota(self, bucket, incoming: int) -> None:
+            """Hard-quota gate for every write path (reference:
+            cmd/bucket-quota.go:32 enforceBucketQuotaHard on PutObject,
+            parts and copies)."""
+            quota = self._bucket_quota(bucket)
+            if not quota:
+                return
+            if self._bucket_usage_bytes(bucket) + incoming > quota:
+                raise S3Error("XMinioAdminBucketQuotaExceeded",
+                              bucket=bucket)
+
+        def _note_quota_write(self, bucket, nbytes: int) -> None:
+            with server._quota_mu:
+                ent = server._quota_usage.get(bucket)
+                if ent is not None:
+                    ent[1] += nbytes
 
         def _apply_checksums(self, payload, h, opts):
             """Wrap the LOGICAL payload in checksum computation when
@@ -2228,6 +2318,7 @@ def _make_handler(server: S3Server):
                 self._object_lock_put_meta(bucket, fields))
             # Bucket default encryption applies to form uploads too
             # (explicit SSE form fields ride the same header names).
+            self._check_quota(bucket, len(file_data))
             post_payload, _ = self._apply_sse(
                 bucket, key, Payload.wrap(file_data),
                 {sse_key: v for sse_key, v in fields.items()
@@ -2235,6 +2326,7 @@ def _make_handler(server: S3Server):
                 opts)
             info = server.object_layer.put_object(bucket, key,
                                                   post_payload, opts)
+            self._note_quota_write(bucket, len(file_data))
             self._site_enqueue("put", bucket, key, info.version_id)
             self._notify("s3:ObjectCreated:Post", bucket, key,
                          size=len(file_data), etag=info.etag,
@@ -2660,6 +2752,64 @@ def _make_handler(server: S3Server):
                     fn()
                 return ok()
 
+            # Pool rebalance (reference:
+            # cmd/admin-handlers-pools.go RebalanceStart/Status/Stop).
+            if op == "rebalance-start" and method == "POST":
+                ol = server.object_layer
+                if not hasattr(ol, "start_rebalance"):
+                    raise S3Error("NotImplemented", "single-pool layout")
+                from minio_tpu.object.rebalance import RebalanceError
+                try:
+                    ol.start_rebalance()
+                except RebalanceError as e:
+                    raise S3Error("InvalidArgument", str(e)) from None
+                return ok()
+            if op == "rebalance-status" and method == "GET":
+                fn = getattr(server.object_layer, "rebalance_status", None)
+                return ok(fn() if fn else None)
+            if op == "rebalance-stop" and method == "POST":
+                fn = getattr(server.object_layer, "stop_rebalance", None)
+                if fn:
+                    fn()
+                return ok()
+
+            # Bucket quotas (reference: cmd/admin-bucket-handlers.go
+            # SetBucketQuotaConfigHandler / GetBucketQuotaConfigHandler,
+            # enforced by cmd/bucket-quota.go).
+            if op == "set-bucket-quota" and method == "PUT":
+                bkt = q1.get("bucket", "")
+                server.object_layer.get_bucket_info(bkt)
+                try:
+                    cfg = _json.loads(body) if body else {}
+                    quota = int(cfg.get("quota") or 0)
+                except (ValueError, TypeError):
+                    raise S3Error("InvalidArgument",
+                                  "malformed quota configuration") \
+                        from None
+                if quota < 0:
+                    raise S3Error("InvalidArgument",
+                                  "quota must be non-negative")
+                with server.bucket_meta_lock:
+                    meta = server.object_layer.get_bucket_meta(bkt)
+                    if quota == 0:
+                        meta.pop("config:quota", None)
+                    else:
+                        meta["config:quota"] = _json.dumps(
+                            {"quota": quota,
+                             "quotatype": cfg.get("quotatype", "hard")})
+                    server.object_layer.set_bucket_meta(bkt, meta)
+                return ok()
+            if op == "get-bucket-quota" and method == "GET":
+                bkt = q1.get("bucket", "")
+                server.object_layer.get_bucket_info(bkt)
+                raw = server.object_layer.get_bucket_meta(bkt) \
+                    .get("config:quota")
+                if not raw:
+                    raise S3Error("XMinioAdminNoSuchQuotaConfiguration",
+                                  bucket=bkt)
+                return ok(_json.loads(raw) if isinstance(raw, str)
+                          else raw)
+
             # Replication target management needs no IAM store.
             if op == "set-remote-target" and method == "PUT":
                 doc = _json.loads(body)
@@ -2751,10 +2901,12 @@ def _make_handler(server: S3Server):
             vid = query.get("versionId", [""])[0]
             self._check_version_deletable(bucket, key, vid,
                                           self._headers_lower())
+            state = _versioning_state(server.object_layer, bucket)
             deleted = server.object_layer.delete_object(
                 bucket, key, DeleteOptions(
                     version_id=vid,
-                    versioned=_versioned(server.object_layer, bucket)))
+                    versioned=state == "Enabled",
+                    null_marker=state == "Suspended" and not vid))
             # Only versionless deletes (which create markers) replicate;
             # pruning ONE old version must never destroy the replica's
             # live object (DeleteMarkerReplication semantics).
@@ -2920,6 +3072,20 @@ def _b64d(s: str) -> str:
 def _versioned(ol, bucket: str) -> bool:
     fn = getattr(ol, "bucket_versioning", None)
     return bool(fn(bucket)) if fn else False
+
+
+def _versioning_state(ol, bucket: str) -> str:
+    """"" (never enabled) | "Enabled" | "Suspended" — the reference
+    keeps Suspended as a REAL state (internal/bucket/versioning/
+    versioning.go:36,76): suspended buckets write null-versionId
+    objects replacing the previous null version while Enabled-era
+    versions survive."""
+    meta = getattr(ol, "get_bucket_meta", lambda b: {})(bucket)
+    if meta.get("versioning"):
+        return "Enabled"
+    if meta.get("versioning-suspended"):
+        return "Suspended"
+    return ""
 
 
 def _range_spec(rng: str):

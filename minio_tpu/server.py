@@ -276,6 +276,13 @@ def main(argv=None) -> int:
         except Exception as e:  # noqa: BLE001 - decom must not block boot
             print(f"WARN: decommission resume failed: {e}",
                   file=sys.stderr)
+        # Likewise an interrupted rebalance (reference: pools.Init
+        # loading persisted rebalanceMeta).
+        try:
+            if layer.resume_rebalance() is not None:
+                print("resuming interrupted pool rebalance", flush=True)
+        except Exception as e:  # noqa: BLE001 - must not block boot
+            print(f"WARN: rebalance resume failed: {e}", file=sys.stderr)
     # Background data scanner: usage accounting, 1/1024 deep-heal
     # sampling, replaced-drive format restore (reference:
     # cmd/data-scanner.go's scanner loop).
@@ -295,6 +302,8 @@ def main(argv=None) -> int:
     creds = Credentials()
     creds.iam = IAMSys(pools[0].sets, creds.access_key, creds.secret_key)
     srv = S3Server(layer, address=args.address, credentials=creds)
+    # Quota enforcement reads the scanner's usage accounting.
+    srv.scanner = scanner
     # Warm tiers: registry on pool 0's drives, resolved by every set's
     # read/transition paths (reference: globalTierConfigMgr).
     from minio_tpu.object.tier import TierRegistry

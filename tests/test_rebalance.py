@@ -1,0 +1,168 @@
+"""Pool rebalance: overfilled pools shed toward the cluster average
+with checkpointed resume; every object readable throughout (reference:
+cmd/erasure-server-pool-rebalance.go:100)."""
+
+import os
+import threading
+
+import pytest
+
+from minio_tpu.object import rebalance
+from minio_tpu.object.erasure_object import ErasureSet
+from minio_tpu.object.pools import ServerPools
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.object.types import DeleteOptions, PutOptions
+from minio_tpu.storage.local import LocalStorage
+
+
+def _pool(tmp_path, name, n=4):
+    disks = [LocalStorage(str(tmp_path / name / f"d{i}")) for i in range(n)]
+    return ErasureSets([ErasureSet(disks)])
+
+
+@pytest.fixture
+def layer(tmp_path):
+    lay = ServerPools([_pool(tmp_path, "p0"), _pool(tmp_path, "p1")])
+    lay.make_bucket("rb")
+    return lay
+
+
+def _used(pool, bucket="rb") -> int:
+    return rebalance.pool_usage(pool)[0]
+
+
+def _seed_imbalance(layer, n=20, size=50_000):
+    """All objects into pool 0; pool 1 empty. Test pools share one
+    filesystem, so equal capacities make 'fill fraction' degenerate to
+    'used bytes' — the rebalance target is then the byte average."""
+    bodies = {}
+    for i in range(n):
+        body = os.urandom(size + i)
+        bodies[f"o{i:03d}"] = body
+        layer.pools[0].put_object("rb", f"o{i:03d}", body)
+    return bodies
+
+
+def test_rebalance_converges_and_preserves_objects(layer):
+    bodies = _seed_imbalance(layer)
+    # Versioned stack + delete marker also migrate intact.
+    layer.pools[0].put_object("rb", "ver", b"v1", PutOptions(versioned=True))
+    layer.pools[0].put_object("rb", "ver", b"v2", PutOptions(versioned=True))
+    layer.pools[0].delete_object("rb", "marked",
+                                 DeleteOptions(versioned=True))
+    before = _used(layer.pools[0])
+    rb = layer.start_rebalance()
+    assert rb.wait(120)
+    st = layer.rebalance_status()
+    assert st["status"] == "complete", st
+    rec0 = st["pools"]["0"]
+    assert rec0["participating"] and rec0["migrated"] > 0
+    assert rec0["failed"] == 0
+    # Pool 0 shed roughly half its bytes (to the average of 2 pools);
+    # pool 1 gained them. Tolerate per-key granularity slack.
+    u0, u1 = _used(layer.pools[0]), _used(layer.pools[1])
+    assert u1 > 0
+    assert u0 < before * 0.75
+    assert abs(u0 - u1) < before * 0.35
+    # Everything still reads correctly through the layer.
+    for k, b in bodies.items():
+        _, got = layer.get_object("rb", k)
+        assert got == b
+    from minio_tpu.object.types import GetOptions, ObjectNotFound
+    with pytest.raises(ObjectNotFound):
+        layer.get_object("rb", "marked", GetOptions())
+    # ...but the marker itself migrated (it lives in SOME pool).
+    def marker_in(p):
+        try:
+            return any(v.deleted for v in p.set_for("marked")
+                       .list_versions_all("rb", "marked"))
+        except ObjectNotFound:
+            return False
+    assert any(marker_in(p) for p in layer.pools)
+
+
+def test_balanced_cluster_is_a_noop(layer):
+    # Same bytes in both pools: nobody participates.
+    for i in range(4):
+        layer.pools[0].put_object("rb", f"a{i}", os.urandom(10_000))
+        layer.pools[1].put_object("rb", f"b{i}", os.urandom(10_000))
+    rb = layer.start_rebalance()
+    assert rb.wait(60)
+    st = layer.rebalance_status()
+    assert st["status"] == "complete"
+    assert all(not r["participating"] for r in st["pools"].values())
+    assert all(r["migrated"] == 0 for r in st["pools"].values())
+
+
+def test_rebalance_kill_midway_then_resume(layer, tmp_path):
+    bodies = _seed_imbalance(layer, n=30)
+    # Checkpoint every key; stop the run as soon as a few keys moved.
+    rb = rebalance.Rebalance(layer, checkpoint_every=1)
+    layer._rebalance = rb
+
+    moved = threading.Event()
+    orig = rebalance.migrate_key
+
+    def spy(lay, src, bucket, key, pick):
+        orig(lay, src, bucket, key, pick)
+        if lay.pools and rb.state["pools"]["0"]["migrated"] >= 4:
+            moved.set()
+
+    rebalance.migrate_key = spy
+    try:
+        rb.start()
+        assert moved.wait(60)
+        rb.stop()                       # simulate a clean kill
+    finally:
+        rebalance.migrate_key = orig
+    st = rebalance.load_state(layer)
+    assert st is not None and st["status"] == "rebalancing"
+    partial = st["pools"]["0"]["migrated"]
+    assert partial >= 4
+    # Every object readable in the interrupted state.
+    for k, b in bodies.items():
+        _, got = layer.get_object("rb", k)
+        assert got == b
+    # Resume (the boot path) finishes the job.
+    rb2 = layer.resume_rebalance()
+    assert rb2 is not None
+    assert rb2.wait(120)
+    st = layer.rebalance_status()
+    assert st["status"] == "complete", st
+    u0, u1 = _used(layer.pools[0]), _used(layer.pools[1])
+    assert u1 > 0 and abs(u0 - u1) < (u0 + u1) * 0.4
+    for k, b in bodies.items():
+        _, got = layer.get_object("rb", k)
+        assert got == b
+
+
+def test_rebalance_admin_api(tmp_path):
+    from minio_tpu.s3.server import S3Server
+    from tests.s3client import S3Client
+    lay = ServerPools([_pool(tmp_path, "p0"), _pool(tmp_path, "p1")])
+    srv = S3Server(lay, address="127.0.0.1:0")
+    srv.start()
+    try:
+        cli = S3Client(srv.address)
+        assert cli.request("PUT", "/rbb")[0] == 200
+        for i in range(10):
+            lay.pools[0].put_object("rbb", f"x{i}", os.urandom(30_000))
+        st, _, body = cli.request(
+            "POST", "/minio/admin/v3/rebalance-start")
+        assert st == 200, body
+        import json
+        for _ in range(200):
+            st, _, body = cli.request(
+                "GET", "/minio/admin/v3/rebalance-status")
+            assert st == 200
+            doc = json.loads(body)
+            if doc and doc.get("status") in ("complete", "failed"):
+                break
+            import time
+            time.sleep(0.1)
+        assert doc["status"] == "complete", doc
+        assert cli.request(
+            "POST", "/minio/admin/v3/rebalance-stop")[0] == 200
+    finally:
+        srv.stop()
+        lay.close()

@@ -1,0 +1,137 @@
+"""Versioning SUSPENSION semantics over the wire: Suspended is a real
+state (reference: internal/bucket/versioning/versioning.go:36,76), not
+versioning-off — suspended writes stamp the null versionId replacing
+the previous null version, Enabled-era versions survive, and simple
+deletes insert a null delete marker. The enable -> suspend -> write ->
+re-enable matrix AWS documents."""
+
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from minio_tpu.object.erasure_object import ErasureSet
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.local import LocalStorage
+from tests.s3client import S3Client
+
+B = "suspbkt"
+
+
+@pytest.fixture(scope="module")
+def cli(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("suspdrv")
+    disks = [LocalStorage(str(tmp / f"d{i}")) for i in range(4)]
+    server = S3Server(ErasureSet(disks), address="127.0.0.1:0")
+    server.start()
+    c = S3Client(server.address)
+    assert c.request("PUT", f"/{B}")[0] == 200
+    yield c
+    server.stop()
+
+
+def _set_versioning(cli, status):
+    body = (f'<VersioningConfiguration><Status>{status}</Status>'
+            f'</VersioningConfiguration>').encode()
+    st, _, b = cli.request("PUT", f"/{B}", query={"versioning": ""},
+                           body=body)
+    assert st == 200, b
+
+
+def _versions(cli, key):
+    """[(versionId, isLatest, isMarker)] newest-first for one key."""
+    st, _, body = cli.request("GET", f"/{B}", query={"versions": "",
+                                                     "prefix": key})
+    assert st == 200
+    root = ET.fromstring(body)
+    ns = root.tag.split("}")[0] + "}"
+    out = []
+    for el in root:
+        if el.tag in (f"{ns}Version", f"{ns}DeleteMarker"):
+            out.append((el.findtext(f"{ns}VersionId"),
+                        el.findtext(f"{ns}IsLatest") == "true",
+                        el.tag == f"{ns}DeleteMarker"))
+    return out
+
+
+def test_enable_suspend_write_reenable_matrix(cli):
+    key = "doc"
+    # 1. Pre-versioning write: the null version.
+    assert cli.request("PUT", f"/{B}/{key}", body=b"null-v0")[0] == 200
+    # 2. Enable; two real versions stack above it.
+    _set_versioning(cli, "Enabled")
+    st, h, _ = cli.request("PUT", f"/{B}/{key}", body=b"real-v1")
+    vid1 = h.get("x-amz-version-id")
+    st, h, _ = cli.request("PUT", f"/{B}/{key}", body=b"real-v2")
+    vid2 = h.get("x-amz-version-id")
+    assert vid1 and vid2 and vid1 != vid2
+    vs = _versions(cli, key)
+    assert [v[0] for v in vs] == [vid2, vid1, "null"]
+    # 3. Suspend: reported as a distinct state, and writes now REPLACE
+    #    the null version while vid1/vid2 survive.
+    _set_versioning(cli, "Suspended")
+    st, _, body = cli.request("GET", f"/{B}", query={"versioning": ""})
+    assert b"Suspended" in body
+    st, h, _ = cli.request("PUT", f"/{B}/{key}", body=b"null-v1")
+    assert st == 200 and not h.get("x-amz-version-id")
+    vs = _versions(cli, key)
+    assert [v[0] for v in vs] == ["null", vid2, vid1]
+    assert vs[0][1]                      # the new null is latest
+    assert cli.request("GET", f"/{B}/{key}")[2] == b"null-v1"
+    # Enabled-era versions still readable by id.
+    st, _, got = cli.request("GET", f"/{B}/{key}",
+                             query={"versionId": vid1})
+    assert st == 200 and got == b"real-v1"
+    # 4. Suspended simple DELETE: a NULL delete marker replaces the
+    #    null version; real versions survive.
+    st, h, _ = cli.request("DELETE", f"/{B}/{key}")
+    assert st == 204
+    assert h.get("x-amz-delete-marker") == "true"
+    assert h.get("x-amz-version-id") in (None, "null")
+    vs = _versions(cli, key)
+    assert [(v[0], v[2]) for v in vs] == [("null", True),
+                                          (vid2, False), (vid1, False)]
+    assert cli.request("GET", f"/{B}/{key}")[0] == 404
+    st, _, got = cli.request("GET", f"/{B}/{key}",
+                             query={"versionId": vid2})
+    assert st == 200 and got == b"real-v2"
+    # A second suspended DELETE is idempotent: still ONE null marker.
+    assert cli.request("DELETE", f"/{B}/{key}")[0] == 204
+    assert len(_versions(cli, key)) == 3
+    # 5. Re-enable: new writes get real ids again; the null marker and
+    #    old versions are preserved beneath.
+    _set_versioning(cli, "Enabled")
+    st, h, _ = cli.request("PUT", f"/{B}/{key}", body=b"real-v3")
+    vid3 = h.get("x-amz-version-id")
+    assert vid3
+    vs = _versions(cli, key)
+    assert [v[0] for v in vs] == [vid3, "null", vid2, vid1]
+    assert cli.request("GET", f"/{B}/{key}")[2] == b"real-v3"
+    # 6. Deleting the null marker by explicit versionId removes it.
+    st, _, _ = cli.request("DELETE", f"/{B}/{key}",
+                           query={"versionId": "null"})
+    assert st == 204
+    assert [v[0] for v in _versions(cli, key)] == [vid3, vid2, vid1]
+
+
+def test_suspended_overwrite_reclaims_only_null(cli):
+    key = "cycle"
+    _set_versioning(cli, "Enabled")
+    st, h, _ = cli.request("PUT", f"/{B}/{key}", body=b"keeper")
+    vid = h.get("x-amz-version-id")
+    _set_versioning(cli, "Suspended")
+    for i in range(3):
+        assert cli.request("PUT", f"/{B}/{key}",
+                           body=f"null-{i}".encode())[0] == 200
+    vs = _versions(cli, key)
+    # Three suspended overwrites collapse into ONE null version.
+    assert [v[0] for v in vs] == ["null", vid]
+    assert cli.request("GET", f"/{B}/{key}")[2] == b"null-2"
+    _set_versioning(cli, "Enabled")
+
+
+def test_invalid_status_rejected(cli):
+    st, _, body = cli.request(
+        "PUT", f"/{B}", query={"versioning": ""},
+        body=b"<VersioningConfiguration><Status>Paused</Status>"
+             b"</VersioningConfiguration>")
+    assert st == 400
