@@ -10,6 +10,9 @@ downtime never loses notifications.
 
 from minio_tpu.events.notify import (EventNotifier, NotificationConfig,
                                      WebhookTarget, parse_notification_xml)
+from minio_tpu.events.targets import (MQTTTarget, NATSTarget, RedisTarget,
+                                      TargetError)
 
 __all__ = ["EventNotifier", "NotificationConfig", "WebhookTarget",
+           "MQTTTarget", "NATSTarget", "RedisTarget", "TargetError",
            "parse_notification_xml"]

@@ -1,0 +1,197 @@
+"""Additional event notification targets: MQTT, NATS, Redis.
+
+The reference ships ten target types under internal/event/target/; the
+webhook target (events/notify.py) covered one. These three speak their
+REAL wire protocols from scratch (no client libraries in the image):
+
+  MQTTTarget   MQTT 3.1.1 (OASIS spec): CONNECT/CONNACK handshake,
+               QoS-1 PUBLISH awaiting PUBACK (internal/event/target/mqtt.go)
+  NATSTarget   NATS text protocol: INFO/CONNECT/PUB/+OK
+               (internal/event/target/nats.go)
+  RedisTarget  RESP2: RPUSH of the event JSON onto a list key
+               (internal/event/target/redis.go's list format)
+
+All three plug into EventNotifier's store-and-forward queue, so a
+broker outage delays delivery but never drops events; each send opens a
+short-lived connection (the queue's cadence is sparse — holding idle
+broker connections from every node buys nothing).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+
+
+class TargetError(Exception):
+    pass
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise TargetError("connection closed mid-frame")
+        buf += chunk
+    return buf
+
+
+# ---------------------------------------------------------------------------
+# MQTT 3.1.1
+# ---------------------------------------------------------------------------
+
+def _mqtt_string(s: bytes) -> bytes:
+    return len(s).to_bytes(2, "big") + s
+
+
+def _mqtt_remaining_len(n: int) -> bytes:
+    """MQTT variable-length remaining-length encoding."""
+    out = bytearray()
+    while True:
+        byte = n % 128
+        n //= 128
+        out.append(byte | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _mqtt_read_packet(sock) -> tuple[int, bytes]:
+    """(packet type, payload) — decodes the variable-length header."""
+    first = _recv_exact(sock, 1)[0]
+    n = shift = 0
+    while True:
+        b = _recv_exact(sock, 1)[0]
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+        if shift > 21:
+            raise TargetError("malformed MQTT remaining length")
+    return first >> 4, _recv_exact(sock, n) if n else b""
+
+
+class MQTTTarget:
+    """QoS-1 JSON publisher to an MQTT 3.1.1 broker."""
+
+    def __init__(self, target_id: str, broker: str, topic: str,
+                 timeout: float = 5.0, qos: int = 1):
+        self.target_id = target_id
+        host, _, port = broker.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self.topic = topic
+        self.timeout = timeout
+        self.qos = 1 if qos else 0
+        self._packet_id = 0
+
+    def send(self, record: dict, wrap: bool = True) -> None:
+        payload = json.dumps({"Records": [record]} if wrap
+                             else record).encode()
+        with socket.create_connection(self._addr,
+                                      timeout=self.timeout) as s:
+            # CONNECT: protocol "MQTT" level 4, clean session, no auth.
+            var = (_mqtt_string(b"MQTT") + b"\x04" + b"\x02" +
+                   (0).to_bytes(2, "big") +
+                   _mqtt_string(b"minio-tpu-notify"))
+            s.sendall(b"\x10" + _mqtt_remaining_len(len(var)) + var)
+            ptype, body = _mqtt_read_packet(s)
+            if ptype != 2 or len(body) < 2 or body[1] != 0:
+                raise TargetError(f"MQTT CONNACK refused: {body!r}")
+            # PUBLISH QoS1 (dup=0, retain=0).
+            self._packet_id = (self._packet_id % 0xFFFF) + 1
+            topic = _mqtt_string(self.topic.encode())
+            if self.qos:
+                var = topic + self._packet_id.to_bytes(2, "big") + payload
+                s.sendall(bytes([0x30 | (self.qos << 1)]) +
+                          _mqtt_remaining_len(len(var)) + var)
+                ptype, body = _mqtt_read_packet(s)
+                if ptype != 4 or body[:2] != \
+                        self._packet_id.to_bytes(2, "big"):
+                    raise TargetError("MQTT PUBACK missing/mismatched")
+            else:
+                var = topic + payload
+                s.sendall(b"\x30" + _mqtt_remaining_len(len(var)) + var)
+            s.sendall(b"\xe0\x00")          # DISCONNECT
+
+
+# ---------------------------------------------------------------------------
+# NATS
+# ---------------------------------------------------------------------------
+
+class NATSTarget:
+    """PUBs the event JSON to a NATS subject (text protocol)."""
+
+    def __init__(self, target_id: str, broker: str, subject: str,
+                 timeout: float = 5.0):
+        self.target_id = target_id
+        host, _, port = broker.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self.subject = subject
+        self.timeout = timeout
+
+    def send(self, record: dict, wrap: bool = True) -> None:
+        payload = json.dumps({"Records": [record]} if wrap
+                             else record).encode()
+        with socket.create_connection(self._addr,
+                                      timeout=self.timeout) as s:
+            f = s.makefile("rb")
+            info = f.readline()
+            if not info.startswith(b"INFO "):
+                raise TargetError(f"not a NATS server: {info[:40]!r}")
+            s.sendall(b'CONNECT {"verbose":true,"pedantic":false,'
+                      b'"name":"minio-tpu-notify","lang":"py",'
+                      b'"version":"1"}\r\n')
+            line = f.readline()
+            if not line.startswith(b"+OK"):
+                raise TargetError(f"NATS CONNECT refused: {line[:40]!r}")
+            s.sendall(b"PUB " + self.subject.encode() + b" " +
+                      str(len(payload)).encode() + b"\r\n" +
+                      payload + b"\r\n")
+            line = f.readline()
+            if not line.startswith(b"+OK"):
+                raise TargetError(f"NATS PUB refused: {line[:40]!r}")
+
+
+# ---------------------------------------------------------------------------
+# Redis (RESP2)
+# ---------------------------------------------------------------------------
+
+class RedisTarget:
+    """RPUSHes the event JSON onto a Redis list key."""
+
+    def __init__(self, target_id: str, broker: str, key: str,
+                 timeout: float = 5.0, password: str = ""):
+        self.target_id = target_id
+        host, _, port = broker.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self.key = key
+        self.timeout = timeout
+        self.password = password
+
+    @staticmethod
+    def _cmd(*parts: bytes) -> bytes:
+        out = b"*" + str(len(parts)).encode() + b"\r\n"
+        for p in parts:
+            out += b"$" + str(len(p)).encode() + b"\r\n" + p + b"\r\n"
+        return out
+
+    @staticmethod
+    def _reply(f) -> bytes:
+        line = f.readline()
+        if not line:
+            raise TargetError("redis closed the connection")
+        if line[:1] == b"-":
+            raise TargetError(f"redis error: {line[1:].strip().decode()}")
+        return line
+
+    def send(self, record: dict, wrap: bool = True) -> None:
+        payload = json.dumps({"Records": [record]} if wrap
+                             else record).encode()
+        with socket.create_connection(self._addr,
+                                      timeout=self.timeout) as s:
+            f = s.makefile("rb")
+            if self.password:
+                s.sendall(self._cmd(b"AUTH", self.password.encode()))
+                self._reply(f)
+            s.sendall(self._cmd(b"RPUSH", self.key.encode(), payload))
+            self._reply(f)

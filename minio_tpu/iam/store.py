@@ -55,6 +55,10 @@ class IAMSys:
         # other nodes drop their IAM caches immediately (reference:
         # cmd/iam.go notifies peers on every IAM object write).
         self.on_change = None
+        # Fired only when MIRRORED durable state (users/policies/...)
+        # changes — site replication hangs here so STS mints don't
+        # push the whole document to every peer site.
+        self.on_mirror_change = None
         self._load()
 
     # -- persistence ----------------------------------------------------
@@ -77,13 +81,19 @@ class IAMSys:
             except ValueError:
                 loaded = None
             if isinstance(loaded, dict):
-                # Older persisted documents predate groups/sts.
+                # Older persisted documents predate groups/sts/rev.
                 loaded.setdefault("groups", {})
                 loaded.setdefault("sts", {})
+                loaded.setdefault("rev", 0)
                 self._state = loaded
         self._loaded_at = time.monotonic()
 
-    def _save(self) -> None:
+    def _save(self, bump: bool = True) -> None:
+        if bump:
+            # Monotonic document revision: site replication's IAM
+            # mirror gates on it so a stale (e.g. bootstrap-empty) peer
+            # push can never clobber newer local state.
+            self._state["rev"] = self._state.get("rev", 0) + 1
         blob = json.dumps(self._state, sort_keys=True).encode()
         ok = 0
         for d in self._disks():
@@ -95,18 +105,21 @@ class IAMSys:
         if ok < len(self._disks()) // 2 + 1:
             raise IAMError("could not persist IAM state to a drive quorum")
 
-    def _fire_change(self) -> None:
+    def _fire_change(self, mirrored: bool = True) -> None:
         """Run the peer fan-out AFTER the mutator released _mu: the
         broadcast can block up to its timeout on a partitioned peer,
         and holding the lock through it would stall every credential
         lookup on this node (and deadlock-by-timeout against a peer
-        mutating concurrently)."""
-        cb = self.on_change
-        if cb is not None:
-            try:
-                cb()
-            except Exception:  # noqa: BLE001 - fan-out must not fail writes
-                pass
+        mutating concurrently). `mirrored` additionally fires the site
+        replication hook — STS-only writes pass False so temp-credential
+        mints don't push the IAM document across sites."""
+        for cb in ((self.on_change,)
+                   + ((self.on_mirror_change,) if mirrored else ())):
+            if cb is not None:
+                try:
+                    cb()
+                except Exception:  # noqa: BLE001 - must not fail writes
+                    pass
 
     def _refresh(self) -> None:
         if time.monotonic() - self._loaded_at > self._TTL:
@@ -137,7 +150,7 @@ class IAMSys:
                 return sa["secret"]
             st = self._state["sts"].get(access_key)
             if st is not None and time.time_ns() < st.get("expiry_ns", 0) \
-                    and self._parent_live(st.get("parent", "")):
+                    and self._sts_live(st):
                 return st["secret"]
         return None
 
@@ -150,6 +163,14 @@ class IAMSys:
         u = self._state["users"].get(parent)
         return u is not None and u.get("status", "enabled") == "enabled"
 
+    def _sts_live(self, st: dict) -> bool:
+        """Liveness beyond expiry: parented STS keys die with their
+        parent; web-identity keys (no local parent — the IdP was the
+        identity) live by expiry alone."""
+        if st.get("web_identity"):
+            return True
+        return self._parent_live(st.get("parent", ""))
+
     def session_token_for(self, access_key: str) -> Optional[str]:
         """The session token an STS credential must present on every
         request (None for permanent credentials)."""
@@ -157,7 +178,7 @@ class IAMSys:
             self._refresh()
             st = self._state["sts"].get(access_key)
             if st is not None and time.time_ns() < st.get("expiry_ns", 0) \
-                    and self._parent_live(st.get("parent", "")):
+                    and self._sts_live(st):
                 return st.get("token", "")
         return None
 
@@ -202,8 +223,13 @@ class IAMSys:
             st = self._state["sts"].get(access_key)
             if st is not None:
                 if time.time_ns() >= st.get("expiry_ns", 0) or \
-                        not self._parent_live(st.get("parent", "")):
+                        not self._sts_live(st):
                     return []
+                if st.get("web_identity"):
+                    # Web-identity keys carry their own policy-name
+                    # mapping (the OIDC claim), no local parent.
+                    return self._compile_names(
+                        list(st.get("policies") or []))
                 access_key = st.get("parent", access_key)
                 if access_key == self.root_access:
                     # Root-parented STS keys inherit everything; the
@@ -443,8 +469,86 @@ class IAMSys:
             self._state["sts"][ak] = {
                 "secret": sk, "parent": parent, "token": token,
                 "expiry_ns": expiry_ns, "policy": session_policy}
-            self._save()
-        self._fire_change()
+            # STS records are NOT mirrored: no rev bump (a burst of
+            # mints must not outrank a peer's real identity edits in
+            # the import gate) and no site push.
+            self._save(bump=False)
+        self._fire_change(mirrored=False)
+        return {"access_key": ak, "secret_key": sk, "session_token": token,
+                "expiry_ns": expiry_ns}
+
+    # -- site replication mirror ------------------------------------------
+
+    _MIRROR_KEYS = ("users", "service_accounts", "policies",
+                    "user_policies", "groups")
+
+    def export_doc(self) -> dict:
+        """The durable identity state site replication mirrors to peer
+        clusters (reference: cmd/site-replication.go replicates IAM
+        users/policies/service accounts). STS temp credentials stay
+        local — they expire and their tokens bind to this cluster."""
+        with self._mu:
+            self._refresh()
+            out = json.loads(json.dumps(
+                {k: self._state.get(k, {}) for k in self._MIRROR_KEYS}))
+            out["rev"] = self._state.get("rev", 0)
+            return out
+
+    def import_doc(self, doc: dict) -> None:
+        """Receiving side of the IAM mirror: replace the durable
+        sections wholesale, gated on the document REVISION — a stale
+        push (a just-registered peer's near-empty bootstrap racing this
+        site's fresh writes) must never clobber newer state; only a
+        strictly newer document applies. Deliberately does NOT fire
+        on_change — an applied mirror must never re-broadcast (site
+        ping-pong); intra-cluster nodes pick the document up within
+        the TTL."""
+        incoming = int(doc.get("rev", 0))
+        with self._mu:
+            self._refresh()
+            if incoming <= self._state.get("rev", 0):
+                return
+            for k in self._MIRROR_KEYS:
+                v = doc.get(k)
+                if isinstance(v, dict):
+                    self._state[k] = v
+            self._state["rev"] = incoming
+            self._save(bump=False)
+
+    def assume_role_web_identity(self, subject: str, policy_names: list,
+                                 duration_s: Optional[int] = None,
+                                 session_policy: Optional[dict] = None
+                                 ) -> dict:
+        """Mint temporary credentials for an OIDC-validated external
+        identity (reference: cmd/sts-handlers.go:61-65
+        AssumeRoleWithWebIdentity): no local user exists — the record
+        carries the claim-mapped policy names directly, intersected
+        with the optional session policy like AssumeRole."""
+        import base64
+        import os as _os
+        if duration_s is None:
+            duration_s = self.STS_DEFAULT_S
+        if not self.STS_MIN_S <= duration_s <= self.STS_MAX_S:
+            raise IAMError(f"DurationSeconds must be in "
+                           f"[{self.STS_MIN_S}, {self.STS_MAX_S}]")
+        if not policy_names:
+            raise IAMError("web identity maps to no policies")
+        if session_policy is not None:
+            Policy.from_json(session_policy)   # validate before storing
+        ak = "STS" + base64.b32encode(_os.urandom(10)).decode().rstrip("=")
+        sk = base64.b64encode(_os.urandom(30)).decode()
+        token = base64.b64encode(_os.urandom(48)).decode()
+        expiry_ns = time.time_ns() + duration_s * 10**9
+        with self._mu:
+            self._refresh()
+            self._prune_expired_sts()
+            self._state["sts"][ak] = {
+                "secret": sk, "parent": "", "token": token,
+                "expiry_ns": expiry_ns, "policy": session_policy,
+                "web_identity": True, "subject": subject,
+                "policies": list(policy_names)}
+            self._save(bump=False)
+        self._fire_change(mirrored=False)
         return {"access_key": ak, "secret_key": sk, "session_token": token,
                 "expiry_ns": expiry_ns}
 

@@ -8,12 +8,14 @@ and object writes/deletes mirror to every peer automatically —
 active-active, with replica markers breaking the ping-pong loop
 (a change received FROM a site never re-replicates back out).
 
-v1 scope: buckets + bucket metadata + objects + delete markers.
-SSE-encrypted objects do not replicate (their keys bind to one
-cluster, same as bucket replication v1); IAM replication is not wired.
-Registering sites bootstraps existing buckets + their metadata to the
-peers; existing OBJECTS are not backfilled (run a batch replicate job
-per bucket for that).
+Scope: buckets + bucket metadata + objects + delete markers + IAM
+(users, service accounts, named policies, policy attachments, groups —
+the durable identity state; STS temp credentials stay local, reference
+cmd/site-replication.go mirrors the same set). SSE-encrypted objects do
+not replicate (their keys bind to one cluster, same as bucket
+replication v1). Registering sites bootstraps existing buckets, their
+metadata, and the IAM document to the peers; existing OBJECTS are not
+backfilled (run a batch replicate job per bucket for that).
 """
 
 from __future__ import annotations
@@ -56,15 +58,40 @@ def load_config(sets) -> Optional[dict]:
         return None
 
 
+def hook_iam_changes(server) -> None:
+    """Install (once per server) an IAM on_change hook that mirrors the
+    identity document to peer sites whenever a replicator is armed.
+    Chained AFTER any existing hook (the intra-cluster peer broadcast),
+    and a no-op while no site is configured — so arming later via the
+    admin API needs no rewiring."""
+    iam = getattr(server.credentials, "iam", None)
+    if iam is None or getattr(server, "_site_iam_hooked", False):
+        return
+    server._site_iam_hooked = True
+    prev = iam.on_mirror_change
+
+    def changed():
+        if prev is not None:
+            prev()
+        site = server.site
+        if site is not None and site.iam is not None:
+            site.enqueue("iam", "")
+
+    # The MIRROR hook, not on_change: STS credential mints fire the
+    # latter constantly and must not push the document across sites.
+    iam.on_mirror_change = changed
+
+
 class SiteReplicator:
     """Fan-out worker mirroring changes to every peer site."""
 
     _RETRIES = 3
 
     def __init__(self, object_layer, sets, config: dict,
-                 workers: int = 2):
+                 workers: int = 2, iam=None):
         self.layer = object_layer
         self._sets = list(sets)
+        self.iam = iam                 # IAMSys to mirror (None = skip)
         self.config = dict(config)
         self._q: queue.Queue = queue.Queue(maxsize=10_000)
         self._stop = threading.Event()
@@ -142,6 +169,8 @@ class SiteReplicator:
         for b in self.layer.list_buckets():
             self.enqueue("bucket-make", b.name)
             self.enqueue("bucket-meta", b.name)
+        if self.iam is not None:
+            self.enqueue("iam", "")
 
     def drain(self, timeout: float = 30.0) -> bool:
         deadline = time.monotonic() + timeout
@@ -198,6 +227,14 @@ class SiteReplicator:
                 body=json.dumps(meta).encode())
             if st != 200:
                 raise SiteError(f"meta import HTTP {st}")
+        elif kind == "iam":
+            if self.iam is None:
+                return
+            st, _, _ = client.request(
+                "PUT", "/minio/admin/v3/site-import-iam",
+                body=json.dumps(self.iam.export_doc()).encode())
+            if st != 200:
+                raise SiteError(f"iam import HTTP {st}")
         elif kind == "put":
             from minio_tpu.replication.common import push_object
             push_object(self.layer, client, bucket, key, version_id,

@@ -13,6 +13,9 @@ Supported keys (unknown keys persist but are inert):
   scanner_interval   seconds (float) — background scanner cadence
   scanner_deep_every N               — deep-heal sampling rate
   scanner_throttle   seconds (float) — per-object scanner sleep
+  identity_openid_*  OIDC provider for AssumeRoleWithWebIdentity
+                     (jwks_url | jwks inline, client_id, claim_name,
+                     issuer — see iam/oidc.py)
 """
 
 from __future__ import annotations
@@ -113,4 +116,9 @@ def apply_config(server, cfg: dict) -> list[str]:
         if "scanner_throttle" in cfg:
             scanner.throttle = float(cfg["scanner_throttle"])
             applied.append("scanner_throttle")
+    if any(k.startswith("identity_openid") for k in cfg):
+        # Drop the cached validator; the next STS web-identity call
+        # rebuilds it from the new provider settings.
+        server.oidc = None
+        applied.append("identity_openid")
     return applied

@@ -140,6 +140,9 @@ class S3Server:
         # Warm-tier registry (object/tier.TierRegistry), created on
         # first admin use or at boot.
         self.tiers = None
+        # OpenID validator for AssumeRoleWithWebIdentity; built lazily
+        # from the config subsystem, reset on config change.
+        self.oidc = None
         # Batch-job manager (object/batch.BatchJobs), ditto.
         self.batch = None
         # Site replicator (replication/site.SiteReplicator); None until
@@ -589,18 +592,19 @@ def _make_handler(server: S3Server):
         # -- service / bucket ops --------------------------------------
 
         def _sts_op(self, auth, body: bytes):
-            """POST / — STS AssumeRole (reference:
-            cmd/sts-handlers.go:61 AssumeRole): any authenticated USER
-            identity mints temporary credentials scoped to its own
-            permissions, optionally narrowed by a session policy."""
+            """POST / — STS (reference: cmd/sts-handlers.go:61-65):
+            AssumeRole (any authenticated USER identity mints temporary
+            credentials scoped to its own permissions, optionally
+            narrowed by a session policy) and
+            AssumeRoleWithWebIdentity (an OIDC JWT from a configured
+            IdP mints credentials mapped from its policy claim — no
+            local user needed, no SigV4 on the request)."""
             import json as _json
             form = dict(urllib.parse.parse_qsl(
                 body.decode("utf-8", "replace")))
             action = form.get("Action", "")
-            if action != "AssumeRole":
+            if action not in ("AssumeRole", "AssumeRoleWithWebIdentity"):
                 raise S3Error("NotImplemented", f"STS action {action!r}")
-            if auth.anonymous:
-                raise S3Error("AccessDenied")
             iam = server.credentials.iam
             if iam is None:
                 raise S3Error("NotImplemented", "no IAM store")
@@ -611,31 +615,79 @@ def _make_handler(server: S3Server):
                 except ValueError:
                     raise S3Error("InvalidArgument",
                                   "bad DurationSeconds") from None
-            policy = None
-            if form.get("Policy"):
-                try:
-                    policy = _json.loads(form["Policy"])
-                except ValueError:
-                    raise S3Error("MalformedPolicy") from None
             from minio_tpu.iam import IAMError
             from minio_tpu.iam.policy import PolicyError
-            try:
-                rec = iam.assume_role(auth.credential.access_key,
-                                      duration, policy)
-            except PolicyError as e:
-                raise S3Error("MalformedPolicy", str(e)) from None
-            except IAMError as e:
-                raise S3Error("AccessDenied", str(e)) from None
+            if action == "AssumeRoleWithWebIdentity":
+                rec = self._sts_web_identity(iam, form, duration)
+            else:
+                if auth.anonymous:
+                    raise S3Error("AccessDenied")
+                policy = None
+                if form.get("Policy"):
+                    try:
+                        policy = _json.loads(form["Policy"])
+                    except ValueError:
+                        raise S3Error("MalformedPolicy") from None
+                try:
+                    rec = iam.assume_role(auth.credential.access_key,
+                                          duration, policy)
+                except PolicyError as e:
+                    raise S3Error("MalformedPolicy", str(e)) from None
+                except IAMError as e:
+                    raise S3Error("AccessDenied", str(e)) from None
             root = ET.Element(
-                "AssumeRoleResponse",
+                f"{action}Response",
                 xmlns="https://sts.amazonaws.com/doc/2011-06-15/")
-            res = _el(root, "AssumeRoleResult")
+            res = _el(root, f"{action}Result")
+            if action == "AssumeRoleWithWebIdentity" and rec.get("subject"):
+                _el(res, "SubjectFromWebIdentityToken", rec["subject"])
             creds = _el(res, "Credentials")
             _el(creds, "AccessKeyId", rec["access_key"])
             _el(creds, "SecretAccessKey", rec["secret_key"])
             _el(creds, "SessionToken", rec["session_token"])
             _el(creds, "Expiration", _iso8601(rec["expiry_ns"]))
             self._send(200, _xml(root))
+
+        def _sts_web_identity(self, iam, form: dict, duration):
+            """Validate the WebIdentityToken against the configured
+            OIDC provider and mint claim-mapped credentials."""
+            from minio_tpu.iam import IAMError
+            from minio_tpu.iam.oidc import OIDCError, OpenIDValidator
+            token = form.get("WebIdentityToken", "")
+            if not token:
+                raise S3Error("InvalidArgument",
+                              "WebIdentityToken is required")
+            validator = server.oidc
+            if validator is None:
+                from minio_tpu.s3 import config as cfg_mod
+                cfg = cfg_mod.load_config(server.object_layer)
+                try:
+                    validator = OpenIDValidator.from_config(cfg)
+                except OIDCError as e:
+                    raise S3Error("NotImplemented", str(e)) from None
+                if validator is None:
+                    raise S3Error("NotImplemented",
+                                  "no OpenID provider configured")
+                server.oidc = validator
+            session_policy = None
+            if form.get("Policy"):
+                import json as _json
+                try:
+                    session_policy = _json.loads(form["Policy"])
+                except ValueError:
+                    raise S3Error("MalformedPolicy") from None
+            try:
+                claims = validator.validate(token)
+                names = validator.policies_from(claims)
+                rec = iam.assume_role_web_identity(
+                    claims.get("sub", ""), names, duration,
+                    session_policy)
+            except OIDCError as e:
+                raise S3Error("AccessDenied", str(e)) from None
+            except IAMError as e:
+                raise S3Error("AccessDenied", str(e)) from None
+            rec["subject"] = claims.get("sub", "")
+            return rec
 
         def _list_buckets(self):
             buckets = server.object_layer.list_buckets()
@@ -2597,14 +2649,16 @@ def _make_handler(server: S3Server):
             # Site replication (reference: cmd/site-replication.go).
             if op in ("site-replication-add", "site-replication-info",
                       "site-replication-remove",
-                      "site-import-bucket-meta"):
+                      "site-import-bucket-meta", "site-import-iam"):
                 from minio_tpu.replication.site import (SiteError,
-                                                        SiteReplicator)
+                                                        SiteReplicator,
+                                                        hook_iam_changes)
                 try:
                     if op == "site-replication-add" and method == "POST":
                         cfg = SiteReplicator.validate(_json.loads(body))
                         new_site = SiteReplicator(
-                            server.object_layer, self._layer_sets(), cfg)
+                            server.object_layer, self._layer_sets(), cfg,
+                            iam=server.credentials.iam)
                         try:
                             # Persist BEFORE arming: a failed save must
                             # not leave an active replicator running a
@@ -2616,6 +2670,7 @@ def _make_handler(server: S3Server):
                         if server.site is not None:
                             server.site.stop()
                         server.site = new_site
+                        hook_iam_changes(server)
                         server.site.bootstrap()
                         return ok()
                     if op == "site-replication-info" and method == "GET":
@@ -2653,6 +2708,19 @@ def _make_handler(server: S3Server):
                             pass
                         with server.bucket_meta_lock:
                             server.object_layer.set_bucket_meta(bkt, meta)
+                        return ok()
+                    if op == "site-import-iam" and method == "PUT":
+                        # Receiving side of a peer's IAM mirror: applied
+                        # directly; import_doc never fires on_change, so
+                        # the change cannot ping-pong back.
+                        doc = _json.loads(body)
+                        if not isinstance(doc, dict):
+                            raise S3Error("InvalidArgument", "bad doc")
+                        iam = server.credentials.iam
+                        if iam is None:
+                            raise S3Error("NotImplemented",
+                                          "no IAM store")
+                        iam.import_doc(doc)
                         return ok()
                 except SiteError as e:
                     raise S3Error("InvalidArgument", str(e)) from None

@@ -53,6 +53,15 @@ def main(argv=None) -> int:
     ap.add_argument("--notify-webhook", default="",
                     help="webhook endpoint URL for bucket event "
                          "notifications (target id 'webhook')")
+    ap.add_argument("--notify-mqtt", default="",
+                    help="host:port/topic of an MQTT 3.1.1 broker for "
+                         "event notifications (target id 'mqtt')")
+    ap.add_argument("--notify-nats", default="",
+                    help="host:port/subject of a NATS server for event "
+                         "notifications (target id 'nats')")
+    ap.add_argument("--notify-redis", default="",
+                    help="host:port/listkey of a Redis server for event "
+                         "notifications (target id 'redis')")
     ap.add_argument("--audit-webhook", default="",
                     help="webhook endpoint URL receiving one audit "
                          "record per completed request")
@@ -312,12 +321,15 @@ def main(argv=None) -> int:
         s.tiers = srv.tiers
     # Site replication: re-arm a persisted peer registry
     # (reference: site replication config survives restarts).
-    from minio_tpu.replication.site import SiteReplicator, load_config
+    from minio_tpu.replication.site import (SiteReplicator,
+                                            hook_iam_changes, load_config)
     site_cfg = load_config(pools[0].sets)
     if site_cfg:
-        srv.site = SiteReplicator(layer, pools[0].sets, site_cfg)
+        srv.site = SiteReplicator(layer, pools[0].sets, site_cfg,
+                                  iam=creds.iam)
         print(f"site replication armed "
               f"({len(site_cfg.get('peers', []))} peers)", flush=True)
+    hook_iam_changes(srv)
     # Batch jobs: resume any that a crash or restart interrupted
     # (reference: batch jobs survive restarts via their checkpoints).
     from minio_tpu.object.batch import BatchJobs
@@ -363,10 +375,33 @@ def main(argv=None) -> int:
     from minio_tpu.replication import ReplicationEngine
     srv.replicator = ReplicationEngine(layer)
     scanner.on_object.append(srv.replicator.scanner_hook)
+    notify_targets = []
     if args.notify_webhook:
-        # Store-and-forward webhook notifications; the queue lives on
-        # the first local drive so it survives restarts.
-        from minio_tpu.events import EventNotifier, WebhookTarget
+        from minio_tpu.events import WebhookTarget
+        notify_targets.append(WebhookTarget("webhook",
+                                            args.notify_webhook))
+    for flag, cls, tid in ((args.notify_mqtt, "MQTTTarget", "mqtt"),
+                           (args.notify_nats, "NATSTarget", "nats"),
+                           (args.notify_redis, "RedisTarget", "redis")):
+        if not flag:
+            continue
+        import minio_tpu.events as _ev
+        broker, _, chan = flag.partition("/")
+        if not chan:
+            print(f"FATAL: --notify-{tid} needs host:port/"
+                  f"{'topic' if tid == 'mqtt' else 'subject' if tid == 'nats' else 'listkey'}",
+                  file=sys.stderr)
+            return 1
+        try:
+            notify_targets.append(getattr(_ev, cls)(tid, broker, chan))
+        except ValueError:
+            print(f"FATAL: --notify-{tid}: {broker!r} is not host:port",
+                  file=sys.stderr)
+            return 1
+    if notify_targets:
+        # Store-and-forward notifications; the queue lives on the
+        # first local drive so it survives restarts.
+        from minio_tpu.events import EventNotifier
         first_local = next((d for p in pools for s in p.sets
                             for d in s.disks
                             if getattr(d, "root", None)), None)
@@ -376,9 +411,7 @@ def main(argv=None) -> int:
             if first_local is not None else \
             os.path.join(os.path.expanduser("~"), ".mtpu",
                          f"events-{deployment_id}")
-        srv.notifier = EventNotifier(
-            layer, store,
-            targets=[WebhookTarget("webhook", args.notify_webhook)])
+        srv.notifier = EventNotifier(layer, store, targets=notify_targets)
     ftp = None
     if args.ftp_address:
         from minio_tpu.gateway import FTPGateway

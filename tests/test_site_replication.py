@@ -151,3 +151,76 @@ def test_bootstrap_syncs_existing_buckets(two_sites):
                       "/minio/admin/v3/site-replication-remove")[0] == 200
     st, _, b = ec.request("GET", "/minio/admin/v3/site-replication-info")
     assert st == 200 and b in (b"", b"null")
+
+
+@pytest.fixture
+def two_iam_sites(tmp_path):
+    """Two clusters WITH IAM stores (the default fixture has none)."""
+    from minio_tpu.iam import IAMSys
+    from minio_tpu.s3.server import Credentials
+    servers = []
+    for name in ("east", "west"):
+        disks = [LocalStorage(str(tmp_path / name / f"d{i}"))
+                 for i in range(4)]
+        es = ErasureSet(disks)
+        creds = Credentials("minioadmin", "minioadmin")
+        creds.iam = IAMSys([es], "minioadmin", "minioadmin")
+        srv = S3Server(es, address="127.0.0.1:0", credentials=creds)
+        srv.start()
+        servers.append(srv)
+    yield servers
+    for s in servers:
+        if s.site is not None:
+            s.site.stop()
+        s.stop()
+
+
+def test_iam_mirrors_across_sites(two_iam_sites):
+    """A user + policy created on east signs requests on west
+    (reference: cmd/site-replication.go mirrors IAM), and the applied
+    import never ping-pongs back."""
+    east, west = two_iam_sites
+    ec = S3Client(east.address)
+    _link(east, west)
+
+    # Create a policy, a user, and the attachment on EAST only.
+    st, _, b = ec.request(
+        "PUT", "/minio/admin/v3/add-canned-policy",
+        query={"name": "mirror-rw"},
+        body=json.dumps({"Version": "2012-10-17", "Statement": [{
+            "Effect": "Allow",
+            "Action": ["s3:GetObject", "s3:PutObject", "s3:CreateBucket",
+                        "s3:ListBucket"],
+            "Resource": ["arn:aws:s3:::shared*"]}]}).encode())
+    assert st == 200, b
+    assert ec.request("PUT", "/minio/admin/v3/add-user",
+                      query={"accessKey": "alice"},
+                      body=json.dumps({"secretKey":
+                                       "alicesecret99"}).encode())[0] == 200
+    assert ec.request("PUT", "/minio/admin/v3/set-user-or-group-policy",
+                      query={"userOrGroup": "alice",
+                             "policyName": "mirror-rw"})[0] == 200
+    assert east.site.drain(30)
+
+    # Alice's credential works on WEST, inside her mirrored policy...
+    west.credentials.iam.invalidate()
+    walice = S3Client(west.address, access_key="alice",
+                      secret_key="alicesecret99")
+    assert walice.request("PUT", "/sharedbkt")[0] == 200
+    assert walice.request("PUT", "/sharedbkt/doc", body=b"hi")[0] == 200
+    assert walice.request("GET", "/sharedbkt/doc")[2] == b"hi"
+    # ...and not outside it.
+    assert walice.request("DELETE", "/sharedbkt/doc")[0] == 403
+
+    # Loop prevention: west's import must not re-enqueue an IAM push
+    # back toward east. Let the queues settle and compare counters.
+    assert west.site.drain(10)
+    failed_before = east.site.failed + west.site.failed
+    time.sleep(0.5)
+    assert east.site.failed + west.site.failed == failed_before
+    # A user REMOVED on east disappears on west too.
+    assert ec.request("DELETE", "/minio/admin/v3/remove-user",
+                      query={"accessKey": "alice"})[0] == 200
+    assert east.site.drain(30)
+    west.credentials.iam.invalidate()
+    assert _wait(lambda: walice.request("GET", "/sharedbkt/doc")[0] == 403)
