@@ -66,7 +66,15 @@ class KMS:
     def unseal(self, sealed: str, context: dict) -> bytes:
         try:
             blob = json.loads(sealed)
-            master = self._keys[blob["kid"]]
+            kid = blob["kid"]
+            if kid not in self._keys:
+                # A key created on ANOTHER node since this process
+                # loaded: refresh the attached store once before
+                # failing (the cross-node analogue of the IAM TTL).
+                ks = getattr(self, "_keystore", None)
+                if ks is not None:
+                    ks.reload()
+            master = self._keys[kid]
             nonce = base64.b64decode(blob["n"])
             ct = base64.b64decode(blob["c"])
         except (ValueError, KeyError, TypeError):
@@ -77,3 +85,104 @@ class KMS:
         except Exception:
             raise KMSError("sealed key does not unseal "
                            "(wrong master key or context)") from None
+
+
+class KeyStore:
+    """Drive-persisted named-key registry behind the KMS admin API.
+
+    The analogue of the reference's KMS key management surface
+    (cmd/kms-handlers.go KMSCreateKey/KMSListKeys/KMSKeyStatus,
+    internal/kms/): named 256-bit keys, each stored SEALED under the
+    env master key (MTPU_KMS_SECRET_KEY) on a quorum of the given
+    drives, loaded into the live KMS so SSE can seal/unseal under any
+    of them. Without an env master key the store refuses to operate —
+    persisting key material unwrapped is not an option.
+    """
+
+    PATH = "config/kms/keys.json"
+    _SYS = ".mtpu.sys"
+
+    # Named keys created on other nodes become visible within this
+    # window (plus immediately on an unknown-kid unseal).
+    _TTL = 2.0
+
+    def __init__(self, kms: "KMS", disks):
+        if kms is None:
+            raise KMSError("KMS key store requires MTPU_KMS_SECRET_KEY")
+        self.kms = kms
+        self._disks = list(disks)
+        self._load()
+        import time as _time
+        self._loaded_at = _time.monotonic()
+        kms._keystore = self
+
+    def reload(self) -> None:
+        import time as _time
+        if _time.monotonic() - self._loaded_at < self._TTL:
+            return
+        self._load()
+        self._loaded_at = _time.monotonic()
+
+    def _ctx(self, name: str) -> dict:
+        return {"kms-key": name}
+
+    def _load(self) -> None:
+        votes: dict[bytes, int] = {}
+        for d in self._disks:
+            try:
+                blob = d.read_all(self._SYS, self.PATH)
+                votes[blob] = votes.get(blob, 0) + 1
+            except Exception:  # noqa: BLE001 - absent / offline
+                continue
+        self._sealed: dict[str, str] = {}
+        if votes:
+            try:
+                doc = json.loads(max(votes.items(),
+                                     key=lambda kv: kv[1])[0])
+                if isinstance(doc, dict):
+                    self._sealed = doc
+            except ValueError:
+                pass
+        for name, sealed in self._sealed.items():
+            try:
+                self.kms._keys[name] = self.kms.unseal(sealed,
+                                                       self._ctx(name))
+            except KMSError:
+                continue            # sealed under a different master
+
+    def _save(self) -> None:
+        blob = json.dumps(self._sealed, sort_keys=True).encode()
+        ok = 0
+        for d in self._disks:
+            try:
+                d.write_all(self._SYS, self.PATH, blob)
+                ok += 1
+            except Exception:  # noqa: BLE001 - offline drive
+                continue
+        if ok < len(self._disks) // 2 + 1:
+            raise KMSError("could not persist KMS keys to a quorum")
+
+    def create(self, name: str) -> None:
+        if not name or "/" in name:
+            raise KMSError("invalid key name")
+        if name in self.kms._keys:
+            raise KMSError(f"key {name!r} already exists")
+        secret = os.urandom(32)
+        self._sealed[name] = self.kms.seal(secret, self._ctx(name))
+        self._save()
+        self.kms._keys[name] = secret
+
+    def list(self) -> list[dict]:
+        return [{"name": n, "default": n == self.kms.default_key}
+                for n in sorted(self.kms._keys)]
+
+    def status(self, name: str) -> dict:
+        """Liveness probe: encrypt/decrypt a canary under the key (the
+        reference's KMSKeyStatus does the same round trip)."""
+        if name not in self.kms._keys:
+            raise KMSError(f"no such key {name!r}")
+        canary = os.urandom(16)
+        nonce = os.urandom(12)
+        ct = AESGCM(self.kms._keys[name]).encrypt(nonce, canary, b"")
+        ok = AESGCM(self.kms._keys[name]).decrypt(nonce, ct, b"") == canary
+        return {"name": name, "encrypt_ok": ok, "decrypt_ok": ok}

@@ -22,6 +22,10 @@ KIND_IAM = "iam"
 KIND_BUCKET_META = "bucket-meta"
 KIND_CONFIG = "config"
 KIND_DECOM = "decom"
+# A bucket's namespace changed on the sending node: drop listing walk
+# streams (object/metacache.py) so the peer's next listing re-walks
+# immediately instead of serving pre-write names.
+KIND_LISTING = "listing"
 
 
 class PeerNotifier:
@@ -77,6 +81,15 @@ def make_reload_handler(iam=None, object_layer=None,
                 apply_config()
             except Exception:  # noqa: BLE001 - bad config must not kill RPC
                 pass
+        elif kind == KIND_LISTING and object_layer is not None:
+            bucket = (payload or {}).get("bucket", "")
+            # Bump WITHOUT re-broadcast: the originating node already
+            # fanned out; echoing would ping-pong bumps forever.
+            from minio_tpu.s3.metrics import layer_sets
+            for es in layer_sets(object_layer):
+                mc = getattr(es, "metacache", None)
+                if mc is not None:
+                    mc.bump(bucket, broadcast=False)
         elif kind == KIND_DECOM and object_layer is not None:
             # A drain started/finished on another node: re-sync this
             # node's pool placement exclusions from persisted state.

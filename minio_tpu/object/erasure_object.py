@@ -1593,32 +1593,19 @@ class ErasureSet:
         self.metacache.bump(bucket)
         return DeletedObject(object_name=object_, version_id=opts.version_id)
 
-    def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
-                     delimiter: str = "", max_keys: int = 1000,
-                     include_versions: bool = False):
-        """Sorted listing with prefix/marker/delimiter semantics.
-
-        Per-drive sorted walks (reference: WalkDir, cmd/metacache-walk.go)
-        merged across up to 3 drives for resilience (reference default
-        askDisks), resolved per object from its journal. Early-exits once
-        max_keys+1 entries are found past the marker.
-        """
-        from minio_tpu.object.types import ListObjectsInfo
+    def _walk_resolved(self, bucket: str, prefix: str,
+                       start: str = ""):
+        """Sorted (path, version_maps) stream — the metacache's
+        production side. Per-drive sorted walks (reference: WalkDir,
+        cmd/metacache-walk.go:73) over a MAJORITY of drives (any write
+        quorum intersects the walked set, so committed objects are
+        never invisible even when some drives missed the write), k-way
+        merged, each key resolved from its journal copies. The walked
+        set rotates per walk (reference askDisks rotation) so a drive
+        failing mid-walk only shadows objects for some walks."""
         import heapq
+        from itertools import groupby
 
-        self._check_bucket(bucket)
-        max_keys = max(1, min(max_keys, 1000))
-        # Metacache: an identical listing against an unchanged bucket
-        # serves from the page cache instead of re-walking a drive
-        # majority (generation-stamped — any write invalidates).
-        # Pages are cached as-returned; callers treat listings as
-        # read-only.
-        cache_key = (bucket, prefix, marker, delimiter, max_keys,
-                     include_versions)
-        cached = self.metacache.get(bucket, cache_key)
-        if cached is not None:
-            return cached
-        walk_gen = self.metacache.generation(bucket)
         base_dir = ""
         if "/" in prefix:
             base_dir = prefix.rsplit("/", 1)[0]
@@ -1626,109 +1613,161 @@ class ErasureSet:
         def disk_iter(d):
             try:
                 yield from d.walk_dir(bucket, base_dir=base_dir,
-                                      forward_from=marker or prefix)
+                                      forward_from=max(start, prefix))
             except Exception:  # noqa: BLE001 - drive loss tolerated
                 return
 
-        # Walk a majority of drives: any write quorum (>= n/2) must
-        # intersect the walked set, so committed objects are never
-        # invisible to listings even when some drives missed the write.
-        # The set ROTATES per call (reference: metacache askDisks
-        # rotation) so a drive that fails mid-walk only shadows objects
-        # for some requests, not persistently.
         n_disks = len(self.disks)
-        start = getattr(self, "_walk_rotor", 0)
-        self._walk_rotor = (start + 1) % n_disks
-        rotated = [self.disks[(start + i) % n_disks]
+        rotor = getattr(self, "_walk_rotor", 0)
+        self._walk_rotor = (rotor + 1) % n_disks
+        rotated = [self.disks[(rotor + i) % n_disks]
                    for i in range(n_disks)]
         walk_disks = rotated[:n_disks // 2 + 1]
         iters = [disk_iter(d) for d in walk_disks if d is not None]
         merged = heapq.merge(*iters, key=lambda kv: kv[0])
+        for path, grp in groupby(merged, key=lambda kv: kv[0]):
+            maps = self._resolve_walked(bucket, path,
+                                        [b for _, b in grp], len(iters))
+            if maps is not None:
+                yield path, maps
 
-        def resolve_latest(path, entries, total_walked):
-            """Resolve one key from its walked journal copies.
+    def _resolve_walked(self, bucket, path, blobs, total_walked):
+        """Resolve one walked key to its version maps.
 
-            When every walked drive has the key and they agree, the parsed
-            copy is authoritative (no extra I/O — the hot path). Otherwise
-            the entry is ambiguous (a drive missed a delete/overwrite, or
-            the object never reached all walked drives) and resolution
-            falls back to a full quorum metadata read, exactly how the
-            reference's metacache resolver escalates disagreements —
-            a lone stale copy must not resurrect deleted objects, and a
-            quorum-thin write must still be listed."""
-            parsed = []
-            for blob in entries:
-                try:
-                    xl = XLMeta.load(blob)
-                    fi = xl.to_fileinfo(bucket, path)
-                    parsed.append((xl, fi))
-                except Exception:  # noqa: BLE001 - unreadable copy
-                    continue
-            agree = (len(parsed) == total_walked and len({
-                (fi.mod_time, fi.version_id, fi.deleted, fi.data_dir)
-                for _, fi in parsed}) == 1)
-            if agree:
-                return parsed[0]
-            try:
-                fi, _, _ = self._get_object_fileinfo(bucket, path)
-            except Exception:  # noqa: BLE001 - dangling / below quorum
-                return None
-            # Walked copies disagreed — none of their journals can be
-            # trusted for a versions expansion, only the quorum fi.
-            return (None, fi)
-
-        info = ListObjectsInfo()
-        seen_prefixes: set[str] = set()
-        last_added = ""   # last key/prefix actually returned; resume point
+        When every walked drive has the key and they agree, the parsed
+        journal is authoritative (no extra I/O — the hot path).
+        Otherwise the entry is ambiguous (a drive missed a
+        delete/overwrite, or the object never reached all walked
+        drives) and resolution falls back to a full quorum metadata
+        read, exactly how the reference's metacache resolver escalates
+        disagreements — a lone stale copy must not resurrect deleted
+        objects, and a quorum-thin write must still be listed."""
         from minio_tpu.storage.meta import XLMeta
-        from itertools import groupby
-        grouped = ((path, [b for _, b in grp]) for path, grp in
-                   groupby(merged, key=lambda kv: kv[0]))
-        for path, blobs in grouped:
-            if not path.startswith(prefix):
-                if path > prefix and not prefix.startswith(path):
-                    break  # sorted walk has passed the prefix range
+        parsed = []
+        for blob in blobs:
+            try:
+                xl = XLMeta.load(blob)
+                fi = xl.to_fileinfo(bucket, path)
+                parsed.append((xl, fi))
+            except Exception:  # noqa: BLE001 - unreadable copy
                 continue
-            if marker and path <= marker:
-                continue
-            if delimiter:
-                rest = path[len(prefix):]
-                di = rest.find(delimiter)
-                if di >= 0:
-                    cp = prefix + rest[:di + len(delimiter)]
-                    # Skip a prefix only when the whole page before it was
-                    # already returned; a marker INSIDE the prefix (e.g.
-                    # start-after=a/1 with cp=a/) must still surface it.
-                    if cp in seen_prefixes or (
-                            marker and cp <= marker
-                            and not (marker.startswith(cp) and marker != cp)):
-                        continue
-                    if len(info.objects) + len(seen_prefixes) >= max_keys:
-                        info.is_truncated = True
-                        info.next_marker = last_added
-                        break
-                    seen_prefixes.add(cp)
-                    last_added = cp
+        agree = (len(parsed) == total_walked and len({
+            (fi.mod_time, fi.version_id, fi.deleted, fi.data_dir)
+            for _, fi in parsed}) == 1)
+        if agree:
+            return list(parsed[0][0].versions)
+        try:
+            fi, _, _ = self._get_object_fileinfo(bucket, path)
+        except Exception:  # noqa: BLE001 - dangling / below quorum
+            return None
+        # Walked copies disagreed — only the quorum fi is trustworthy.
+        return [fi.to_version_map()]
+
+    def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
+                     delimiter: str = "", max_keys: int = 1000,
+                     include_versions: bool = False):
+        """Sorted listing with prefix/marker/delimiter semantics, served
+        from the shared metacache walk stream (reference:
+        cmd/metacache-set.go:700): every page, every concurrent listing
+        of the same prefix, and every follow-up within the reuse window
+        consumes ONE background walk — a large bucket walks once, not
+        once per page. Writes bump the bucket generation, orphaning the
+        stream (object/metacache.py)."""
+        import bisect
+
+        from minio_tpu.object.types import ListObjectsInfo
+        from minio_tpu.storage.meta import XLMeta
+
+        self._check_bucket(bucket)
+        max_keys = max(1, min(max_keys, 1000))
+        walk = self.metacache.walk_for(self, bucket, prefix)
+        if walk.truncated and walk.done and walk.keys and \
+                marker >= walk.keys[-1]:
+            # Continuing past a capped stream: a start-floored walk
+            # (shared by further continuations) keeps pagination
+            # moving instead of re-walking into the same cap.
+            walk = self.metacache.walk_for(self, bucket, prefix,
+                                           start=marker)
+        floor = marker if marker > prefix else prefix
+        need = max_keys + 1
+        while True:
+            count, done = walk.wait_past(floor, need)
+            keys, maps_list = walk.keys, walk.maps   # append-only; read
+            # only indices < count (stable)
+            info = ListObjectsInfo()
+            seen_prefixes: set[str] = set()
+            last_added = ""
+            complete = False     # page filled or range exhausted
+            idx = bisect.bisect_right(keys, marker, 0, count) \
+                if marker else 0
+            for i in range(idx, count):
+                path = keys[i]
+                if not path.startswith(prefix):
+                    if path > prefix and not prefix.startswith(path):
+                        complete = True
+                        break    # sorted stream passed the prefix range
                     continue
-            best = resolve_latest(path, blobs, len(iters))
-            if best is None:
-                continue
-            xl, fi = best
-            if fi.deleted and not include_versions:
-                continue
-            if len(info.objects) + len(seen_prefixes) >= max_keys:
-                info.is_truncated = True
-                info.next_marker = last_added
-                break
-            if include_versions and xl is not None:
-                for v in xl.list_versions(bucket, path):
-                    info.objects.append(self._to_object_info(bucket, path, v))
-            else:
-                info.objects.append(self._to_object_info(bucket, path, fi))
-            last_added = path
-        info.prefixes = sorted(seen_prefixes)
-        self.metacache.put(bucket, cache_key, info, gen=walk_gen)
-        return info
+                if delimiter:
+                    rest = path[len(prefix):]
+                    di = rest.find(delimiter)
+                    if di >= 0:
+                        cp = prefix + rest[:di + len(delimiter)]
+                        # Skip a prefix only when the whole page before
+                        # it was already returned; a marker INSIDE the
+                        # prefix (start-after=a/1, cp=a/) must still
+                        # surface it.
+                        if cp in seen_prefixes or (
+                                marker and cp <= marker
+                                and not (marker.startswith(cp)
+                                         and marker != cp)):
+                            continue
+                        if len(info.objects) + len(seen_prefixes) \
+                                >= max_keys:
+                            info.is_truncated = True
+                            info.next_marker = last_added
+                            complete = True
+                            break
+                        seen_prefixes.add(cp)
+                        last_added = cp
+                        continue
+                xl = XLMeta()
+                xl.versions = list(maps_list[i])
+                try:
+                    fi = xl.to_fileinfo(bucket, path)
+                except Exception:  # noqa: BLE001 - empty maps
+                    continue
+                if fi.deleted and not include_versions:
+                    continue
+                if len(info.objects) + len(seen_prefixes) >= max_keys:
+                    info.is_truncated = True
+                    info.next_marker = last_added
+                    complete = True
+                    break
+                if include_versions:
+                    for v in xl.list_versions(bucket, path):
+                        info.objects.append(
+                            self._to_object_info(bucket, path, v))
+                else:
+                    info.objects.append(
+                        self._to_object_info(bucket, path, fi))
+                last_added = path
+            if complete or done:
+                if walk.error is not None and not complete and not keys:
+                    raise walk.error
+                if done and not complete and walk.truncated:
+                    # The stream hit its memory cap before the range
+                    # was exhausted: page out what we have; the next
+                    # page starts a fresh walk (expensive but correct —
+                    # names past the cap must not silently vanish).
+                    info.is_truncated = True
+                    info.next_marker = last_added or (
+                        keys[count - 1] if count else "")
+                info.prefixes = sorted(seen_prefixes)
+                return info
+            # Stream not deep enough to fill the page yet: wait for
+            # more entries (delimiter collapse can consume many raw
+            # entries per returned prefix).
+            need *= 2
 
     def list_versions_all(self, bucket: str, object_: str) -> list[FileInfo]:
         results, _ = self._fanout(

@@ -1,84 +1,302 @@
-"""Metacache: shared listing-page cache with write invalidation.
+"""Metacache: shared listing walk streams with write invalidation.
 
-The analogue (scoped down) of the reference's metacache
-(cmd/metacache.go:55-70, cmd/metacache-set.go:700): the reference
-persists listing walk streams and shares them between concurrent
-listers; here, resolved listing PAGES are cached in a bounded LRU keyed
-by the exact listing parameters and stamped with the bucket's mutation
-GENERATION — any object write/delete in the bucket bumps the
-generation, so a cached page can never serve names or metadata from
-before a change (correctness first; the win is the common hot pattern
-of dashboards and SDKs re-issuing identical listings against a quiet
-bucket, which previously re-walked a drive majority every time).
+The analogue of the reference's metacache subsystem
+(cmd/metacache.go:55-70, cmd/metacache-set.go:700,
+cmd/metacache-walk.go:73): a listing starts ONE background walk of the
+erasure set — per-drive sorted journal walks, k-way merged, each key
+quorum-resolved — whose sorted entry stream accumulates in memory and
+persists in blocks on the set's first drive. Every page of that
+listing, every concurrent listing of the same prefix, and every
+follow-up listing within the reuse window serves from the SAME stream:
+a 50k-object bucket walks once, not once per page.
+
+Invalidation is generation-based: any namespace mutation in the bucket
+bumps its generation, orphaning walks started before it (correctness
+first — a cached stream can never serve names from before a change).
+In distributed mode the `on_bump` hook broadcasts the bump to peer
+nodes (grid/peers KIND_LISTING) with leading-edge coalescing, so a
+peer's next listing after a remote write re-walks immediately instead
+of waiting out a TTL. Persisted blocks additionally let a RESTARTED
+process warm its first listing from the previous run's walk when the
+bucket has been quiet (age-bounded — a crash loses only cache, never
+correctness).
 """
 
 from __future__ import annotations
 
+import bisect
 import threading
-from collections import OrderedDict
+import time
+from typing import Callable, Optional
+
+# Entries per persisted block.
+_BLOCK = 4096
+# A completed walk is reusable this long after its last touch; an
+# ACTIVE walk is always reusable (generation still governs validity).
+_IDLE_TTL = 30.0
+# Persisted-walk warm-start window for a fresh process: the same 2 s
+# cross-restart staleness contract the bucket-metadata cache uses.
+_PERSIST_TTL = 2.0
+# Per-bucket leading-edge coalescing window for peer bump broadcasts.
+_BUMP_COALESCE = 0.1
+# Cap on in-memory entries per walk (~100 MB worst case); beyond it the
+# walk marks itself truncated and later listings fall back to fresh
+# walks — bounded memory beats completeness here.
+_MAX_ENTRIES = 500_000
+
+META_DIR = "listcache"         # under SYS_VOL on the first drive
+SYS_VOL_ = ".mtpu.sys"
+
+
+class WalkStream:
+    """One background merged+resolved walk of (bucket, prefix)."""
+
+    def __init__(self, bucket: str, prefix: str, gen: int,
+                 start: str = ""):
+        self.bucket = bucket
+        self.prefix = prefix
+        # Walks normally start at the prefix; a continuation PAST a
+        # truncated stream's cap starts at that listing's marker so
+        # pagination always progresses.
+        self.start_after = start
+        self.gen = gen
+        self.keys: list[str] = []          # sorted walked keys
+        self.maps: list[list] = []         # per-key resolved version maps
+        self.done = False
+        self.error: Optional[Exception] = None
+        self.truncated = False             # hit _MAX_ENTRIES
+        self.last_touch = time.monotonic()
+        self.cond = threading.Condition()
+        self._cancel = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- production (walk thread) --------------------------------------
+
+    def start(self, es) -> None:
+        self._thread = threading.Thread(
+            target=self._run, args=(es,), daemon=True,
+            name=f"metacache-walk-{self.bucket}")
+        self._thread.start()
+
+    def _run(self, es) -> None:
+        try:
+            for path, maps in es._walk_resolved(
+                    self.bucket, self.prefix, self.start_after):
+                if self._cancel.is_set():
+                    # Orphaned by a bump/eviction: stop burning drive
+                    # I/O and memory on a stream nobody can read.
+                    self.truncated = True
+                    break
+                with self.cond:
+                    self.keys.append(path)
+                    self.maps.append(maps)
+                    self.cond.notify_all()
+                    if len(self.keys) >= _MAX_ENTRIES:
+                        self.truncated = True
+                        break
+            if not self.truncated:
+                self._persist(es)
+        except Exception as e:  # noqa: BLE001 - reported to waiters
+            self.error = e
+        finally:
+            with self.cond:
+                self.done = True
+                self.cond.notify_all()
+
+    def _persist(self, es) -> None:
+        """Write the completed stream to the first drive in blocks so a
+        restarted process can warm-start (best-effort)."""
+        import json
+
+        import msgpack
+        if not es.disks:
+            return
+        d = es.disks[0]
+        base = f"{META_DIR}/{_safe(self.bucket)}/{_safe(self.prefix)}"
+        try:
+            for i in range(0, max(len(self.keys), 1), _BLOCK):
+                blob = msgpack.packb(
+                    list(zip(self.keys[i:i + _BLOCK],
+                             self.maps[i:i + _BLOCK])))
+                d.write_all(SYS_VOL_, f"{base}/blk-{i // _BLOCK:06d}",
+                            blob)
+            d.write_all(SYS_VOL_, f"{base}/head", json.dumps({
+                "created_ns": time.time_ns(),
+                "blocks": (len(self.keys) + _BLOCK - 1) // _BLOCK,
+                "count": len(self.keys)}).encode())
+        except Exception:  # noqa: BLE001 - cache persistence is optional
+            pass
+
+    @classmethod
+    def load_persisted(cls, es, bucket: str, prefix: str,
+                       gen: int) -> Optional["WalkStream"]:
+        """A previous process's completed walk, if fresh enough."""
+        import json
+
+        import msgpack
+        if not es.disks:
+            return None
+        d = es.disks[0]
+        base = f"{META_DIR}/{_safe(bucket)}/{_safe(prefix)}"
+        try:
+            head = json.loads(d.read_all(SYS_VOL_, f"{base}/head"))
+            if time.time_ns() - head["created_ns"] > _PERSIST_TTL * 1e9:
+                return None
+            w = cls(bucket, prefix, gen)
+            for i in range(head["blocks"]):
+                for path, maps in msgpack.unpackb(
+                        d.read_all(SYS_VOL_, f"{base}/blk-{i:06d}")):
+                    w.keys.append(path)
+                    w.maps.append(maps)
+            if len(w.keys) != head["count"]:
+                return None
+            w.done = True
+            return w
+        except Exception:  # noqa: BLE001 - absent / stale / corrupt
+            return None
+
+    def cancel(self) -> None:
+        self._cancel.set()
+        with self.cond:
+            self.cond.notify_all()
+
+    # -- consumption (listing threads) ---------------------------------
+
+    def wait_past(self, key: str, need: int, timeout: float = 60.0):
+        """Block until the walk has produced `need` entries strictly
+        after `key` (or finished); returns (count, done) — a stable
+        VIEW bound: keys/maps are append-only, so indices below count
+        never change and readers need no copy (a full-list snapshot
+        per page would make pagination of a big walk quadratic)."""
+        deadline = time.monotonic() + timeout
+        with self.cond:
+            while True:
+                idx = bisect.bisect_right(self.keys, key)
+                if self.done or len(self.keys) - idx >= need:
+                    self.last_touch = time.monotonic()
+                    return (len(self.keys), self.done)
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return (len(self.keys), self.done)
+                self.cond.wait(timeout=min(left, 5))
+
+
+def _safe(s: str) -> str:
+    import hashlib
+    return hashlib.sha256(s.encode()).hexdigest()[:24]
 
 
 class MetaCache:
-    """Per-erasure-set listing page cache.
+    """Per-erasure-set walk-stream registry + bucket generations."""
 
-    Generation bumps catch every mutation made through THIS process's
-    set object; in distributed mode a peer node writes shard files over
-    the storage RPC without touching this layer, so a short TTL bounds
-    cross-node staleness (the same 2 s contract the bucket-metadata and
-    IAM caches use)."""
-
-    MAX_PAGES = 256
-    TTL = 2.0
+    MAX_WALKS = 8
 
     def __init__(self):
         self._mu = threading.Lock()
-        self._gen: dict[str, int] = {}           # bucket -> generation
-        self._pages: OrderedDict = OrderedDict()  # key -> (gen, ts, page)
+        self._gen: dict[str, int] = {}            # bucket -> generation
+        self._walks: dict[tuple, WalkStream] = {}  # (bucket,prefix) -> walk
         self.hits = 0
         self.misses = 0
+        # Distributed boot installs a broadcaster(bucket) here; bumps
+        # fan out to peers with leading-edge coalescing.
+        self.on_bump: Optional[Callable] = None
+        self._last_broadcast: dict[str, float] = {}
+        self._pending_broadcast: set[str] = set()
 
     def generation(self, bucket: str) -> int:
         with self._mu:
             return self._gen.get(bucket, 0)
 
-    def bump(self, bucket: str) -> None:
-        """Any namespace mutation in the bucket invalidates every
-        cached page for it (lazily, via the generation stamp)."""
+    def bump(self, bucket: str, broadcast: bool = True) -> None:
+        """Any namespace mutation in the bucket orphans its walks."""
+        defer = 0.0
         with self._mu:
             self._gen[bucket] = self._gen.get(bucket, 0) + 1
-
-    def get(self, bucket: str, key: tuple):
-        import time
-        with self._mu:
-            hit = self._pages.get(key)
-            if hit is None or hit[0] != self._gen.get(bucket, 0) or \
-                    time.monotonic() - hit[1] > self.TTL:
-                self.misses += 1
-                return None
-            self._pages.move_to_end(key)
-            self.hits += 1
-            return hit[2]
-
-    def put(self, bucket: str, key: tuple, page,
-            gen: int = -1) -> None:
-        """`gen`: the generation read BEFORE the walk began. A write
-        concurrent with the walk bumps past it, so the page stores with
-        the stale stamp and the next get() misses — stamping the
-        CURRENT generation would mark a possibly-incomplete page
-        fresh."""
-        import time
-        with self._mu:
-            if gen < 0:
-                gen = self._gen.get(bucket, 0)
-            self._pages[key] = (gen, time.monotonic(), page)
-            self._pages.move_to_end(key)
-            while len(self._pages) > self.MAX_PAGES:
-                self._pages.popitem(last=False)
+            for k in [k for k in self._walks if k[0] == bucket]:
+                w = self._walks.pop(k, None)
+                if w is not None:
+                    w.cancel()
+            cb = self.on_bump
+            now = time.monotonic()
+            if cb is not None and broadcast:
+                last = self._last_broadcast.get(bucket, 0.0)
+                if now - last < _BUMP_COALESCE:
+                    # Coalesce the burst, but GUARANTEE a trailing
+                    # broadcast — dropping it would leave peers stale
+                    # after the burst's last write until their next
+                    # fresh walk.
+                    if bucket in self._pending_broadcast:
+                        cb = None
+                    else:
+                        self._pending_broadcast.add(bucket)
+                        defer = _BUMP_COALESCE - (now - last)
+                else:
+                    self._last_broadcast[bucket] = now
+        if cb is None or not broadcast:
+            return
+        if defer > 0:
+            def fire():
+                with self._mu:
+                    self._pending_broadcast.discard(bucket)
+                    self._last_broadcast[bucket] = time.monotonic()
+                try:
+                    cb(bucket)
+                except Exception:  # noqa: BLE001 - best-effort
+                    pass
+            t = threading.Timer(defer, fire)
+            t.daemon = True
+            t.start()
+            return
+        try:
+            cb(bucket)
+        except Exception:  # noqa: BLE001 - peer fan-out best-effort
+            pass
 
     def drop_bucket(self, bucket: str) -> None:
-        """Bucket deletion: the generation map must not pin memory for
-        names that no longer exist."""
         with self._mu:
             self._gen.pop(bucket, None)
-            self._pages = OrderedDict(
-                (k, v) for k, v in self._pages.items() if k[0] != bucket)
+            self._last_broadcast.pop(bucket, None)
+            for k in [k for k in self._walks if k[0] == bucket]:
+                w = self._walks.pop(k, None)
+                if w is not None:
+                    w.cancel()
+
+    def walk_for(self, es, bucket: str, prefix: str,
+                 start: str = "") -> WalkStream:
+        """Find-or-start the shared walk of (bucket, prefix) at the
+        current generation; concurrent and follow-up listings share it
+        (reference: cmd/metacache-set.go lookup before starting a new
+        listing)."""
+        with self._mu:
+            gen = self._gen.get(bucket, 0)
+            key = (bucket, prefix, start)
+            w = self._walks.get(key)
+            now = time.monotonic()
+            cancelled = w is not None and w._cancel.is_set()
+            if w is not None and w.gen == gen and w.error is None and \
+                    not cancelled and \
+                    (not w.done or now - w.last_touch < _IDLE_TTL):
+                # Truncated-but-complete walks are still served: pages
+                # below the cap come from them, and the listing layer
+                # requests a start-floored continuation walk for pages
+                # past it (a blanket rejection would livelock huge
+                # buckets re-walking into the same cap forever).
+                self.hits += 1
+                return w
+            self.misses += 1
+            w = None
+            if gen == 0 and not start:
+                # Quiet bucket, fresh process: a recent persisted walk
+                # warm-starts the first listing.
+                w = WalkStream.load_persisted(es, bucket, prefix, gen)
+            if w is None:
+                w = WalkStream(bucket, prefix, gen, start=start)
+                w.start(es)
+            self._walks[key] = w
+            while len(self._walks) > self.MAX_WALKS:
+                oldest = min(self._walks,
+                             key=lambda k: self._walks[k].last_touch)
+                evicted = self._walks.pop(oldest)
+                if evicted is not None:
+                    evicted.cancel()
+            return w

@@ -1,0 +1,107 @@
+"""Admin profiling: start/stop CPU profiles, bundle results.
+
+The analogue of the reference's profiling handlers
+(cmd/admin-handlers.go:1021 StartProfilingHandler /
+DownloadProfilingDataHandler): an admin starts a profile, load runs,
+and the download returns a zip bundle of per-node profile data. The
+reference captures Go pprof profiles; the runtime here is Python, so
+the capture is cProfile — the zip carries both the raw marshaled stats
+(loadable with pstats.Stats) and a rendered text summary per node.
+
+In distributed mode the start/stop fan out over the grid
+(PROFILE_HANDLER) so the bundle covers every peer, the way the
+reference's NotificationSys collects remote profiles.
+"""
+
+from __future__ import annotations
+
+import cProfile
+import io
+import marshal
+import pstats
+import threading
+import time
+import zipfile
+
+PROFILE_HANDLER = "peer.profile"
+
+
+class ProfileError(Exception):
+    pass
+
+
+class Profiler:
+    """One node's profile capture (CPU via cProfile)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._prof: cProfile.Profile | None = None
+        self._started_ns = 0
+
+    def start(self) -> None:
+        with self._mu:
+            if self._prof is not None:
+                raise ProfileError("a profile is already running")
+            self._prof = cProfile.Profile()
+            self._started_ns = time.time_ns()
+            self._prof.enable()
+
+    def stop(self) -> dict:
+        """Stop and return {"stats": marshaled pstats bytes,
+        "text": rendered summary, "duration_s": float}."""
+        with self._mu:
+            if self._prof is None:
+                raise ProfileError("no profile is running")
+            prof, self._prof = self._prof, None
+        prof.disable()
+        stats = pstats.Stats(prof)
+        out = io.StringIO()
+        stats.stream = out
+        stats.sort_stats("cumulative").print_stats(60)
+        return {
+            "stats": marshal.dumps(stats.stats),
+            "text": out.getvalue(),
+            "duration_s": (time.time_ns() - self._started_ns) / 1e9,
+        }
+
+    @property
+    def running(self) -> bool:
+        with self._mu:
+            return self._prof is not None
+
+
+def bundle(per_node: dict[str, dict]) -> bytes:
+    """zip bytes: <node>/profile.pstats + <node>/profile.txt per node
+    (the shape of the reference's profiling zip download)."""
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for node, rec in per_node.items():
+            z.writestr(f"{node}/profile.pstats", rec.get("stats", b""))
+            z.writestr(f"{node}/profile.txt", rec.get("text", ""))
+    return buf.getvalue()
+
+
+def make_profile_handler(profiler: Profiler):
+    """Grid handler: peers start/stop their local profiler on request
+    (the receiving half of the cluster-wide fan-out)."""
+
+    def handler(payload):
+        action = (payload or {}).get("action", "")
+        if action == "start":
+            try:
+                profiler.start()
+            except ProfileError:
+                pass                      # already running: converged
+            return {"ok": True}
+        if action == "stop":
+            try:
+                rec = profiler.stop()
+            except ProfileError:
+                return {"ok": False}
+            import base64
+            return {"ok": True, "text": rec["text"],
+                    "duration_s": rec["duration_s"],
+                    "stats_b64": base64.b64encode(rec["stats"]).decode()}
+        return {"ok": False}
+
+    return handler

@@ -143,6 +143,11 @@ class S3Server:
         # OpenID validator for AssumeRoleWithWebIdentity; built lazily
         # from the config subsystem, reset on config change.
         self.oidc = None
+        # Admin profiling (s3/profiling.py); peer grid clients are set
+        # by the distributed boot so bundles cover every node.
+        from minio_tpu.s3.profiling import Profiler
+        self.profiler = Profiler()
+        self.profile_peers = []            # [(name, grid client)]
         # Batch-job manager (object/batch.BatchJobs), ditto.
         self.batch = None
         # Site replicator (replication/site.SiteReplicator); None until
@@ -2840,6 +2845,68 @@ def _make_handler(server: S3Server):
                 if fn:
                     fn()
                 return ok()
+
+            # KMS key management (reference: cmd/kms-handlers.go
+            # KMSCreateKey / KMSListKeys / KMSKeyStatus).
+            if op in ("kms-key-create", "kms-key-list", "kms-key-status"):
+                from minio_tpu.crypto.kms import KeyStore, KMSError
+                try:
+                    ks = getattr(server, "_kms_keystore", None)
+                    if ks is None:
+                        disks = [d for s in self._layer_sets()
+                                 for d in s.disks]
+                        ks = server._kms_keystore = KeyStore(
+                            server.kms, disks)
+                    ks.reload()
+                    if op == "kms-key-create" and method == "POST":
+                        ks.create(q1.get("key-id", ""))
+                        return ok()
+                    if op == "kms-key-list" and method == "GET":
+                        return ok(ks.list())
+                    if op == "kms-key-status" and method == "GET":
+                        return ok(ks.status(q1.get("key-id", "")))
+                except KMSError as e:
+                    raise S3Error("InvalidRequest", str(e)) from None
+                raise S3Error("MethodNotAllowed")
+
+            # Profiling (reference: cmd/admin-handlers.go:1021
+            # StartProfilingHandler / DownloadProfilingDataHandler).
+            if op == "start-profiling" and method == "POST":
+                from minio_tpu.s3.profiling import ProfileError
+                try:
+                    server.profiler.start()
+                except ProfileError as e:
+                    raise S3Error("InvalidRequest", str(e)) from None
+                for _name, client in server.profile_peers:
+                    try:
+                        client.call("peer.profile", {"action": "start"},
+                                    timeout=5)
+                    except Exception:  # noqa: BLE001 - peer down
+                        pass
+                return ok({"started": True})
+            if op == "download-profiling" and method == "GET":
+                import base64 as _b64
+
+                from minio_tpu.s3 import profiling as prof_mod
+                from minio_tpu.s3.profiling import ProfileError
+                per_node = {}
+                try:
+                    per_node["local"] = server.profiler.stop()
+                except ProfileError as e:
+                    raise S3Error("InvalidRequest", str(e)) from None
+                for name, client in server.profile_peers:
+                    try:
+                        rec = client.call("peer.profile",
+                                          {"action": "stop"}, timeout=10)
+                        if rec.get("ok"):
+                            per_node[name] = {
+                                "stats": _b64.b64decode(
+                                    rec.get("stats_b64", "")),
+                                "text": rec.get("text", "")}
+                    except Exception:  # noqa: BLE001 - peer down
+                        pass
+                return self._send(200, prof_mod.bundle(per_node),
+                                  content_type="application/zip")
 
             # Bucket quotas (reference: cmd/admin-bucket-handlers.go
             # SetBucketQuotaConfigHandler / GetBucketQuotaConfigHandler,

@@ -231,12 +231,15 @@ def run_select(body, request_xml: bytes) -> bytes:
 
         field_order = [alias for _, alias in query.columns] \
             if query.columns else None
+        if query.aggregates:
+            field_order = [a.alias for a in query.aggregates]
         out = bytearray()
         pending: list = []
         pending_bytes = 0
         returned = 0
-        count = 0
         emitted = 0
+        # Aggregate accumulators: [count, sum, min, max] per item.
+        acc = [[0, 0.0, None, None] for _ in (query.aggregates or ())]
         # Flush Records frames at ~128 KiB like the reference's writer.
         step = 128 * 1024
 
@@ -253,9 +256,9 @@ def run_select(body, request_xml: bytes) -> bytes:
             pending_bytes = 0
 
         for row in rows_iter:
-            # LIMIT bounds OUTPUT records: an aggregate emits one
-            # record, so COUNT(*) scans everything regardless of LIMIT.
-            if not query.count_star and query.limit is not None \
+            # LIMIT bounds OUTPUT records: aggregates emit one record,
+            # so they scan everything regardless of LIMIT.
+            if not query.aggregates and query.limit is not None \
                     and emitted >= query.limit:
                 break
             if query.where is not None:
@@ -267,16 +270,53 @@ def run_select(body, request_xml: bytes) -> bytes:
                     keep = False
                 if not keep:
                     continue
-            if query.count_star:
-                count += 1
+            if query.aggregates:
+                for a, st in zip(query.aggregates, acc):
+                    if a.operand is None:          # COUNT(*)
+                        st[0] += 1
+                        continue
+                    try:
+                        v = a.operand.eval(row)
+                    except Exception:  # noqa: BLE001 - bad cell
+                        v = None
+                    if v is None or v == "":
+                        continue     # NULL / empty cells don't count
+                    st[0] += 1
+                    from minio_tpu.s3select.sql import _as_number
+                    n = _as_number(v)
+                    if n is not None:
+                        st[1] += n
+                        v = n
+                    # Mixed numeric/string cells in one column: compare
+                    # everything as strings from then on (deterministic,
+                    # never a TypeError mid-scan).
+                    if st[2] is not None and \
+                            isinstance(v, str) != isinstance(st[2], str):
+                        v = str(v)
+                        st[2], st[3] = str(st[2]), str(st[3])
+                    st[2] = v if st[2] is None else min(st[2], v)
+                    st[3] = v if st[3] is None else max(st[3], v)
             else:
                 pending.append(_project(query, row))
                 emitted += 1
                 pending_bytes += sum(len(str(v)) for v in row.values())
                 if pending_bytes >= step:
                     flush()
-        if query.count_star:
-            pending = [{"_1": count}]
+        if query.aggregates:
+            rec = {}
+            for a, st in zip(query.aggregates, acc):
+                cnt, total, mn, mx = st
+                if a.func == "count":
+                    rec[a.alias] = cnt
+                elif a.func == "sum":
+                    rec[a.alias] = total if cnt else None
+                elif a.func == "avg":
+                    rec[a.alias] = (total / cnt) if cnt else None
+                elif a.func == "min":
+                    rec[a.alias] = mn
+                elif a.func == "max":
+                    rec[a.alias] = mx
+            pending = [rec]
         flush()
         out.extend(eventstream.stats_message(counter.total, counter.total,
                                              returned))

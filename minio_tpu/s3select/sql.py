@@ -40,7 +40,10 @@ _TOKEN_RE = re.compile(r"""
     )""", re.VERBOSE)
 
 _KEYWORDS = {"select", "from", "where", "limit", "and", "or", "not",
-             "as", "is", "null", "count"}
+             "as", "is", "null", "count", "sum", "avg", "min", "max",
+             "cast", "like", "escape"}
+
+_AGG_FUNCS = ("count", "sum", "avg", "min", "max")
 
 
 def _tokenize(text: str) -> list[tuple[str, str]]:
@@ -141,11 +144,92 @@ class Not:
 
 
 @dataclasses.dataclass
+class Cast:
+    """CAST(expr AS type) — the reference's sql.FuncCast family
+    (internal/s3select/sql/parser.go:23 territory)."""
+    expr: object
+    type: str
+
+    def eval(self, row: dict):
+        v = self.expr.eval(row)
+        if v is None:
+            return None
+        t = self.type
+        try:
+            if t in ("int", "integer"):
+                return int(float(v))
+            if t in ("float", "double", "decimal", "numeric"):
+                return float(v)
+            if t in ("string", "varchar", "char"):
+                return str(v)
+            if t in ("bool", "boolean"):
+                if isinstance(v, bool):
+                    return v
+                s = str(v).strip().lower()
+                if s in ("true", "1"):
+                    return True
+                if s in ("false", "0"):
+                    return False
+                raise ValueError(s)
+        except (TypeError, ValueError):
+            raise SQLError(
+                f"cannot cast {v!r} to {t}") from None
+        raise SQLError(f"unsupported CAST type {t!r}")
+
+
+@dataclasses.dataclass
+class Like:
+    """operand [NOT] LIKE pattern [ESCAPE c] — SQL wildcard match
+    (% = any run, _ = any one char)."""
+    operand: object
+    pattern: object
+    escape: str = ""
+    negate: bool = False
+
+    def _regex(self, pat: str):
+        esc = self.escape
+        out = []
+        i = 0
+        while i < len(pat):
+            c = pat[i]
+            if esc and c == esc and i + 1 < len(pat):
+                out.append(re.escape(pat[i + 1]))
+                i += 2
+                continue
+            if c == "%":
+                out.append(".*")
+            elif c == "_":
+                out.append(".")
+            else:
+                out.append(re.escape(c))
+            i += 1
+        return re.compile("".join(out) + r"\Z", re.DOTALL)
+
+    def eval(self, row: dict):
+        v = self.operand.eval(row)
+        p = self.pattern.eval(row)
+        if v is None or p is None:
+            return None
+        hit = self._regex(str(p)).match(str(v)) is not None
+        return (not hit) if self.negate else hit
+
+
+@dataclasses.dataclass
+class Agg:
+    """One aggregate projection item (COUNT/SUM/AVG/MIN/MAX); the
+    engine accumulates across rows and emits one result row."""
+    func: str
+    operand: Optional[object]      # None = '*' (COUNT only)
+    alias: str
+
+
+@dataclasses.dataclass
 class Query:
-    columns: Optional[list]        # [(Col, alias)] or None for '*'
-    count_star: bool
+    columns: Optional[list]        # [(expr, alias)] or None for '*'
+    aggregates: Optional[list]     # [Agg] — exclusive with columns
     where: Optional[object]
     limit: Optional[int]
+
 
 
 def _as_number(v) -> Optional[float]:
@@ -182,7 +266,7 @@ class _Parser:
 
     def parse(self) -> Query:
         self.expect("kw", "select")
-        columns, count_star = self._projection()
+        columns, aggregates = self._projection()
         self.expect("kw", "from")
         self._from()
         where = None
@@ -211,33 +295,81 @@ class _Parser:
             else:
                 raise SQLError("unsupported column reference "
                                f"{'.'.join(parts)!r}")
-        return Query(columns=columns, count_star=count_star, where=where,
-                     limit=limit)
+        return Query(columns=columns, aggregates=aggregates,
+                     where=where, limit=limit)
 
     def _projection(self):
+        """Returns (columns, aggregates) — exactly one is non-None
+        unless '*' (both None). Mixing aggregates with plain columns is
+        rejected (no GROUP BY in the S3 Select subset, matching the
+        reference)."""
         if self.peek() == ("punct", "*"):
             self.next()
-            return None, False
-        if self.peek() == ("kw", "count"):
-            self.next()
-            self.expect("punct", "(")
-            self.expect("punct", "*")
-            self.expect("punct", ")")
-            return None, True
+            return None, None
         cols = []
+        aggs = []
+        idx = 0
         while True:
-            col = self._column()
-            alias = col.name
-            if self.peek() == ("kw", "as"):
-                self.next()
-                alias = self.expect("ident")[1]
-            elif self.peek()[0] == "ident":
-                alias = self.next()[1]
-            cols.append((col, alias))
+            idx += 1
+            t = self.peek()
+            if t[0] == "kw" and t[1] in _AGG_FUNCS:
+                func = self.next()[1]
+                self.expect("punct", "(")
+                if self.peek() == ("punct", "*"):
+                    if func != "count":
+                        raise SQLError(f"{func.upper()}(*) is not valid")
+                    self.next()
+                    operand = None
+                else:
+                    operand = self._value_expr()
+                self.expect("punct", ")")
+                alias = f"_{idx}"
+                if self.peek() == ("kw", "as"):
+                    self.next()
+                    alias = self.expect("ident")[1]
+                aggs.append(Agg(func, operand, alias))
+            else:
+                expr = self._value_expr()
+                alias = expr.name if isinstance(expr, Col) else f"_{idx}"
+                if self.peek() == ("kw", "as"):
+                    self.next()
+                    alias = self.expect("ident")[1]
+                elif self.peek()[0] == "ident":
+                    alias = self.next()[1]
+                cols.append((expr, alias))
             if self.peek() == ("punct", ","):
                 self.next()
                 continue
-            return cols, False
+            break
+        if aggs and cols:
+            raise SQLError("cannot mix aggregates with plain columns "
+                           "(no GROUP BY)")
+        if aggs:
+            return None, aggs
+        return cols, None
+
+    def _value_expr(self):
+        """A projection/operand value: column, literal, or CAST."""
+        t = self.peek()
+        if t == ("kw", "cast"):
+            self.next()
+            self.expect("punct", "(")
+            inner = self._value_expr()
+            self.expect("kw", "as")
+            ty = self.next()
+            if ty[0] not in ("ident", "kw"):
+                raise SQLError(f"expected type name, got {ty[1]!r}")
+            self.expect("punct", ")")
+            return Cast(inner, ty[1].lower())
+        if t[0] == "string":
+            self.next()
+            return Lit(t[1])
+        if t[0] == "number":
+            self.next()
+            return Lit(float(t[1]))
+        if t[0] == "ident":
+            return self._column()
+        raise SQLError(f"unexpected {t[1]!r}")
 
     def _from(self):
         # FROM S3Object[.path][ alias] — the alias becomes a valid
@@ -299,11 +431,29 @@ class _Parser:
                 negate = True
             self.expect("kw", "null")
             return IsNull(left, negate)
+        if t == ("kw", "not") and self.pos + 1 < len(self.toks) and \
+                self.toks[self.pos + 1] == ("kw", "like"):
+            self.next()
+            return self._like(left, negate=True)
+        if t == ("kw", "like"):
+            return self._like(left, negate=False)
         if t[0] == "op":
             op = self.next()[1]
             right = self._operand()
             return Cmp(op, left, right)
         return left
+
+    def _like(self, left, negate: bool):
+        self.expect("kw", "like")
+        pattern = self._operand()
+        escape = ""
+        if self.peek() == ("kw", "escape"):
+            self.next()
+            e = self.expect("string")[1]
+            if len(e) != 1:
+                raise SQLError("ESCAPE must be a single character")
+            escape = e
+        return Like(left, pattern, escape=escape, negate=negate)
 
     def _operand(self):
         t = self.peek()
@@ -312,15 +462,7 @@ class _Parser:
             e = self._expr()
             self.expect("punct", ")")
             return e
-        if t[0] == "string":
-            self.next()
-            return Lit(t[1])
-        if t[0] == "number":
-            self.next()
-            return Lit(float(t[1]))
-        if t[0] == "ident":
-            return self._column()
-        raise SQLError(f"unexpected {t[1]!r}")
+        return self._value_expr()
 
 
 def parse_select(sql: str) -> Query:

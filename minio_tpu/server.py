@@ -367,6 +367,23 @@ def main(argv=None) -> int:
             lambda bucket: peer_notifier.broadcast("bucket-meta",
                                                    bucket=bucket)
         layer.on_decom_change = lambda: peer_notifier.broadcast("decom")
+        # Listing walk-stream invalidation: a write on this node drops
+        # peers' metacache streams for the bucket (leading-edge
+        # coalesced inside MetaCache.bump, trailing-guaranteed).
+        for p in pools:
+            for s in p.sets:
+                s.metacache.on_bump = (
+                    lambda bucket: peer_notifier.broadcast("listing",
+                                                           bucket=bucket))
+        # Cluster-wide profiling fan-out (reference: profiling rides
+        # NotificationSys too).
+        from minio_tpu.s3.profiling import (PROFILE_HANDLER,
+                                            make_profile_handler)
+        grid_srv.register(PROFILE_HANDLER,
+                          make_profile_handler(srv.profiler))
+        srv.profile_peers = [
+            (f"{h}:{p}", client_for(h, p + GRID_PORT_OFFSET))
+            for h, p in remote_nodes]
     if args.audit_webhook:
         from minio_tpu.s3.trace import AuditLogger
         srv.audit = AuditLogger(args.audit_webhook)

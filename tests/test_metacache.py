@@ -1,8 +1,10 @@
-"""Metacache: listing pages served from cache on quiet buckets, every
-write invalidating instantly (reference: cmd/metacache.go, scoped to a
-generation-stamped page cache)."""
+"""Metacache: shared listing walk streams — one background walk per
+(bucket, prefix) serves every page and every concurrent listing, with
+generation invalidation on writes (reference: cmd/metacache.go,
+cmd/metacache-set.go:700)."""
 
 import os
+import threading
 
 import pytest
 
@@ -28,9 +30,10 @@ def test_repeat_listing_hits_cache(es):
     assert es.metacache.hits == 1
     assert [o.name for o in again.objects] == \
         [o.name for o in first.objects]
-    # Different parameters are different pages.
+    # DIFFERENT page parameters of the same prefix share the walk too
+    # (the whole point of walk streams vs page caching).
     es.list_objects("mcb", prefix="k", max_keys=2)
-    assert es.metacache.hits == 1
+    assert es.metacache.hits == 2
 
 
 def test_writes_invalidate_immediately(es):
@@ -60,3 +63,137 @@ def test_multipart_and_bucket_delete_invalidate(es, tmp_path):
     es.delete_bucket("mcb")
     with pytest.raises(Exception):
         es.list_objects("mcb")
+
+
+def _counting(disks):
+    """Wrap drives so walk_dir invocations are counted."""
+    counter = {"walks": 0}
+
+    class W:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def walk_dir(self, *a, **k):
+            counter["walks"] += 1
+            return self._inner.walk_dir(*a, **k)
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    return [W(d) for d in disks], counter
+
+
+def test_large_bucket_pages_without_rewalking(tmp_path):
+    """A multi-page listing of a big bucket drives ONE walk of the
+    drives, not one per page (reference: metacache streams shared
+    across pages, cmd/metacache-set.go:700)."""
+    disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    es = ErasureSet(disks)
+    es.make_bucket("big")
+    for i in range(0, 5000, 100):
+        # Seed sparse then fill with cheap empty objects for speed.
+        pass
+    for i in range(2000):
+        es.put_object("big", f"o{i:05d}", b"")
+    wrapped, counter = _counting(es.disks)
+    es.disks[:] = wrapped
+    names = []
+    marker = ""
+    pages = 0
+    while True:
+        page = es.list_objects("big", marker=marker, max_keys=100)
+        names.extend(o.name for o in page.objects)
+        pages += 1
+        if not page.is_truncated:
+            break
+        marker = page.next_marker
+    assert pages >= 20
+    assert names == [f"o{i:05d}" for i in range(2000)]
+    # One walk = one walk_dir per walked drive (majority of 4 = 3).
+    assert counter["walks"] <= 3, counter
+
+
+def test_concurrent_listings_share_one_walk(tmp_path):
+    disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    es = ErasureSet(disks)
+    es.make_bucket("cc")
+    for i in range(500):
+        es.put_object("cc", f"k{i:04d}", b"")
+    wrapped, counter = _counting(es.disks)
+    es.disks[:] = wrapped
+    results = [None] * 6
+    def worker(i):
+        results[i] = [o.name for o in
+                      es.list_objects("cc", max_keys=1000).objects]
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(20)
+    want = [f"k{i:04d}" for i in range(500)]
+    assert all(r == want for r in results)
+    assert counter["walks"] <= 3, counter
+
+
+def test_peer_bump_invalidates_other_nodes_walk(tmp_path):
+    """Two 'nodes' over the same drives: after node A writes, node B's
+    very next listing reflects it — A's metacache bump rides the peer
+    hook to B (no TTL window). The hook here is wired directly; in
+    production it is the grid KIND_LISTING broadcast."""
+    mk = lambda: ErasureSet(  # noqa: E731
+        [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)])
+    a, b = mk(), mk()
+    a.make_bucket("xn")
+    a.put_object("xn", "one", b"1")
+    # B warms a walk stream.
+    assert [o.name for o in b.list_objects("xn").objects] == ["one"]
+    # Wire A's bump broadcast to B (leading-edge coalesced).
+    a.metacache.on_bump = lambda bucket: b.metacache.bump(
+        bucket, broadcast=False)
+    a.put_object("xn", "two", b"2")
+    assert [o.name for o in b.list_objects("xn").objects] == \
+        ["one", "two"]
+    # A rapid follow-up mutation coalesces into a guaranteed TRAILING
+    # broadcast (<= the 100 ms window), so B converges promptly even
+    # mid-burst.
+    import time
+    a.delete_object("xn", "one", DeleteOptions())
+    deadline = time.monotonic() + 2
+    while time.monotonic() < deadline:
+        if [o.name for o in b.list_objects("xn").objects] == ["two"]:
+            break
+        time.sleep(0.02)
+    assert [o.name for o in b.list_objects("xn").objects] == ["two"]
+
+
+def test_persisted_walk_warm_starts_fresh_process(tmp_path):
+    """A restarted process's first listing of a quiet bucket loads the
+    previous run's persisted walk blocks instead of re-walking."""
+    disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    es = ErasureSet(disks)
+    es.make_bucket("pp")
+    for i in range(50):
+        es.put_object("pp", f"k{i:03d}", b"")
+    es.list_objects("pp")                       # walk + persist
+    # wait for the background persist
+    import time
+    for _ in range(100):
+        try:
+            disks[0].read_all(".mtpu.sys", "listcache/" +
+                              __import__("minio_tpu.object.metacache",
+                                         fromlist=["_safe"])
+                              ._safe("pp") + "/" +
+                              __import__("minio_tpu.object.metacache",
+                                         fromlist=["_safe"])._safe("") +
+                              "/head")
+            break
+        except Exception:
+            time.sleep(0.05)
+    # "Restart": a new set object over the same drives.
+    es2 = ErasureSet([LocalStorage(str(tmp_path / f"d{i}"))
+                      for i in range(4)])
+    wrapped, counter = _counting(es2.disks)
+    es2.disks[:] = wrapped
+    names = [o.name for o in es2.list_objects("pp", max_keys=1000).objects]
+    assert names == [f"k{i:03d}" for i in range(50)]
+    assert counter["walks"] == 0, counter        # served from blocks

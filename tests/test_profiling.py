@@ -1,0 +1,64 @@
+"""Admin profiling (reference: cmd/admin-handlers.go:1021): start a
+CPU profile, run load, download the per-node bundle."""
+
+import io
+import marshal
+import os
+import zipfile
+
+import pytest
+
+from minio_tpu.object.erasure_object import ErasureSet
+from minio_tpu.s3.profiling import Profiler, bundle, make_profile_handler
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.local import LocalStorage
+from tests.s3client import S3Client
+
+
+def test_profile_start_load_download(tmp_path):
+    disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    srv = S3Server(ErasureSet(disks), address="127.0.0.1:0")
+    srv.start()
+    try:
+        cli = S3Client(srv.address)
+        assert cli.request("PUT", "/profbkt")[0] == 200
+        st, _, b = cli.request("POST", "/minio/admin/v3/start-profiling")
+        assert st == 200, b
+        # Double start is refused.
+        assert cli.request("POST",
+                           "/minio/admin/v3/start-profiling")[0] == 400
+        for i in range(5):
+            cli.request("PUT", f"/profbkt/o{i}", body=os.urandom(20_000))
+        st, h, body = cli.request("GET",
+                                  "/minio/admin/v3/download-profiling")
+        assert st == 200
+        assert h.get("Content-Type") == "application/zip"
+        z = zipfile.ZipFile(io.BytesIO(body))
+        names = z.namelist()
+        assert "local/profile.txt" in names
+        assert "local/profile.pstats" in names
+        text = z.read("local/profile.txt").decode()
+        # The profile saw the PUT handler run.
+        assert "put_object" in text
+        stats = marshal.loads(z.read("local/profile.pstats"))
+        assert stats                        # loadable pstats table
+        # Download without a running profile is a clean 400.
+        assert cli.request("GET",
+                           "/minio/admin/v3/download-profiling")[0] == 400
+    finally:
+        srv.stop()
+
+
+def test_peer_profile_handler_roundtrip():
+    p = Profiler()
+    h = make_profile_handler(p)
+    assert h({"action": "start"})["ok"]
+    sum(i * i for i in range(50_000))      # some work to profile
+    rec = h({"action": "stop"})
+    assert rec["ok"] and rec["text"]
+    import base64
+    assert marshal.loads(base64.b64decode(rec["stats_b64"]))
+    assert not h({"action": "stop"})["ok"]  # nothing running now
+    blob = bundle({"n1": {"stats": b"x", "text": "t"}})
+    assert zipfile.ZipFile(io.BytesIO(blob)).namelist() == \
+        ["n1/profile.pstats", "n1/profile.txt"]

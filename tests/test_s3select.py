@@ -67,7 +67,8 @@ def test_parse_variants():
     assert [a for _, a in q.columns] == ["name", "pay"]
     assert q.limit == 5
     q = parse_select("SELECT COUNT(*) FROM S3Object WHERE salary >= 90")
-    assert q.count_star
+    assert q.aggregates and q.aggregates[0].func == "count" \
+        and q.aggregates[0].operand is None
     with pytest.raises(SQLError):
         parse_select("SELECT * FROM other_table")
     with pytest.raises(SQLError):
@@ -209,3 +210,60 @@ def test_select_parquet():
     resp = run_select(buf.getvalue(), req)
     rows = _records(resp).decode().strip().splitlines()
     assert rows == ["ada", "cara"]
+
+
+def _req(sql, in_fmt="csv", header="USE", out_fmt="json"):
+    serial = ('<CSV><FileHeaderInfo>%s</FileHeaderInfo></CSV>' % header
+              if in_fmt == "csv" else "<JSON><Type>LINES</Type></JSON>")
+    return (f'<SelectObjectContentRequest>'
+            f'<Expression>{sql}</Expression>'
+            f'<ExpressionType>SQL</ExpressionType>'
+            f'<InputSerialization>{serial}</InputSerialization>'
+            f'<OutputSerialization><JSON/></OutputSerialization>'
+            f'</SelectObjectContentRequest>').encode()
+
+
+CSV_NUM = b"name,cost,qty\nalpha,10,2\nbeta,4.5,8\nalpine,2,5\ngamma,,1\n"
+
+
+def test_select_aggregates():
+    import json as _json
+    from minio_tpu.s3select import run_select
+    resp = run_select(CSV_NUM, _req(
+        "SELECT SUM(cost) AS total, AVG(qty) AS avgq, MIN(cost) AS lo, "
+        "MAX(cost) AS hi, COUNT(cost) AS n FROM S3Object"))
+    rec = _json.loads(_records(resp))
+    assert rec["total"] == 16.5
+    assert rec["avgq"] == 4.0
+    assert rec["lo"] == 2 and rec["hi"] == 10
+    assert rec["n"] == 3          # the empty cost cell doesn't count
+
+
+def test_select_aggregate_with_where():
+    import json as _json
+    from minio_tpu.s3select import run_select
+    resp = run_select(CSV_NUM, _req(
+        "SELECT COUNT(*) FROM S3Object WHERE CAST(qty AS INT) >= 5"))
+    rec = _json.loads(_records(resp))
+    assert rec["_1"] == 2
+
+
+def test_select_like_and_cast_projection():
+    import json as _json
+    from minio_tpu.s3select import run_select
+    resp = run_select(CSV_NUM, _req(
+        "SELECT name, CAST(qty AS INT) AS q FROM S3Object "
+        "WHERE name LIKE 'al%'"))
+    rows = [_json.loads(ln) for ln in _records(resp).splitlines()]
+    assert rows == [{"name": "alpha", "q": 2}, {"name": "alpine", "q": 5}]
+    # NOT LIKE + single-char wildcard + ESCAPE
+    resp = run_select(CSV_NUM, _req(
+        "SELECT name FROM S3Object WHERE name NOT LIKE '_l%'"))
+    rows = [_json.loads(ln) for ln in _records(resp).splitlines()]
+    assert [r["name"] for r in rows] == ["beta", "gamma"]
+
+
+def test_select_mixing_agg_and_columns_rejected():
+    from minio_tpu.s3select import SelectError, run_select
+    with pytest.raises(SelectError):
+        run_select(CSV_NUM, _req("SELECT name, SUM(cost) FROM S3Object"))
