@@ -53,12 +53,23 @@ class KMS:
         key = os.urandom(32)
         return key, self.seal(key, context)
 
-    def seal(self, key: bytes, context: dict) -> str:
-        master = self._keys[self.default_key]
+    def seal(self, key: bytes, context: dict, kid: str = "") -> str:
+        """Seal under the default master key, or a NAMED key (batch
+        key rotation reseals existing objects under a new key)."""
+        kid = kid or self.default_key
+        if kid not in self._keys:
+            # Mirror unseal(): the key may have been created on another
+            # node since this process loaded — refresh once.
+            ks = getattr(self, "_keystore", None)
+            if ks is not None:
+                ks.reload()
+        if kid not in self._keys:
+            raise KMSError(f"no such key {kid!r}")
+        master = self._keys[kid]
         nonce = os.urandom(12)
         aad = json.dumps(context, sort_keys=True).encode()
         ct = AESGCM(master).encrypt(nonce, key, aad)
-        blob = {"v": 1, "kid": self.default_key,
+        blob = {"v": 1, "kid": kid,
                 "n": base64.b64encode(nonce).decode(),
                 "c": base64.b64encode(ct).decode()}
         return json.dumps(blob, sort_keys=True)

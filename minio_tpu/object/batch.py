@@ -17,6 +17,9 @@ Job document (JSON; the reference uses YAML — same fields):
      "filters": {"createdBefore": iso, "createdAfter": iso,
                  "tags": {"k": "v"}}}
     {"type": "expire", "source": {...}, "filters": {...}}
+    {"type": "keyrotate", "source": {...}, "filters": {...},
+     "encryption": {"keyId": "name"}}   # reseal SSE-S3 data keys
+                                        # (reference: cmd/batch-rotate.go)
 """
 
 from __future__ import annotations
@@ -50,7 +53,7 @@ def _parse_time(s: str) -> float:
 def validate_job(spec: dict) -> dict:
     """Normalize + validate a job document (raises BatchError)."""
     jtype = spec.get("type", "")
-    if jtype not in ("replicate", "expire"):
+    if jtype not in ("replicate", "expire", "keyrotate"):
         raise BatchError(f"unknown job type {jtype!r}")
     src = spec.get("source") or {}
     if not src.get("bucket"):
@@ -70,6 +73,11 @@ def validate_job(spec: dict) -> dict:
             # amplification (x/k -> x/x/k -> ...), never terminating.
             raise BatchError("target prefix lies inside the source "
                              "listing range (recursive copy)")
+    if jtype == "keyrotate" and not (spec.get("encryption")
+                                     or {}).get("keyId"):
+        # Without a target key the job would re-seal under the SAME
+        # key and report success — a silent non-rotation.
+        raise BatchError("keyrotate requires encryption.keyId")
     filters = spec.get("filters") or {}
     for k in ("createdBefore", "createdAfter"):
         if filters.get(k):
@@ -320,9 +328,48 @@ class BatchJobs:
         state["finished_ns"] = time.time_ns()
         self._save(state)
 
+    def _rotate_key(self, spec: dict, bucket: str, key: str) -> None:
+        """Re-seal one SSE-S3 object's data key (reference:
+        cmd/batch-rotate.go rotates the object encryption key in
+        place — object bytes never move)."""
+        from minio_tpu.crypto import sse as sse_mod
+        from minio_tpu.crypto.kms import KMS, KeyStore, KMSError
+        from minio_tpu.object.types import GetOptions
+        kms = getattr(self, "kms", None) or KMS.from_env()
+        if kms is None:
+            raise BatchError("keyrotate requires a configured KMS")
+        if getattr(kms, "_keystore", None) is None:
+            # Load the drive-persisted named keys (admin-created
+            # rotation targets) into this KMS instance.
+            try:
+                KeyStore(kms, self._disks())
+            except KMSError:
+                pass
+        kid = (spec.get("encryption") or {}).get("keyId", "")
+        ctx = {"bucket": bucket, "object": key}
+        # EVERY version re-seals, not just the latest — the point of
+        # rotation is retiring the old master, and an Enabled-era
+        # version left under it would become unreadable (or stay
+        # exposed) the day it goes.
+        for fi in self.layer.list_versions_all(bucket, key):
+            if fi.deleted:
+                continue
+            imeta = {k: v for k, v in (fi.metadata or {}).items()
+                     if k.startswith("x-internal-")}
+            if imeta.get(sse_mod.META_ALG) != sse_mod.ALG_SSE_S3:
+                continue           # plaintext / SSE-C versions skip
+            data_key = kms.unseal(imeta.get(sse_mod.META_KEY, ""), ctx)
+            new_sealed = kms.seal(data_key, ctx, kid=kid)
+            self.layer.update_version_metadata(
+                bucket, key, fi.version_id,
+                lambda m, s=new_sealed: m.__setitem__(
+                    sse_mod.META_KEY, s))
+
     def _process(self, spec: dict, bucket: str, key: str) -> None:
         from minio_tpu.object.types import (DeleteOptions, GetOptions,
                                             PutOptions)
+        if spec["type"] == "keyrotate":
+            return self._rotate_key(spec, bucket, key)
         if spec["type"] == "expire":
             versioned = bool(self.layer.get_bucket_meta(bucket)
                              .get("versioning"))

@@ -213,3 +213,42 @@ def probe_disks(object_layer) -> list:
         for d, di in zip(s.disks, results):
             out.append((si, d, di))
     return out
+
+
+def node_info(server) -> dict:
+    """One node's admin-info summary (drives, usage, heal state) —
+    served locally by the admin handler and remotely over the grid's
+    peer.info endpoint so cluster info covers every node (reference:
+    cmd/notification.go ServerInfo fan-out)."""
+    scanner = getattr(server.object_layer, "scanner", None)
+    sets = layer_sets(server.object_layer)
+    drives = []
+    for si, d, di in probe_disks(server.object_layer):
+        entry = {"set": si,
+                 "endpoint": getattr(d, "endpoint", "")
+                 or getattr(d, "root", "")}
+        if di is not None:
+            entry.update(state="ok", total=di.total,
+                         used=di.used, free=di.free)
+        else:
+            entry.update(state="offline")
+        drives.append(entry)
+    usage = {}
+    total_objects = 0
+    if scanner is not None:
+        u = scanner.usage
+        total_objects = u.objects
+        usage = {"objects": u.objects, "versions": u.versions,
+                 "total_size": u.total_size,
+                 "buckets": len(u.buckets),
+                 "last_update": u.last_update}
+    return {
+        "mode": "online",
+        "sets": len(sets),
+        "drives": drives,
+        "drives_online": sum(1 for d in drives if d["state"] == "ok"),
+        "drives_offline": sum(1 for d in drives if d["state"] != "ok"),
+        "objects": total_objects,
+        "usage": usage,
+        "heal": server.heal_status,
+    }

@@ -25,7 +25,7 @@ from minio_tpu.object.types import (DeleteOptions, GetOptions, InvalidArgument,
 from minio_tpu.s3 import sigv4
 from minio_tpu.s3.errors import S3Error, from_exception
 from minio_tpu.s3.metrics import Metrics, layer_sets as _layer_sets, \
-    probe_disks as _probe_disks
+    node_info, probe_disks as _probe_disks
 from minio_tpu.utils.streams import (HashingReader, HttpChunkedReader,
                                      LimitedReader, Payload)
 
@@ -1374,6 +1374,7 @@ def _make_handler(server: S3Server):
                 else:
                     sets = [ol]
                 server.batch = BatchJobs(ol, sets)
+                server.batch.kms = server.kms
             return server.batch
 
         def _tier_registry(self):
@@ -2513,40 +2514,35 @@ def _make_handler(server: S3Server):
 
         def _admin_info(self):
             import json as _json
-            total_objects = 0
-            scanner = getattr(server.object_layer, "scanner", None)
-            sets = _layer_sets(server.object_layer)
-            drives = []
-            for si, d, di in _probe_disks(server.object_layer):
-                entry = {"set": si,
-                         "endpoint": getattr(d, "endpoint", "")
-                         or getattr(d, "root", "")}
-                if di is not None:
-                    entry.update(state="ok", total=di.total,
-                                 used=di.used, free=di.free)
-                else:
-                    entry.update(state="offline")
-                drives.append(entry)
-            usage = {}
-            if scanner is not None:
-                u = scanner.usage
-                total_objects = u.objects
-                usage = {"objects": u.objects, "versions": u.versions,
-                         "total_size": u.total_size,
-                         "buckets": len(u.buckets),
-                         "last_update": u.last_update}
-            info = {
-                "mode": "online",
-                "sets": len(sets),
-                "drives": drives,
-                "drives_online": sum(1 for d in drives
-                                     if d["state"] == "ok"),
-                "drives_offline": sum(1 for d in drives
-                                      if d["state"] != "ok"),
-                "objects": total_objects,
-                "usage": usage,
-                "heal": server.heal_status,
-            }
+            info = node_info(server)
+            # Cluster view: each peer contributes its own node summary
+            # over the grid (reference: cmd/notification.go ServerInfo
+            # fan-out) — admin info reports the whole deployment, not
+            # just the node that answered the HTTP call.
+            if server.profile_peers:
+                nodes = {"local": dict(info)}
+
+                def _fetch(name, client):
+                    try:
+                        nodes[name] = client.call("peer.info", {},
+                                                  timeout=3)
+                    except Exception:  # noqa: BLE001 - peer down
+                        nodes[name] = {"mode": "offline"}
+
+                # Concurrent fan-out: serial calls would stack one
+                # timeout per DOWN peer onto every info request.
+                ts = [threading.Thread(target=_fetch, args=(n, c),
+                                       daemon=True)
+                      for n, c in server.profile_peers]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(timeout=4)
+                info["nodes"] = nodes
+                info["nodes_online"] = sum(
+                    1 for n in nodes.values()
+                    if n.get("mode") == "online")
+                info["nodes_offline"] = len(nodes) - info["nodes_online"]
             self._send(200, _json.dumps(info).encode(),
                        content_type="application/json")
 

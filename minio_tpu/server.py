@@ -334,6 +334,7 @@ def main(argv=None) -> int:
     # (reference: batch jobs survive restarts via their checkpoints).
     from minio_tpu.object.batch import BatchJobs
     srv.batch = BatchJobs(layer, pools[0].sets)
+    srv.batch.kms = srv.kms
     try:
         resumed = srv.batch.resume_all()
         if resumed:
@@ -381,6 +382,9 @@ def main(argv=None) -> int:
                                             make_profile_handler)
         grid_srv.register(PROFILE_HANDLER,
                           make_profile_handler(srv.profiler))
+        # Per-node admin-info summaries for the cluster info fan-out.
+        from minio_tpu.s3.metrics import node_info as _node_info
+        grid_srv.register("peer.info", lambda payload: _node_info(srv))
         srv.profile_peers = [
             (f"{h}:{p}", client_for(h, p + GRID_PORT_OFFSET))
             for h, p in remote_nodes]

@@ -177,3 +177,85 @@ def test_remote_replicate_and_admin_api(tmp_path):
     finally:
         src_srv.stop()
         dst_srv.stop()
+
+
+def test_batch_keyrotate_reseals_sse_objects(tmp_path):
+    """keyrotate (reference: cmd/batch-rotate.go): SSE-S3 objects'
+    sealed data keys re-seal under a new named key in place — data
+    never moves, old-master compromise stops mattering."""
+    import base64 as _b64
+    import hashlib as _hash
+    import json as _json
+
+    from minio_tpu.crypto import (EncryptingPayload, encrypt_stream_size,
+                                  sse as sse_mod)
+    from minio_tpu.crypto.kms import KMS
+    from minio_tpu.object.batch import BatchJobs
+    from minio_tpu.object.erasure_object import ErasureSet
+    from minio_tpu.object.types import GetOptions, PutOptions
+    from minio_tpu.storage.local import LocalStorage
+    from minio_tpu.utils.streams import Payload
+
+    disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    es = ErasureSet(disks)
+    es.make_bucket("rotb")
+    kms = KMS({"old": b"\x01" * 32, "new": b"\x02" * 32}, "old")
+    bodies = {}
+    for i in range(3):
+        body = os.urandom(40_000)
+        bodies[f"s{i}"] = body
+        data_key, nonce, imeta = sse_mod.encrypt_metadata(
+            "rotb", f"s{i}", len(body), kms, None)
+        opts = PutOptions()
+        opts.internal_metadata.update(imeta)
+        enc = Payload(EncryptingPayload(Payload.wrap(body), data_key,
+                                        nonce),
+                      encrypt_stream_size(len(body)))
+        es.put_object("rotb", f"s{i}", enc, opts)
+    es.put_object("rotb", "plain", b"not encrypted")
+    # A versioned stack: BOTH versions must rotate (an Enabled-era
+    # version left under the old master would die with it).
+    ver_keys = []
+    for txt in (b"v-one", b"v-two"):
+        dk, nonce, imeta = sse_mod.encrypt_metadata(
+            "rotb", "vstack", len(txt), kms, None)
+        opts = PutOptions(versioned=True)
+        opts.internal_metadata.update(imeta)
+        enc = Payload(EncryptingPayload(Payload.wrap(txt), dk, nonce),
+                      encrypt_stream_size(len(txt)))
+        info = es.put_object("rotb", "vstack", enc, opts)
+        ver_keys.append(info.version_id)
+
+    jobs = BatchJobs(es, [es])
+    jobs.kms = kms
+    jid = jobs.start({"type": "keyrotate",
+                       "source": {"bucket": "rotb"},
+                       "encryption": {"keyId": "new"}})
+    assert jobs.wait(jid, 30)
+    st = jobs.status(jid)
+    assert st["status"] == "complete", st
+    # Every SSE object's sealed blob now names the new key and unseals
+    # under it — even with the old master gone.
+    kms_new_only = KMS({"new": b"\x02" * 32}, "new")
+    for name, body in bodies.items():
+        info = es.get_object_info("rotb", name, GetOptions())
+        sealed = info.internal_metadata[sse_mod.META_KEY]
+        assert _json.loads(sealed)["kid"] == "new"
+        data_key = kms_new_only.unseal(sealed,
+                                       {"bucket": "rotb", "object": name})
+        # The rotated key still decrypts the stored bytes.
+        from minio_tpu.crypto.dare import decrypt_packages
+        nonce = _b64.b64decode(info.internal_metadata[sse_mod.META_NONCE])
+        _, stored = es.get_object("rotb", name, GetOptions())
+        plain = b"".join(decrypt_packages(iter([stored]), data_key,
+                                          nonce, 0, 0, len(body)))
+        assert plain == body
+    # The plaintext object was skipped untouched.
+    _, got = es.get_object("rotb", "plain", GetOptions())
+    assert got == b"not encrypted"
+    # Every VERSION of the stack now seals under the new key.
+    for vid in ver_keys:
+        info = es.get_object_info("rotb", "vstack",
+                                  GetOptions(version_id=vid))
+        sealed = info.internal_metadata[sse_mod.META_KEY]
+        assert _json.loads(sealed)["kid"] == "new", vid

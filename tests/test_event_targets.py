@@ -126,9 +126,12 @@ RECORD = {"eventName": "s3:ObjectCreated:Put",
 
 
 @pytest.mark.parametrize("handler,mk", [
-    (_mqtt_handler, lambda a: MQTTTarget("mqtt", a, "minio/events")),
-    (_nats_handler, lambda a: NATSTarget("nats", a, "minio.events")),
-    (_redis_handler, lambda a: RedisTarget("redis", a, "minio:events")),
+    (_mqtt_handler, lambda a: MQTTTarget("mqtt", a, "minio/events",
+                                     timeout=30)),
+    (_nats_handler, lambda a: NATSTarget("nats", a, "minio.events",
+                                     timeout=30)),
+    (_redis_handler, lambda a: RedisTarget("redis", a, "minio:events",
+                                       timeout=30)),
 ])
 def test_target_speaks_its_protocol(handler, mk):
     broker = _Broker(handler)
