@@ -367,7 +367,10 @@ def make_encoder32(matrix: np.ndarray, mode: str = "auto"):
         # real enforcement and measured-best tiles sit near the cap).
         tile4 = 128
         per_lane4 = 32 * k + 16 * rp * 4 + (k + r) * 4 + 4 * rp
-        while tile4 < _TILE_L_MAX // 4 and tile4 * per_lane4 <= _VMEM_BUDGET \
+        # Cap at 16k lanes: measured best for the pair-packed kernel on
+        # v5e (32k-lane cells run ~8% slower — the acc no longer
+        # double-buffers cleanly against the next cell's bits).
+        while tile4 < _TILE_L_MAX // 8 and tile4 * per_lane4 <= _VMEM_BUDGET \
                 and tile4 < l4:
             tile4 *= 2
         bb = 1

@@ -83,8 +83,12 @@ def _mqtt_handler(broker, sock):
     if qos:
         pid = body[off:off + 2]
         off += 2
-        sock.sendall(b"\x40\x02" + pid)          # PUBACK
+    # Record BEFORE acking: the client returns on PUBACK, and the test
+    # asserts immediately — appending after the ack is a lost race
+    # under load.
     broker.published.append((topic, body[off:]))
+    if qos:
+        sock.sendall(b"\x40\x02" + pid)          # PUBACK
 
 
 def _nats_handler(broker, sock):
