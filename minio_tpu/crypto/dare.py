@@ -19,7 +19,12 @@ from __future__ import annotations
 import struct
 from typing import Iterator
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:  # optional dep, gated at use (crypto/kms.py)
+    AESGCM = None
+
+from minio_tpu.crypto.kms import require_aesgcm
 
 PACKAGE_SIZE = 64 * 1024
 TAG_SIZE = 16
@@ -67,6 +72,7 @@ class EncryptingPayload:
     plaintext streams through — O(package) memory."""
 
     def __init__(self, inner, key: bytes, base_nonce: bytes):
+        require_aesgcm()
         self._inner = inner
         self._aead = AESGCM(key)
         self._base = base_nonce
@@ -109,6 +115,7 @@ def decrypt_packages(chunks: Iterator, key: bytes, base_nonce: bytes,
     """Decrypt a ciphertext byte stream of whole packages starting at
     package `first_seq`; yield plaintext, dropping `skip` leading bytes
     and stopping after `length` bytes (range-GET trimming)."""
+    require_aesgcm()
     aead = AESGCM(key)
     try:
         yield from _decrypt_inner(chunks, aead, base_nonce, first_seq,

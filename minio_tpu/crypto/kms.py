@@ -13,11 +13,24 @@ import base64
 import json
 import os
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:  # gated optional dep: KMS/SSE need the wheel,
+    AESGCM = None    # the rest of the server must boot without it
 
 
 class KMSError(Exception):
     pass
+
+
+def require_aesgcm() -> None:
+    """Fail loudly AT USE TIME when the optional `cryptography` wheel
+    is absent: a deployment that never touches KMS/SSE must not pay an
+    import-time crash for a feature it does not use."""
+    if AESGCM is None:
+        raise KMSError(
+            "the 'cryptography' package is not installed; "
+            "KMS/SSE features are unavailable")
 
 
 class KMS:
@@ -56,6 +69,7 @@ class KMS:
     def seal(self, key: bytes, context: dict, kid: str = "") -> str:
         """Seal under the default master key, or a NAMED key (batch
         key rotation reseals existing objects under a new key)."""
+        require_aesgcm()
         kid = kid or self.default_key
         if kid not in self._keys:
             # Mirror unseal(): the key may have been created on another
@@ -75,6 +89,7 @@ class KMS:
         return json.dumps(blob, sort_keys=True)
 
     def unseal(self, sealed: str, context: dict) -> bytes:
+        require_aesgcm()
         try:
             blob = json.loads(sealed)
             kid = blob["kid"]
@@ -193,6 +208,7 @@ class KeyStore:
         if name not in self.kms._keys:
             raise KMSError(f"no such key {name!r}")
         canary = os.urandom(16)
+        require_aesgcm()
         nonce = os.urandom(12)
         ct = AESGCM(self.kms._keys[name]).encrypt(nonce, canary, b"")
         ok = AESGCM(self.kms._keys[name]).decrypt(nonce, ct, b"") == canary

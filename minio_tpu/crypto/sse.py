@@ -15,9 +15,12 @@ import json
 import os
 from typing import Optional
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:  # optional dep, gated at use (crypto/kms.py)
+    AESGCM = None
 
-from minio_tpu.crypto.kms import KMS, KMSError
+from minio_tpu.crypto.kms import KMS, KMSError, require_aesgcm
 
 ALG_SSE_S3 = "SSE-S3"
 ALG_SSE_C = "SSE-C"
@@ -93,6 +96,7 @@ def part_key(data_key: bytes, part_number: int) -> bytes:
 
 def seal_with_customer_key(data_key: bytes, customer_key: bytes,
                            context: dict) -> str:
+    require_aesgcm()
     nonce = os.urandom(12)
     aad = json.dumps(context, sort_keys=True).encode()
     ct = AESGCM(customer_key).encrypt(nonce, data_key, aad)
@@ -103,6 +107,7 @@ def seal_with_customer_key(data_key: bytes, customer_key: bytes,
 
 def unseal_with_customer_key(sealed: str, customer_key: bytes,
                              context: dict) -> bytes:
+    require_aesgcm()
     try:
         blob = json.loads(sealed)
         nonce = base64.b64decode(blob["n"])

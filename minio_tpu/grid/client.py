@@ -12,23 +12,35 @@ from __future__ import annotations
 
 import itertools
 import queue
+import random
 import socket
 import threading
+import time
 from typing import Iterator, Optional
 
 from minio_tpu.grid import wire
 from minio_tpu.grid.wire import GridError, RemoteCallError
+from minio_tpu.utils import deadline as deadline_mod
+from minio_tpu.utils.deadline import DeadlineExceeded
 
 _SENTINEL_ERR = "__conn_lost__"
 
 
 class GridClient:
     def __init__(self, host: str, port: int, connect_timeout: float = 5.0,
-                 call_timeout: float = 60.0):
+                 call_timeout: float = 60.0, send_retries: int = 2,
+                 retry_backoff: float = 0.05):
         self.host = host
         self.port = port
         self.connect_timeout = connect_timeout
         self.call_timeout = call_timeout
+        # Send-phase retries: connect/reset failures BEFORE a reply
+        # could exist are transient (peer restarting, conn replaced)
+        # and safe to retry — the request was never processed. Reply
+        # timeouts and remote errors are NEVER retried here, and no
+        # retry runs against an exhausted request deadline.
+        self.send_retries = max(0, send_retries)
+        self.retry_backoff = retry_backoff
         self._sock: Optional[socket.socket] = None
         self._mu = threading.Lock()          # guards connect + state maps
         # Socket writes serialize on their own lock, held per FRAME only:
@@ -134,20 +146,62 @@ class GridClient:
         with self._mu:
             self._pending.pop(mux, None)
 
+    def _send_with_retry(self, kind: int, handler: str, payload):
+        """Send one request frame, retrying transient connect/send
+        failures with jittered exponential backoff. Returns (mux, q).
+
+        Only the SEND phase retries: a frame that failed to leave (or
+        a connection that died while it left) was never answered, so
+        re-sending cannot double-apply. Retries stop the moment the
+        bound request deadline cannot afford another attempt."""
+        dl = deadline_mod.current()
+        last: Optional[GridError] = None
+        for attempt in range(self.send_retries + 1):
+            if attempt:
+                delay = self.retry_backoff * (2 ** (attempt - 1)) \
+                    * (0.5 + random.random())
+                if dl is not None and dl.remaining() <= delay:
+                    break           # no budget for a backoff: surface
+                time.sleep(delay)
+            if dl is not None and dl.expired():
+                raise DeadlineExceeded(
+                    f"deadline exceeded calling {handler} on "
+                    f"{self.host}:{self.port}")
+            mux = next(self._mux)
+            q: "queue.Queue[dict]" = queue.Queue()
+            try:
+                self._send({"t": kind, "m": mux, "h": handler,
+                            "p": payload}, mux, q)
+                return mux, q
+            except RemoteCallError:
+                raise
+            except GridError as e:
+                last = e
+        raise last if last is not None else GridError(
+            f"send {handler} to {self.host}:{self.port} failed")
+
+    def _recv(self, q, handler: str, wait: Optional[float]):
+        """One reply frame, waiting at most min(wait, deadline left)."""
+        wait = wait or self.call_timeout
+        dl = deadline_mod.current()
+        eff = wait if dl is None else dl.clamp(wait)
+        try:
+            return q.get(timeout=eff)
+        except queue.Empty:
+            if dl is not None and eff < wait:
+                raise DeadlineExceeded(
+                    f"deadline exceeded awaiting {handler} from "
+                    f"{self.host}:{self.port}") from None
+            raise GridError(
+                f"call {handler} to {self.host}:{self.port} timed out") \
+                from None
+
     def call(self, handler: str, payload=None,
              timeout: Optional[float] = None):
         """Unary call; raises RemoteCallError with the remote's code."""
-        mux = next(self._mux)
-        q: "queue.Queue[dict]" = queue.Queue()
-        self._send({"t": wire.T_REQ, "m": mux, "h": handler, "p": payload},
-                   mux, q)
+        mux, q = self._send_with_retry(wire.T_REQ, handler, payload)
         try:
-            try:
-                msg = q.get(timeout=timeout or self.call_timeout)
-            except queue.Empty:
-                raise GridError(
-                    f"call {handler} to {self.host}:{self.port} timed out") \
-                    from None
+            msg = self._recv(q, handler, timeout)
             if msg["t"] == wire.T_RESP:
                 return msg.get("p")
             code = msg.get("e", "Internal")
@@ -160,16 +214,10 @@ class GridClient:
     def stream(self, handler: str, payload=None,
                timeout: Optional[float] = None) -> Iterator:
         """Streaming call: yields items until EOF. Raises on error."""
-        mux = next(self._mux)
-        q: "queue.Queue[dict]" = queue.Queue()
-        self._send({"t": wire.T_SREQ, "m": mux, "h": handler, "p": payload},
-                   mux, q)
+        mux, q = self._send_with_retry(wire.T_SREQ, handler, payload)
         try:
             while True:
-                try:
-                    msg = q.get(timeout=timeout or self.call_timeout)
-                except queue.Empty:
-                    raise GridError(f"stream {handler} timed out") from None
+                msg = self._recv(q, handler, timeout)
                 t = msg["t"]
                 if t == wire.T_CHUNK:
                     yield msg.get("p")

@@ -28,9 +28,12 @@ import time
 import urllib.request
 from typing import Optional
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric import padding, rsa
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+except ImportError:  # optional dep: OIDC validation needs the wheel;
+    InvalidSignature = hashes = padding = rsa = None  # gated at use
 
 DEFAULT_CLAIM = "policy"
 # JWKS responses are cached briefly: one fetch per token would hammer
@@ -61,6 +64,10 @@ class OpenIDValidator:
     def __init__(self, jwks_url: str = "", jwks_inline: str = "",
                  client_id: str = "", claim_name: str = DEFAULT_CLAIM,
                  issuer: str = ""):
+        if rsa is None:
+            raise OIDCError(
+                "the 'cryptography' package is not installed; "
+                "OIDC token validation is unavailable")
         if not jwks_url and not jwks_inline:
             raise OIDCError("no JWKS source configured")
         self.jwks_url = jwks_url

@@ -26,13 +26,17 @@ import dataclasses
 import functools
 import hashlib
 import threading
+import time as _time_mod
 import zlib
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeout
 from typing import Optional, Sequence
 
 import numpy as np
 
 from minio_tpu.erasure.codec import CodecError, Erasure, ceil_frac
+from minio_tpu.utils import deadline as deadline_mod
+from minio_tpu.utils.deadline import DeadlineExceeded
 from minio_tpu.object.types import (BucketExists, BucketInfo, BucketNotEmpty,
                                     BucketNotFound, DeleteOptions,
                                     DeletedObject, GetOptions, InvalidRange,
@@ -262,9 +266,38 @@ class ErasureSet:
     # fan-out helper
     # ------------------------------------------------------------------
 
+    # Grace added to the request deadline when collecting fan-out
+    # futures: the per-op deadline inside the worker (health wrapper,
+    # grid call) is the precise one and should fire first; this bound
+    # only catches workers on raw, unwrapped drives that can hang.
+    _FANOUT_DEADLINE_SLOP = 0.25
+
     def _fanout(self, fns):
-        """Run one callable per disk in parallel; returns (results, errors)."""
-        futures = [self.pool.submit(fn) if fn else None for fn in fns]
+        """Run one callable per disk in parallel; returns (results, errors).
+
+        The caller's request deadline (utils/deadline.py) is re-bound
+        inside each worker thread — thread locals do not cross the pool
+        boundary on their own — and bounds the collection wait, so one
+        hung drive can never hold the whole request past its budget."""
+        dl = deadline_mod.current()
+        if dl is not None and dl.expired():
+            # Budget already spent: answer without touching any drive.
+            err = DeadlineExceeded("request deadline exceeded")
+            return [None] * len(fns), [err] * len(fns)
+
+        def bound(fn):
+            def run():
+                with deadline_mod.bind(dl):
+                    return fn()
+            return run
+
+        futures = [self.pool.submit(bound(fn)) if fn else None
+                   for fn in fns]
+        # One ABSOLUTE collection deadline for the whole fan-out: the
+        # slop must not stack per hung future, or n stuck drives
+        # overshoot the budget n times over.
+        collect_by = None if dl is None \
+            else dl.expires_at + self._FANOUT_DEADLINE_SLOP
         results, errors = [], []
         for f in futures:
             if f is None:
@@ -272,12 +305,32 @@ class ErasureSet:
                 errors.append(StorageError("disk offline"))
                 continue
             try:
-                results.append(f.result())
+                if collect_by is None:
+                    results.append(f.result())
+                else:
+                    results.append(f.result(timeout=max(
+                        0.0, collect_by - _time_mod.monotonic())))
                 errors.append(None)
+            except FutureTimeout:
+                # The worker is stuck on something that ignores
+                # deadlines; leave it to finish unobserved and move on.
+                results.append(None)
+                errors.append(DeadlineExceeded(
+                    "request deadline exceeded in drive fan-out"))
             except Exception as e:  # noqa: BLE001 - per-disk fault isolation
                 results.append(None)
                 errors.append(e)
         return results, errors
+
+    def _cleanup_fanout(self, fns):
+        """Best-effort rollback/cleanup fan-out, SHIELDED from the
+        request deadline (utils/deadline.shield): a request whose
+        budget just expired still must not leave partially committed
+        versions or staged shard files behind — skipping the rollback
+        because the request timed out would create exactly the partial
+        state the rollback exists to remove."""
+        with deadline_mod.shield():
+            return self._fanout(fns)
 
     # ------------------------------------------------------------------
     # buckets
@@ -294,7 +347,7 @@ class ErasureSet:
                 raise BucketExists(bucket)
             raise WriteQuorumError(bucket)
         # Heal disks that failed transiently so the set stays consistent.
-        self._fanout([lambda d=d: _swallow(
+        self._cleanup_fanout([lambda d=d: _swallow(
             lambda: d.make_vol_if_missing(bucket))
             for d, e in zip(self.disks, errors) if e is not None])
 
@@ -330,7 +383,7 @@ class ErasureSet:
         # (versioning state must not survive deletion).
         self.invalidate_bucket_meta(bucket)
         self.metacache.drop_bucket(bucket)
-        self._fanout([lambda d=d: _swallow(
+        self._cleanup_fanout([lambda d=d: _swallow(
             lambda: d.delete(SYS_VOL, f"buckets/{bucket}", recursive=True))
             for d in self.disks])
 
@@ -498,12 +551,14 @@ class ErasureSet:
         # getReadQuorum == dataBlocks).
         any_fi = next((f for f in fis if f is not None), None)
         if any_fi is None:
-            raise ReadQuorumError(bucket, object_)
+            _raise_for_quorum(errors, ReadQuorumError(bucket, object_),
+                              quorum=n // 2 + 1)
         quorum = max(any_fi.erasure.data_blocks, n // 2) if any_fi.erasure.data_blocks \
             else n // 2 + 1
         fi, idxs = self._quorum_fileinfo(fis, quorum)
         if fi is None:
-            raise ReadQuorumError(bucket, object_)
+            _raise_for_quorum(errors, ReadQuorumError(bucket, object_),
+                              quorum=quorum)
         return fi, fis, errors
 
     def _reap_dangling(self, bucket: str, object_: str) -> None:
@@ -733,15 +788,16 @@ class ErasureSet:
             # Best-effort cleanup: committed versions on the disks that
             # succeeded, and staged shard files everywhere (a failed
             # rename_data leaves its staging dir behind).
-            self._fanout([lambda d=d: _swallow(
+            self._cleanup_fanout([lambda d=d: _swallow(
                 lambda: d.delete_version(bucket, object_, version_id))
                 for d, err in zip(self.disks, errors) if err is None])
             if not inline:
-                self._fanout([lambda d=d: _swallow(
+                self._cleanup_fanout([lambda d=d: _swallow(
                     lambda: d.delete(SYS_VOL, staging, recursive=True))
                     for d in self.disks])
-            raise WriteQuorumError(bucket, object_,
-                                   f"wrote {ok}/{n}, need {write_quorum}")
+            _raise_for_quorum(errors, WriteQuorumError(
+                bucket, object_, f"wrote {ok}/{n}, need {write_quorum}"),
+                quorum=write_quorum)
         if ok < n:
             # Partial success: queue immediate background repair of the
             # drives that missed the write (reference MRF hook,
@@ -864,7 +920,7 @@ class ErasureSet:
 
         with self.ns.write(bucket, object_):
             if newer_null_exists():
-                self._fanout([lambda d=d: _swallow(
+                self._cleanup_fanout([lambda d=d: _swallow(
                     lambda: d.delete(SYS_VOL, staging, recursive=True))
                     for d in self.disks])
                 return
@@ -872,7 +928,7 @@ class ErasureSet:
                 [lambda i=i: write_one(i) for i in range(n)])
         ok = sum(e is None for e in errors)
         if ok < write_quorum:
-            self._fanout([lambda d=d: _swallow(
+            self._cleanup_fanout([lambda d=d: _swallow(
                 lambda: d.delete(SYS_VOL, staging, recursive=True))
                 for d in self.disks])
             raise WriteQuorumError(bucket, object_)
@@ -908,24 +964,41 @@ class ErasureSet:
         sentinel_seen = [False] * n
         _SENTINEL = object()
 
+        dl = deadline_mod.current()
+
+        def got_sentinel(i: int, c) -> bool:
+            """Sentinel handling shared by every consumer of qs[i]. The
+            sentinel is STICKY (re-queued on receipt): when a health-
+            wrapped create_file times out, its abandoned pool worker is
+            still blocked in gen()'s get() while the writer's drain
+            loop also consumes — one sentinel with two consumers would
+            park the loser forever (leaking a pool worker per timed-out
+            stream, or hanging the producer's join). Re-queueing wakes
+            every consumer; the producer has stopped feeding this
+            queue, so the re-put can never block."""
+            if c is _SENTINEL:
+                sentinel_seen[i] = True
+                qs[i].put(c)
+                return True
+            return False
+
         def writer(i: int):
             try:
-                disk, vol, path = path_for(i)
+                with deadline_mod.bind(dl):
+                    disk, vol, path = path_for(i)
 
-                def gen():
-                    while True:
-                        c = qs[i].get()
-                        if c is _SENTINEL:
-                            sentinel_seen[i] = True
-                            return
-                        yield from c
-                disk.create_file(vol, path, gen())
+                    def gen():
+                        while True:
+                            c = qs[i].get()
+                            if got_sentinel(i, c):
+                                return
+                            yield from c
+                    disk.create_file(vol, path, gen())
             except Exception as exc:  # noqa: BLE001 - collected for quorum
                 errors[i] = exc
                 dead[i] = True
                 while not sentinel_seen[i]:
-                    if qs[i].get() is _SENTINEL:
-                        sentinel_seen[i] = True
+                    got_sentinel(i, qs[i].get())
 
         import threading
         threads = [threading.Thread(target=writer, args=(i,), daemon=True)
@@ -937,6 +1010,8 @@ class ErasureSet:
         stream_error: Optional[Exception] = None
         try:
             while True:
+                if dl is not None:
+                    dl.check()
                 window = payload.read_exact(window_bytes)
                 if not window:
                     break
@@ -984,7 +1059,7 @@ class ErasureSet:
             return self.disks[i], SYS_VOL, f"{staging}/{data_dir}/part.1"
 
         def cleanup_staging(disks=None):
-            self._fanout([lambda d=d: _swallow(
+            self._cleanup_fanout([lambda d=d: _swallow(
                 lambda: d.delete(SYS_VOL, staging, recursive=True))
                 for d in (disks if disks is not None else self.disks)])
 
@@ -998,8 +1073,9 @@ class ErasureSet:
         ok = sum(err is None for err in errors)
         if ok < write_quorum:
             cleanup_staging()
-            raise WriteQuorumError(bucket, object_,
-                                   f"staged {ok}/{n}, need {write_quorum}")
+            _raise_for_quorum(errors, WriteQuorumError(
+                bucket, object_, f"staged {ok}/{n}, need {write_quorum}"),
+                quorum=write_quorum)
 
         mod_time = opts.mod_time or now_ns()
         metadata = _clean_user_meta(opts.user_metadata)
@@ -1033,12 +1109,14 @@ class ErasureSet:
                 [lambda i=i: commit_one(i) for i in range(n)])
         ok = sum(e2 is None for e2 in cerrors)
         if ok < write_quorum:
-            self._fanout([lambda d=d: _swallow(
+            self._cleanup_fanout([lambda d=d: _swallow(
                 lambda: d.delete_version(bucket, object_, version_id))
                 for d, err in zip(self.disks, cerrors) if err is None])
             cleanup_staging()
-            raise WriteQuorumError(bucket, object_,
-                                   f"committed {ok}/{n}, need {write_quorum}")
+            _raise_for_quorum(cerrors, WriteQuorumError(
+                bucket, object_,
+                f"committed {ok}/{n}, need {write_quorum}"),
+                quorum=write_quorum)
         laggards = [d for d, err in zip(self.disks, cerrors)
                     if err is not None]
         if laggards:
@@ -1266,6 +1344,11 @@ class ErasureSet:
                 return d.read_file(
                     bucket, f"{object_}/{fi.data_dir}/{part_file}",
                     offset=framed_lo, length=framed_hi - framed_lo)
+            except DeadlineExceeded:
+                # The REQUEST ran out of budget, not the shard out of
+                # luck — must reach the quorum triage, not become a
+                # silent missing shard.
+                raise
             except Exception:  # noqa: BLE001 - bad shard == missing shard
                 return None
 
@@ -1284,20 +1367,23 @@ class ErasureSet:
 
         # Read data shards first; hedge with parity shards for failures.
         shards: list[Optional[np.ndarray]] = [None] * n
-        results, _ = self._fanout([lambda s=s: fetch_raw(s)
-                                   for s in range(k)])
+        results, ferrs = self._fanout([lambda s=s: fetch_raw(s)
+                                       for s in range(k)])
         for s, r in enumerate(verify(results)):
             shards[s] = r
         missing = [s for s in range(k) if shards[s] is None]
         if missing:
-            extra, _ = self._fanout([lambda s=s: fetch_raw(s)
-                                     for s in range(k, n)])
+            extra, ferrs2 = self._fanout([lambda s=s: fetch_raw(s)
+                                          for s in range(k, n)])
             for j, r in enumerate(verify(extra)):
                 shards[k + j] = r
             available = sum(1 for s in shards if s is not None)
             if available < k:
-                raise ReadQuorumError(bucket, object_,
-                                      f"{available}/{n} shards readable")
+                _raise_for_quorum(
+                    ferrs + ferrs2,
+                    ReadQuorumError(bucket, object_,
+                                    f"{available}/{n} shards readable"),
+                    quorum=k, ok=available)
             e.decode_data_blocks(shards)
             # Bytes were served from reconstruction: heal in background
             # (reference: MRF enqueue on degraded reads,
@@ -1820,3 +1906,21 @@ def _swallow(fn):
         fn()
     except Exception:  # noqa: BLE001
         pass
+
+
+def _raise_for_quorum(errors, exc, quorum=None, ok=None):
+    """Quorum-failure triage: surface DeadlineExceeded (-> 408
+    RequestTimeout) only when the REQUEST's budget was DECISIVE — had
+    the deadline-cut drives been given time and succeeded, `quorum`
+    could have been met. When genuine drive faults alone preclude
+    quorum, the honest verdict stays the 503 quorum error: masking
+    real cluster unhealth as a client timeout would hide it from
+    operators and send clients into retry loops."""
+    deadline_cut = sum(isinstance(e, DeadlineExceeded) for e in errors)
+    if deadline_cut:
+        if ok is None:
+            ok = sum(e is None for e in errors)
+        if quorum is None or ok + deadline_cut >= quorum:
+            raise DeadlineExceeded(
+                "request deadline exceeded before quorum")
+    raise exc

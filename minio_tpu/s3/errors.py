@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from minio_tpu.object import types as ot
 from minio_tpu.s3.sigv4 import SigError
+from minio_tpu.utils.deadline import DeadlineExceeded
 
 # code -> (http status, default message)
 _CATALOG = {
@@ -30,6 +31,8 @@ _CATALOG = {
     "InternalError": (500, "We encountered an internal error, please try again."),
     "SlowDownRead": (503, "Resource requested is unreadable, please reduce your request rate"),
     "SlowDownWrite": (503, "Resource requested is unwritable, please reduce your request rate"),
+    "SlowDown": (503, "Please reduce your request rate."),
+    "RequestTimeout": (408, "The request did not complete within the allotted time, please reduce your request rate."),
     "MalformedXML": (400, "The XML you provided was not well-formed or did not validate against our published schema."),
     "NoSuchUpload": (404, "The specified multipart upload does not exist."),
     "InvalidPart": (400, "One or more of the specified parts could not be found."),
@@ -79,6 +82,11 @@ def from_exception(e: Exception) -> S3Error:
     if isinstance(e, SigError):
         return S3Error(e.code if e.code in _CATALOG else "AccessDenied",
                        str(e))
+    if isinstance(e, DeadlineExceeded):
+        # The request outlived its admission-granted budget: the
+        # correct verdict is "you timed out", never a hang and never a
+        # misleading quorum error.
+        return S3Error("RequestTimeout")
     from minio_tpu.object import multipart as mp
     mp_map = {mp.UploadNotFound: "NoSuchUpload", mp.InvalidPart: "InvalidPart",
               mp.InvalidPartOrder: "InvalidPartOrder",

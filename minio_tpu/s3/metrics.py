@@ -132,6 +132,38 @@ class Metrics:
                    [({}, misses)])
 
         if server is not None:
+            adm = getattr(server, "admission", None)
+            if adm is not None:
+                snap = adm.snapshot()
+                classes = sorted(k for k, v in snap.items()
+                                 if isinstance(v, dict))
+                metric("minio_tpu_api_requests_max",
+                       "Configured in-flight request limit per class "
+                       "(0 = unlimited)", "gauge",
+                       [({"class": c}, snap[c]["limit"]) for c in classes])
+                metric("minio_tpu_api_requests_in_flight",
+                       "Requests currently admitted per class", "gauge",
+                       [({"class": c}, snap[c]["in_flight"])
+                        for c in classes])
+                metric("minio_tpu_api_requests_waiting",
+                       "Requests queued for an admission slot", "gauge",
+                       [({"class": c}, snap[c]["waiting"])
+                        for c in classes])
+                metric("minio_tpu_api_requests_admitted_total",
+                       "Requests admitted per class", "counter",
+                       [({"class": c}, snap[c]["admitted_total"])
+                        for c in classes])
+                metric("minio_tpu_api_requests_shed_total",
+                       "Requests shed with 503 by admission control",
+                       "counter",
+                       [({"class": c, "reason": r},
+                         snap[c][f"shed_{r}_total"])
+                        for c in classes
+                        for r in ("queue_full", "deadline")])
+                metric("minio_tpu_api_request_deadline_exceeded_total",
+                       "Requests that exhausted their deadline budget "
+                       "mid-flight (408)", "counter",
+                       [({}, snap["deadline_exceeded_total"])])
             repl = getattr(server, "replicator", None)
             if repl is not None:
                 metric("minio_tpu_replication_queued_total",
@@ -242,7 +274,7 @@ def node_info(server) -> dict:
                  "total_size": u.total_size,
                  "buckets": len(u.buckets),
                  "last_update": u.last_update}
-    return {
+    info = {
         "mode": "online",
         "sets": len(sets),
         "drives": drives,
@@ -252,3 +284,10 @@ def node_info(server) -> dict:
         "usage": usage,
         "heal": server.heal_status,
     }
+    adm = getattr(server, "admission", None)
+    if adm is not None:
+        # Shed/queue/deadline counters per request class: the operator-
+        # facing view of admission control (reference: madmin info's
+        # requests fields).
+        info["admission"] = adm.snapshot()
+    return info

@@ -15,6 +15,7 @@ reference's NotificationSys collects remote profiles.
 
 from __future__ import annotations
 
+import contextlib
 import cProfile
 import io
 import marshal
@@ -33,9 +34,14 @@ class ProfileError(Exception):
 class Profiler:
     """One node's profile capture (CPU via cProfile)."""
 
+    # Per-request capture cap: an admin who forgets to stop a profile
+    # on a busy server must not accumulate profiles without bound.
+    _MAX_REQUEST_PROFILES = 4096
+
     def __init__(self):
         self._mu = threading.Lock()
         self._prof: cProfile.Profile | None = None
+        self._request_profs: list[cProfile.Profile] = []
         self._started_ns = 0
 
     def start(self) -> None:
@@ -43,8 +49,40 @@ class Profiler:
             if self._prof is not None:
                 raise ProfileError("a profile is already running")
             self._prof = cProfile.Profile()
+            self._request_profs = []
             self._started_ns = time.time_ns()
             self._prof.enable()
+
+    @contextlib.contextmanager
+    def request_profile(self):
+        """Per-request capture on the HANDLER thread. cProfile hooks
+        are per-thread, so the start() enable() only ever sees the
+        admin thread; each request records its own profile here and
+        the bundle merges them at stop — without this the downloaded
+        profile is empty of the very load it was meant to explain."""
+        # Lock-free fast path: this wraps EVERY request's dispatch,
+        # and profiling is almost always off — a single attribute read
+        # (atomic in CPython) must not become a shared-lock point.
+        if self._prof is None:
+            yield
+            return
+        with self._mu:
+            active = self._prof is not None and \
+                len(self._request_profs) < self._MAX_REQUEST_PROFILES
+        if not active:
+            yield
+            return
+        p = cProfile.Profile()
+        p.enable()
+        try:
+            yield
+        finally:
+            p.disable()
+            with self._mu:
+                if self._prof is not None and \
+                        len(self._request_profs) < \
+                        self._MAX_REQUEST_PROFILES:
+                    self._request_profs.append(p)
 
     def stop(self) -> dict:
         """Stop and return {"stats": marshaled pstats bytes,
@@ -53,8 +91,14 @@ class Profiler:
             if self._prof is None:
                 raise ProfileError("no profile is running")
             prof, self._prof = self._prof, None
+            request_profs, self._request_profs = self._request_profs, []
         prof.disable()
         stats = pstats.Stats(prof)
+        for p in request_profs:
+            try:
+                stats.add(p)
+            except Exception:  # noqa: BLE001 - one bad capture != no bundle
+                continue
         out = io.StringIO()
         stats.stream = out
         stats.sort_stats("cumulative").print_stats(60)

@@ -23,7 +23,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from minio_tpu.object.types import (DeleteOptions, GetOptions, InvalidArgument,
                                     ObjectNotFound, PutOptions)
 from minio_tpu.s3 import sigv4
+from minio_tpu.s3.admission import AdmissionController, AdmissionShed
+from minio_tpu.s3.admission import path_class as admission_path_class
 from minio_tpu.s3.errors import S3Error, from_exception
+from minio_tpu.utils import deadline as deadline_mod
 from minio_tpu.s3.metrics import Metrics, layer_sets as _layer_sets, \
     node_info, probe_disks as _probe_disks
 from minio_tpu.utils.streams import (HashingReader, HttpChunkedReader,
@@ -115,6 +118,10 @@ class S3Server:
         # serialization would ride the dsync namespace lock.
         self.bucket_meta_lock = threading.Lock()
         self.metrics = Metrics()
+        # Admission control: bounded in-flight requests with per-class
+        # gates and the per-request deadline budget
+        # (MTPU_API_REQUESTS_MAX / _DEADLINE / _TIMEOUT; s3/admission.py).
+        self.admission = AdmissionController.from_env()
         # Admin-triggered heal sweeps run in this background slot.
         self.heal_status: dict = {"state": "idle"}
         self._heal_thread: threading.Thread | None = None
@@ -399,12 +406,46 @@ def _make_handler(server: S3Server):
                 self._sent_bytes = getattr(self, "_sent_bytes", 0) \
                     + len(body)
 
+        # Shed-path body drain cap: reading the remnant is cheap
+        # network receive (the resource being protected is CPU/disk,
+        # not the NIC), but it must stay bounded — a multi-GiB upload
+        # is closed on instead (SDKs retry on connection errors).
+        _DRAIN_CAP = 8 << 20
+
+        def _drain_unread_body(self) -> None:
+            """Discard the request body AFTER an early error response,
+            bounded by _DRAIN_CAP and a read timeout. Only safe where
+            NOTHING of the body has been consumed yet (the admission
+            path runs before any body read); Content-Length framing
+            only — chunked bodies just close (framing-position
+            unknown). The shape of Go http.Server's pre-close drain."""
+            try:
+                h = self._headers_lower()
+                if "chunked" in h.get("transfer-encoding", "").lower():
+                    return
+                remaining = int(h.get("content-length") or 0)
+            except ValueError:
+                return
+            if remaining <= 0 or remaining > self._DRAIN_CAP:
+                return
+            try:
+                self.connection.settimeout(2.0)
+                while remaining > 0:
+                    chunk = self.rfile.read(min(65536, remaining))
+                    if not chunk:
+                        return
+                    remaining -= len(chunk)
+            except OSError:
+                pass        # stalled/gone client; the close handles it
+
         def _send_error(self, e: Exception, bucket="", key=""):
             # The request body may be partially or fully unread (auth runs
             # before body consumption): close the connection rather than
             # letting keep-alive parse leftover body bytes as a request.
             self.close_connection = True
             err = from_exception(e)
+            if err.code == "RequestTimeout":
+                server.admission.record_deadline_exceeded()
             root = ET.Element("Error")
             _el(root, "Code", err.code)
             _el(root, "Message", err.message)
@@ -412,7 +453,8 @@ def _make_handler(server: S3Server):
             _el(root, "Key", err.key or key)
             _el(root, "Resource", self.path)
             _el(root, "RequestId", "0")
-            self._send(err.status, _xml(root))
+            self._send(err.status, _xml(root),
+                       headers=getattr(err, "headers", None))
 
         # -- dispatch ---------------------------------------------------
 
@@ -421,12 +463,9 @@ def _make_handler(server: S3Server):
             super().send_response(code, message)
 
         def _api_label(self, method, raw_path, bucket, key) -> str:
-            if raw_path.startswith("/minio/admin"):
-                return f"{method}:admin"
-            if raw_path.startswith("/minio/health"):
-                return f"{method}:health"
-            if raw_path.startswith("/minio/v2/metrics"):
-                return f"{method}:metrics"
+            pc = admission_path_class(raw_path)
+            if pc != "s3":
+                return f"{method}:{pc}"
             scope = "object" if key else ("bucket" if bucket else "service")
             return f"{method}:{scope}"
 
@@ -438,9 +477,39 @@ def _make_handler(server: S3Server):
             t0 = _time_mod.perf_counter()
             with server._inflight_mu:
                 server._inflight += 1
+            gate = None
             try:
-                self._route_inner(method, raw_path, query, bucket, key)
+                # Admission: bounded in-flight slots per request class
+                # BEFORE any auth/body work — a saturated server sheds
+                # with 503 + Retry-After instead of queueing unbounded
+                # (reference: maxClients, cmd/generic-handlers.go).
+                try:
+                    gate = server.admission.enter(
+                        server.admission.classify(raw_path))
+                except AdmissionShed as shed:
+                    err = S3Error("SlowDown", str(shed))
+                    err.headers = {"Retry-After": str(shed.retry_after)}
+                    self._send_error(err, bucket, key)
+                    # A shed PUT's client is mid-upload: discard its
+                    # body (bounded) so it can finish sending and READ
+                    # the 503 + Retry-After instead of dying on a
+                    # connection reset when we close under its write.
+                    self._drain_unread_body()
+                    return
+                # Per-request deadline budget: every layer below (fan-
+                # outs, drive deadlines, grid calls) consumes from it,
+                # so one hung drive bounds the request, not the stack
+                # of per-layer timeouts.
+                dl = None
+                if server.admission.request_timeout > 0:
+                    dl = deadline_mod.Deadline(
+                        server.admission.request_timeout)
+                with deadline_mod.bind(dl), \
+                        server.profiler.request_profile():
+                    self._route_inner(method, raw_path, query, bucket, key)
             finally:
+                if gate is not None:
+                    gate.leave()
                 with server._inflight_mu:
                     server._inflight -= 1
                 try:
@@ -468,11 +537,14 @@ def _make_handler(server: S3Server):
                 # Unauthenticated endpoints: health probes and metrics
                 # (reference: cmd/healthcheck-handler.go is authless;
                 # metrics here follow suit for scrape simplicity).
+                # (path_class in s3/admission.py is the shared pattern
+                # source for these operator endpoints; keep dispatch
+                # and classification in lockstep.)
                 if raw_path == "/minio/health/live":
                     return self._send(200)
                 if raw_path == "/minio/health/ready":
                     return self._health_ready()
-                if raw_path.startswith("/minio/v2/metrics"):
+                if admission_path_class(raw_path) == "metrics":
                     text = server.metrics.render(
                         object_layer=server.object_layer,
                         scanner=getattr(server.object_layer, "scanner",
@@ -518,8 +590,7 @@ def _make_handler(server: S3Server):
                         if presented != tok:
                             raise S3Error("AccessDenied",
                                           "invalid session token")
-                if raw_path == "/minio/admin" or \
-                        raw_path.startswith("/minio/admin/"):
+                if admission_path_class(raw_path) == "admin":
                     if auth.anonymous:
                         raise S3Error("AccessDenied")
                     return self._admin_op(method, raw_path, query, auth)

@@ -26,6 +26,8 @@ from minio_tpu.storage.local import (DiskAccessDenied, FaultyDisk,
                                      VolumeNotFound)
 from minio_tpu.storage.meta import (FileNotFoundErr, MetaError,
                                     VersionNotFoundErr)
+from minio_tpu.utils import deadline as deadline_mod
+from minio_tpu.utils.deadline import DeadlineExceeded
 
 # Errors that mean "the drive answered correctly" — never breaker fuel.
 # The BUILTIN FileNotFoundError is deliberately absent: LocalStorage
@@ -99,6 +101,13 @@ class DiskHealthWrapper:
         self._consecutive = 0
         self._open_since: float = 0.0     # 0 = closed
         self._half_open_probe = False
+        # Consecutive budget-clamped expiries with a GENEROUS window
+        # (see _SUSPICION_WINDOW): ambiguous individually, but a drive
+        # that repeatedly cannot answer inside whole seconds is hung —
+        # without this, any request deadline shorter than the op
+        # timeout would classify every expiry as "the request's
+        # problem" and a dead drive could never trip the breaker.
+        self._clamped_streak = 0
         # op -> [count, errors, total_seconds]; small and bounded.
         self.op_stats: dict[str, list] = {}
         # A hung call occupies a worker until it returns; the breaker
@@ -171,21 +180,91 @@ class DiskHealthWrapper:
     def _ok(self) -> None:
         with self._mu:
             self._consecutive = 0
+            self._clamped_streak = 0
             self._open_since = 0.0
             self._half_open_probe = False
 
+    # Clamped expiries only count toward suspicion when the drive had
+    # at least this long to answer — a request with 50 ms left proves
+    # nothing, but whole seconds of silence repeated trip_after times
+    # in a row does.
+    _SUSPICION_WINDOW = 1.0
+
+    def _clamped_expiry(self, window: float) -> None:
+        """A budget-clamped op expiry: release the probe slot, and
+        accumulate generous-window expiries; a full streak is treated
+        as a real fault episode and opens the breaker outright."""
+        with self._mu:
+            self._half_open_probe = False
+            if window < self._SUSPICION_WINDOW:
+                return
+            self._clamped_streak += 1
+            if self._clamped_streak >= self._trip_after:
+                self._clamped_streak = 0
+                self._consecutive = max(self._consecutive + 1,
+                                        self._trip_after)
+                self._open_since = time.monotonic()
+
+    def _probe_inconclusive(self) -> None:
+        """A half-open probe that ended for REQUEST reasons (deadline
+        budget) proved nothing about the drive: release the probe slot
+        so the next caller can probe, without touching fault state —
+        otherwise the flag wedges and the drive stays offline forever."""
+        with self._mu:
+            self._half_open_probe = False
+
     def _call(self, op: str, fn, args, kwargs):
+        # Deadline pre-check BEFORE _admit(): an already-exhausted
+        # request must not consume the breaker's half-open probe slot.
+        dl = deadline_mod.current()
+        if dl is not None and dl.expired():
+            raise DeadlineExceeded(
+                f"request deadline exceeded before {op} on "
+                f"{self.endpoint}")
         self._admit()
-        timeout = self._bulk_timeout if op in _BULK_OPS else self._op_timeout
+        base = self._bulk_timeout if op in _BULK_OPS else self._op_timeout
+        # Clamp the op deadline to the REQUEST's remaining budget
+        # (utils/deadline.py): a request with 200 ms left must not wait
+        # a full op timeout on this drive. A single clamped expiry is
+        # the request running out of time, not breaker fuel; only a
+        # generous-window streak becomes suspicion (_clamped_expiry).
+        timeout = base
+        if dl is not None:
+            timeout = min(base, dl.remaining())
         t0 = time.monotonic()
-        fut: Future = self._pool.submit(fn, *args, **kwargs)
+        if dl is None:
+            fut: Future = self._pool.submit(fn, *args, **kwargs)
+        else:
+            # Re-bind the budget inside the pool worker so nested
+            # layers (remote drives -> grid calls) keep consuming it.
+            def run(_dl=dl):
+                with deadline_mod.bind(_dl):
+                    return fn(*args, **kwargs)
+            fut = self._pool.submit(run)
         try:
             result = fut.result(timeout=timeout)
         except FutureTimeout:
             self._record(op, time.monotonic() - t0, failed=True)
+            if timeout < base:
+                # The REQUEST's budget expired first; one such expiry
+                # proves nothing about drive health, but a streak of
+                # generous-window ones does (see _clamped_expiry) —
+                # otherwise a budget permanently shorter than the op
+                # timeout would starve the breaker of evidence and a
+                # dead drive could never fail fast.
+                self._clamped_expiry(timeout)
+                raise DeadlineExceeded(
+                    f"request deadline exceeded during {op} on "
+                    f"{self.endpoint}") from None
             self._fault()
             raise FaultyDisk(
                 f"drive {self.endpoint}: {op} exceeded {timeout}s") from None
+        except DeadlineExceeded:
+            # Raised by a nested layer (e.g. a remote drive's grid
+            # call): the request's problem, not this drive's.
+            self._record(op, time.monotonic() - t0, failed=True)
+            self._probe_inconclusive()
+            raise
         except _DOMAIN_ERRORS:
             # The drive responded; the object/volume state is the news.
             self._record(op, time.monotonic() - t0, failed=False)

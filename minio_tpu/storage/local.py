@@ -437,14 +437,74 @@ class LocalStorage:
             os.close(fd)
             buf.close()
 
+    # Bulk reads at/above this size go O_DIRECT (mirror of the write
+    # path): GET/heal shard-window reads are read-once data that would
+    # otherwise churn the page cache the hot PUT path needs.
+    _DIRECT_READ_MIN = 1 << 20
+
     def read_file(self, volume: str, path: str, offset: int = 0,
                   length: int = -1) -> bytes:
+        full = self._obj_dir(volume, path)
         try:
-            with open(self._obj_dir(volume, path), "rb") as f:
+            if O_DIRECT_ENABLED:
+                want = length
+                if want < 0:
+                    try:
+                        want = max(0, os.path.getsize(full) - offset)
+                    except OSError:
+                        want = -1
+                if want >= self._DIRECT_READ_MIN:
+                    got = self._read_file_direct(full, offset, want)
+                    if got is not None:
+                        return got
+            with open(full, "rb") as f:
                 f.seek(offset)
                 return f.read() if length < 0 else f.read(length)
         except FileNotFoundError:
             raise FileNotFoundErr(f"{volume}/{path}") from None
+
+    def _read_file_direct(self, full: str, offset: int,
+                          length: int) -> Optional[bytes]:
+        """O_DIRECT read of [offset, offset+length) via a page-aligned
+        staging buffer (O_DIRECT demands aligned fd offset, memory and
+        transfer size; mmap pages satisfy the memory part — the read
+        counterpart of _create_file_direct's CopyAligned trick). None
+        means "cannot here" (filesystem refused, e.g. tmpfs/overlay) —
+        the caller falls back to the buffered path, nothing consumed.
+        MTPU_O_DIRECT=off never reaches this."""
+        import mmap
+        try:
+            fd = os.open(full, os.O_RDONLY | os.O_DIRECT)
+        except OSError:
+            # Includes FileNotFoundError: the buffered path re-opens
+            # and raises the proper not-found from its own attempt.
+            return None
+        align = self._ALIGN
+        lo = (offset // align) * align
+        head = offset - lo
+        buf = mmap.mmap(-1, 1 << 20)
+        out = bytearray()
+        try:
+            try:
+                os.lseek(fd, lo, os.SEEK_SET)
+                need = head + length
+                while need > 0:
+                    take = min(len(buf),
+                               (need + align - 1) // align * align)
+                    n = os.readv(fd, [memoryview(buf)[:take]])
+                    if n <= 0:
+                        break                    # EOF
+                    out += buf[:n]
+                    need -= n
+            except OSError:
+                # First read EINVAL (mount accepts open(O_DIRECT) but
+                # rejects the read) or a mid-stream fault: either way
+                # the buffered path re-reads from scratch.
+                return None
+            return bytes(out[head:head + length])
+        finally:
+            os.close(fd)
+            buf.close()
 
     def stat_info_file(self, volume: str, path: str) -> os.stat_result:
         try:

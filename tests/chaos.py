@@ -1,0 +1,150 @@
+"""Chaos harness: composable fault injection + concurrent client load.
+
+Builds S3 stacks whose drives misbehave on a programmed schedule —
+NaughtyDisk error schedules (storage/naughty.py), sleep-injected hung
+drives (the failure mode that trips the health breaker's op deadline
+rather than erroring), and killed grid peers — then drives them with
+concurrent clients and collects per-request outcomes, so the chaos
+tests (tests/test_chaos.py) can assert the degradation INVARIANTS:
+in-quorum traffic succeeds, out-of-quorum traffic fails fast with the
+right S3 error, shed traffic gets 503 + Retry-After, and nothing
+outlives its deadline budget.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from minio_tpu.object.erasure_object import ErasureSet
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.health import wrap_disks
+from minio_tpu.storage.local import LocalStorage
+from tests.s3client import S3Client
+
+
+class HungDisk:
+    """Sleep-injecting drive wrapper: selected ops (all by default)
+    block `delay` seconds before passing through — "the drive answers,
+    but glacially", which only op deadlines catch, never error
+    handling. release() unblocks every in-flight and future sleep so
+    teardown is instant."""
+
+    def __init__(self, disk, delay: float, ops: Optional[set] = None):
+        self._disk = disk
+        self.delay = delay
+        self.ops = set(ops) if ops else None
+        self._released = threading.Event()
+        self.hung_calls = 0
+        self._mu = threading.Lock()
+
+    @property
+    def wrapped(self):
+        return self._disk
+
+    @property
+    def endpoint(self):
+        return getattr(self._disk, "endpoint", "hung")
+
+    @property
+    def root(self):
+        return getattr(self._disk, "root", None)
+
+    def release(self) -> None:
+        self._released.set()
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._disk, name)
+        if not callable(attr):
+            return attr
+
+        def wrapped(*args, **kwargs):
+            if self.ops is None or name in self.ops:
+                with self._mu:
+                    self.hung_calls += 1
+                self._released.wait(self.delay)
+            return attr(*args, **kwargs)
+        return wrapped
+
+
+def build_set(tmp_path, n_disks: int = 4,
+              chaos: Optional[Callable[[int, object], object]] = None,
+              health: bool = True, op_timeout: float = 0.3,
+              bulk_timeout: float = 1.0, trip_after: int = 2,
+              cooldown: float = 60.0) -> ErasureSet:
+    """ErasureSet over local drives, each passed through `chaos(i, disk)`
+    (return a wrapper or the disk unchanged), then health-wrapped with
+    tight test-scale deadlines. cooldown defaults high so a tripped
+    breaker stays open for the rest of the test unless the test wants
+    half-open probes."""
+    disks: list = [LocalStorage(str(tmp_path / f"d{i}"))
+                   for i in range(n_disks)]
+    if chaos is not None:
+        disks = [chaos(i, d) or d for i, d in enumerate(disks)]
+    if health:
+        disks = wrap_disks(disks, op_timeout=op_timeout,
+                           bulk_timeout=bulk_timeout,
+                           trip_after=trip_after, cooldown=cooldown)
+    return ErasureSet(disks)
+
+
+def boot_server(object_layer, admission=None) -> S3Server:
+    """S3Server on an ephemeral port; `admission` (an
+    AdmissionController) replaces the env-derived default so tests
+    control gating without mutating process env."""
+    server = S3Server(object_layer, address="127.0.0.1:0")
+    if admission is not None:
+        server.admission = admission
+    server.start()
+    return server
+
+
+@dataclass
+class Outcome:
+    """One request's fate under load."""
+    status: int                    # HTTP status; 0 = transport error
+    seconds: float
+    headers: dict = field(default_factory=dict)
+    error: Optional[Exception] = None
+
+
+def run_load(address: str, work: Callable[[S3Client], tuple],
+             threads: int = 8, per_thread: int = 1,
+             timeout: float = 30.0) -> list[Outcome]:
+    """Fire `work(client) -> (status, headers, body)` from N concurrent
+    threads, `per_thread` times each, all released on one barrier so
+    the burst truly lands together. Returns every Outcome."""
+    outcomes: list[Outcome] = []
+    mu = threading.Lock()
+    barrier = threading.Barrier(threads)
+
+    def runner():
+        cli = S3Client(address, timeout=timeout)
+        barrier.wait()
+        for _ in range(per_thread):
+            t0 = time.monotonic()
+            try:
+                status, headers, _ = work(cli)
+                out = Outcome(status, time.monotonic() - t0,
+                              dict(headers))
+            except Exception as e:  # noqa: BLE001 - an outcome, not a bug
+                out = Outcome(0, time.monotonic() - t0, {}, e)
+            with mu:
+                outcomes.append(out)
+
+    ts = [threading.Thread(target=runner, daemon=True)
+          for _ in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=timeout + 30)
+    return outcomes
+
+
+def statuses(outcomes: list[Outcome]) -> dict[int, int]:
+    hist: dict[int, int] = {}
+    for o in outcomes:
+        hist[o.status] = hist.get(o.status, 0) + 1
+    return hist

@@ -8,6 +8,12 @@ import time
 
 import pytest
 
+from minio_tpu.crypto.kms import AESGCM as _AESGCM
+
+requires_crypto = pytest.mark.skipif(
+    _AESGCM is None,
+    reason="SSE needs the optional 'cryptography' wheel")
+
 from minio_tpu.object.batch import BatchError, BatchJobs, validate_job
 from minio_tpu.object.erasure_object import ErasureSet
 from minio_tpu.object.types import PutOptions
@@ -179,6 +185,7 @@ def test_remote_replicate_and_admin_api(tmp_path):
         dst_srv.stop()
 
 
+@requires_crypto
 def test_batch_keyrotate_reseals_sse_objects(tmp_path):
     """keyrotate (reference: cmd/batch-rotate.go): SSE-S3 objects'
     sealed data keys re-seal under a new named key in place — data

@@ -7,6 +7,12 @@ import time
 
 import pytest
 
+from minio_tpu.crypto.kms import AESGCM as _AESGCM
+
+requires_crypto = pytest.mark.skipif(
+    _AESGCM is None,
+    reason="SSE needs the optional 'cryptography' wheel")
+
 from minio_tpu.object import decom
 from minio_tpu.object.erasure_object import ErasureSet
 from minio_tpu.object.pools import ServerPools
@@ -191,6 +197,7 @@ def test_decommission_guards(layer):
         decom.Decommission(single, 0)
 
 
+@requires_crypto
 def test_decommission_preserves_sse_multipart(tmp_path):
     """The riskiest cross-feature seam this round: an SSE-S3 MULTIPART
     object (per-part DARE streams, per-part nonces in ObjectPartInfo)

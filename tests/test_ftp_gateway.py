@@ -8,6 +8,12 @@ import os
 
 import pytest
 
+from minio_tpu.crypto.kms import AESGCM as _AESGCM
+
+requires_crypto = pytest.mark.skipif(
+    _AESGCM is None,
+    reason="SSE needs the optional 'cryptography' wheel")
+
 from minio_tpu.gateway import FTPGateway
 from minio_tpu.iam import IAMSys
 from minio_tpu.object.erasure_object import ErasureSet
@@ -108,6 +114,7 @@ def test_path_escape_confined_to_namespace(gw):
     c.quit()
 
 
+@requires_crypto
 def test_stor_honors_bucket_default_sse(gw):
     """A bucket whose default-encryption config demands SSE must not
     store FTP uploads as plaintext — and RETR must decrypt, so both
@@ -152,6 +159,7 @@ def test_retr_decompresses(gw):
     c.quit()
 
 
+@requires_crypto
 def test_retr_sse_c_refused(gw):
     """SSE-C objects need a client-held key FTP cannot carry: RETR
     answers 550 instead of leaking ciphertext."""

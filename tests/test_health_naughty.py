@@ -1,0 +1,209 @@
+"""Breaker x NaughtyDisk interplay: what counts as breaker fuel.
+
+The health wrapper's circuit breaker must trip on INFRASTRUCTURE
+faults only (I/O errors, op timeouts), never on domain answers
+(missing files/volumes — the drive working correctly), never on the
+request's own deadline budget running out, and must re-admit a
+recovered drive through the half-open probe after cooldown.
+"""
+
+import time
+
+import pytest
+
+from minio_tpu.storage.health import DiskHealthWrapper
+from minio_tpu.storage.local import FaultyDisk, LocalStorage, VolumeNotFound
+from minio_tpu.storage.meta import FileNotFoundErr
+from minio_tpu.storage.naughty import NaughtyDisk
+from minio_tpu.utils import deadline as deadline_mod
+
+
+def _wrapped(tmp_path, naughty_kwargs=None, **health_kwargs):
+    disk = LocalStorage(str(tmp_path / "d"))
+    naughty = NaughtyDisk(disk, **(naughty_kwargs or {}))
+    kwargs = dict(op_timeout=0.5, trip_after=3, cooldown=60.0)
+    kwargs.update(health_kwargs)
+    return naughty, DiskHealthWrapper(naughty, **kwargs)
+
+
+def test_infra_errors_trip_breaker_and_fail_fast(tmp_path):
+    naughty, hd = _wrapped(tmp_path,
+                           {"default_err": OSError("injected io")})
+    for _ in range(3):
+        with pytest.raises(OSError):
+            hd.list_vols()
+    assert not hd.is_online()
+    # Breaker open: calls fail fast WITHOUT reaching the drive.
+    before = naughty.call_count
+    t0 = time.monotonic()
+    with pytest.raises(FaultyDisk):
+        hd.list_vols()
+    assert time.monotonic() - t0 < 0.1
+    assert naughty.call_count == before
+
+
+def test_domain_errors_are_never_fuel(tmp_path):
+    """Missing files/volumes are the storage layer working CORRECTLY;
+    even trip_after consecutive ones leave the breaker closed."""
+    naughty, hd = _wrapped(
+        tmp_path,
+        {"fail_ops": {"read_version": FileNotFoundErr("gone"),
+                      "stat_vol": VolumeNotFound("nope")}},
+        trip_after=2)
+    for _ in range(5):
+        with pytest.raises(FileNotFoundErr):
+            hd.read_version("b", "o")
+        with pytest.raises(VolumeNotFound):
+            hd.stat_vol("b")
+    assert hd.is_online()
+    assert hd._consecutive == 0
+
+
+def test_domain_error_resets_consecutive_infra_count(tmp_path):
+    """fault, domain-answer, fault must NOT trip a trip_after=2
+    breaker: the domain answer proves the drive is alive in between."""
+    naughty, hd = _wrapped(tmp_path, trip_after=2)
+    naughty.fail_ops["list_vols"] = OSError("io")
+    with pytest.raises(OSError):
+        hd.list_vols()
+    with pytest.raises(VolumeNotFound):
+        hd.stat_vol("missing-vol")       # real answer from the drive
+    with pytest.raises(OSError):
+        hd.list_vols()
+    assert hd.is_online()
+
+
+def test_half_open_probe_readmits_after_cooldown(tmp_path):
+    naughty, hd = _wrapped(tmp_path,
+                           {"default_err": OSError("injected io")},
+                           trip_after=2, cooldown=0.1)
+    for _ in range(2):
+        with pytest.raises(OSError):
+            hd.list_vols()
+    assert not hd.is_online()
+    # Drive recovers; before cooldown the breaker still fails fast.
+    naughty.default_err = None
+    with pytest.raises(FaultyDisk):
+        hd.list_vols()
+    time.sleep(0.15)
+    # Half-open probe passes through and closes the breaker.
+    assert hd.list_vols() == []
+    assert hd.is_online()
+    assert hd.list_vols() == []
+
+
+def test_failed_probe_restarts_cooldown(tmp_path):
+    naughty, hd = _wrapped(tmp_path,
+                           {"default_err": OSError("injected io")},
+                           trip_after=2, cooldown=0.15)
+    for _ in range(2):
+        with pytest.raises(OSError):
+            hd.list_vols()
+    time.sleep(0.2)
+    with pytest.raises(OSError):     # the probe itself fails
+        hd.list_vols()
+    # Immediately after the failed probe: open again, fail fast.
+    with pytest.raises(FaultyDisk):
+        hd.list_vols()
+    # After another full cooldown a fresh probe succeeds.
+    naughty.default_err = None
+    time.sleep(0.2)
+    assert hd.list_vols() == []
+    assert hd.is_online()
+
+
+def test_deadline_cut_probe_does_not_wedge_half_open():
+    """A half-open probe cut short by the REQUEST deadline proves
+    nothing: the probe slot must be released so a later (budgeted)
+    caller can still re-admit the recovered drive. Also: an already-
+    expired budget must fail BEFORE consuming the probe slot."""
+    class Flaky:
+        endpoint = "flaky"
+        mode = "fail"
+
+        def list_vols(self):
+            if self.mode == "fail":
+                raise OSError("io")
+            if self.mode == "slow":
+                time.sleep(0.3)
+            return []
+
+    disk = Flaky()
+    hd = DiskHealthWrapper(disk, op_timeout=5.0, trip_after=2,
+                           cooldown=0.1)
+    for _ in range(2):
+        with pytest.raises(OSError):
+            hd.list_vols()
+    assert not hd.is_online()
+    disk.mode = "slow"               # recovered, but not instant
+    time.sleep(0.15)
+    # Expired budget: rejected before the probe slot is consumed.
+    with deadline_mod.bind(deadline_mod.Deadline(0.0)):
+        with pytest.raises(deadline_mod.DeadlineExceeded):
+            hd.list_vols()
+    # Probe cut mid-op by a short budget: aborted, inconclusive.
+    with deadline_mod.bind(deadline_mod.Deadline(0.05)):
+        with pytest.raises(deadline_mod.DeadlineExceeded):
+            hd.list_vols()
+    assert not hd.is_online()        # still open, but not wedged:
+    disk.mode = "ok"
+    assert hd.list_vols() == []      # a healthy caller's probe closes it
+    assert hd.is_online()
+
+
+def test_clamped_expiry_streak_still_trips_dead_drive():
+    """A request budget permanently shorter than the op timeout must
+    not starve the breaker: repeated GENEROUS-window (>= 1 s) clamped
+    expiries on the same drive are evidence enough to trip, while
+    tiny-window expiries never count."""
+    class Dead:
+        endpoint = "dead"
+
+        def list_vols(self):
+            time.sleep(30)
+
+    hd = DiskHealthWrapper(Dead(), op_timeout=10.0, trip_after=2,
+                           cooldown=300.0)
+    # Tiny windows prove nothing, however many.
+    for _ in range(4):
+        with deadline_mod.bind(deadline_mod.Deadline(0.05)):
+            with pytest.raises(deadline_mod.DeadlineExceeded):
+                hd.list_vols()
+    assert hd.is_online()
+    # Whole-second windows of silence, trip_after in a row: trip.
+    for _ in range(2):
+        with deadline_mod.bind(deadline_mod.Deadline(1.1)):
+            with pytest.raises(deadline_mod.DeadlineExceeded):
+                hd.list_vols()
+    assert not hd.is_online()
+    # And the open breaker now fails fast, budget or no budget.
+    t0 = time.monotonic()
+    with pytest.raises(FaultyDisk):
+        hd.list_vols()
+    assert time.monotonic() - t0 < 0.5
+
+
+def test_request_deadline_exhaustion_is_not_fuel(tmp_path):
+    """An op cut short by the REQUEST's deadline budget (clamped below
+    the drive's own op timeout) raises DeadlineExceeded and never
+    counts against the drive."""
+    class Slow:
+        endpoint = "slow"
+
+        def list_vols(self):
+            time.sleep(0.3)
+            return []
+
+    hd = DiskHealthWrapper(Slow(), op_timeout=5.0, trip_after=1,
+                           cooldown=60.0)
+    with deadline_mod.bind(deadline_mod.Deadline(0.05)):
+        with pytest.raises(deadline_mod.DeadlineExceeded):
+            hd.list_vols()
+    assert hd.is_online()            # trip_after=1, yet still closed
+    with deadline_mod.bind(deadline_mod.Deadline(0.0)):
+        with pytest.raises(deadline_mod.DeadlineExceeded):
+            hd.list_vols()
+    assert hd.is_online()
+    # Without a deadline the same op completes and records success.
+    assert hd.list_vols() == []
+    assert hd.health_info()["ops"]["list_vols"]["count"] >= 1

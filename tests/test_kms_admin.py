@@ -8,6 +8,10 @@ import os
 
 import pytest
 
+pytest.importorskip(
+    "cryptography",
+    reason="SSE/KMS needs the optional 'cryptography' wheel")
+
 from minio_tpu.crypto.kms import KMS, KeyStore, KMSError
 from minio_tpu.object.erasure_object import ErasureSet
 from minio_tpu.s3.server import S3Server

@@ -8,6 +8,10 @@ import os
 
 import pytest
 
+pytest.importorskip(
+    "cryptography",
+    reason="SSE/KMS needs the optional 'cryptography' wheel")
+
 from minio_tpu.crypto import (EncryptingPayload, KMS, KMSError,
                               encrypt_stream_size, decrypt_packages,
                               package_range, plaintext_size, PACKAGE_SIZE)

@@ -190,3 +190,41 @@ def test_create_file_falls_back_without_odirect(tmp_path, monkeypatch):
     d.make_vol("v")
     d.create_file("v", "f", iter([b"q" * 9999]))
     assert d.read_file("v", "f") == b"q" * 9999
+
+
+def test_read_file_odirect_matches_buffered(tmp_path, monkeypatch):
+    """Bulk reads mirror the O_DIRECT write path: byte-identical to the
+    buffered path across aligned/unaligned offsets and lengths, at EOF,
+    and for whole-file reads (length=-1)."""
+    import os as _os
+
+    from minio_tpu.storage import local as local_mod
+    d = local_mod.LocalStorage(str(tmp_path / "odr"))
+    d.make_vol("v")
+    blob = bytes(range(256)) * ((3 << 20) // 256) + b"tail" * 33
+    d.create_file("v", "f", blob)
+    # Force the direct path by dropping the size floor; every case
+    # must match the buffered result exactly (including EOF clamps).
+    monkeypatch.setattr(local_mod.LocalStorage, "_DIRECT_READ_MIN", 1)
+    cases = [(0, len(blob)), (0, -1), (4096, 1 << 20),
+             (4097, (1 << 20) + 13), (123, 456789),
+             (len(blob) - 100, 100), (len(blob) - 7, 999),
+             (0, len(blob) + 5000)]
+    for off, ln in cases:
+        got = d.read_file("v", "f", offset=off, length=ln)
+        want = blob[off:] if ln < 0 else blob[off:off + ln]
+        assert got == want, (off, ln, len(got), len(want))
+    # The direct opener actually engaged (or cleanly fell back) —
+    # either way behavior is identical; exercise fallback explicitly.
+    monkeypatch.setattr(local_mod, "O_DIRECT_ENABLED", False)
+    assert d.read_file("v", "f", offset=11, length=1 << 20) == \
+        blob[11:11 + (1 << 20)]
+
+
+def test_read_file_odirect_missing_file_raises(tmp_path):
+    from minio_tpu.storage import local as local_mod
+    from minio_tpu.storage.meta import FileNotFoundErr
+    d = local_mod.LocalStorage(str(tmp_path / "odm"))
+    d.make_vol("v")
+    with pytest.raises(FileNotFoundErr):
+        d.read_file("v", "nope", offset=0, length=4 << 20)

@@ -12,6 +12,11 @@ import time
 
 import pytest
 
+pytest.importorskip(
+    "cryptography",
+    reason="OIDC tests sign real RS256 JWTs; the optional "
+           "'cryptography' wheel is not installed")
+
 from cryptography.hazmat.primitives import hashes, serialization
 from cryptography.hazmat.primitives.asymmetric import padding, rsa
 
