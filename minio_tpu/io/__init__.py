@@ -1,0 +1,17 @@
+"""I/O engine: the allocation- and process-level runtime the data path
+runs on.
+
+Three pillars (the host-side analogue of the reference's internal/bpool
+byte pools + its goroutine-per-connection front-end):
+
+  * bufpool  — tiered, reference-counted pool of O_DIRECT-aligned
+               buffers leased by the PUT/GET/heal hot paths instead of
+               fresh allocations per window (reference: internal/bpool).
+  * engine   — per-drive submission queues with fixed worker crews and
+               bounded depth, replacing the shared ad-hoc fan-out pool.
+  * workers  — pre-forked SO_REUSEPORT worker processes, each running
+               the full S3 handler stack (the multi-core escape from
+               the single GIL-shared ThreadingHTTPServer process).
+"""
+
+from minio_tpu.io.bufpool import BufferPool, Lease, global_pool  # noqa: F401

@@ -1,0 +1,190 @@
+"""Per-drive submission queues: fixed worker crews, bounded depth.
+
+The analogue of the reference's per-drive connection discipline: every
+drive owns a submission queue served by a small fixed crew of workers,
+replacing the one shared fan-out ThreadPoolExecutor whose 2n workers
+interleaved every request's shard ops across every drive. Properties:
+
+  * bounded depth — a saturated drive sheds new submissions with
+    EngineSaturated (per-disk fault isolation in the erasure layer
+    turns that into one drive error, counted against quorum) instead
+    of queueing unbounded;
+  * per-drive ordering pressure — one drive's ops serialize through
+    its own crew, so a slow drive convoys only itself, and seek-ish
+    interleaving across requests on one drive is bounded by the crew
+    size rather than by total concurrency;
+  * GIL-friendly workers — the ops the crews run are syscall- and
+    native-call-dominated (os I/O, fdatasync, ctypes kernels), which
+    all release the GIL; the crews are where the overlap happens;
+  * self-cleaning — idle workers exit after IDLE_EXIT_S and respawn on
+    demand, so sets created ad hoc (tests, sidecars) do not strand
+    threads beyond a short tail.
+
+Environment:
+  MTPU_IO_WORKERS  worker crew size per drive (default 2)
+  MTPU_IO_DEPTH    submission queue depth per drive (default 64)
+"""
+
+from __future__ import annotations
+
+import os
+import queue as queue_mod
+import threading
+from concurrent.futures import Future
+
+IDLE_EXIT_S = 10.0
+
+
+class EngineSaturated(Exception):
+    """A drive's submission queue is full past the waitable deadline."""
+
+
+def _env_int(key: str, default: int) -> int:
+    try:
+        v = int(os.environ.get(key, "") or default)
+        return v if v > 0 else default
+    except ValueError:
+        return default
+
+
+class DriveQueue:
+    """One drive's bounded submission queue + worker crew."""
+
+    def __init__(self, name: str, workers: int, depth: int):
+        self.name = name
+        self.max_workers = max(1, workers)
+        self.depth = max(1, depth)
+        # SimpleQueue: C-level put/get (queue.Queue's pure-Python
+        # Condition costs several GIL-held lock rounds per op — real
+        # money at 12 drives x every request). Depth is enforced from
+        # qsize(), approximate by one crew's width at worst.
+        self._q: queue_mod.SimpleQueue = queue_mod.SimpleQueue()
+        self._mu = threading.Lock()
+        self._alive = 0
+        self._closed = False
+        self.in_flight = 0
+        self.submitted_total = 0
+        self.rejected_total = 0
+
+    def submit(self, fn) -> Future:
+        """Queue `fn` for this drive; returns its Future. A full queue
+        sheds immediately with EngineSaturated — bounded depth, and a
+        saturated drive must not stall submissions to healthy ones."""
+        f: Future = Future()
+        self._enqueue((f, fn))
+        return f
+
+    def submit_nowait(self, fn) -> None:
+        """Fire-and-forget submission: `fn` owns its own result/error
+        delivery (the erasure fan-out's latch slots). Saves the Future
+        allocation + two lock/notify rounds per op on the hot path."""
+        self._enqueue((None, fn))
+
+    def _enqueue(self, item) -> None:
+        if self._closed:
+            # A post-close submission must fail fast: nobody will ever
+            # work the queue, and a silently parked job would hang its
+            # fan-out latch forever.
+            raise EngineSaturated(f"drive {self.name}: engine closed")
+        if self._q.qsize() >= self.depth:
+            # Saturated: shed IMMEDIATELY (bounded depth, not
+            # unbounded queueing). The erasure layer counts the shed
+            # against quorum like any other drive fault; waiting here
+            # would stall submission to every healthy drive behind
+            # this one in the fan-out loop — the convoy the per-drive
+            # queues exist to prevent.
+            with self._mu:
+                self.rejected_total += 1
+            raise EngineSaturated(
+                f"drive {self.name}: submission queue full "
+                f"({self.depth} deep)")
+        self._q.put(item)
+        spawn = False
+        with self._mu:
+            self.submitted_total += 1
+            # Spawn a worker when the backlog outruns the live crew
+            # (workers idle-exit; the crew regrows on demand).
+            if not self._closed and self._alive < self.max_workers \
+                    and (self._alive == 0 or not self._q.empty()):
+                self._alive += 1
+                spawn = True
+        if spawn:
+            threading.Thread(target=self._work, daemon=True,
+                             name=f"io-{self.name}").start()
+
+    def _work(self) -> None:
+        while True:
+            try:
+                item = self._q.get(timeout=IDLE_EXIT_S)
+            except queue_mod.Empty:
+                with self._mu:
+                    # Re-check under the lock: a submit landing between
+                    # the timeout and here must not strand its item
+                    # with a crew of zero.
+                    if self._q.empty() or self._closed:
+                        self._alive -= 1
+                        return
+                continue
+            if item is None:
+                with self._mu:
+                    self._alive -= 1
+                return
+            f, fn = item
+            if f is not None and not f.set_running_or_notify_cancel():
+                continue
+            with self._mu:
+                self.in_flight += 1
+            try:
+                if f is None:
+                    fn()        # fire-and-forget: fn delivers its own
+                else:
+                    f.set_result(fn())
+            except BaseException as e:  # noqa: BLE001 - ferried to caller
+                if f is not None:
+                    f.set_exception(e)
+            finally:
+                with self._mu:
+                    self.in_flight -= 1
+
+    def close(self) -> None:
+        with self._mu:
+            self._closed = True
+            alive = self._alive
+        for _ in range(alive):
+            self._q.put(None)   # busy workers also see _closed at idle
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "queued": self._q.qsize(),
+                "in_flight": self.in_flight,
+                "depth": self.depth,
+                "workers": self._alive,
+                "submitted_total": self.submitted_total,
+                "rejected_total": self.rejected_total,
+            }
+
+
+class IOEngine:
+    """The per-drive queues of one erasure set."""
+
+    def __init__(self, names, workers: int | None = None,
+                 depth: int | None = None):
+        workers = workers if workers is not None \
+            else _env_int("MTPU_IO_WORKERS", 2)
+        depth = depth if depth is not None \
+            else _env_int("MTPU_IO_DEPTH", 64)
+        self.queues = [DriveQueue(str(nm), workers, depth) for nm in names]
+
+    def submit(self, drive_idx: int, fn) -> Future:
+        return self.queues[drive_idx].submit(fn)
+
+    def submit_nowait(self, drive_idx: int, fn) -> None:
+        self.queues[drive_idx].submit_nowait(fn)
+
+    def close(self) -> None:
+        for q in self.queues:
+            q.close()
+
+    def stats(self) -> list[dict]:
+        return [q.stats() for q in self.queues]

@@ -1,0 +1,625 @@
+"""Pre-forked SO_REUSEPORT front-end: N worker processes, one port.
+
+The multi-core escape from the single GIL-shared ThreadingHTTPServer
+process (the reference's goroutine-per-request model spreads over all
+cores for free; CPython needs processes). Each worker binds the SAME
+(host, port) via SO_REUSEPORT — the kernel load-balances accepted
+connections across workers, no proxy hop — and runs the full S3Server
+handler stack over its own object-layer instance on the shared drives.
+
+Cross-process coordination:
+  * namespace locks — each worker's in-process NSLockMap is wrapped
+    with striped flock() files under the first drive's system volume
+    (FlockNSLock), so put/delete/heal of one key serialize across
+    workers exactly as they do across threads;
+  * cache invalidation — namespace mutations append to shared
+    generation files; workers pull-check them before serving listings
+    or trusting their bucket-meta TTL caches (the single-process
+    bump/TTL model, made multi-process);
+  * admission — MTPU_API_REQUESTS_MAX budgets divide across workers
+    (ceil), so the fleet-wide in-flight bound stays what the operator
+    configured;
+  * control pipes — every worker can ask the parent for a cluster
+    snapshot (per-worker in-flight, metrics state, admission, bufpool,
+    engine depths), so /minio/v2/metrics and admin info served by ANY
+    worker aggregate across ALL of them;
+  * lifecycle — parent forwards SIGTERM; workers stop accepting,
+    drain in-flight requests (S3Server.stop), and exit; the parent
+    reaps and restarts unexpectedly-dead workers (bounded).
+
+MTPU_HTTP_WORKERS: worker count (default = cores; 0/1 = today's
+in-process mode, used by tests and distributed deployments).
+"""
+
+from __future__ import annotations
+
+import errno
+import fcntl
+import json
+import os
+import signal
+import socket
+import struct
+import sys
+import threading
+import time
+import zlib
+from contextlib import contextmanager
+
+_NS_STRIPES = 128
+_READY_TIMEOUT = 60.0
+_DRAIN_TIMEOUT = 15.0
+_MAX_RESPAWNS = 10
+
+
+def worker_count_from_env(env=os.environ) -> int:
+    """Resolved MTPU_HTTP_WORKERS: default = cores; 0/1 = in-process."""
+    raw = env.get("MTPU_HTTP_WORKERS", "")
+    if raw.strip() == "":
+        return max(1, os.cpu_count() or 1)
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        return 1
+
+
+def reserve_port(host: str, port: int) -> tuple[socket.socket, int]:
+    """Bind (not listen) a SO_REUSEPORT socket to learn/hold the port:
+    workers then bind+listen the same address; the non-listening
+    reservation never receives connections but keeps the port ours
+    between forks."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+    s.bind((host, port))
+    return s, s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------------
+# cross-process locks
+# ---------------------------------------------------------------------------
+
+class FlockMutex:
+    """One exclusive cross-process lock (bucket-metadata RMW). Also
+    excludes threads within a process: flock is per open-file-
+    description and every acquire opens its own fd. The fd lives in
+    thread-local storage — on the shared instance, one thread's exit
+    would unlock/close another thread's acquisition."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._tls = threading.local()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+
+    def __enter__(self):
+        fd = os.open(self._path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+        except BaseException:
+            os.close(fd)
+            raise
+        stack = getattr(self._tls, "fds", None)
+        if stack is None:
+            stack = self._tls.fds = []
+        stack.append(fd)
+        return self
+
+    def __exit__(self, *exc):
+        fd = self._tls.fds.pop()
+        try:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+        finally:
+            os.close(fd)
+        return False
+
+
+class FlockNSLock:
+    """NSLockMap-compatible namespace locking that ALSO excludes other
+    worker processes: the in-process RW lock runs first (cheap, full
+    fidelity between threads), then a striped flock file is taken
+    SH/EX for the cross-process edge. Stripes bound the lock-file
+    population; two keys sharing a stripe only over-serialize, never
+    under-serialize."""
+
+    def __init__(self, lock_dir: str, inner=None):
+        from minio_tpu.object.nslock import NSLockMap
+        self._dir = lock_dir
+        os.makedirs(lock_dir, exist_ok=True)
+        self._inner = inner if inner is not None else NSLockMap()
+
+    def _stripe(self, volume: str, path: str) -> str:
+        h = zlib.crc32(f"{volume}/{path}".encode()) % _NS_STRIPES
+        return os.path.join(self._dir, f"ns-{h:03d}.lock")
+
+    @contextmanager
+    def _flocked(self, volume: str, path: str, op: int, timeout: float):
+        from minio_tpu.object.nslock import LockTimeout
+        fd = os.open(self._stripe(volume, path),
+                     os.O_CREAT | os.O_RDWR, 0o644)
+        deadline = time.monotonic() + timeout
+        try:
+            while True:
+                try:
+                    fcntl.flock(fd, op | fcntl.LOCK_NB)
+                    break
+                except OSError as e:
+                    if e.errno not in (errno.EAGAIN, errno.EACCES):
+                        raise
+                    if time.monotonic() >= deadline:
+                        raise LockTimeout(
+                            f"cross-worker lock on {volume}/{path}") \
+                            from None
+                    time.sleep(0.005)
+            try:
+                yield
+            finally:
+                fcntl.flock(fd, fcntl.LOCK_UN)
+        finally:
+            os.close(fd)
+
+    @contextmanager
+    def write(self, volume: str, path: str, timeout: float = 30.0):
+        with self._inner.write(volume, path, timeout):
+            with self._flocked(volume, path, fcntl.LOCK_EX, timeout):
+                yield
+
+    @contextmanager
+    def read(self, volume: str, path: str, timeout: float = 30.0):
+        with self._inner.read(volume, path, timeout):
+            with self._flocked(volume, path, fcntl.LOCK_SH, timeout):
+                yield
+
+
+# ---------------------------------------------------------------------------
+# shared generation files (pull-model cache invalidation)
+# ---------------------------------------------------------------------------
+
+class SharedGen:
+    """A monotonic cross-process generation: bump() appends one byte
+    (O_APPEND — atomic), changed() compares the observed size against
+    the last seen. Size inequality — not ordering — signals change, so
+    even truncation/recreation invalidates."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        self._last = -1
+
+    def bump(self) -> None:
+        fd = os.open(self.path, os.O_CREAT | os.O_WRONLY | os.O_APPEND,
+                     0o644)
+        try:
+            os.write(fd, b".")
+        finally:
+            os.close(fd)
+
+    def changed(self) -> bool:
+        try:
+            size = os.stat(self.path).st_size
+        except OSError:
+            size = 0
+        if size != self._last:
+            self._last = size
+            return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# control plane (parent <-> workers)
+# ---------------------------------------------------------------------------
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    blob = json.dumps(obj).encode()
+    sock.sendall(struct.pack(">I", len(blob)) + blob)
+
+
+def _recv_msg(sock: socket.socket, timeout: float = 5.0):
+    sock.settimeout(timeout)
+    head = b""
+    while len(head) < 4:
+        got = sock.recv(4 - len(head))
+        if not got:
+            raise ConnectionError("control peer closed")
+        head += got
+    (n,) = struct.unpack(">I", head)
+    blob = b""
+    while len(blob) < n:
+        got = sock.recv(n - len(blob))
+        if not got:
+            raise ConnectionError("control peer closed")
+        blob += got
+    return json.loads(blob)
+
+
+def _worker_stat(server, worker_id: int) -> dict:
+    """One worker's control-plane snapshot."""
+    from minio_tpu.io.bufpool import global_pool
+    from minio_tpu.s3.metrics import layer_sets
+    engine = []
+    for s in layer_sets(server.object_layer):
+        io_eng = getattr(s, "io", None)
+        if io_eng is not None:
+            engine.extend(io_eng.stats())
+    return {
+        "worker": worker_id,
+        "pid": os.getpid(),
+        "in_flight": server._inflight,
+        "metrics": server.metrics.state(),
+        "admission": server.admission.snapshot(),
+        "bufpool": global_pool().stats(),
+        "engine": engine,
+    }
+
+
+class WorkerContext:
+    """Everything a forked worker wires into its S3Server."""
+
+    def __init__(self, worker_id: int, total: int,
+                 query_sock: socket.socket, hub_sock: socket.socket):
+        self.worker_id = worker_id
+        self.total = total
+        self._query = query_sock       # parent asks US for stats
+        self._hub = hub_sock           # we ask parent for cluster stats
+        self._hub_mu = threading.Lock()
+
+    def attach(self, server) -> None:
+        """Wire the worker's server: control responder, cluster-stat
+        hook, cross-process locks + cache generations, divided
+        admission, drain-on-SIGTERM."""
+        from minio_tpu.s3.metrics import layer_sets
+
+        server.worker_id = self.worker_id
+        server.worker_total = self.total
+        server.admission = server.admission.divided(self.total)
+        server.cluster_stats = self.cluster_stats
+
+        root = _first_drive_root(server.object_layer)
+        if root is not None:
+            shared = os.path.join(root, ".mtpu.sys", "workers")
+            server.bucket_meta_lock = FlockMutex(
+                os.path.join(shared, "bucket-meta.lock"))
+            list_gen = SharedGen(os.path.join(shared, "list.gen"))
+            meta_gen = SharedGen(os.path.join(shared, "meta.gen"))
+            for s in layer_sets(server.object_layer):
+                _wire_set(s, shared, list_gen, meta_gen)
+
+        # Control responder: answer the parent's stat queries.
+        threading.Thread(target=self._serve_queries, args=(server,),
+                         daemon=True, name="worker-control").start()
+
+        def drain(signum, frame):
+            try:
+                server.stop()
+            finally:
+                os._exit(0)
+        signal.signal(signal.SIGTERM, drain)
+
+    def cluster_stats(self) -> list[dict]:
+        """All workers' snapshots, via the parent hub (self included)."""
+        with self._hub_mu:
+            _send_msg(self._hub, {"op": "cluster_stats"})
+            reply = _recv_msg(self._hub, timeout=5.0)
+        return reply.get("stats", [])
+
+    def _serve_queries(self, server) -> None:
+        while True:
+            try:
+                msg = _recv_msg(self._query, timeout=3600.0)
+            except socket.timeout:
+                continue
+            except (ConnectionError, OSError):
+                return
+            if msg.get("op") == "stat":
+                try:
+                    _send_msg(self._query, _worker_stat(
+                        server, self.worker_id))
+                except OSError:
+                    return
+
+
+def _first_drive_root(object_layer):
+    from minio_tpu.s3.metrics import layer_sets
+    for s in layer_sets(object_layer):
+        for d in s.disks:
+            root = getattr(d, "root", None)
+            if root:
+                return root
+    return None
+
+
+def _wire_set(s, shared_dir: str, list_gen: SharedGen,
+              meta_gen: SharedGen) -> None:
+    """One erasure set's cross-worker wiring: flock namespace locks,
+    and pull-model invalidation for the listing metacache and the
+    bucket-meta TTL caches."""
+    s.ns = FlockNSLock(os.path.join(shared_dir, "nslocks"), inner=s.ns)
+
+    mc = s.metacache
+    orig_bump = mc.bump
+
+    def bump(bucket: str, broadcast: bool = True):
+        orig_bump(bucket, broadcast)
+        list_gen.bump()
+    mc.bump = bump
+
+    orig_walk = mc.walk_for
+
+    def walk_for(es, bucket: str, prefix: str, start: str = ""):
+        if list_gen.changed():
+            # Another worker mutated some namespace since we last
+            # looked: orphan EVERY cached walk stream (coarse, but a
+            # re-walk is cheap next to serving a listing that misses
+            # committed objects). The registry — not just _gen — is
+            # the source of bucket names: a worker that never wrote
+            # locally has walks but no generation entries.
+            buckets = {k[0] for k in list(mc._walks)} | set(mc._gen) \
+                | {bucket}
+            for b in buckets:
+                orig_bump(b, False)
+        return orig_walk(es, bucket, prefix, start=start)
+    mc.walk_for = walk_for
+
+    orig_set_meta = s.set_bucket_meta
+
+    def set_bucket_meta(bucket: str, meta: dict):
+        orig_set_meta(bucket, meta)
+        meta_gen.bump()
+    s.set_bucket_meta = set_bucket_meta
+
+    orig_get_meta = s.get_bucket_meta
+
+    def get_bucket_meta(bucket: str):
+        if meta_gen.changed():
+            s.invalidate_bucket_meta()
+        return orig_get_meta(bucket)
+    s.get_bucket_meta = get_bucket_meta
+
+
+# ---------------------------------------------------------------------------
+# the pool
+# ---------------------------------------------------------------------------
+
+class WorkerPool:
+    """Fork + supervise N workers. `boot(address, reuse_port, ctx)`
+    runs IN THE CHILD and must build, attach (ctx.attach(server)) and
+    START an S3Server bound to `address` with SO_REUSEPORT."""
+
+    def __init__(self, address: str, n_workers: int, boot):
+        host, _, port_s = address.rpartition(":")
+        self.host = host or "127.0.0.1"
+        self.n = max(2, n_workers)
+        self.boot = boot
+        self._reserve, self.port = reserve_port(self.host, int(port_s or 0))
+        self.address = f"{self.host}:{self.port}"
+        self._children: dict[int, dict] = {}      # pid -> rec
+        # Lock-free snapshot of live pids for stop(): the SIGTERM
+        # handler runs on the MAIN thread between bytecodes, so it must
+        # never take _mu (the same thread may hold it in supervise/
+        # _spawn — a non-reentrant self-deadlock). The tuple reference
+        # is replaced atomically under _mu and read without it.
+        self._pids: tuple = ()
+        self._stopping = False
+        self._respawns = 0
+        self._mu = threading.Lock()
+
+    # -- child side ------------------------------------------------------
+
+    def _run_child(self, worker_id: int, query_child, hub_child,
+                   respawn: bool) -> None:
+        ctx = WorkerContext(worker_id, self.n, query_child, hub_child)
+        os.environ["MTPU_HTTP_WORKERS"] = "1"
+        os.environ["MTPU_WORKER_ID"] = str(worker_id)
+        if respawn:
+            # A respawned worker 0 boots while siblings are serving:
+            # the boot janitor (stale-staging sweep) must NOT run — it
+            # would delete their in-flight staged shards.
+            os.environ["MTPU_WORKER_RESPAWN"] = "1"
+        try:
+            self.boot(self.address, True, ctx)
+        except BaseException as e:  # noqa: BLE001 - child must not return
+            print(f"worker {worker_id} boot failed: {e}", file=sys.stderr)
+            os._exit(1)
+        while True:
+            time.sleep(3600)
+
+    # -- parent side -----------------------------------------------------
+
+    def _spawn(self, worker_id: int, respawn: bool = False) -> None:
+        query_parent, query_child = socket.socketpair()
+        hub_parent, hub_child = socket.socketpair()
+        pid = os.fork()
+        if pid == 0:
+            query_parent.close()
+            hub_parent.close()
+            self._reserve.close()
+            # The child owns only its own fate: drop the parent's
+            # child table so accidental parent-path calls cannot
+            # signal siblings.
+            self._children = {}
+            try:
+                self._run_child(worker_id, query_child, hub_child,
+                                respawn)
+            finally:
+                os._exit(0)
+        query_child.close()
+        hub_child.close()
+        rec = {"worker": worker_id, "pid": pid, "query": query_parent,
+               "hub": hub_parent, "qmu": threading.Lock()}
+        with self._mu:
+            self._children[pid] = rec
+            self._pids = tuple(self._children)
+        threading.Thread(target=self._serve_hub, args=(rec,),
+                         daemon=True, name=f"hub-{worker_id}").start()
+
+    def _serve_hub(self, rec) -> None:
+        """Answer one child's cluster-stat requests."""
+        while True:
+            try:
+                msg = _recv_msg(rec["hub"], timeout=3600.0)
+            except socket.timeout:
+                continue
+            except (ConnectionError, OSError):
+                return
+            if msg.get("op") == "cluster_stats":
+                try:
+                    _send_msg(rec["hub"],
+                              {"stats": self._collect_stats()})
+                except OSError:
+                    return
+
+    def _collect_stats(self) -> list[dict]:
+        out = []
+        with self._mu:
+            recs = list(self._children.values())
+        for rec in sorted(recs, key=lambda r: r["worker"]):
+            try:
+                with rec["qmu"]:
+                    _send_msg(rec["query"], {"op": "stat"})
+                    out.append(_recv_msg(rec["query"], timeout=3.0))
+            except (OSError, ConnectionError, socket.timeout):
+                out.append({"worker": rec["worker"], "pid": rec["pid"],
+                            "unreachable": True})
+        return out
+
+    def start(self) -> None:
+        """Fork worker 0, wait until it accepts (its boot initializes
+        shared on-disk state — formats, system volumes — exactly once),
+        then fork the rest."""
+        self._spawn(0)
+        self._wait_ready()
+        for wid in range(1, self.n):
+            self._spawn(wid)
+        signal.signal(signal.SIGTERM, lambda s, f: self.stop())
+        signal.signal(signal.SIGINT, lambda s, f: self.stop())
+
+    def _wait_ready(self) -> None:
+        deadline = time.monotonic() + _READY_TIMEOUT
+        with self._mu:
+            pid0 = next(iter(self._children))
+        while time.monotonic() < deadline:
+            done, status = os.waitpid(pid0, os.WNOHANG)
+            if done:
+                # supervise() never sees this pid again; drop it here.
+                with self._mu:
+                    self._children.pop(pid0, None)
+                    self._pids = tuple(self._children)
+                raise RuntimeError(
+                    f"worker 0 died during boot (status {status})")
+            try:
+                probe = socket.create_connection(
+                    (self.host, self.port), timeout=1.0)
+                probe.close()
+                return
+            except OSError:
+                time.sleep(0.1)
+        raise RuntimeError("worker 0 never started accepting")
+
+    def supervise(self) -> int:
+        """Reap children; restart unexpected deaths (bounded); return
+        once all children are gone after stop()."""
+        while True:
+            try:
+                pid, status = os.wait()
+            except ChildProcessError:
+                return 0
+            except InterruptedError:
+                continue
+            with self._mu:
+                rec = self._children.pop(pid, None)
+                self._pids = tuple(self._children)
+            if rec is None:
+                continue
+            for end in ("query", "hub"):
+                try:
+                    rec[end].close()
+                except OSError:
+                    pass
+            if self._stopping:
+                with self._mu:
+                    if not self._children:
+                        return 0
+                continue
+            self._respawns += 1
+            if self._respawns > _MAX_RESPAWNS:
+                print("too many worker deaths; shutting down",
+                      file=sys.stderr)
+                self.stop()
+                continue
+            print(f"worker {rec['worker']} (pid {pid}) died "
+                  f"(status {status}); respawning", file=sys.stderr)
+            self._spawn(rec["worker"], respawn=True)
+
+    def stop(self) -> None:
+        """Graceful drain: SIGTERM every worker (they stop accepting,
+        finish in-flight requests, exit); SIGKILL stragglers. SIGNAL-
+        SAFE: runs as the SIGTERM/SIGINT handler on the main thread,
+        which may be inside a _mu critical section — so this touches
+        only the lock-free _pids snapshot; the reaper thread does the
+        locked bookkeeping."""
+        self._stopping = True
+        for pid in self._pids:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        deadline = time.monotonic() + _DRAIN_TIMEOUT
+
+        def reaper():
+            while time.monotonic() < deadline:
+                if not self._pids:
+                    return
+                time.sleep(0.1)
+            for pid in self._pids:
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+        threading.Thread(target=reaper, daemon=True).start()
+
+
+def serve_cli(argv, address: str, n_workers: int, main_fn) -> int:
+    """CLI glue for `python -m minio_tpu.server`: fork n_workers
+    children that each re-enter main_fn with the concrete address and
+    MTPU_HTTP_WORKERS=1 (the child's normal single-process boot), the
+    parent supervising. main_fn sees MTPU_WORKER_CTX via
+    maybe_attach_worker at serve time."""
+
+    def boot(concrete_addr: str, reuse_port: bool, ctx: WorkerContext):
+        global _PENDING_CTX
+        _PENDING_CTX = ctx
+        os.environ["MTPU_REUSE_PORT"] = "1"
+        child_argv = _swap_address(argv, address, concrete_addr)
+        code = main_fn(child_argv)
+        os._exit(code or 0)
+
+    pool = WorkerPool(address, n_workers, boot)
+    print(f"minio-tpu pre-forked front-end: {pool.n} workers on "
+          f"{pool.address} (SO_REUSEPORT)", flush=True)
+    pool.start()
+    return pool.supervise()
+
+
+def _swap_address(argv, old: str, new: str):
+    out = list(argv)
+    for i, a in enumerate(out):
+        if a == old:
+            out[i] = new
+        elif a.startswith("--address=") and a[len("--address="):] == old:
+            out[i] = f"--address={new}"
+    if new not in out and not any(a.startswith("--address") for a in out):
+        out = ["--address", new] + out
+    return out
+
+
+# Set by serve_cli's child boot before re-entering server main; consumed
+# by maybe_attach_worker when the child's S3Server is ready.
+_PENDING_CTX: WorkerContext | None = None
+
+
+def maybe_attach_worker(server) -> None:
+    """Called by the server boot just before serving: if this process
+    is a pre-forked worker (serve_cli child), wire it up."""
+    global _PENDING_CTX
+    ctx, _PENDING_CTX = _PENDING_CTX, None
+    if ctx is not None:
+        ctx.attach(server)

@@ -57,20 +57,43 @@ def load():
             lib = ctypes.CDLL(_SO)
         except Exception:
             return None
-        u8p = ctypes.POINTER(ctypes.c_uint8)
-        lib.mtpu_hh256.argtypes = [u8p, u8p, ctypes.c_size_t, u8p]
-        lib.mtpu_hh256.restype = None
-        lib.mtpu_hh256_many.argtypes = [u8p, u8p, ctypes.c_size_t,
-                                        ctypes.c_size_t, ctypes.c_size_t, u8p]
-        lib.mtpu_hh256_many.restype = None
-        lib.mtpu_xxh64.argtypes = [u8p, ctypes.c_size_t, ctypes.c_uint64]
-        lib.mtpu_xxh64.restype = ctypes.c_uint64
-        lib.mtpu_gf_apply.argtypes = [u8p, ctypes.c_size_t, ctypes.c_size_t,
-                                      u8p, ctypes.c_size_t, ctypes.c_size_t,
-                                      u8p, ctypes.c_size_t]
-        lib.mtpu_gf_apply.restype = None
+        # A stale .so can predate newer symbols (e.g. mtpu_put_frame)
+        # even when mtimes look fresh: declare, and on a missing
+        # symbol rebuild once and re-declare.
+        try:
+            _declare(lib)
+        except AttributeError:
+            if not _build():
+                return None
+            lib = ctypes.CDLL(_SO)
+            try:
+                _declare(lib)
+            except AttributeError:
+                return None
         _lib = lib
         return _lib
+
+
+def _declare(lib) -> None:
+    """ctypes prototypes for every exported symbol — the ONE place the
+    C ABI is spelled on the Python side (raises AttributeError when the
+    loaded .so lacks a symbol)."""
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    for name, argt in (
+            ("mtpu_hh256", [u8p, u8p, ctypes.c_size_t, u8p]),
+            ("mtpu_hh256_many", [u8p, u8p, ctypes.c_size_t,
+                                 ctypes.c_size_t, ctypes.c_size_t, u8p]),
+            ("mtpu_gf_apply", [u8p, ctypes.c_size_t, ctypes.c_size_t,
+                               u8p, ctypes.c_size_t, ctypes.c_size_t,
+                               u8p, ctypes.c_size_t]),
+            ("mtpu_put_frame", [u8p, u8p, u8p, ctypes.c_size_t,
+                                ctypes.c_size_t, ctypes.c_size_t,
+                                ctypes.c_size_t, u8p])):
+        fn = getattr(lib, name)
+        fn.argtypes = argt
+        fn.restype = None
+    lib.mtpu_xxh64.argtypes = [u8p, ctypes.c_size_t, ctypes.c_uint64]
+    lib.mtpu_xxh64.restype = ctypes.c_uint64
 
 
 def _u8(arr) -> "ctypes.POINTER(ctypes.c_uint8)":

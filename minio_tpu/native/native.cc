@@ -426,4 +426,50 @@ void mtpu_gf_apply(const uint8_t* matrix, size_t r, size_t k,
   }
 }
 
+// ---------------------------------------------------------------------------
+// Fused PUT framing: GF parity + HighwayHash-256 + on-disk interleave
+// ---------------------------------------------------------------------------
+//
+// The whole host-side PutObject hot loop in one GIL-free call: for each
+// erasure block, compute the m parity rows (same coding matrix as
+// mtpu_gf_apply), then emit every shard's on-disk frame
+// `digest || block` directly into per-shard-file contiguous output —
+// no intermediate shard tensors, no Python-side interleave copies.
+//
+//   data: full * k * S bytes, block-major ([full][k][S]); each block's
+//         k data rows are the stripe split of one BLOCK_SIZE chunk.
+//   out:  n * full * (32 + S) bytes, shard-major — shard i's framed
+//         file body is out[i * full * (32+S) ..).
+//
+// Byte-identical to frame_shards_batch(encode(data)) by construction:
+// the same GF tables produce the parity, the same HighwayHash-256
+// produces the digests, and the frame layout is digest-then-block
+// (reference: cmd/bitrot-streaming.go:44-75).
+
+void mtpu_put_frame(const uint8_t* key32, const uint8_t* matrix,
+                    const uint8_t* data, size_t full, size_t k, size_t m,
+                    size_t S, uint8_t* out) {
+  const size_t n = k + m;
+  const size_t frame = 32 + S;
+  const size_t shard_span = full * frame;
+  for (size_t b = 0; b < full; ++b) {
+    const uint8_t* block = data + b * k * S;
+    // Data rows: copy into their frames.
+    for (size_t j = 0; j < k; ++j)
+      std::memcpy(out + j * shard_span + b * frame + 32, block + j * S, S);
+    // Parity rows: GF apply straight into the output frames (the rows
+    // of one block land in DIFFERENT shard files => out_stride spans
+    // a whole shard file).
+    if (m)
+      mtpu_gf_apply(matrix, m, k, block, S, S,
+                    out + k * shard_span + b * frame + 32, shard_span);
+  }
+  // Bitrot digests over every framed block (data + parity alike).
+  for (size_t i = 0; i < n; ++i) {
+    uint8_t* shard = out + i * shard_span;
+    for (size_t b = 0; b < full; ++b)
+      mtpu_hh256(key32, shard + b * frame + 32, S, shard + b * frame);
+  }
+}
+
 }  // extern "C"

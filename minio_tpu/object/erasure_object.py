@@ -29,12 +29,13 @@ import threading
 import time as _time_mod
 import zlib
 from concurrent.futures import ThreadPoolExecutor
-from concurrent.futures import TimeoutError as FutureTimeout
 from typing import Optional, Sequence
 
 import numpy as np
 
 from minio_tpu.erasure.codec import CodecError, Erasure, ceil_frac
+from minio_tpu.io.bufpool import global_pool
+from minio_tpu.io.engine import EngineSaturated, IOEngine
 from minio_tpu.utils import deadline as deadline_mod
 from minio_tpu.utils.deadline import DeadlineExceeded
 from minio_tpu.object.types import (BucketExists, BucketInfo, BucketNotEmpty,
@@ -172,6 +173,11 @@ class ErasureSet:
         self.default_parity = default_parity(n) if parity is None else parity
         self.backend = backend
         self.pool = pool or ThreadPoolExecutor(max_workers=max(8, 2 * n))
+        # Per-drive submission queues (io/engine.py): aligned fan-outs
+        # ride these fixed crews instead of the shared pool, so one
+        # drive's backlog convoys only itself and depth stays bounded.
+        self.io = IOEngine([getattr(d, "endpoint", "") or str(i)
+                            for i, d in enumerate(self.disks)])
         from minio_tpu.object.nslock import NSLockMap
         self.ns = NSLockMap()
         self._mrf = None
@@ -195,6 +201,7 @@ class ErasureSet:
             if self._mrf is not None:
                 self._mrf.stop()
         self.pool.shutdown(wait=False)
+        self.io.close()
 
     @property
     def mrf(self):
@@ -275,52 +282,87 @@ class ErasureSet:
     def _fanout(self, fns):
         """Run one callable per disk in parallel; returns (results, errors).
 
-        The caller's request deadline (utils/deadline.py) is re-bound
+        A fns list aligned with self.disks (the common case: one op per
+        drive) routes each entry through that drive's engine queue
+        (io/engine.py) — bounded depth, fixed crew; anything else
+        (subset cleanups, ad-hoc shapes) uses the shared pool. Jobs are
+        fire-and-forget into shared result slots with ONE countdown
+        latch for collection (one caller wait per fan-out, not one per
+        drive — future-per-op handoff cost is real at 12+ drives). The
+        caller's request deadline (utils/deadline.py) is re-bound
         inside each worker thread — thread locals do not cross the pool
         boundary on their own — and bounds the collection wait, so one
         hung drive can never hold the whole request past its budget."""
         dl = deadline_mod.current()
+        n = len(fns)
         if dl is not None and dl.expired():
             # Budget already spent: answer without touching any drive.
             err = DeadlineExceeded("request deadline exceeded")
-            return [None] * len(fns), [err] * len(fns)
+            return [None] * n, [err] * n
 
-        def bound(fn):
+        results: list = [None] * n
+        errors: list = [None] * n
+        done: list = [False] * n
+        pending = sum(1 for fn in fns if fn)
+        if pending == 0:
+            return results, [StorageError("disk offline")] * n
+        all_done = threading.Event()
+        latch_mu = threading.Lock()
+        latch = [pending]
+
+        def finish_one():
+            with latch_mu:
+                latch[0] -= 1
+                if latch[0] == 0:
+                    all_done.set()
+
+        def make_job(i, fn):
             def run():
-                with deadline_mod.bind(dl):
-                    return fn()
+                try:
+                    with deadline_mod.bind(dl):
+                        results[i] = fn()
+                except BaseException as e:  # noqa: BLE001 - per-disk isolation
+                    errors[i] = e
+                finally:
+                    done[i] = True
+                    finish_one()
             return run
 
-        futures = [self.pool.submit(bound(fn)) if fn else None
-                   for fn in fns]
-        # One ABSOLUTE collection deadline for the whole fan-out: the
-        # slop must not stack per hung future, or n stuck drives
-        # overshoot the budget n times over.
-        collect_by = None if dl is None \
-            else dl.expires_at + self._FANOUT_DEADLINE_SLOP
-        results, errors = [], []
-        for f in futures:
-            if f is None:
-                results.append(None)
-                errors.append(StorageError("disk offline"))
+        per_drive = n == len(self.disks)
+        for i, fn in enumerate(fns):
+            if not fn:
+                errors[i] = StorageError("disk offline")
                 continue
-            try:
-                if collect_by is None:
-                    results.append(f.result())
-                else:
-                    results.append(f.result(timeout=max(
-                        0.0, collect_by - _time_mod.monotonic())))
-                errors.append(None)
-            except FutureTimeout:
-                # The worker is stuck on something that ignores
-                # deadlines; leave it to finish unobserved and move on.
-                results.append(None)
-                errors.append(DeadlineExceeded(
-                    "request deadline exceeded in drive fan-out"))
-            except Exception as e:  # noqa: BLE001 - per-disk fault isolation
-                results.append(None)
-                errors.append(e)
-        return results, errors
+            job = make_job(i, fn)
+            if per_drive:
+                try:
+                    self.io.submit_nowait(i, job)
+                except EngineSaturated as e:
+                    # A saturated drive queue is a drive fault for THIS
+                    # op: surfaced per disk, counted against quorum.
+                    errors[i] = StorageError(str(e))
+                    done[i] = True
+                    finish_one()
+            else:
+                self.pool.submit(job)
+        # One ABSOLUTE collection deadline for the whole fan-out: the
+        # slop must not stack per hung worker, or n stuck drives
+        # overshoot the budget n times over.
+        if dl is None:
+            all_done.wait()
+        else:
+            collect_by = dl.expires_at + self._FANOUT_DEADLINE_SLOP
+            if not all_done.wait(timeout=max(
+                    0.0, collect_by - _time_mod.monotonic())):
+                # Workers stuck on something that ignores deadlines:
+                # mark their slots and leave them to finish unobserved
+                # (late completions write results nobody reads — the
+                # snapshot below is what callers see).
+                for i in range(n):
+                    if fns[i] and not done[i]:
+                        errors[i] = DeadlineExceeded(
+                            "request deadline exceeded in drive fan-out")
+        return list(results), list(errors)
 
     def _cleanup_fanout(self, fns):
         """Best-effort rollback/cleanup fan-out, SHIELDED from the
@@ -643,29 +685,65 @@ class ErasureSet:
         return np.stack([be.apply_matrix(pm, stacked[b])
                          for b in range(stacked.shape[0])])
 
-    def _encode_and_frame(self, data: bytes, k: int, m: int,
-                          pad_blocks: int = 0) -> list[list]:
-        """Encode + bitrot-frame the object: per-drive lists of framed
-        byte chunks (shard index order), ready to write as shard files.
+    def _frame_pooled(self, data: bytes, k: int, m: int, full: int,
+                      shard_size: int):
+        """Fused HOST encode+frame into a pooled aligned buffer: GF
+        parity + HighwayHash + `digest || block` interleave in ONE
+        GIL-free native call (native/native.cc mtpu_put_frame), output
+        leased from the buffer pool instead of fresh per-put arrays.
+        Returns (chunks, lease) covering the FULL blocks — chunks[i] a
+        single memoryview into the lease — or None when the native
+        library, the shape, or the algorithm rules it out."""
+        if bitrot.DEFAULT_ALGORITHM != bitrot.HIGHWAYHASH256S \
+                or k * shard_size != BLOCK_SIZE:
+            return None
+        from minio_tpu import native
+        lib = native.load()
+        if lib is None:
+            return None
+        n = k + m
+        hsize = bitrot.digest_size(bitrot.DEFAULT_ALGORITHM)
+        frame = hsize + shard_size
+        span = full * frame
+        lease = global_pool().lease(n * span)
+        import ctypes
+
+        from minio_tpu.utils.highwayhash import MAGIC_KEY
+        src = np.frombuffer(data, dtype=np.uint8, count=full * BLOCK_SIZE)
+        pm = np.ascontiguousarray(_parity_matrix(k, m)) if m \
+            else np.zeros((0, k), dtype=np.uint8)
+        out = (ctypes.c_uint8 * (n * span)).from_buffer(lease.raw)
+        try:
+            lib.mtpu_put_frame(
+                native._u8(MAGIC_KEY), native._u8(pm),
+                src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                full, k, m, shard_size, out)
+        except BaseException:
+            lease.release()
+            raise
+        mv = lease.view(n * span)
+        return [[mv[i * span:(i + 1) * span]] for i in range(n)], lease
+
+    def _frame_windows(self, data: bytes, k: int, m: int):
+        """Encode + bitrot-frame the object: (chunks, lease) where
+        chunks is per-drive lists of framed byte chunks (shard index
+        order) ready to write as shard files, and lease is a bufpool
+        Lease the chunks view into (None when they own their bytes).
+        The caller must release the lease — exactly once — after the
+        chunks have been consumed; retain() it per concurrent consumer.
 
         On TPU with an eligible shape the full 1 MiB blocks run through
         the fused device pipeline (RS parity + HighwayHash + on-disk
-        framing in one pass, ops/hh_device) and only the ragged tail
-        block is framed on the host. Everywhere else this is the
-        host/XLA batched path (byte-identical output).
-
-        pad_blocks: retained for call-site compatibility; batch-shape
-        stability is now the stripe batcher's job (it pads coalesced
-        batches to fixed buckets, so compiled shapes stay bounded no
-        matter how requests interleave).
+        framing in one pass, ops/hh_device); on the host they run
+        through the fused native kernel into a pooled buffer. Fallback
+        is the batched numpy path (byte-identical output everywhere).
         """
-        del pad_blocks
         e = self._erasure(k, m)
         n = k + m
         total = len(data)
         shard_size = e.shard_size()
         if total == 0:
-            return [[b""] for _ in range(n)]
+            return [[b""] for _ in range(n)], None
         full = total // BLOCK_SIZE
         # Honor the set's injected backend seam: the fused framer runs
         # only when this set was explicitly configured with a device
@@ -679,31 +757,68 @@ class ErasureSet:
         # with zero added latency (ops/batcher.py).
         use_device = (full >= 1 and m > 0 and _on_tpu()
                       and hasattr(self.backend, "apply_matrix_device")
-                      and BLOCK_SIZE % k == 0 and shard_size % 1024 == 0)
-        if not use_device:
-            shards = self._encode_object(data, k, m)
-            return [[f] for f in bitrot.frame_shards_batch(shards, shard_size)]
+                      and BLOCK_SIZE % k == 0 and shard_size % 1024 == 0
+                      # Once the batcher's calibration resolves to
+                      # host, skip its queue entirely: the pooled
+                      # native path below IS the fast host path.
+                      and _batcher_for(k, m).wants_device())
         chunks: list[list] = [[] for _ in range(n)]
-        buf = np.frombuffer(data, dtype=np.uint8, count=full * BLOCK_SIZE)
-        stacked = buf.reshape(full, k, shard_size)
-        rows = _batcher_for(k, m).frame(stacked)
-        # rows[i] = per-block (digest, block) piece tuples. The
-        # `hash || block` on-disk frame is assembled by the writer from
-        # the pieces (reference cmd/bitrot-streaming.go:44-75 likewise
-        # writes hash then block; no interleaved buffer ever exists).
-        for i in range(n):
-            for pieces in rows[i][:full]:
-                chunks[i].extend(pieces)
+        lease = None
+        if use_device:
+            buf = np.frombuffer(data, dtype=np.uint8,
+                                count=full * BLOCK_SIZE)
+            stacked = buf.reshape(full, k, shard_size)
+            rows = _batcher_for(k, m).frame(stacked)
+            # rows[i] = per-block (digest, block) piece tuples. The
+            # `hash || block` on-disk frame is assembled by the writer
+            # from the pieces (reference cmd/bitrot-streaming.go:44-75
+            # likewise writes hash then block; no interleaved buffer
+            # ever exists).
+            for i in range(n):
+                for pieces in rows[i][:full]:
+                    chunks[i].extend(pieces)
+        elif full:
+            pooled = self._frame_pooled(data, k, m, full, shard_size)
+            if pooled is not None:
+                chunks, lease = pooled
+            else:
+                shards = self._encode_object(
+                    data[:full * BLOCK_SIZE] if total % BLOCK_SIZE
+                    else data, k, m)
+                chunks = [[f] for f in
+                          bitrot.frame_shards_batch(shards, shard_size)]
         tail = total - full * BLOCK_SIZE
         if tail:
             tail_shards = e.split(data[full * BLOCK_SIZE:])
             parity = np.asarray(e.backend.apply_matrix(
-                _parity_matrix(k, m), tail_shards))
+                _parity_matrix(k, m), tail_shards)) if m else \
+                np.zeros((0, tail_shards.shape[1]), dtype=np.uint8)
             framed_tail = bitrot.frame_shards_batch(
-                np.concatenate([tail_shards, parity], axis=0), shard_size)
+                np.concatenate([tail_shards, parity], axis=0)
+                if m else tail_shards, shard_size)
             for i in range(n):
                 chunks[i].append(framed_tail[i])
-        return chunks
+        return chunks, lease
+
+    def _encode_and_frame(self, data: bytes, k: int, m: int,
+                          pad_blocks: int = 0) -> list[list]:
+        """Compatibility wrapper over _frame_windows for callers that
+        want self-owned bytes (decom/restore paths, tests): any pooled
+        views are copied out and the lease returns immediately.
+
+        pad_blocks: retained for call-site compatibility; batch-shape
+        stability is the stripe batcher's job (it pads coalesced
+        batches to fixed buckets, so compiled shapes stay bounded no
+        matter how requests interleave).
+        """
+        del pad_blocks
+        chunks, lease = self._frame_windows(data, k, m)
+        if lease is None:
+            return chunks
+        try:
+            return [[bytes(c) for c in row] for row in chunks]
+        finally:
+            lease.release()
 
     # ------------------------------------------------------------------
     # PutObject
@@ -736,7 +851,7 @@ class ErasureSet:
         # commit fan-out below serializes against other ops on this key.
         e = self._erasure(k, m)
         shard_size = e.shard_size()
-        framed = self._encode_and_frame(data, k, m)
+        framed, frames_lease = self._frame_windows(data, k, m)
 
         etag = opts.etag or hashlib.md5(data).hexdigest()
         version_id = opts.version_id or (new_uuid() if opts.versioned else "")
@@ -744,6 +859,14 @@ class ErasureSet:
         shard_file_len = e.shard_file_size(len(data))
         inline = shard_file_len <= SMALL_FILE_THRESHOLD and not opts.versioned \
             or shard_file_len <= SMALL_FILE_THRESHOLD // 8
+        if inline and frames_lease is not None:
+            # Inline data commits straight into xl.meta (no staging +
+            # rename gate), so the journal must never reference pooled
+            # memory a recycled buffer could tear under a late writer:
+            # copy out now and return the lease immediately.
+            framed = [[bytes(c) for c in row] for row in framed]
+            frames_lease.release()
+            frames_lease = None
 
         data_dir = "" if inline else new_uuid()
         metadata = _clean_user_meta(opts.user_metadata)
@@ -780,9 +903,18 @@ class ErasureSet:
                               list(framed[shard_idx]))
                 d.rename_data(SYS_VOL, staging, fi, bucket, object_)
 
-        with self.ns.write(bucket, object_):
-            _, errors = self._fanout(
-                [lambda i=i: write_one(i) for i in range(n)])
+        try:
+            with self.ns.write(bucket, object_):
+                _, errors = self._fanout(
+                    _leased_fns([lambda i=i: write_one(i)
+                                 for i in range(n)], frames_lease))
+        finally:
+            # The producer's reference, released even when the lock
+            # times out; per-drive references (_leased_fns) are
+            # returned by the workers themselves.
+            if frames_lease is not None:
+                frames_lease.release()
+                frames_lease = None
         ok = sum(e is None for e in errors)
         if ok < write_quorum:
             # Best-effort cleanup: committed versions on the disks that
@@ -983,6 +1115,26 @@ class ErasureSet:
             return False
 
         def writer(i: int):
+            # Release hook for the window row currently being consumed:
+            # rows framed into pooled buffers carry a per-consumer
+            # reference (bufpool.Lease.retain) that must return exactly
+            # once — at the next queue pull (row fully written), in the
+            # drain loop (row skipped), or when the writer dies
+            # mid-row. TWO threads can reach the in-flight hook (this
+            # writer thread's finally, and a deadline-abandoned
+            # health-pool worker still driving gen()), so the handoff
+            # swaps the callback out under a lock: whoever swaps it
+            # runs it, nobody runs it twice.
+            in_mu = threading.Lock()
+            inflight: list = []
+
+            def finish_inflight():
+                with in_mu:
+                    cbs, inflight[:] = list(inflight), []
+                for cb in cbs:
+                    if cb is not None:
+                        cb()
+
             try:
                 with deadline_mod.bind(dl):
                     disk, vol, path = path_for(i)
@@ -990,15 +1142,27 @@ class ErasureSet:
                     def gen():
                         while True:
                             c = qs[i].get()
+                            finish_inflight()
                             if got_sentinel(i, c):
                                 return
-                            yield from c
+                            row, cb = c
+                            with in_mu:
+                                inflight.append(cb)
+                            yield from row
                     disk.create_file(vol, path, gen())
             except Exception as exc:  # noqa: BLE001 - collected for quorum
                 errors[i] = exc
                 dead[i] = True
                 while not sentinel_seen[i]:
-                    got_sentinel(i, qs[i].get())
+                    c = qs[i].get()
+                    if not got_sentinel(i, c):
+                        # Drain-owned rows never enter inflight: this
+                        # thread is their only holder.
+                        _, cb = c
+                        if cb is not None:
+                            cb()
+            finally:
+                finish_inflight()
 
         import threading
         threads = [threading.Thread(target=writer, args=(i,), daemon=True)
@@ -1016,14 +1180,26 @@ class ErasureSet:
                 if not window:
                     break
                 md5.update(window)
-                framed = self._encode_and_frame(
-                    window, k, m, pad_blocks=STREAM_WINDOW_BLOCKS)
-                if n - sum(dead) < write_quorum:
-                    raise WriteQuorumError(
-                        "", "", f"{sum(dead)}/{n} writers failed mid-stream")
-                for i in range(n):
-                    if not dead[i]:
-                        qs[i].put(framed[distribution[i] - 1])
+                window_lease = None
+                try:
+                    framed, window_lease = self._frame_windows(window, k, m)
+                    if n - sum(dead) < write_quorum:
+                        raise WriteQuorumError(
+                            "", "",
+                            f"{sum(dead)}/{n} writers failed mid-stream")
+                    for i in range(n):
+                        if dead[i]:
+                            continue
+                        cb = None
+                        if window_lease is not None:
+                            window_lease.retain()
+                            cb = window_lease.release
+                        qs[i].put((framed[distribution[i] - 1], cb))
+                finally:
+                    # The producer's own reference; per-writer refs are
+                    # returned by each consumer.
+                    if window_lease is not None:
+                        window_lease.release()
         except Exception as exc:  # noqa: BLE001 - unwind writers first
             stream_error = exc
         finally:
@@ -1365,16 +1541,34 @@ class ErasureSet:
             return bitrot.read_framed_blocks_many(
                 blobs, shard_size, win_len, device=use_device)
 
+        def fetch_many(shard_idxs):
+            """Fetch a set of shards through their holders' per-drive
+            engine queues: the fns list is aligned with self.disks (so
+            _fanout routes it per drive), results return in shard
+            order. Shards with no holder stay None."""
+            n_disks = len(self.disks)
+            fns: list = [None] * n_disks
+            pos: dict[int, int] = {}
+            for s in shard_idxs:
+                di = holders.get(s)
+                if di is None:
+                    continue
+                pos[s] = di
+                fns[di] = (lambda s=s: fetch_raw(s))
+            results, errs = self._fanout(fns)
+            return ([results[pos[s]] if s in pos else None
+                     for s in shard_idxs],
+                    [errs[pos[s]] if s in pos else None
+                     for s in shard_idxs])
+
         # Read data shards first; hedge with parity shards for failures.
         shards: list[Optional[np.ndarray]] = [None] * n
-        results, ferrs = self._fanout([lambda s=s: fetch_raw(s)
-                                       for s in range(k)])
+        results, ferrs = fetch_many(range(k))
         for s, r in enumerate(verify(results)):
             shards[s] = r
         missing = [s for s in range(k) if shards[s] is None]
         if missing:
-            extra, ferrs2 = self._fanout([lambda s=s: fetch_raw(s)
-                                          for s in range(k, n)])
+            extra, ferrs2 = fetch_many(range(k, n))
             for j, r in enumerate(verify(extra)):
                 shards[k + j] = r
             available = sum(1 for s in shards if s is not None)
@@ -1906,6 +2100,34 @@ def _swallow(fn):
         fn()
     except Exception:  # noqa: BLE001
         pass
+
+
+def _leased_fns(fns, lease):
+    """Wrap per-drive fan-out callables so each holds its own reference
+    on `lease` until its op truly completes: fan-out collection may
+    abandon a future on deadline while the drive worker is still
+    reading the pooled memory, and an unreferenced buffer recycled
+    under a live reader is silent shard corruption. Each wrapper
+    releases exactly once, in the worker's own thread. (A wrapper that
+    never runs — engine shed, pre-expired deadline — parks its
+    reference until GC, where the pool's leak net returns and counts
+    it.) No-op when lease is None."""
+    if lease is None:
+        return fns
+    out = []
+    for fn in fns:
+        if fn is None:
+            out.append(None)
+            continue
+        lease.retain()
+
+        def run(fn=fn):
+            try:
+                return fn()
+            finally:
+                lease.release()
+        out.append(run)
+    return out
 
 
 def _raise_for_quorum(errors, exc, quorum=None, ok=None):

@@ -175,7 +175,11 @@ def put_object_part(es, bucket: str, object_: str, upload_id: str,
                               mod_time=meta["mod_time"], nonce=nonce)
 
     body = payload.read_all()
-    framed = es._encode_and_frame(body, k, m)
+    # Pool-leased fused framing (io/bufpool + native mtpu_put_frame):
+    # each drive's writer holds its own lease reference until its shard
+    # write truly finishes (_leased_fns), so a deadline-abandoned
+    # writer can never read a recycled window buffer.
+    framed, frames_lease = es._frame_windows(body, k, m)
     etag = hashlib.md5(body).hexdigest()
     meta = {"number": part_number, "size": size,
             "actual_size": logical, "etag": etag, "mod_time": now_ns(),
@@ -189,8 +193,12 @@ def put_object_part(es, bucket: str, object_: str, upload_id: str,
         d.write_all(eo.SYS_VOL, f"{updir}/part.{part_number}.meta",
                     json.dumps(meta).encode())
 
-    _, errors = es._fanout(
-        [lambda i=i: write_one(i) for i in range(n)])
+    try:
+        _, errors = es._fanout(eo._leased_fns(
+            [lambda i=i: write_one(i) for i in range(n)], frames_lease))
+    finally:
+        if frames_lease is not None:
+            frames_lease.release()
     if sum(e2 is None for e2 in errors) < write_quorum:
         raise WriteQuorumError(bucket, object_)
     return ObjectPartInfo(number=part_number, size=size,

@@ -128,12 +128,39 @@ class StripeBatcher:
         threading.Thread(target=probe, daemon=False,
                          name="stripe-batcher-probe").start()
 
+    def wants_device(self) -> bool:
+        """False only once calibration has RESOLVED to host — the
+        caller can then skip the batcher entirely (its own host path is
+        at least as good, without the queue/lock hop). Unprobed (None)
+        answers True so traffic keeps flowing through frame() until the
+        probe settles."""
+        return self._device_ok is not False
+
+    def force(self, device_ok: bool) -> None:
+        """Pin the calibration verdict (bench/tests): no probe runs,
+        dispatch follows `device_ok` unconditionally."""
+        with self._mu:
+            self._probe_started = True
+            self._device_ok = bool(device_ok)
+
+    def reset_calibration(self) -> None:
+        """Back to unprobed (bench/tests cleanup after force())."""
+        with self._mu:
+            self._probe_started = False
+            self._device_ok = None
+
     # -- submission -----------------------------------------------------
 
     def frame(self, stacked: np.ndarray):
         """Frame one request's stripe window [B, k, L]; blocks until
         the (possibly coalesced) result is ready. Returns per-drive
         rows for exactly this window's blocks."""
+        if self._device_ok is False:
+            # Calibration resolved to host: genuinely free pass-through
+            # — no lock, no inflight bookkeeping, no condition-variable
+            # hop, just the host codec (the unlocked read is safe: the
+            # verdict transitions once, None -> True/False).
+            return self._host_fn(stacked)
         big = stacked.shape[0] >= self._min_device_blocks
         with self._mu:
             self._inflight += 1

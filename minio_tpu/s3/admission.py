@@ -204,6 +204,25 @@ class AdmissionController:
                 env.get("MTPU_API_REQUEST_TIMEOUT", ""), 0.0),
         )
 
+    def divided(self, workers: int) -> "AdmissionController":
+        """This controller's budgets split across `workers` pre-forked
+        processes (io/workers.py): per-worker limit = ceil(limit / n),
+        so the fleet-wide in-flight bound stays what the operator
+        configured (within rounding). 0 (unlimited) stays 0; the
+        per-request deadline budget is per request, not per fleet, and
+        passes through unchanged."""
+        if workers <= 1:
+            return self
+        def split(limit: int) -> int:
+            return math.ceil(limit / workers) if limit > 0 else 0
+        s3 = self.gates[CLASS_S3]
+        admin = self.gates[CLASS_ADMIN]
+        return AdmissionController(
+            max_requests=split(s3.limit),
+            wait_deadline=s3.wait_deadline,
+            admin_max_requests=split(admin.limit),
+            request_timeout=self.request_timeout)
+
     def classify(self, raw_path: str) -> str:
         """Admin, health, and metrics endpoints ride the admin gate —
         an operator diagnosing an overloaded server must not queue

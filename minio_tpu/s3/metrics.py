@@ -9,6 +9,7 @@ rendered in Prometheus text exposition format at
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 
@@ -34,9 +35,27 @@ class Metrics:
             self._bytes_rx += rx
             self._bytes_tx += tx
 
+    def state(self) -> dict:
+        """JSON-safe counter snapshot for cross-worker aggregation
+        (io/workers.py control pipe)."""
+        with self._mu:
+            return {
+                "requests": [[a, s, v]
+                             for (a, s), v in self._requests.items()],
+                "latency_sum": dict(self._latency_sum),
+                "latency_count": dict(self._latency_count),
+                "rx": self._bytes_rx,
+                "tx": self._bytes_tx,
+            }
+
     # -- rendering -------------------------------------------------------
 
-    def render(self, object_layer=None, scanner=None, server=None) -> str:
+    def render(self, object_layer=None, scanner=None, server=None,
+               peer_states=None) -> str:
+        """Prometheus text. With `peer_states` (every worker's control
+        snapshot, this worker included), request counters render as
+        the FLEET totals and per-worker gauges are appended — one
+        scrape of any worker sees the whole front-end."""
         lines: list[str] = []
 
         def metric(name, help_, type_, samples):
@@ -54,6 +73,20 @@ class Metrics:
             lat_sum = dict(self._latency_sum)
             lat_count = dict(self._latency_count)
             rx, tx = self._bytes_rx, self._bytes_tx
+        peer_metrics = [p["metrics"] for p in (peer_states or [])
+                        if isinstance(p.get("metrics"), dict)]
+        if peer_metrics:
+            reqs, lat_sum, lat_count = {}, {}, {}
+            rx = tx = 0
+            for st in peer_metrics:
+                for a, s, v in st.get("requests", []):
+                    reqs[(a, s)] = reqs.get((a, s), 0) + v
+                for a, v in st.get("latency_sum", {}).items():
+                    lat_sum[a] = lat_sum.get(a, 0.0) + v
+                for a, v in st.get("latency_count", {}).items():
+                    lat_count[a] = lat_count.get(a, 0) + v
+                rx += st.get("rx", 0)
+                tx += st.get("tx", 0)
 
         metric("minio_tpu_http_requests_total",
                "HTTP requests by API and status class", "counter",
@@ -211,6 +244,66 @@ class Metrics:
                            "Objects the drain failed to migrate",
                            "counter", [({}, st.get("failed", 0))])
 
+        # -- I/O engine observability (io/bufpool + io/engine) ----------
+        # Saturation diagnosis: pool hit rate says whether hot paths
+        # recycle window buffers; outstanding/leaks say whether leases
+        # return; per-drive queue depth says which drive is the wall.
+        from minio_tpu.io.bufpool import global_pool
+        bp = global_pool().stats()
+        for name, help_, type_, key in (
+                ("minio_tpu_bufpool_hits_total",
+                 "Buffer leases served from the pool", "counter", "hits"),
+                ("minio_tpu_bufpool_misses_total",
+                 "Buffer leases that allocated fresh memory", "counter",
+                 "misses"),
+                ("minio_tpu_bufpool_oversized_total",
+                 "Leases larger than every size class (unpooled)",
+                 "counter", "oversized"),
+                ("minio_tpu_bufpool_outstanding",
+                 "Leases currently held", "gauge", "outstanding"),
+                ("minio_tpu_bufpool_leaks_total",
+                 "Dropped leases returned by the leak net", "counter",
+                 "leaks"),
+                ("minio_tpu_bufpool_idle_bytes",
+                 "Bytes parked on pool free lists", "gauge",
+                 "idle_bytes")):
+            metric(name, help_, type_, [({}, bp[key])])
+        if object_layer is not None:
+            samples_q, samples_f, samples_r = [], [], []
+            for si, s in enumerate(layer_sets(object_layer)):
+                eng = getattr(s, "io", None)
+                if eng is None:
+                    continue
+                for di, st in enumerate(eng.stats()):
+                    lab = {"set": si, "drive": di}
+                    samples_q.append((lab, st["queued"]))
+                    samples_f.append((lab, st["in_flight"]))
+                    samples_r.append((lab, st["rejected_total"]))
+            metric("minio_tpu_drive_queue_depth",
+                   "Ops waiting in each drive's submission queue",
+                   "gauge", samples_q)
+            metric("minio_tpu_drive_queue_in_flight",
+                   "Ops executing on each drive's worker crew",
+                   "gauge", samples_f)
+            metric("minio_tpu_drive_queue_rejected_total",
+                   "Submissions shed by bounded drive queues",
+                   "counter", samples_r)
+        if peer_states:
+            metric("minio_tpu_worker_in_flight",
+                   "In-flight requests per pre-forked worker", "gauge",
+                   [({"worker": p.get("worker", "?")},
+                     p.get("in_flight", 0))
+                    for p in peer_states if not p.get("unreachable")])
+            metric("minio_tpu_worker_up",
+                   "Pre-forked worker control-plane reachability",
+                   "gauge",
+                   [({"worker": p.get("worker", "?")},
+                     0 if p.get("unreachable") else 1)
+                    for p in peer_states])
+            metric("minio_tpu_workers_total",
+                   "Configured pre-forked worker count", "gauge",
+                   [({}, len(peer_states))])
+
         return "\n".join(lines) + "\n"
 
 
@@ -290,4 +383,26 @@ def node_info(server) -> dict:
         # facing view of admission control (reference: madmin info's
         # requests fields).
         info["admission"] = adm.snapshot()
+    # I/O engine: pool + per-drive queue health (and, in worker mode,
+    # the whole fleet's per-worker snapshots via the control pipe).
+    from minio_tpu.io.bufpool import global_pool
+    info["bufpool"] = global_pool().stats()
+    engine = []
+    for si, s in enumerate(sets):
+        eng = getattr(s, "io", None)
+        if eng is not None:
+            engine.append({"set": si, "drives": eng.stats()})
+    info["io_engine"] = engine
+    cluster = getattr(server, "cluster_stats", None)
+    if cluster is not None:
+        try:
+            info["workers"] = [
+                {k: p.get(k) for k in ("worker", "pid", "in_flight",
+                                       "unreachable", "bufpool")
+                 if k in p}
+                for p in cluster()]
+        except Exception:  # noqa: BLE001 - control plane down; own view
+            info["workers"] = [{"worker": getattr(server, "worker_id", 0),
+                                "pid": os.getpid(),
+                                "in_flight": server._inflight}]
     return info

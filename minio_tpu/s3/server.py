@@ -15,6 +15,7 @@ import email.utils
 import hashlib
 import time as _time_mod
 import os
+import socket as socket_mod
 import threading
 import urllib.parse
 import xml.etree.ElementTree as ET
@@ -102,16 +103,40 @@ class Credentials:
         return None
 
 
+class _ReusePortHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that joins an SO_REUSEPORT group: every
+    pre-forked worker (io/workers.py) binds the same (host, port) and
+    the kernel spreads accepted connections across their independent
+    accept queues — no proxy hop, no shared listener lock."""
+
+    def server_bind(self):
+        self.socket.setsockopt(socket_mod.SOL_SOCKET,
+                               socket_mod.SO_REUSEPORT, 1)
+        super().server_bind()
+
+
 class S3Server:
     def __init__(self, object_layer, address: str = "127.0.0.1:9000",
-                 credentials: Credentials | None = None):
+                 credentials: Credentials | None = None,
+                 reuse_port: bool | None = None):
         self.object_layer = object_layer
         self.credentials = credentials or Credentials()
         host, _, port = address.rpartition(":")
         handler = _make_handler(self)
-        self.httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)),
-                                         handler)
+        if reuse_port is None:
+            reuse_port = os.environ.get("MTPU_REUSE_PORT", "") \
+                in ("1", "on", "true")
+        server_cls = _ReusePortHTTPServer if reuse_port \
+            else ThreadingHTTPServer
+        self.httpd = server_cls((host or "127.0.0.1", int(port)), handler)
         self.httpd.daemon_threads = True
+        # Pre-forked worker identity (io/workers.py attaches these;
+        # single-process mode is worker 0 of 1). cluster_stats, when
+        # set, answers every worker's control-plane snapshot so
+        # metrics/admin info aggregate across the fleet.
+        self.worker_id = 0
+        self.worker_total = 1
+        self.cluster_stats = None
         self._thread: threading.Thread | None = None
         # Serializes read-modify-write of bucket metadata (policy /
         # tagging / versioning toggles) within this process; cross-node
@@ -545,11 +570,20 @@ def _make_handler(server: S3Server):
                 if raw_path == "/minio/health/ready":
                     return self._health_ready()
                 if admission_path_class(raw_path) == "metrics":
+                    # Worker mode: whichever worker the kernel handed
+                    # this scrape to aggregates the whole fleet via
+                    # the parent control pipe (io/workers.py).
+                    peers = None
+                    if server.cluster_stats is not None:
+                        try:
+                            peers = server.cluster_stats()
+                        except Exception:  # noqa: BLE001 - serve own
+                            peers = None
                     text = server.metrics.render(
                         object_layer=server.object_layer,
                         scanner=getattr(server.object_layer, "scanner",
                                         None),
-                        server=server)
+                        server=server, peer_states=peers)
                     return self._send(200, text.encode(),
                                       content_type="text/plain; "
                                       "version=0.0.4")

@@ -77,35 +77,6 @@ def main(argv=None) -> int:
                          "argument forms its own server pool")
     args = ap.parse_args(argv)
 
-    # Boot self-tests: identical math to the reference or refuse to serve.
-    from minio_tpu.erasure.selftest import erasure_self_test
-    from minio_tpu.storage.bitrot import bitrot_self_test
-    erasure_self_test()
-    bitrot_self_test()
-
-    backend = None
-    if args.ec_backend == "tpu":
-        from minio_tpu.ops.rs_device import DeviceBackend
-        backend = DeviceBackend()
-    elif args.ec_backend == "auto":
-        try:
-            import jax
-            if jax.default_backend() == "tpu":
-                from minio_tpu.ops.rs_device import DeviceBackend
-                backend = DeviceBackend()
-        except Exception as e:  # noqa: BLE001 - no JAX device -> host math
-            print(f"ec-backend auto-detect: no TPU ({type(e).__name__}: {e}); "
-                  "using host GF kernels", file=sys.stderr)
-            backend = None
-    if backend is not None:
-        # Boot gate for the DEVICE kernels too: the golden-vector sweep
-        # with the host cutover disabled, so the Pallas/XLA GF path that
-        # large PUTs will actually run is what gets verified (the plain
-        # erasure_self_test above covers the host core only — its
-        # 256-byte vectors are all below HOST_CUTOVER_BYTES).
-        from minio_tpu.ops.rs_device import DeviceBackend
-        erasure_self_test(DeviceBackend(host_cutover=0))
-
     from minio_tpu.object.erasure_object import ErasureSet
     from minio_tpu.object.pools import ServerPools
     from minio_tpu.object.sets import ErasureSets
@@ -135,6 +106,72 @@ def main(argv=None) -> int:
     remote_nodes = sorted({(ep.host, ep.port) for ep in all_eps
                            if not is_local(ep)})
     distributed = bool(remote_nodes)
+
+    # Argument validation that must fail in THIS process, before any
+    # worker fork: a bad flag erroring only inside a forked child
+    # would leave a supervising parent waiting on nothing.
+    for spec in pool_eps:
+        try:
+            ss = args.set_size or ellipses.choose_set_size(len(spec))
+        except ValueError as e:
+            ap.error(str(e))
+        if len(spec) % ss:
+            ap.error(f"{len(spec)} drives not divisible into sets "
+                     f"of {ss}")
+        if args.parity is not None and not 0 <= args.parity <= ss // 2:
+            ap.error(f"--parity must be in [0, {ss // 2}] for "
+                     f"{ss}-drive sets")
+
+    # Pre-forked SO_REUSEPORT front-end (io/workers.py): N worker
+    # processes each run this whole boot (MTPU_HTTP_WORKERS=1 in the
+    # children prevents recursion). MUST run before self-tests and
+    # ec-backend detection: those may import and initialize JAX, and
+    # forking a process with a live XLA runtime (its thread pools, a
+    # claimed TPU device) is undefined — every child does its own
+    # detection instead. Default = cores; distributed topologies keep
+    # one process per node (each worker would need its own grid port —
+    # the mesh already spreads load across nodes).
+    from minio_tpu.io import workers as workers_mod
+    worker_id = os.environ.get("MTPU_WORKER_ID", "")
+    if not worker_id:
+        n_workers = workers_mod.worker_count_from_env()
+        if n_workers > 1:
+            if distributed:
+                print("WARN: MTPU_HTTP_WORKERS > 1 is single-node only; "
+                      "serving from one process", file=sys.stderr)
+            else:
+                return workers_mod.serve_cli(
+                    list(argv) if argv is not None else sys.argv[1:],
+                    args.address, n_workers, main)
+
+    # Boot self-tests: identical math to the reference or refuse to serve.
+    from minio_tpu.erasure.selftest import erasure_self_test
+    from minio_tpu.storage.bitrot import bitrot_self_test
+    erasure_self_test()
+    bitrot_self_test()
+
+    backend = None
+    if args.ec_backend == "tpu":
+        from minio_tpu.ops.rs_device import DeviceBackend
+        backend = DeviceBackend()
+    elif args.ec_backend == "auto":
+        try:
+            import jax
+            if jax.default_backend() == "tpu":
+                from minio_tpu.ops.rs_device import DeviceBackend
+                backend = DeviceBackend()
+        except Exception as e:  # noqa: BLE001 - no JAX device -> host math
+            print(f"ec-backend auto-detect: no TPU ({type(e).__name__}: {e}); "
+                  "using host GF kernels", file=sys.stderr)
+            backend = None
+    if backend is not None:
+        # Boot gate for the DEVICE kernels too: the golden-vector sweep
+        # with the host cutover disabled, so the Pallas/XLA GF path that
+        # large PUTs will actually run is what gets verified (the plain
+        # erasure_self_test above covers the host core only — its
+        # 256-byte vectors are all below HOST_CUTOVER_BYTES).
+        from minio_tpu.ops.rs_device import DeviceBackend
+        erasure_self_test(DeviceBackend(host_cutover=0))
 
     # -- grid mesh up BEFORE the object layer (reference: initGlobalGrid
     #    precedes newObjectLayer, cmd/server-main.go:882-942) ----------
@@ -187,16 +224,10 @@ def main(argv=None) -> int:
     n_sets = n_drives = 0
     for spec in pool_eps:
         disks = [make_disk(ep) for ep in spec]
-        try:
-            set_size = args.set_size or ellipses.choose_set_size(len(disks))
-        except ValueError as e:
-            ap.error(str(e))
-        if len(disks) % set_size:
-            ap.error(f"{len(disks)} drives not divisible into sets "
-                     f"of {set_size}")
-        if args.parity is not None and not 0 <= args.parity <= set_size // 2:
-            ap.error(f"--parity must be in [0, {set_size // 2}] for "
-                     f"{set_size}-drive sets")
+        # Set-size/divisibility/parity were validated pre-fork above
+        # (they must error in the parent, not inside a worker child);
+        # this recomputation cannot fail.
+        set_size = args.set_size or ellipses.choose_set_size(len(disks))
 
         # Only the node owning the pool's first endpoint initializes a
         # fresh format; everyone else waits for it to appear (reference:
@@ -233,13 +264,18 @@ def main(argv=None) -> int:
                    for i, d in enumerate(ordered)]
         # Boot janitor: crashed PUTs leave staged shards under the
         # system volume; sweep them before serving (reference sweeps
-        # .minio.sys/tmp at startup).
-        from minio_tpu.storage.local import sweep_stale_tmp
-        for d in ordered:
-            try:
-                sweep_stale_tmp(d)
-            except Exception:  # noqa: BLE001 - janitor never blocks boot
-                pass
+        # .minio.sys/tmp at startup). First-boot worker 0 only:
+        # siblings (and a RESPAWNED worker 0) boot while others are
+        # already serving, and sweeping then would destroy their
+        # in-flight staged writes.
+        if worker_id in ("", "0") \
+                and not os.environ.get("MTPU_WORKER_RESPAWN"):
+            from minio_tpu.storage.local import sweep_stale_tmp
+            for d in ordered:
+                try:
+                    sweep_stale_tmp(d)
+                except Exception:  # noqa: BLE001 - janitor never blocks boot
+                    pass
         # Deadline + circuit-breaker wrapper: a hung (not dead) drive
         # fails fast instead of stalling every quorum fan-out
         # (reference: cmd/xl-storage-disk-id-check.go).
@@ -302,7 +338,10 @@ def main(argv=None) -> int:
     # object (reference: cmd/bucket-lifecycle.go via the scanner).
     from minio_tpu.object.lifecycle import make_scanner_hook
     scanner.on_object.append(make_scanner_hook())
-    if args.scanner_interval > 0:
+    # Worker mode: background sweeps (scanner, heal sampling) run on
+    # worker 0 only — the drives are shared, and n workers scanning
+    # the same sets would multiply every heal/ILM action by n.
+    if args.scanner_interval > 0 and worker_id in ("", "0"):
         scanner.start()
     layer.scanner = scanner
     # IAM: users/service-accounts/policies, replicated on pool 0's
@@ -335,13 +374,15 @@ def main(argv=None) -> int:
     from minio_tpu.object.batch import BatchJobs
     srv.batch = BatchJobs(layer, pools[0].sets)
     srv.batch.kms = srv.kms
-    try:
-        resumed = srv.batch.resume_all()
-        if resumed:
-            print(f"resumed {resumed} interrupted batch job(s)",
-                  flush=True)
-    except Exception as e:  # noqa: BLE001 - batch must not block boot
-        print(f"WARN: batch resume failed: {e}", file=sys.stderr)
+    if worker_id in ("", "0"):
+        # Checkpointed batch jobs resume once, not once per worker.
+        try:
+            resumed = srv.batch.resume_all()
+            if resumed:
+                print(f"resumed {resumed} interrupted batch job(s)",
+                      flush=True)
+        except Exception as e:  # noqa: BLE001 - batch must not block boot
+            print(f"WARN: batch resume failed: {e}", file=sys.stderr)
     srv.compression = args.compression
     # Persisted config overrides flags (the flags seed first boot).
     from minio_tpu.s3 import config as cfg_mod
@@ -439,9 +480,14 @@ def main(argv=None) -> int:
         ftp = FTPGateway(layer, creds, address=args.ftp_address)
         ftp.start()
         print(f"minio-tpu serving FTP on {ftp.address}", flush=True)
+    # Pre-forked worker wiring (no-op outside worker mode): control
+    # pipes, divided admission budgets, cross-process locks and cache
+    # generations, SIGTERM drain.
+    workers_mod.maybe_attach_worker(srv)
     print(f"minio-tpu serving S3 on {srv.address} "
           f"({len(pools)} pools, {n_sets} sets, {n_drives} drives, "
           f"{'distributed, ' if distributed else ''}"
+          f"{'worker ' + worker_id + ', ' if worker_id else ''}"
           f"ec-backend={'tpu' if backend else 'host'})", flush=True)
     srv.start()
     try:

@@ -353,24 +353,34 @@ class LocalStorage:
 
     def _create_file_direct(self, dest: str, chunks) -> bool:
         """O_DIRECT streaming write; returns False (with NOTHING
-        consumed or written) when O_DIRECT cannot be used here."""
+        consumed or written) when O_DIRECT cannot be used here.
+
+        The aligned staging buffer is LEASED from the buffer pool
+        (io/bufpool) rather than mmap'd fresh per call — at steady
+        state the shard-write path allocates nothing. The lease is
+        acquired and released on this thread, so a deadline-abandoned
+        health-wrapper call can never leave a recycled buffer exposed."""
         import fcntl
-        import mmap
+
+        from minio_tpu.io.bufpool import global_pool
         try:
             fd = os.open(dest, os.O_CREAT | os.O_WRONLY | os.O_TRUNC
                          | os.O_DIRECT, 0o644)
         except (OSError, AttributeError):
             return False
         align = self._ALIGN
-        # Page-aligned staging buffer (O_DIRECT needs aligned memory).
-        buf = mmap.mmap(-1, 1 << 20)
+        # Page-aligned staging buffer (O_DIRECT needs aligned memory;
+        # pooled buffers are mmap pages, so any lease satisfies it).
+        lease = global_pool().lease(1 << 20)
+        buf = lease.raw
         fill = 0
         wrote_any = False
 
         def write_full(view):
-            # os.write may write SHORT (e.g. ENOSPC mid-stream returns
-            # a count, not an error): loop the remainder; zero progress
-            # raises rather than silently truncating the shard.
+            # os.pwritev-style full write: os.write may write SHORT
+            # (e.g. ENOSPC mid-stream returns a count, not an error):
+            # loop the remainder; zero progress raises rather than
+            # silently truncating the shard.
             off = 0
             while off < view.nbytes:
                 n = os.write(fd, view[off:])
@@ -435,7 +445,7 @@ class LocalStorage:
             return True
         finally:
             os.close(fd)
-            buf.close()
+            lease.release()
 
     # Bulk reads at/above this size go O_DIRECT (mirror of the write
     # path): GET/heal shard-window reads are read-once data that would
@@ -472,7 +482,7 @@ class LocalStorage:
         means "cannot here" (filesystem refused, e.g. tmpfs/overlay) —
         the caller falls back to the buffered path, nothing consumed.
         MTPU_O_DIRECT=off never reaches this."""
-        import mmap
+        from minio_tpu.io.bufpool import global_pool
         try:
             fd = os.open(full, os.O_RDONLY | os.O_DIRECT)
         except OSError:
@@ -482,19 +492,24 @@ class LocalStorage:
         align = self._ALIGN
         lo = (offset // align) * align
         head = offset - lo
-        buf = mmap.mmap(-1, 1 << 20)
+        # Pooled aligned staging (lease scoped to this thread, so a
+        # deadline-abandoned wrapper call cannot expose recycled
+        # memory); os.preadv into it keeps the copy loop GIL-free.
+        lease = global_pool().lease(1 << 20)
+        buf = lease.raw
         out = bytearray()
         try:
             try:
-                os.lseek(fd, lo, os.SEEK_SET)
+                pos = lo
                 need = head + length
                 while need > 0:
                     take = min(len(buf),
                                (need + align - 1) // align * align)
-                    n = os.readv(fd, [memoryview(buf)[:take]])
+                    n = os.preadv(fd, [memoryview(buf)[:take]], pos)
                     if n <= 0:
                         break                    # EOF
                     out += buf[:n]
+                    pos += n
                     need -= n
             except OSError:
                 # First read EINVAL (mount accepts open(O_DIRECT) but
@@ -504,7 +519,7 @@ class LocalStorage:
             return bytes(out[head:head + length])
         finally:
             os.close(fd)
-            buf.close()
+            lease.release()
 
     def stat_info_file(self, volume: str, path: str) -> os.stat_result:
         try:

@@ -1,0 +1,75 @@
+#!/usr/bin/env bash
+# Bench smoke gate (opt-in; see scripts/verify.sh): run ONLY the
+# concurrent-PUT aggregate at a small budget (8 clients x 2 puts,
+# object-layer columns only) and fail when the measured host aggregate
+# regresses more than 20% against the newest committed BENCH_r*.json.
+# Meant to run on the host that produced the committed artifact —
+# cross-machine comparisons measure the machines, not the code.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+latest=$(ls BENCH_r*.json 2>/dev/null | sort | tail -1 || true)
+if [ -z "$latest" ]; then
+    echo "bench_smoke: no committed BENCH_r*.json; nothing to compare"
+    exit 0
+fi
+
+echo "== bench smoke (baseline: $latest) =="
+out=$(JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} \
+      MTPU_BENCH_ONLY=put_concurrent MTPU_BENCH_SMALL=1 \
+      python bench.py)
+echo "$out"
+
+SMOKE_OUT="$out" BASELINE_FILE="$latest" python - <<'EOF'
+import json
+import os
+import sys
+
+def host_gibps_from(obj):
+    """host_gibps of the put_concurrent metric inside a BENCH artifact
+    (its `parsed` field when that is the aggregate metric, else any
+    metric line embedded in `tail`)."""
+    cands = []
+    if isinstance(obj, dict):
+        if obj.get("metric") == "put_concurrent_aggregate_gibps":
+            cands.append(obj)
+        parsed = obj.get("parsed")
+        if isinstance(parsed, dict) and \
+                parsed.get("metric") == "put_concurrent_aggregate_gibps":
+            cands.append(parsed)
+        for line in str(obj.get("tail", "")).splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    j = json.loads(line)
+                except ValueError:
+                    continue
+                if j.get("metric") == "put_concurrent_aggregate_gibps":
+                    cands.append(j)
+    for c in cands:
+        v = c.get("host_gibps")
+        if v:
+            return float(v)
+    return None
+
+with open(os.environ["BASELINE_FILE"]) as f:
+    baseline = host_gibps_from(json.load(f))
+measured = None
+for line in os.environ["SMOKE_OUT"].splitlines():
+    line = line.strip()
+    if line.startswith("{"):
+        j = json.loads(line)
+        if j.get("metric") == "put_concurrent_aggregate_gibps":
+            measured = float(j.get("host_gibps") or 0)
+if baseline is None:
+    print("bench_smoke: baseline artifact has no host aggregate; skip")
+    sys.exit(0)
+if not measured:
+    print("bench_smoke: FAILED to measure the aggregate")
+    sys.exit(1)
+floor = baseline * 0.8
+verdict = "OK" if measured >= floor else "REGRESSION"
+print(f"bench_smoke: host aggregate {measured:.3f} GiB/s vs committed "
+      f"{baseline:.3f} GiB/s (floor {floor:.3f}) -> {verdict}")
+sys.exit(0 if measured >= floor else 1)
+EOF

@@ -9,6 +9,15 @@ cd "$(dirname "$0")/.."
 echo "== compileall gate =="
 python -m compileall -q minio_tpu || exit 1
 
+# Opt-in bench smoke (MTPU_BENCH_SMOKE=1): the concurrent-PUT
+# aggregate at small budget, failing on >20% regression against the
+# committed BENCH_r*.json. Off by default — tier-1 wall time stays
+# inside budget and cross-machine numbers are not comparable.
+if [ "${MTPU_BENCH_SMOKE:-}" = "1" ]; then
+    echo "== bench smoke =="
+    bash scripts/bench_smoke.sh || exit 1
+fi
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \

@@ -148,3 +148,41 @@ def statuses(outcomes: list[Outcome]) -> dict[int, int]:
     for o in outcomes:
         hist[o.status] = hist.get(o.status, 0) + 1
     return hist
+
+
+class pool_balance:
+    """Context manager asserting buffer-pool lease hygiene across a
+    chaos scenario: every lease taken during the block is returned
+    exactly once — outstanding drains back to the entry level, no leak
+    was counted, no double release happened — even when shard writes
+    time out or NaughtyDisks kill writers mid-op. `settle` bounds the
+    wait for abandoned (deadline-cut) drive workers to finish and
+    return their retained references."""
+
+    def __init__(self, settle: float = 5.0):
+        self.settle = settle
+
+    def __enter__(self):
+        from minio_tpu.io.bufpool import global_pool
+        self.pool = global_pool()
+        self.before = self.pool.stats()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            return False
+        deadline = time.monotonic() + self.settle
+        while time.monotonic() < deadline:
+            if self.pool.stats()["outstanding"] \
+                    <= self.before["outstanding"]:
+                break
+            time.sleep(0.05)
+        after = self.pool.stats()
+        assert after["outstanding"] <= self.before["outstanding"], (
+            f"leases not returned: {after['outstanding']} outstanding "
+            f"(was {self.before['outstanding']})")
+        assert after["leaks"] == self.before["leaks"], (
+            "dropped lease hit the leak net during chaos run")
+        assert after["double_releases"] == self.before["double_releases"], \
+            "a lease was returned more than once"
+        return False

@@ -19,6 +19,13 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# The pytest process has JAX loaded, and the pre-forked worker
+# front-end's os.fork() is unsafe after that: any in-process
+# minio_tpu.server.main() call must take the single-process path.
+# Worker-mode tests boot the fleet in a clean subprocess and override
+# this explicitly (tests/test_io_engine.py).
+os.environ.setdefault("MTPU_HTTP_WORKERS", "1")
+
 
 def pytest_configure(config):
     config.addinivalue_line(

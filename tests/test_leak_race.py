@@ -162,6 +162,78 @@ def test_single_key_race_harness(tmp_path):
         pass
 
 
+def test_lease_returned_once_on_naughty_shard_writes(tmp_path):
+    """Pool invariant under injected faults: a NaughtyDisk failing
+    every create_file still sees its per-drive lease reference
+    returned exactly once (pool drains to baseline, no leaks, no
+    double releases), and the PUT itself succeeds on quorum."""
+    from minio_tpu.storage.naughty import NaughtyDisk
+    from tests.chaos import pool_balance
+    disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    disks[0] = NaughtyDisk(disks[0],
+                           fail_ops={"create_file": OSError("boom")})
+    es = ErasureSet(disks)
+    es.make_bucket("leaseb")
+    # > SMALL_FILE_THRESHOLD per shard so the non-inline (leased
+    # memoryview) path runs: 2 MiB at EC 2+2 -> 1 MiB shards.
+    body = os.urandom(2 << 20)
+    with pool_balance():
+        for i in range(4):
+            es.put_object("leaseb", f"o{i}", body)
+        _, got = es.get_object("leaseb", "o0")
+        assert got == body
+    es.close()
+
+
+def test_lease_returned_once_on_timed_out_shard_writes(tmp_path):
+    """A health-wrapped drive whose create_file exceeds its deadline
+    abandons the op mid-write: the abandoned worker must hold the
+    window buffer until it truly finishes and then return it exactly
+    once — never recycle-under-writer, never leak."""
+    from tests.chaos import HungDisk, build_set, pool_balance
+    hung: list = []
+
+    def chaos(i, disk):
+        if i == 0:
+            h = HungDisk(disk, delay=1.2, ops={"create_file"})
+            hung.append(h)
+            return h
+        return disk
+
+    es = build_set(tmp_path, n_disks=4, chaos=chaos,
+                   op_timeout=0.25, bulk_timeout=0.25, trip_after=100)
+    es.make_bucket("hungb")
+    body = os.urandom(2 << 20)
+    with pool_balance(settle=8.0):
+        for i in range(2):
+            es.put_object("hungb", f"o{i}", body)   # d0 times out
+        _, got = es.get_object("hungb", "o0")
+        assert got == body
+        for h in hung:
+            h.release()
+    es.close()
+
+
+def test_lease_returned_once_streaming_writer_death(tmp_path):
+    """Streaming PUT with one writer dying mid-stream: the dead
+    writer's drain loop must return every window reference it
+    swallows; the stream commits on the surviving quorum."""
+    from minio_tpu.storage.naughty import NaughtyDisk
+    from tests.chaos import pool_balance
+    disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    disks[1] = NaughtyDisk(disks[1],
+                           fail_ops={"create_file": OSError("mid-stream")})
+    es = ErasureSet(disks)
+    es.make_bucket("streamb")
+    from minio_tpu.object import erasure_object as eo
+    body = os.urandom(eo.STREAM_THRESHOLD + (1 << 20))
+    with pool_balance(settle=8.0):
+        es.put_object("streamb", "big", body)
+        _, got = es.get_object("streamb", "big")
+        assert got == body
+    es.close()
+
+
 def test_bucket_meta_write_race(tmp_path):
     """Concurrent metadata writers must never corrupt the quorum doc:
     the final document parses and holds one writer's complete value."""
