@@ -114,6 +114,12 @@ def main() -> None:
             _put_latency()
         if _want("put_concurrent"):
             _put_concurrent()
+        if _want("get_latency"):
+            _get_latency()
+        if _want("get_concurrent"):
+            _get_concurrent()
+        if _want("range_get"):
+            _range_get()
         return
 
     import jax
@@ -198,6 +204,14 @@ def main() -> None:
     if _want("put_concurrent"):
         _put_concurrent()
 
+    # ---- 5-7. Read path: GET latency / aggregate / ranged -------------
+    if _want("get_latency"):
+        _get_latency()
+    if _want("get_concurrent"):
+        _get_concurrent()
+    if _want("range_get"):
+        _range_get()
+
 
 def _put_latency() -> None:
     """End-to-end PutObject p50/p99 through the real object layer on
@@ -281,8 +295,6 @@ def _put_concurrent() -> None:
         win/loss on this host is a recorded number.
     """
     import shutil
-    import subprocess
-    import sys as _sys
     import tempfile
     from concurrent.futures import ThreadPoolExecutor
 
@@ -338,19 +350,9 @@ def _put_concurrent() -> None:
         finally:
             _batcher_for(K, M).reset_calibration()
 
-        # Front-end aggregate in a clean subprocess (no inherited JAX).
-        try:
-            out = subprocess.run(
-                [_sys.executable, __file__, "--serve-probe"],
-                capture_output=True, timeout=600,
-                env={**_os.environ, "JAX_PLATFORMS": "cpu"})
-            for line in out.stdout.decode().splitlines():
-                if line.startswith("SERVED_GIBPS="):
-                    got = float(line.split("=", 1)[1])
-                    if got == got:          # NaN-guard: nan != nan
-                        served = got
-        except Exception:  # noqa: BLE001 - front-end probe best-effort
-            served = None
+        # Front-end aggregate in a clean subprocess (no inherited JAX);
+        # the probe run is shared with the GET aggregate section.
+        served = _served_probe_value("SERVED_GIBPS")
 
     # Headline: the best measured aggregate among the store's serving
     # configurations — the served front-end number when the worker
@@ -371,6 +373,208 @@ def _put_concurrent() -> None:
         "http_workers": _os.cpu_count(),
         "concurrency": threads,
     }))
+
+
+def _bench_set(root, n_objects, body):
+    """A 12-drive EC 8+4 set pre-loaded with n_objects copies of body
+    under bench/o-<i> (host codec — the GET path is host-side by
+    construction on tunneled-TPU hosts, same as the front-end)."""
+    from minio_tpu.object.erasure_object import ErasureSet
+    from minio_tpu.storage.local import LocalStorage
+    disks = [LocalStorage(f"{root}/d{i}") for i in range(12)]
+    for d in disks:
+        d.make_vol("bench")
+    es = ErasureSet(disks, parity=M)
+    for i in range(n_objects):
+        es.put_object("bench", f"o-{i}", body)
+    return es
+
+
+def _get_latency() -> None:
+    """End-to-end GetObject p50/p99 through the real object layer on
+    12 local drives, EC 8+4, 1 MiB bodies. Two columns: `cold` — the
+    first GET of each object (full quorum read_version fan-out) —
+    and `hot` — repeat GETs of already-read objects (the fileinfo-
+    cache + native-kernel path when present). The headline value is
+    the hot p50: repeat reads are the serving steady state."""
+    import shutil
+    import tempfile
+
+    rng = np.random.default_rng(4)
+    body = rng.integers(0, 256, size=1 << 20, dtype=np.uint8).tobytes()
+    n_objects = 8 if _SMALL else 24
+    root = tempfile.mkdtemp(prefix="bench-get-")
+    try:
+        es = _bench_set(root, n_objects, body)
+        cold, hot = [], []
+        for i in range(n_objects):
+            t0 = time.perf_counter()
+            _, got = es.get_object("bench", f"o-{i}")
+            cold.append(time.perf_counter() - t0)
+            assert len(got) == len(body)
+        for _rep in range(2):
+            for i in range(n_objects):
+                t0 = time.perf_counter()
+                es.get_object("bench", f"o-{i}")
+                hot.append(time.perf_counter() - t0)
+        cold.sort()
+        hot.sort()
+
+        def pct(ts, p):
+            return round(ts[min(len(ts) - 1, len(ts) * p // 100)] * 1e3, 2)
+
+        es.close()
+        print(json.dumps({
+            "metric": "get_object_p50_ec4_1mib_ms",
+            "value": pct(hot, 50),
+            "unit": "ms",
+            "vs_baseline": round(pct(cold, 50) / max(pct(hot, 50), 1e-6),
+                                 3),
+            "cold": {"p50_ms": pct(cold, 50), "p99_ms": pct(cold, 99)},
+            "hot": {"p50_ms": pct(hot, 50), "p99_ms": pct(hot, 99)},
+        }))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _get_concurrent() -> None:
+    """Aggregate throughput of 16 concurrent 1 MiB GETs — the read-side
+    mirror of _put_concurrent. Columns:
+      object_layer_gibps — 16 threads re-reading pre-put objects
+        through the object layer in-process;
+      served_gibps — the same aggregate through the full pre-forked
+        SO_REUSEPORT front-end (signed HTTP GETs in a clean
+        subprocess); this is the headline when the fleet wins.
+    """
+    import shutil
+    import tempfile
+    from concurrent.futures import ThreadPoolExecutor
+
+    rng = np.random.default_rng(5)
+    body = rng.integers(0, 256, size=1 << 20, dtype=np.uint8).tobytes()
+    threads, per_thread = (16, 3) if _SMALL else (16, 6)
+    root = tempfile.mkdtemp(prefix="bench-getagg-")
+    try:
+        es = _bench_set(root, threads * per_thread, body)
+        ex = ThreadPoolExecutor(max_workers=threads)
+
+        def worker(t):
+            for i in range(per_thread):
+                _, got = es.get_object("bench", f"o-{t * per_thread + i}")
+                assert len(got) == len(body)
+
+        list(ex.map(worker, range(threads)))          # warm pass
+        best = 0.0
+        for _rep in range(1 if _SMALL else 2):
+            t0 = time.perf_counter()
+            list(ex.map(worker, range(threads)))
+            wall = time.perf_counter() - t0
+            best = max(best, threads * per_thread * len(body) / wall
+                       / (1 << 30))
+        ex.shutdown(wait=False)
+        es.close()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    served = None
+    if not _SMALL:
+        served = _served_probe_value("SERVED_GET_GIBPS")
+    value = max(v for v in (best, served) if v is not None)
+    # vs_baseline mirrors the PUT metric's config-ratio shape:
+    # served / object-layer — how much of the in-process read rate
+    # survives the full front-end (signing, HTTP, worker fleet).
+    print(json.dumps({
+        "metric": "get_concurrent_aggregate_gibps",
+        "value": round(value, 3),
+        "unit": "GiB/s",
+        "vs_baseline": round((served if served is not None else best)
+                             / max(best, 1e-9), 3),
+        "object_layer_gibps": round(best, 3),
+        "served_gibps": None if served is None else round(served, 3),
+        "http_workers": _os.cpu_count(),
+        "concurrency": threads,
+    }))
+
+
+def _range_get() -> None:
+    """Ranged GETs against one large streamed object (multi-window on
+    the streaming read path): p50 latency of 1 MiB ranges at
+    block-unaligned offsets, plus the effective throughput of one
+    big range streamed via get_object_stream."""
+    import shutil
+    import tempfile
+
+    from minio_tpu.object.types import GetOptions
+
+    rng = np.random.default_rng(6)
+    size = (36 if _SMALL else 64) << 20
+    body = rng.integers(0, 256, size=size, dtype=np.uint8).tobytes()
+    root = tempfile.mkdtemp(prefix="bench-range-")
+    try:
+        es = _bench_set(root, 0, b"")
+        es.put_object("bench", "big", body)
+        # 1 MiB ranges at odd offsets spread across the object.
+        reps = 8 if _SMALL else 24
+        lat = []
+        for i in range(reps):
+            off = (i * (size // reps) + 4097) % (size - (1 << 20))
+            t0 = time.perf_counter()
+            _, got = es.get_object(
+                "bench", "big", GetOptions(offset=off, length=1 << 20))
+            lat.append(time.perf_counter() - t0)
+            assert len(got) == 1 << 20
+        lat.sort()
+        # One big streamed range (all but the first/last unaligned MiB).
+        t0 = time.perf_counter()
+        n = 0
+        _, chunks = es.get_object_stream(
+            "bench", "big",
+            GetOptions(offset=12345, length=size - 23456))
+        for c in chunks:
+            n += len(c)
+        wall = time.perf_counter() - t0
+        assert n == size - 23456
+        es.close()
+        print(json.dumps({
+            "metric": "range_get_1mib_p50_ms",
+            "value": round(lat[len(lat) // 2] * 1e3, 2),
+            "unit": "ms",
+            "vs_baseline": 1.0,
+            "p99_ms": round(lat[min(reps - 1, reps * 99 // 100)] * 1e3, 2),
+            "stream_gibps": round(n / wall / (1 << 30), 3),
+        }))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+# One probe subprocess can serve several sections (PUT + GET
+# aggregates): cache its parsed output for the process lifetime.
+_PROBE_LINES: dict | None = None
+
+
+def _served_probe_value(key: str):
+    """Value of `key` from the front-end probe subprocess (run once)."""
+    global _PROBE_LINES
+    import subprocess
+    import sys as _sys
+    if _PROBE_LINES is None:
+        _PROBE_LINES = {}
+        try:
+            out = subprocess.run(
+                [_sys.executable, __file__, "--serve-probe"],
+                capture_output=True, timeout=900,
+                env={**_os.environ, "JAX_PLATFORMS": "cpu"})
+            for line in out.stdout.decode().splitlines():
+                if "=" in line and line.split("=", 1)[0].isupper():
+                    try:
+                        v = float(line.split("=", 1)[1])
+                    except ValueError:
+                        continue
+                    if v == v:                  # NaN-guard
+                        _PROBE_LINES[line.split("=", 1)[0]] = v
+        except Exception:  # noqa: BLE001 - front-end probe best-effort
+            pass
+    return _PROBE_LINES.get(key)
 
 
 def _serve_probe() -> None:
@@ -430,6 +634,21 @@ def _serve_probe() -> None:
         list(ex.map(lambda t: worker("m", t), range(threads)))
         wall = time.perf_counter() - t0
         print("SERVED_GIBPS="
+              f"{threads * per_thread * len(body) / wall / (1 << 30):.4f}")
+
+        def getter(tag, t):
+            cli = S3Client(f"127.0.0.1:{port}")
+            for i in range(per_thread):
+                st, _, got = cli.request("GET", f"/bench/{tag}-{t}-{i}")
+                assert st == 200 and len(got) == len(body), st
+
+        # Served GET aggregate over the objects the measured pass wrote
+        # (warm pass primes caches — repeat reads are the steady state).
+        list(ex.map(lambda t: getter("m", t), range(threads)))  # warm
+        t0 = time.perf_counter()
+        list(ex.map(lambda t: getter("m", t), range(threads)))
+        wall = time.perf_counter() - t0
+        print("SERVED_GET_GIBPS="
               f"{threads * per_thread * len(body) / wall / (1 << 30):.4f}")
     finally:
         srv.send_signal(signal.SIGTERM)

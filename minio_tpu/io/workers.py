@@ -236,10 +236,14 @@ def _worker_stat(server, worker_id: int) -> dict:
     from minio_tpu.io.bufpool import global_pool
     from minio_tpu.s3.metrics import layer_sets
     engine = []
+    fileinfo = []
     for s in layer_sets(server.object_layer):
         io_eng = getattr(s, "io", None)
         if io_eng is not None:
             engine.extend(io_eng.stats())
+        fic = getattr(s, "fi_cache", None)
+        if fic is not None:
+            fileinfo.append(fic.stats())
     return {
         "worker": worker_id,
         "pid": os.getpid(),
@@ -248,6 +252,7 @@ def _worker_stat(server, worker_id: int) -> dict:
         "admission": server.admission.snapshot(),
         "bufpool": global_pool().stats(),
         "engine": engine,
+        "fileinfo_cache": fileinfo,
     }
 
 
@@ -330,9 +335,18 @@ def _first_drive_root(object_layer):
 def _wire_set(s, shared_dir: str, list_gen: SharedGen,
               meta_gen: SharedGen) -> None:
     """One erasure set's cross-worker wiring: flock namespace locks,
-    and pull-model invalidation for the listing metacache and the
-    bucket-meta TTL caches."""
+    and pull-model invalidation for the listing metacache, the
+    bucket-meta TTL caches, and the quorum-fileinfo cache."""
     s.ns = FlockNSLock(os.path.join(shared_dir, "nslocks"), inner=s.ns)
+
+    fi_cache = getattr(s, "fi_cache", None)
+    if fi_cache is not None:
+        # The fileinfo cache observes the SAME generation file every
+        # worker's namespace mutations bump (via the mc.bump wrapper
+        # below) — its own SharedGen instance, because changed() is
+        # stateful per observer and the metacache already consumes one.
+        fi_cache.shared_gen = SharedGen(
+            os.path.join(shared_dir, "list.gen"))
 
     mc = s.metacache
     orig_bump = mc.bump

@@ -94,6 +94,11 @@ def _declare(lib) -> None:
         fn.restype = None
     lib.mtpu_xxh64.argtypes = [u8p, ctypes.c_size_t, ctypes.c_uint64]
     lib.mtpu_xxh64.restype = ctypes.c_uint64
+    lib.mtpu_get_frame.argtypes = [u8p, ctypes.POINTER(u8p),
+                                   ctypes.c_size_t, ctypes.c_size_t,
+                                   ctypes.c_size_t, ctypes.c_size_t,
+                                   ctypes.c_size_t, ctypes.c_size_t, u8p]
+    lib.mtpu_get_frame.restype = ctypes.c_uint64
 
 
 def _u8(arr) -> "ctypes.POINTER(ctypes.c_uint8)":

@@ -472,4 +472,65 @@ void mtpu_put_frame(const uint8_t* key32, const uint8_t* matrix,
   }
 }
 
+// ---------------------------------------------------------------------------
+// Fused GET framing: bitrot verify + block-major interleave
+// ---------------------------------------------------------------------------
+//
+// The read-side mirror of mtpu_put_frame: one GIL-free call that takes
+// the k data shards' framed byte windows (`digest || block` per erasure
+// block, exactly as stored), re-hashes every block against its stored
+// digest, and interleaves the verified data blocks block-major straight
+// into the caller's (pooled) output buffer — replacing the GET path's
+// Python-level verify -> per-slice .tobytes() -> b"".join loop.
+//
+//   shards:    k pointers, shard j's framed window of nb blocks. All
+//              blocks carry S data bytes except the LAST, which carries
+//              slast (<= S; the shard file's ragged tail when the
+//              window reaches it).
+//   take_full: object bytes emitted per full block (BLOCK_SIZE — the
+//              k*S concatenation may exceed it by the split padding).
+//   take_last: object bytes emitted for the last block (the part tail).
+//
+// Emission per block = min(take, k*slen), walking shards in index
+// order — byte-identical to the numpy reassembly by construction.
+//
+// Returns a bitmask of shards whose digest verification FAILED (bit j
+// = shard j); nonzero means `out` holds no usable data and the caller
+// falls back to the reconstruct path, treating failed shards as
+// missing. Verification runs over EVERY shard before returning so the
+// caller learns all bad shards in one pass.
+
+uint64_t mtpu_get_frame(const uint8_t* key32, const uint8_t* const* shards,
+                        size_t k, size_t S, size_t nb, size_t slast,
+                        size_t take_full, size_t take_last, uint8_t* out) {
+  const size_t frame = 32 + S;
+  uint64_t bad = 0;
+  for (size_t j = 0; j < k && j < 64; ++j) {
+    const uint8_t* sh = shards[j];
+    for (size_t b = 0; b < nb; ++b) {
+      const size_t slen = (b + 1 == nb) ? slast : S;
+      const uint8_t* fr = sh + b * frame;
+      uint8_t dig[32];
+      mtpu_hh256(key32, fr + 32, slen, dig);
+      if (std::memcmp(dig, fr, 32) != 0) {
+        bad |= uint64_t(1) << j;
+        break;
+      }
+    }
+  }
+  if (bad) return bad;
+  uint8_t* dst = out;
+  for (size_t b = 0; b < nb; ++b) {
+    const size_t slen = (b + 1 == nb) ? slast : S;
+    size_t take = (b + 1 == nb) ? take_last : take_full;
+    for (size_t j = 0; j < k && take; ++j) {
+      const size_t c = slen < take ? slen : take;
+      std::memcpy(dst, shards[j] + b * frame + 32, c);
+      dst += c;
+      take -= c;
+    }
+  }
+  return 0;
+}
+
 }  // extern "C"

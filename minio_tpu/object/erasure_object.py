@@ -188,6 +188,31 @@ class ErasureSet:
         # Listing page cache with write invalidation (metacache).
         from minio_tpu.object.metacache import MetaCache
         self.metacache = MetaCache()
+        # Quorum-fileinfo cache: repeat GET/HEAD of a key serves the
+        # quorum-agreed (fi, fis) from memory instead of a k-drive
+        # read_version fan-out. Invalidation rides the metacache bump
+        # funnel (every namespace mutation already goes through it);
+        # pre-forked workers additionally attach a shared-generation
+        # observer (io/workers._wire_set).
+        from minio_tpu.object.fi_cache import FileInfoCache
+        self.fi_cache = FileInfoCache()
+        self.metacache.listeners.append(self.fi_cache.invalidate_bucket)
+        if any(_unwrap_disk(d).__class__.__module__
+               == "minio_tpu.storage.remote"
+               for d in self.disks if d is not None):
+            # Distributed set: a PEER node's writes reach this cache
+            # only via the coalesced best-effort listing broadcast —
+            # too weak a coherence contract for metadata serving. The
+            # cache stays a single-node (and pre-forked-worker, where
+            # the shared generation file is authoritative) win.
+            self.fi_cache.enabled = False
+        # Read-kernel counters (admin info): windows served by the
+        # fused native GET kernel, by the numpy path, and native
+        # verifies that demoted to reconstruction. Incremented from
+        # concurrent request/prefetch threads — dict += is a
+        # read-modify-write, so a lock keeps the counts honest.
+        self.get_kernel = {"native": 0, "numpy": 0, "demoted": 0}
+        self._gk_mu = threading.Lock()
 
     def close(self) -> None:
         """Release the set's background resources (fan-out executor,
@@ -561,7 +586,21 @@ class ErasureSet:
 
     def _get_object_fileinfo(self, bucket: str, object_: str,
                              version_id: str = "", read_data: bool = False):
-        """(fi, per-disk fis, errors) with read-quorum enforcement."""
+        """(fi, per-disk fis, errors) with read-quorum enforcement.
+
+        Repeat lookups of an unchanged key are memory hits in the
+        fileinfo cache — zero drive calls; the token protocol makes
+        the insert race-free against concurrent mutations (see
+        object/fi_cache.py). Only fully-healthy reads (every drive
+        answered, quorum found) are cached: a degraded read must keep
+        re-reading so heal progress is observed and the MRF hook in
+        callers keeps firing."""
+        cached = self.fi_cache.get(bucket, object_, version_id,
+                                   need_data=read_data)
+        if cached is not None:
+            fi, fis = cached
+            return fi, fis, [None] * len(self.disks)
+        token = self.fi_cache.token(bucket)
         fis, errors = self._read_version_all(bucket, object_, version_id,
                                              read_data=read_data)
         not_found = sum(isinstance(e, FileNotFoundErr) for e in errors)
@@ -601,6 +640,9 @@ class ErasureSet:
         if fi is None:
             _raise_for_quorum(errors, ReadQuorumError(bucket, object_),
                               quorum=quorum)
+        if all(e is None for e in errors):
+            self.fi_cache.put(bucket, object_, version_id, fi, fis,
+                              read_data, token)
         return fi, fis, errors
 
     def _reap_dangling(self, bucket: str, object_: str) -> None:
@@ -1393,34 +1435,98 @@ class ErasureSet:
         next(g)
         return info, g
 
-    def _iter_payload(self, bucket: str, object_: str, fi: FileInfo,
-                      fis: list, offset: int, length: int):
-        """Yield [offset, offset+length) as block-aligned windows."""
-        tb = self._tier_read(fi, offset, length)
-        if tb is not None:
-            yield tb
-            return
+    def _window_descs(self, fi: FileInfo, offset: int,
+                      length: int) -> list[tuple]:
+        """(part_number, part_size, rel, step) windows covering
+        [offset, offset+length), snapped to erasure-block boundaries
+        within each part so consecutive windows never re-read a block."""
         parts = fi.parts or [ObjectPartInfo(number=1, size=fi.size,
                                             actual_size=fi.size)]
+        descs: list[tuple] = []
         cum = 0
         for p in parts:
             p_lo = max(offset, cum)
             p_hi = min(offset + length, cum + p.size)
             pos = p_lo
             while pos < p_hi:
-                # Snap window ends to erasure-block boundaries within the
-                # part so consecutive windows never re-read a block.
                 rel = pos - cum
                 end_rel = min(p.size,
                               (rel // BLOCK_SIZE) * BLOCK_SIZE
                               + GET_WINDOW_BYTES)
                 step = min(p_hi - pos, end_rel - rel)
-                yield self._read_part_window(bucket, object_, fi, fis,
-                                             p.number, p.size, rel, step)
+                descs.append((p.number, p.size, rel, step))
                 pos += step
             cum += p.size
             if cum >= offset + length:
                 break
+        return descs
+
+    def _iter_payload(self, bucket: str, object_: str, fi: FileInfo,
+                      fis: list, offset: int, length: int):
+        """Yield [offset, offset+length) as block-aligned windows.
+
+        Readahead: while window i is on the wire to the client, window
+        i+1 is already fetching/verifying/decoding through the
+        per-drive engine queues — bounded to ONE window in flight, so
+        memory stays O(window). Chunks decoded by the native kernel
+        are POOLED-buffer views (pool -> decode -> socket, the read
+        mirror of the PUT path's leased buffers): each is valid until
+        the consumer pulls the next chunk (or closes the generator),
+        when its lease returns to the pool. The caller's request
+        deadline is re-bound inside the prefetch thread, and the
+        namespace read lock (held by get_object_stream around this
+        iterator) outlives every prefetch it issues."""
+        from minio_tpu.object import tier as tier_mod
+        if (fi.metadata or {}).get(tier_mod.META_TIER):
+            # Transitioned version: stream the warm-tier range in
+            # GET_WINDOW_BYTES windows instead of one O(range) blob.
+            pos, end = offset, offset + length
+            while pos < end:
+                step = min(GET_WINDOW_BYTES, end - pos)
+                yield self._tier_read(fi, pos, step)
+                pos += step
+            return
+        descs = self._window_descs(fi, offset, length)
+        if not descs:
+            return
+        inline_cache: dict = {}
+        dl = deadline_mod.current()
+
+        def read_desc(desc):
+            num, psize, rel, step = desc
+            with deadline_mod.bind(dl):
+                return self._read_part_window_pooled(
+                    bucket, object_, fi, fis, num, psize, rel, step,
+                    inline_cache=inline_cache)
+
+        fut = self.pool.submit(read_desc, descs[0])
+        lease = None
+        try:
+            for i in range(len(descs)):
+                chunk, lease = fut.result()
+                # Prefetch the NEXT window before handing this one to
+                # the consumer: its drive reads overlap the socket
+                # sends (and the native decode releases the GIL).
+                fut = self.pool.submit(read_desc, descs[i + 1]) \
+                    if i + 1 < len(descs) else None
+                yield chunk
+                if lease is not None:
+                    lease.release()
+                    lease = None
+        finally:
+            if lease is not None:
+                lease.release()
+            if fut is not None:
+                # A prefetch is still in flight (consumer closed early
+                # or a window failed): collect it so its lease returns
+                # — abandoning the future would park a pooled buffer
+                # until GC (the pool's leak net would count it).
+                try:
+                    _, l2 = fut.result()
+                    if l2 is not None:
+                        l2.release()
+                except BaseException:  # noqa: BLE001 - already unwinding
+                    pass
 
     def _tier_read(self, fi: FileInfo, offset: int,
                    length: int) -> Optional[bytes]:
@@ -1454,13 +1560,14 @@ class ErasureSet:
                                             actual_size=fi.size)]
         out = bytearray()
         cum = 0
+        inline_cache: dict = {}
         for p in parts:
             p_lo = max(offset, cum)
             p_hi = min(offset + length, cum + p.size)
             if p_hi > p_lo:
                 out += self._read_part_window(
                     bucket, object_, fi, fis, p.number, p.size,
-                    p_lo - cum, p_hi - p_lo)
+                    p_lo - cum, p_hi - p_lo, inline_cache=inline_cache)
             cum += p.size
             if cum >= offset + length:
                 break
@@ -1468,12 +1575,45 @@ class ErasureSet:
 
     def _read_part_window(self, bucket: str, object_: str, fi: FileInfo,
                           fis: list, part_number: int, part_size: int,
-                          offset: int, length: int) -> bytes:
+                          offset: int, length: int,
+                          inline_cache: Optional[dict] = None) -> bytes:
+        """Self-owned-bytes wrapper over _read_part_window_pooled for
+        callers that hold the result past the read (buffered GET,
+        tiering upload)."""
+        chunk, lease = self._read_part_window_pooled(
+            bucket, object_, fi, fis, part_number, part_size, offset,
+            length, inline_cache=inline_cache)
+        if lease is None:
+            return chunk
+        try:
+            return bytes(chunk)
+        finally:
+            lease.release()
+
+    def _read_part_window_pooled(self, bucket: str, object_: str,
+                                 fi: FileInfo, fis: list, part_number: int,
+                                 part_size: int, offset: int, length: int,
+                                 inline_cache: Optional[dict] = None):
         """Gather only the erasure blocks covering the window inside one
         part: verified shard-block slices (k preferred, hedge to all),
         batched reconstruct of missing shards, block-major reassembly.
         I/O, hashing and memory are O(range), not O(object) — the
-        reference's ShardFileOffset range math (cmd/erasure-coding.go:135)."""
+        reference's ShardFileOffset range math (cmd/erasure-coding.go:135).
+
+        Returns (chunk, lease). The fast path is the fused native GET
+        kernel (native/native.cc mtpu_get_frame): ONE GIL-free ctypes
+        call verifies every shard block's HighwayHash digest and
+        interleaves the data block-major straight into a pooled buffer;
+        chunk is then a memoryview into `lease` and the caller owns one
+        reference. The numpy path (native lib absent, non-default
+        algorithm, missing/corrupt shards needing reconstruction)
+        returns (bytes, None) — byte-identical output either way.
+
+        `inline_cache`: per-REQUEST dict sharing resolved inline blobs
+        across this request's windows and shard fetches — an inline
+        journal read with the empty not-loaded sentinel re-fetches each
+        holder's xl.meta at most once per request, not once per shard
+        fetch per window."""
         k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
         n = k + m
         e = self._erasure(k, m)
@@ -1502,6 +1642,22 @@ class ErasureSet:
                 continue
             holders[dfi.erasure.index - 1] = disk_idx
 
+        def resolve_inline(disk_idx: int) -> bytes:
+            """This holder's full inline shard blob, re-read from its
+            journal at most once per request when fis carries the
+            empty not-loaded sentinel."""
+            blob = fis[disk_idx].inline_data
+            if blob:
+                return blob
+            if inline_cache is not None and disk_idx in inline_cache:
+                return inline_cache[disk_idx]
+            blob = self.disks[disk_idx].read_version(
+                bucket, object_, fi.version_id,
+                read_data=True).inline_data or b""
+            if inline_cache is not None:
+                inline_cache[disk_idx] = blob
+            return blob
+
         def fetch_raw(shard_idx: int):
             """Raw framed bytes of this shard's block window (no verify)."""
             disk_idx = holders.get(shard_idx)
@@ -1511,12 +1667,7 @@ class ErasureSet:
             dfi = fis[disk_idx]
             try:
                 if dfi.inline_data is not None:
-                    blob = dfi.inline_data
-                    if not blob:
-                        blob = d.read_version(bucket, object_,
-                                              fi.version_id,
-                                              read_data=True).inline_data or b""
-                    return blob[framed_lo:framed_hi]
+                    return resolve_inline(disk_idx)[framed_lo:framed_hi]
                 return d.read_file(
                     bucket, f"{object_}/{fi.data_dir}/{part_file}",
                     offset=framed_lo, length=framed_hi - framed_lo)
@@ -1564,6 +1715,27 @@ class ErasureSet:
         # Read data shards first; hedge with parity shards for failures.
         shards: list[Optional[np.ndarray]] = [None] * n
         results, ferrs = fetch_many(range(k))
+        skip = offset - start_b * BLOCK_SIZE
+
+        # Fast path: all k data shards present and whole -> ONE native
+        # call verifies every block digest and interleaves straight
+        # into a pooled buffer. A nonzero bad-mask means bitrot: demote
+        # those shards to missing and take the reconstruct path below
+        # (which re-verifies, rebuilds, and enqueues the MRF heal).
+        native_got = self._native_get_window(results, k, shard_size,
+                                             win_len, start_b, end_b,
+                                             part_size)
+        if native_got is not None:
+            view, lease, bad = native_got
+            if not bad:
+                self._count_get("native")
+                return view[skip:skip + length], lease
+            self._count_get("demoted")
+            for s in range(k):
+                if bad >> s & 1:
+                    results[s] = None
+
+        self._count_get("numpy")
         for s, r in enumerate(verify(results)):
             shards[s] = r
         missing = [s for s in range(k) if shards[s] is None]
@@ -1594,8 +1766,65 @@ class ErasureSet:
             take = min(BLOCK_SIZE, part_size - b * BLOCK_SIZE)
             out += chunk[:take]
         # `out` holds object bytes [start_b*BLOCK_SIZE, ...); cut the range.
-        skip = offset - start_b * BLOCK_SIZE
-        return bytes(out[skip:skip + length])
+        return bytes(out[skip:skip + length]), None
+
+    def _count_get(self, path: str) -> None:
+        with self._gk_mu:
+            self.get_kernel[path] += 1
+
+    def _native_get_window(self, results, k: int, shard_size: int,
+                           win_len: int, start_b: int, end_b: int,
+                           part_size: int):
+        """Run the fused native GET kernel over k fetched shard windows.
+
+        None when the fast path does not apply (native lib absent,
+        non-default bitrot algorithm, a shard missing or short — those
+        need the reconstruct path). Otherwise (view, lease, 0) with the
+        window's plaintext in a pooled lease the caller now owns, or
+        (None, None, bad_mask) when verification failed bit-mask shards
+        (the lease is already returned)."""
+        if bitrot.DEFAULT_ALGORITHM != bitrot.HIGHWAYHASH256S \
+                or win_len <= 0:
+            return None
+        from minio_tpu import native
+        lib = native.load()
+        if lib is None:
+            return None
+        nb = end_b - start_b + 1
+        slast = win_len - (nb - 1) * shard_size
+        hsize = bitrot.digest_size(bitrot.DEFAULT_ALGORITHM)
+        expect = nb * hsize + win_len
+        blobs = []
+        for r in results:
+            if r is None or len(r) != expect:
+                return None
+            blobs.append(r if isinstance(r, bytes) else bytes(r))
+        take_last = min(BLOCK_SIZE, part_size - end_b * BLOCK_SIZE)
+        out_len = (nb - 1) * BLOCK_SIZE + min(take_last, k * slast)
+
+        import ctypes
+
+        from minio_tpu.utils.highwayhash import MAGIC_KEY
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        # c_char_p views the bytes objects' buffers without copying;
+        # `keep` pins them for the duration of the call.
+        keep = [ctypes.c_char_p(b) for b in blobs]
+        ptrs = (u8p * k)(*[ctypes.cast(c, u8p) for c in keep])
+        lease = global_pool().lease(out_len)
+        out = (ctypes.c_uint8 * out_len).from_buffer(lease.raw)
+        try:
+            bad = lib.mtpu_get_frame(
+                native._u8(MAGIC_KEY), ptrs, k, shard_size, nb, slast,
+                BLOCK_SIZE, take_last, out)
+        except BaseException:
+            lease.release()
+            raise
+        finally:
+            del out     # drop the ctypes export so the mmap can recycle
+        if bad:
+            lease.release()
+            return None, None, int(bad)
+        return lease.view(out_len), lease, 0
 
     # ------------------------------------------------------------------
     # info / delete / list
@@ -1788,6 +2017,11 @@ class ErasureSet:
                 raise WriteQuorumError(bucket, object_)
             if len(agree) < n:
                 self.mrf.enqueue(bucket, object_, fi.version_id)
+        # The version's data just moved off-drive and its local shard
+        # dirs are gone: cached fileinfo (ours and sibling workers')
+        # must re-resolve or reads would chase deleted shard files
+        # instead of the tier pointer.
+        self.metacache.bump(bucket)
 
     def _tier_pointer(self, bucket: str, object_: str,
                       version_id: str) -> Optional[tuple[str, str]]:
@@ -2100,6 +2334,17 @@ def _swallow(fn):
         fn()
     except Exception:  # noqa: BLE001
         pass
+
+
+def _unwrap_disk(d):
+    """Innermost drive behind health/test wrappers (each exposes
+    `wrapped`), bounded against pathological self-wrapping."""
+    for _ in range(8):
+        inner = getattr(d, "wrapped", None)
+        if inner is None:
+            return d
+        d = inner
+    return d
 
 
 def _leased_fns(fns, lease):

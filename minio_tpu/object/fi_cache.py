@@ -1,0 +1,194 @@
+"""Coherent quorum-fileinfo cache: (bucket, object, version) -> (fi, fis).
+
+Every GET/HEAD pays a k-drive `read_version` fan-out to quorum-pick the
+version before a single data byte moves. The reference amortizes that
+through its metadata layer; here repeat reads of the same key serve the
+quorum-agreed FileInfo (and the per-drive fis the shard-holder map is
+built from) straight from memory — zero drive calls — while writes
+invalidate, so a cached entry can never outlive the version it
+describes.
+
+Coherence model (correctness first, three layers):
+
+  * in-process — every namespace mutation already funnels through
+    `MetaCache.bump(bucket)` (puts, deletes, multipart completes,
+    heals, decom restores); the erasure set registers this cache as a
+    bump listener, so one hook covers every mutation path without
+    per-call-site wiring. Invalidation is bucket-wide: coarser than
+    per-key, but bump IS the per-mutation signal that already exists
+    and a spurious re-read costs one fan-out.
+  * insert races — an entry is only stored if the bucket's
+    invalidation generation still matches a token taken BEFORE the
+    drive fan-out that produced it (`token()`/`put(..., token)`).
+    Without this, an unlocked metadata read (get_object_info takes no
+    namespace lock) could read pre-overwrite state, lose the race to
+    the overwrite's bump, and insert a stale entry nothing would ever
+    invalidate.
+  * cross-process — pre-forked workers (io/workers.py) attach a
+    SharedGen observer on the shared `list.gen` file that every
+    worker's bump appends to; `maybe_flush()` runs at each lookup and
+    at each token grab, clearing the whole cache when ANY worker
+    mutated ANY namespace since we last looked (same pull model the
+    listing metacache uses; a full flush is the price of zero
+    cross-process chatter on the hot path).
+
+Bounds: entry count AND resident bytes (inline objects carry their
+framed shard payloads in fis — a few hundred KiB each at the inline
+threshold), both LRU-evicted.
+
+Environment:
+  MTPU_FILEINFO_CACHE        "0"/"off" disables the cache entirely
+  MTPU_FILEINFO_CACHE_MAX    max cached keys (default 4096)
+  MTPU_FILEINFO_CACHE_BYTES  max resident inline bytes (default 64 MiB)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+
+def _env_int(key: str, default: int) -> int:
+    try:
+        v = int(os.environ.get(key, "") or default)
+        return v if v > 0 else default
+    except ValueError:
+        return default
+
+
+class FileInfoCache:
+    """Thread-safe LRU of (bucket, object, version_id) -> (fi, fis)."""
+
+    def __init__(self, max_entries: int | None = None,
+                 max_bytes: int | None = None,
+                 enabled: bool | None = None):
+        if enabled is None:
+            enabled = os.environ.get("MTPU_FILEINFO_CACHE", "").lower() \
+                not in ("0", "off", "false")
+        self.enabled = enabled
+        self.max_entries = max_entries if max_entries is not None \
+            else _env_int("MTPU_FILEINFO_CACHE_MAX", 4096)
+        self.max_bytes = max_bytes if max_bytes is not None \
+            else _env_int("MTPU_FILEINFO_CACHE_BYTES", 64 << 20)
+        self._mu = threading.Lock()
+        self._map: OrderedDict = OrderedDict()   # key -> entry dict
+        self._gens: dict[str, int] = {}          # bucket -> invalidation gen
+        self._bytes = 0
+        # Cross-process invalidation observer (io/workers.SharedGen or
+        # anything with a changed() -> bool); None in single-process.
+        self.shared_gen = None
+        # Stats (monotonic counters; entries/bytes are gauges).
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.invalidations = 0
+
+    # -- coherence -------------------------------------------------------
+
+    def maybe_flush(self) -> None:
+        """Pull-check the cross-process generation; a change made by
+        ANY worker flushes everything (pull model, no hot-path IPC)."""
+        sg = self.shared_gen
+        if sg is not None and sg.changed():
+            self.invalidate_all()
+
+    def token(self, bucket: str) -> int:
+        """Generation token to take BEFORE the drive fan-out whose
+        result will be put(); put() refuses when it no longer
+        matches (the read raced a mutation's invalidation).
+
+        setdefault, not get: the bucket must EXIST in the generation
+        map from this moment, or an invalidate_all() racing the fan-out
+        (a sibling worker's bump seen by maybe_flush) would have no
+        entry to bump for it and the stale put() would pass the token
+        check."""
+        self.maybe_flush()
+        with self._mu:
+            return self._gens.setdefault(bucket, 0)
+
+    def invalidate_bucket(self, bucket: str) -> None:
+        with self._mu:
+            self._gens[bucket] = self._gens.get(bucket, 0) + 1
+            stale = [k for k in self._map if k[0] == bucket]
+            for k in stale:
+                self._drop(k)
+            if stale:
+                self.invalidations += 1
+
+    def invalidate_all(self) -> None:
+        with self._mu:
+            for b in set(self._gens) | {k[0] for k in self._map}:
+                self._gens[b] = self._gens.get(b, 0) + 1
+            if self._map:
+                self.invalidations += 1
+            self._map.clear()
+            self._bytes = 0
+
+    # -- lookup / insert -------------------------------------------------
+
+    def get(self, bucket: str, object_: str, version_id: str,
+            need_data: bool) -> Optional[tuple]:
+        """(fi, fis) or None. `need_data=True` only matches entries
+        whose fis were read with read_data (inline payloads loaded) —
+        a metadata-only entry must not feed the data path its empty
+        inline sentinels."""
+        if not self.enabled:
+            return None
+        self.maybe_flush()
+        key = (bucket, object_, version_id)
+        with self._mu:
+            e = self._map.get(key)
+            if e is None or (need_data and not e["read_data"]):
+                self.misses += 1
+                return None
+            self._map.move_to_end(key)
+            self.hits += 1
+            return e["fi"], e["fis"]
+
+    def put(self, bucket: str, object_: str, version_id: str,
+            fi, fis, read_data: bool, token: int) -> None:
+        if not self.enabled:
+            return
+        self.maybe_flush()
+        key = (bucket, object_, version_id)
+        size = sum(len(f.inline_data) for f in fis
+                   if f is not None and f.inline_data)
+        with self._mu:
+            if self._gens.get(bucket, 0) != token:
+                return        # a mutation landed during the fan-out
+            old = self._map.get(key)
+            if old is not None:
+                if old["read_data"] and not read_data:
+                    return    # never downgrade a data-bearing entry
+                self._drop(key)
+            self._map[key] = {"fi": fi, "fis": fis,
+                              "read_data": read_data, "bytes": size}
+            self._bytes += size
+            while len(self._map) > self.max_entries \
+                    or self._bytes > self.max_bytes:
+                victim = next(iter(self._map))
+                self._drop(victim)
+                self.evictions += 1
+
+    def _drop(self, key) -> None:
+        e = self._map.pop(key, None)
+        if e is not None:
+            self._bytes -= e["bytes"]
+
+    # -- observability ---------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._mu:
+            total = self.hits + self.misses
+            return {
+                "enabled": self.enabled,
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": (self.hits / total) if total else 0.0,
+                "entries": len(self._map),
+                "bytes": self._bytes,
+                "evictions": self.evictions,
+                "invalidations": self.invalidations,
+            }

@@ -64,7 +64,14 @@ def heal_object(es, bucket: str, object_: str, version_id: str = "",
     normal vs deep, cmd/erasure-healing.go:296).
     """
     with es.ns.write(bucket, object_):
-        return _heal_object_locked(es, bucket, object_, version_id, deep)
+        result = _heal_object_locked(es, bucket, object_, version_id, deep)
+    if result.healed:
+        # Drive journals changed under this key: cached quorum
+        # fileinfo (here and, via the shared generation, in sibling
+        # pre-forked workers) must re-resolve or reads would keep an
+        # out-of-date holder map past the heal.
+        es.metacache.bump(bucket)
+    return result
 
 
 def _heal_object_locked(es, bucket: str, object_: str, version_id: str,

@@ -202,6 +202,12 @@ class MetaCache:
         self.on_bump: Optional[Callable] = None
         self._last_broadcast: dict[str, float] = {}
         self._pending_broadcast: set[str] = set()
+        # Local bump listeners (no coalescing, fired on EVERY bump —
+        # including broadcast=False pulls from peers/workers): bump is
+        # the one funnel every namespace mutation already goes through,
+        # so caches that must see writes (object/fi_cache) subscribe
+        # here instead of wiring each mutation call site.
+        self.listeners: list[Callable[[str], None]] = []
 
     def generation(self, bucket: str) -> int:
         with self._mu:
@@ -209,6 +215,11 @@ class MetaCache:
 
     def bump(self, bucket: str, broadcast: bool = True) -> None:
         """Any namespace mutation in the bucket orphans its walks."""
+        for listener in self.listeners:
+            try:
+                listener(bucket)
+            except Exception:  # noqa: BLE001 - listeners are best-effort
+                pass
         defer = 0.0
         with self._mu:
             self._gen[bucket] = self._gen.get(bucket, 0) + 1
@@ -253,6 +264,11 @@ class MetaCache:
             pass
 
     def drop_bucket(self, bucket: str) -> None:
+        for listener in self.listeners:
+            try:
+                listener(bucket)
+            except Exception:  # noqa: BLE001 - listeners are best-effort
+                pass
         with self._mu:
             self._gen.pop(bucket, None)
             self._last_broadcast.pop(bucket, None)

@@ -288,6 +288,45 @@ class Metrics:
             metric("minio_tpu_drive_queue_rejected_total",
                    "Submissions shed by bounded drive queues",
                    "counter", samples_r)
+
+        # -- read path: quorum-fileinfo cache + fused GET kernel --------
+        # Hit rate says whether repeat GETs skip the k-drive metadata
+        # fan-out; invalidations say writes are being observed; the
+        # kernel split says whether reads ride the native fast path.
+        if object_layer is not None:
+            fic = {"hits": 0, "misses": 0, "evictions": 0,
+                   "invalidations": 0, "entries": 0, "bytes": 0}
+            gk = {"native": 0, "numpy": 0, "demoted": 0}
+            for s in layer_sets(object_layer):
+                cache = getattr(s, "fi_cache", None)
+                if cache is not None:
+                    st = cache.stats()
+                    for key in fic:
+                        fic[key] += st[key]
+                for key in gk:
+                    gk[key] += getattr(s, "get_kernel", {}).get(key, 0)
+            for name, help_, type_, key in (
+                    ("minio_tpu_fileinfo_cache_hits_total",
+                     "GET/HEAD metadata served from the fileinfo cache",
+                     "counter", "hits"),
+                    ("minio_tpu_fileinfo_cache_misses_total",
+                     "Fileinfo lookups that paid the drive fan-out",
+                     "counter", "misses"),
+                    ("minio_tpu_fileinfo_cache_evictions_total",
+                     "Entries LRU-evicted from the fileinfo cache",
+                     "counter", "evictions"),
+                    ("minio_tpu_fileinfo_cache_invalidations_total",
+                     "Write/heal invalidations of cached fileinfo",
+                     "counter", "invalidations"),
+                    ("minio_tpu_fileinfo_cache_entries",
+                     "Keys currently cached", "gauge", "entries"),
+                    ("minio_tpu_fileinfo_cache_bytes",
+                     "Resident inline bytes held by cached fileinfo",
+                     "gauge", "bytes")):
+                metric(name, help_, type_, [({}, fic[key])])
+            metric("minio_tpu_get_kernel_windows_total",
+                   "GET windows decoded, by path",
+                   "counter", [({"path": p}, v) for p, v in gk.items()])
         if peer_states:
             metric("minio_tpu_worker_in_flight",
                    "In-flight requests per pre-forked worker", "gauge",
@@ -388,17 +427,27 @@ def node_info(server) -> dict:
     from minio_tpu.io.bufpool import global_pool
     info["bufpool"] = global_pool().stats()
     engine = []
+    fileinfo = []
+    get_kernel = {"native": 0, "numpy": 0, "demoted": 0}
     for si, s in enumerate(sets):
         eng = getattr(s, "io", None)
         if eng is not None:
             engine.append({"set": si, "drives": eng.stats()})
+        cache = getattr(s, "fi_cache", None)
+        if cache is not None:
+            fileinfo.append({"set": si, **cache.stats()})
+        for key in get_kernel:
+            get_kernel[key] += getattr(s, "get_kernel", {}).get(key, 0)
     info["io_engine"] = engine
+    info["fileinfo_cache"] = fileinfo
+    info["get_kernel"] = get_kernel
     cluster = getattr(server, "cluster_stats", None)
     if cluster is not None:
         try:
             info["workers"] = [
                 {k: p.get(k) for k in ("worker", "pid", "in_flight",
-                                       "unreachable", "bufpool")
+                                       "unreachable", "bufpool",
+                                       "fileinfo_cache")
                  if k in p}
                 for p in cluster()]
         except Exception:  # noqa: BLE001 - control plane down; own view

@@ -1,0 +1,460 @@
+"""Read-path acceleration: fused native GET kernel golden tests,
+native/numpy/streaming byte-identity under a range sweep, quorum-
+fileinfo cache coherence (overwrite/delete/heal, zero-drive-call
+repeat GETs), and pooled-lease hygiene of the streaming reader.
+
+The invariants here are the PR's acceptance gates: the three GET paths
+must be byte-identical for ANY range over ANY layout (single-part,
+multipart, inline), and a cached repeat GET must issue zero
+read_version drive calls while never surviving a mutation.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import gc
+import shutil
+import tempfile
+
+import numpy as np
+import pytest
+
+from minio_tpu import native
+from minio_tpu.object.erasure_object import BLOCK_SIZE, ErasureSet
+from minio_tpu.object.types import GetOptions, ObjectNotFound, PutOptions
+from minio_tpu.storage import bitrot
+from minio_tpu.storage.local import LocalStorage
+from minio_tpu.utils.highwayhash import MAGIC_KEY
+
+RNG = np.random.default_rng(20260803)
+
+
+# ---------------------------------------------------------------------------
+# mtpu_get_frame golden tests
+# ---------------------------------------------------------------------------
+
+def _frame_shard_rows(rows):
+    """On-disk framing of one shard's block rows: digest || block."""
+    out = bytearray()
+    for block in rows:
+        out += bitrot.hash_block(bitrot.HIGHWAYHASH256S, block)
+        out += bytes(block)
+    return bytes(out)
+
+
+def _numpy_reference(shards_rows, k, nb, take_full, take_last):
+    ref = bytearray()
+    for b in range(nb):
+        take = take_last if b == nb - 1 else take_full
+        chunk = b"".join(bytes(shards_rows[j][b]) for j in range(k))
+        ref += chunk[:take]
+    return bytes(ref)
+
+
+@pytest.mark.parametrize("k,S,nb,slast,take_last", [
+    (8, 1 << 17, 3, 1 << 17, BLOCK_SIZE),      # aligned full blocks
+    (8, 1 << 17, 3, 7, 8 * 7),                 # ragged object tail
+    (8, 1 << 17, 1, 5, 40),                    # single ragged block
+    (3, 349526, 2, 349524, BLOCK_SIZE - 2),    # k does not divide BLOCK
+    (2, 1 << 19, 2, 11, 22),                   # tiny tail, k=2
+])
+def test_get_frame_golden(k, S, nb, slast, take_last):
+    lib = native.load()
+    if lib is None:
+        pytest.skip("native library unavailable")
+    shards_rows, blobs = [], []
+    for _ in range(k):
+        rows = [RNG.integers(0, 256,
+                             size=(slast if b == nb - 1 else S),
+                             dtype=np.uint8)
+                for b in range(nb)]
+        shards_rows.append(rows)
+        blobs.append(_frame_shard_rows(rows))
+    ref = _numpy_reference(shards_rows, k, nb, BLOCK_SIZE, take_last)
+
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    keep = [ctypes.c_char_p(b) for b in blobs]
+    ptrs = (u8p * k)(*[ctypes.cast(c, u8p) for c in keep])
+    out = (ctypes.c_uint8 * len(ref))()
+    rc = lib.mtpu_get_frame(native._u8(MAGIC_KEY), ptrs, k, S, nb,
+                            slast, BLOCK_SIZE, take_last, out)
+    assert rc == 0
+    assert bytes(out) == ref
+
+
+def test_get_frame_reports_corrupt_shards():
+    lib = native.load()
+    if lib is None:
+        pytest.skip("native library unavailable")
+    k, S, nb = 4, 1 << 16, 2
+    shards_rows, blobs = [], []
+    for _ in range(k):
+        rows = [RNG.integers(0, 256, size=S, dtype=np.uint8)
+                for _ in range(nb)]
+        shards_rows.append(rows)
+        blobs.append(bytearray(_frame_shard_rows(rows)))
+    # Flip one data byte in shard 1 and one in shard 3.
+    blobs[1][32 + 100] ^= 0xFF
+    blobs[3][(32 + S) + 32 + 5] ^= 0x01
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    keep = [ctypes.c_char_p(bytes(b)) for b in blobs]
+    ptrs = (u8p * k)(*[ctypes.cast(c, u8p) for c in keep])
+    out = (ctypes.c_uint8 * (nb * BLOCK_SIZE))()
+    rc = lib.mtpu_get_frame(native._u8(MAGIC_KEY), ptrs, k, S, nb, S,
+                            BLOCK_SIZE, BLOCK_SIZE, out)
+    assert rc == (1 << 1) | (1 << 3)
+
+
+# ---------------------------------------------------------------------------
+# object-layer fixtures
+# ---------------------------------------------------------------------------
+
+class CountingDisk:
+    """Delegating wrapper that counts read_version calls."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.read_version_calls = 0
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def read_version(self, *a, **kw):
+        self.read_version_calls += 1
+        return self._inner.read_version(*a, **kw)
+
+
+@pytest.fixture()
+def es6():
+    root = tempfile.mkdtemp(prefix="getpath-")
+    disks = [CountingDisk(LocalStorage(f"{root}/d{i}")) for i in range(6)]
+    for d in disks:
+        d.make_vol("b")
+    es = ErasureSet(disks, parity=2)
+    yield es, disks
+    es.close()
+    shutil.rmtree(root, ignore_errors=True)
+
+
+def _sweep_ranges(size: int):
+    """Offsets/lengths hugging block (and part) boundaries + random."""
+    interesting = {0, 1, BLOCK_SIZE - 1, BLOCK_SIZE, BLOCK_SIZE + 1,
+                   2 * BLOCK_SIZE - 1, 2 * BLOCK_SIZE, size - 1}
+    pairs = [(0, size), (7, size - 8)]
+    for off in sorted(o for o in interesting if 0 <= o < size):
+        for ln in (1, BLOCK_SIZE + 3):
+            if 0 < ln <= size - off:
+                pairs.append((off, ln))
+    for _ in range(3):
+        off = int(RNG.integers(0, size))
+        ln = int(RNG.integers(1, size - off + 1))
+        pairs.append((off, ln))
+    return pairs
+
+
+def _read_three_ways(es, bucket, key, off, ln, monkeypatch_ctx):
+    got_native = es.get_object(bucket, key,
+                               GetOptions(offset=off, length=ln))[1]
+    _, chunks = es.get_object_stream(bucket, key,
+                                     GetOptions(offset=off, length=ln))
+    got_stream = b"".join(bytes(c) for c in chunks)
+    with monkeypatch_ctx() as m:
+        m.setattr("minio_tpu.native.load", lambda: None)
+        got_numpy = es.get_object(bucket, key,
+                                  GetOptions(offset=off, length=ln))[1]
+    return got_native, got_numpy, got_stream
+
+
+def test_range_sweep_single_part(es6, monkeypatch):
+    es, _ = es6
+    size = 2 * BLOCK_SIZE + 34567
+    body = RNG.integers(0, 256, size=size, dtype=np.uint8).tobytes()
+    es.put_object("b", "o", body)
+    for off, ln in _sweep_ranges(size):
+        got_native, got_numpy, got_stream = _read_three_ways(
+            es, "b", "o", off, ln, monkeypatch.context)
+        want = body[off:off + ln]
+        assert got_native == want, (off, ln, "native")
+        assert got_numpy == want, (off, ln, "numpy")
+        assert got_stream == want, (off, ln, "stream")
+    assert es.get_kernel["native"] > 0
+
+
+def test_range_sweep_multipart(es6, monkeypatch):
+    es, _ = es6
+    p1 = RNG.integers(0, 256, size=5 * (1 << 20) + 17,
+                      dtype=np.uint8).tobytes()
+    p2 = RNG.integers(0, 256, size=(1 << 20) + 999,
+                      dtype=np.uint8).tobytes()
+    uid = es.new_multipart_upload("b", "mp")
+    e1 = es.put_object_part("b", "mp", uid, 1, p1).etag
+    e2 = es.put_object_part("b", "mp", uid, 2, p2).etag
+    es.complete_multipart_upload("b", "mp", uid, [(1, e1), (2, e2)])
+    body = p1 + p2
+    size = len(body)
+    # Ranges straddling the part boundary + the generic sweep points.
+    pairs = _sweep_ranges(size)[:8]
+    pairs += [(len(p1) - 5, 10), (len(p1) - 1, 1), (len(p1), 1),
+              (len(p1) - BLOCK_SIZE, 2 * BLOCK_SIZE)]
+    for off, ln in pairs:
+        if not (0 <= off < size and 0 < ln <= size - off):
+            continue
+        got_native, got_numpy, got_stream = _read_three_ways(
+            es, "b", "mp", off, ln, monkeypatch.context)
+        want = body[off:off + ln]
+        assert got_native == want, (off, ln, "native")
+        assert got_numpy == want, (off, ln, "numpy")
+        assert got_stream == want, (off, ln, "stream")
+
+
+def test_range_sweep_inline(es6, monkeypatch):
+    es, _ = es6
+    body = RNG.integers(0, 256, size=100_000, dtype=np.uint8).tobytes()
+    es.put_object("b", "tiny", body)
+    for off, ln in [(0, 100_000), (0, 1), (99_999, 1), (12345, 4567)]:
+        got_native, got_numpy, got_stream = _read_three_ways(
+            es, "b", "tiny", off, ln, monkeypatch.context)
+        want = body[off:off + ln]
+        assert got_native == want == got_numpy == got_stream, (off, ln)
+
+
+# ---------------------------------------------------------------------------
+# fileinfo cache coherence
+# ---------------------------------------------------------------------------
+
+def test_repeat_get_zero_drive_metadata_calls(es6):
+    es, disks = es6
+    body = RNG.integers(0, 256, size=1 << 20, dtype=np.uint8).tobytes()
+    es.put_object("b", "hot", body)
+    _, got = es.get_object("b", "hot")          # cold: pays the fan-out
+    assert got == body
+    before = sum(d.read_version_calls for d in disks)
+    for _ in range(3):
+        _, got = es.get_object("b", "hot")      # hot: memory hit
+        assert got == body
+    es.get_object_info("b", "hot")
+    assert sum(d.read_version_calls for d in disks) == before, \
+        "repeat GET of a cached object must issue zero read_version calls"
+    assert es.fi_cache.stats()["hits"] >= 4
+
+
+def test_cache_invalidation_overwrite_delete(es6):
+    es, _ = es6
+    es.put_object("b", "k", b"v1" * 50000)
+    assert es.get_object("b", "k")[1] == b"v1" * 50000
+    es.put_object("b", "k", b"v2" * 70000)      # overwrite -> bump
+    assert es.get_object("b", "k")[1] == b"v2" * 70000
+    es.delete_object("b", "k")
+    with pytest.raises(ObjectNotFound):
+        es.get_object("b", "k")
+
+
+def test_cache_invalidation_heal(es6):
+    es, disks = es6
+    body = RNG.integers(0, 256, size=(1 << 20) + 5,
+                        dtype=np.uint8).tobytes()
+    es.put_object("b", "healme", body)
+    assert es.get_object("b", "healme")[1] == body      # cached
+    # Destroy one drive's whole copy behind the cache's back.
+    disks[2].delete("b", "healme", recursive=True)
+    inv0 = es.fi_cache.stats()["invalidations"]
+    res = es.heal_object("b", "healme")
+    assert res.healed >= 1
+    assert es.fi_cache.stats()["invalidations"] > inv0, \
+        "a heal that rewrote drive state must invalidate cached fileinfo"
+    # Re-read resolves fresh metadata and the healed drive serves again.
+    assert es.get_object("b", "healme")[1] == body
+
+
+def test_cache_disabled_by_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("MTPU_FILEINFO_CACHE", "0")
+    disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    for d in disks:
+        d.make_vol("b")
+    es = ErasureSet(disks, parity=1)
+    try:
+        es.put_object("b", "o", b"z" * 300_000)
+        es.get_object("b", "o")
+        es.get_object("b", "o")
+        st = es.fi_cache.stats()
+        assert not st["enabled"] and st["hits"] == 0 and st["entries"] == 0
+    finally:
+        es.close()
+
+
+def test_versioned_get_cached_per_version(es6):
+    es, disks = es6
+    v1 = es.put_object("b", "ver", b"a" * 200_000,
+                       PutOptions(versioned=True)).version_id
+    v2 = es.put_object("b", "ver", b"b" * 200_000,
+                       PutOptions(versioned=True)).version_id
+    assert es.get_object("b", "ver", GetOptions(version_id=v1))[1] \
+        == b"a" * 200_000
+    assert es.get_object("b", "ver", GetOptions(version_id=v2))[1] \
+        == b"b" * 200_000
+    assert es.get_object("b", "ver")[1] == b"b" * 200_000  # prime latest
+    before = sum(d.read_version_calls for d in disks)
+    assert es.get_object("b", "ver", GetOptions(version_id=v1))[1] \
+        == b"a" * 200_000
+    assert es.get_object("b", "ver")[1] == b"b" * 200_000
+    assert sum(d.read_version_calls for d in disks) == before
+
+
+# ---------------------------------------------------------------------------
+# degraded reads through the new paths
+# ---------------------------------------------------------------------------
+
+def test_bitrot_demotes_to_reconstruct_and_heals(es6):
+    es, disks = es6
+    body = RNG.integers(0, 256, size=(2 << 20) + 777,
+                        dtype=np.uint8).tobytes()
+    es.put_object("b", "rot", body)
+    # Corrupt one data byte of one shard file on disk.
+    fi = disks[0].read_version("b", "rot")
+    import os
+    target = None
+    for d in disks:
+        p = os.path.join(d.root, "b", "rot", fi.data_dir, "part.1")
+        if os.path.exists(p):
+            target = p
+            break
+    assert target is not None
+    with open(target, "r+b") as f:
+        f.seek(40)
+        c = f.read(1)
+        f.seek(40)
+        f.write(bytes([c[0] ^ 0xFF]))
+    demoted0 = es.get_kernel["demoted"]
+    _, got = es.get_object("b", "rot")
+    assert got == body, "degraded read must reconstruct byte-identically"
+    assert es.get_kernel["demoted"] > demoted0
+
+
+def test_missing_shard_reconstructs_via_numpy_path(es6):
+    es, disks = es6
+    body = RNG.integers(0, 256, size=(1 << 20) + 13,
+                        dtype=np.uint8).tobytes()
+    es.put_object("b", "gone", body)
+    fi = disks[0].read_version("b", "gone")
+    import os
+    removed = 0
+    for d in disks:
+        p = os.path.join(d.root, "b", "gone", fi.data_dir, "part.1")
+        if os.path.exists(p) and removed < 2:
+            os.unlink(p)
+            removed += 1
+    assert removed == 2
+    _, got = es.get_object("b", "gone")
+    assert got == body
+
+
+# ---------------------------------------------------------------------------
+# cross-process coherence: 2 pre-forked workers
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fi_worker_server(tmp_path_factory):
+    """A 2-worker pre-forked fleet on shared drives (subprocess — the
+    pytest process has JAX loaded and fork-after-JAX is unsafe)."""
+    import os
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import time
+    root = tmp_path_factory.mktemp("fiworkers")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", MTPU_HTTP_WORKERS="2")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "minio_tpu.server",
+         "--address", f"127.0.0.1:{port}", "--scanner-interval", "0",
+         f"{root}/d{{1...4}}"],
+        env=env, cwd=repo,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    from tests.s3client import S3Client
+    address = f"127.0.0.1:{port}"
+    deadline = time.time() + 90
+    ready = False
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            break
+        try:
+            st, _, _ = S3Client(address).request(
+                "GET", "/minio/health/live", sign=False)
+            if st == 200:
+                ready = True
+                break
+        except OSError:
+            time.sleep(0.4)
+    if not ready:
+        out = proc.stdout.read().decode(errors="replace") \
+            if proc.stdout else ""
+        proc.kill()
+        pytest.skip(f"worker fleet failed to boot: {out[-800:]}")
+    yield address
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(timeout=25)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def test_workers_fileinfo_cache_cross_invalidation(fi_worker_server):
+    """Overwrites and deletes from ANY pre-forked worker invalidate
+    every sibling's fileinfo cache: prime both workers' caches with
+    repeat GETs on fresh connections (the kernel spreads them), then
+    overwrite and assert NO connection anywhere serves stale bytes."""
+    from tests.s3client import S3Client
+    addr = fi_worker_server
+    assert S3Client(addr).request("PUT", "/fib")[0] == 200
+    body1 = b"one" * 123_457
+    body2 = b"two" * 150_001
+    assert S3Client(addr).request("PUT", "/fib/k", body=body1)[0] == 200
+    for _ in range(8):       # fresh connections: both workers cache it
+        st, _, got = S3Client(addr).request("GET", "/fib/k")
+        assert st == 200 and got == body1
+    assert S3Client(addr).request("PUT", "/fib/k", body=body2)[0] == 200
+    for _ in range(8):
+        st, _, got = S3Client(addr).request("GET", "/fib/k")
+        assert st == 200 and got == body2, \
+            "stale fileinfo served across workers after overwrite"
+    assert S3Client(addr).request("DELETE", "/fib/k")[0] == 204
+    for _ in range(6):
+        st, _, _ = S3Client(addr).request("GET", "/fib/k")
+        assert st == 404, "deleted object still served from a cache"
+
+
+# ---------------------------------------------------------------------------
+# pooled-lease hygiene of the streaming reader
+# ---------------------------------------------------------------------------
+
+def test_stream_close_returns_pooled_leases(es6):
+    from minio_tpu.io.bufpool import global_pool
+    es, _ = es6
+    size = 40 << 20                    # > GET_WINDOW_BYTES: multi-window
+    body = RNG.integers(0, 256, size=size, dtype=np.uint8).tobytes()
+    es.put_object("b", "big", body)
+    gc.collect()
+    pool = global_pool()
+    out0 = pool.stats()["outstanding"]
+    leaks0 = pool.stats()["leaks"]
+    _, chunks = es.get_object_stream("b", "big")
+    first = bytes(next(chunks))
+    assert body.startswith(first) and len(first) > 0
+    chunks.close()                      # mid-stream abandon
+    gc.collect()
+    st = pool.stats()
+    assert st["outstanding"] == out0, "stream close leaked pooled leases"
+    assert st["leaks"] == leaks0
+    # And a full consume is byte-identical + clean.
+    _, chunks = es.get_object_stream("b", "big")
+    acc = bytearray()
+    for c in chunks:
+        acc += c
+    assert bytes(acc) == body
+    gc.collect()
+    assert pool.stats()["outstanding"] == out0
