@@ -244,7 +244,7 @@ def _worker_stat(server, worker_id: int) -> dict:
         fic = getattr(s, "fi_cache", None)
         if fic is not None:
             fileinfo.append(fic.stats())
-    return {
+    stat = {
         "worker": worker_id,
         "pid": os.getpid(),
         "in_flight": server._inflight,
@@ -254,6 +254,17 @@ def _worker_stat(server, worker_id: int) -> dict:
         "engine": engine,
         "fileinfo_cache": fileinfo,
     }
+    dh = getattr(server, "drive_heal", None)
+    if dh is not None:
+        # Bulk heals run on worker 0 only, but SO_REUSEPORT balances
+        # metrics/admin scrapes across ALL workers — without this every
+        # non-0 worker would answer "no heal running" for (N-1)/N of
+        # the scrapes.
+        try:
+            stat["drive_heal"] = dh.status()
+        except Exception:  # noqa: BLE001 - status best effort
+            pass
+    return stat
 
 
 class WorkerContext:
@@ -294,7 +305,20 @@ class WorkerContext:
 
         def drain(signum, frame):
             try:
+                dh = getattr(server, "drive_heal", None)
+                if dh is not None:
+                    # Checkpoint any in-flight bulk heal on the way
+                    # out (stop() sets the event; bulk_heal_drive
+                    # persists its position and returns) so the next
+                    # boot resumes instead of rescanning from 'a'.
+                    dh.stop()
                 server.stop()
+                # Graceful drain: stamp local drives so the next boot
+                # skips the deep crash-recovery sweep.
+                from minio_tpu.storage.local import mark_clean_shutdown
+                for s in layer_sets(server.object_layer):
+                    for d in s.disks:
+                        mark_clean_shutdown(d)
             finally:
                 os._exit(0)
         signal.signal(signal.SIGTERM, drain)

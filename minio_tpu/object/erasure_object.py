@@ -76,6 +76,15 @@ MIN_DEVICE_BLOCKS = 8
 _RESERVED_BUCKETS = {SYS_VOL}
 
 
+def new_staging() -> str:
+    """A fresh staging dir path, pid-tagged (`staging/p<pid>-<uuid>`)
+    so the boot janitor (storage/local.sweep_stale_tmp) can tell a LIVE
+    sibling worker's in-flight PUT from a crash leftover and never
+    sweep the former."""
+    import os as _os
+    return f"{STAGING_PREFIX}/p{_os.getpid()}-{new_uuid()}"
+
+
 @functools.lru_cache(maxsize=1)
 def _on_tpu() -> bool:
     try:
@@ -932,7 +941,7 @@ class ErasureSet:
                 inline_data=_join_chunks(framed[shard_idx]) if inline else None,
             )
 
-        staging = f"{STAGING_PREFIX}/{new_uuid()}"
+        staging = new_staging()
 
         def write_one(disk_idx: int):
             d = self.disks[disk_idx]
@@ -1063,7 +1072,7 @@ class ErasureSet:
             parts = [ObjectPartInfo(number=1, size=len(data or b""),
                                     actual_size=len(data or b""))]
         data_dir = new_uuid()
-        staging = f"{STAGING_PREFIX}/{new_uuid()}"
+        staging = new_staging()
         # Frame each part independently: the read path opens part files
         # one by one and sizes shards per part.
         framed_parts = []
@@ -1271,7 +1280,7 @@ class ErasureSet:
         distribution = hash_order(f"{bucket}/{object_}", n)
         version_id = opts.version_id or (new_uuid() if opts.versioned else "")
         data_dir = new_uuid()
-        staging = f"{STAGING_PREFIX}/{new_uuid()}"
+        staging = new_staging()
 
         def path_for(i: int):
             return self.disks[i], SYS_VOL, f"{staging}/{data_dir}/part.1"

@@ -43,6 +43,7 @@ class HealResult:
     healed: int = 0
     data_blocks: int = 0
     parity_blocks: int = 0
+    size: int = 0                 # logical object bytes (bulk-heal stats)
 
 
 class HealError(Exception):
@@ -235,7 +236,7 @@ def _heal_object_locked(es, bucket: str, object_: str, version_id: str,
 
     result = HealResult(bucket=bucket, object=object_,
                         version_id=fi.version_id, before=list(states),
-                        data_blocks=k, parity_blocks=m)
+                        data_blocks=k, parity_blocks=m, size=fi.size)
     bad = [i for i in range(n) if states[i] in
            (DRIVE_STATE_MISSING, DRIVE_STATE_OUTDATED, DRIVE_STATE_CORRUPT)]
     if not bad:
@@ -285,7 +286,7 @@ def _heal_object_locked(es, bucket: str, object_: str, version_id: str,
                 part_shards[0][shard_idx], shard_size)
             d.write_metadata(bucket, object_, hfi)
         else:
-            staging = f"{eo.STAGING_PREFIX}/{eo.new_uuid()}"
+            staging = eo.new_staging()
             for pi, p in enumerate(parts):
                 framed = bitrot.frame_shard(part_shards[pi][shard_idx],
                                             shard_size)
@@ -376,9 +377,19 @@ class MRFQueue:
         self.q: "queue.Queue[tuple]" = queue.Queue(maxsize=max_items)
         self.retries = retries
         self.healed = 0
+        # Two failure counters with very different severities:
+        # `spilled` — bounded-queue overflow that parked the entry in
+        # the persisted pending set (nothing lost, replays later);
+        # `dropped` — retries exhausted, the heal is genuinely gone.
+        # Exported separately so alerting on real loss is possible.
+        self.spilled = 0
         self.dropped = 0
         self._persist = persist
-        self._pending: dict[tuple, int] = {}   # (bucket, obj, vid) -> 1
+        # (bucket, obj, vid) -> queued? False = overflow spill: the
+        # entry could not enter the bounded queue but stays pending, so
+        # it persists across save/boot cycles and re-feeds when the
+        # queue drains — queue.Full must never silently lose a heal.
+        self._pending: dict[tuple, bool] = {}
         self._dirty = False
         self._last_save = 0.0
         self._mu = threading.Lock()
@@ -389,13 +400,18 @@ class MRFQueue:
         self._worker.start()
 
     def enqueue(self, bucket: str, object_: str, version_id: str = "") -> None:
+        key = (bucket, object_, version_id)
+        with self._mu:
+            self._pending[key] = True
+            self._dirty = True
         try:
             self.q.put_nowait((bucket, object_, version_id, 0))
-            with self._mu:
-                self._pending[(bucket, object_, version_id)] = 1
-                self._dirty = True
         except queue.Full:
-            self.dropped += 1
+            # Spill: stays in _pending (persisted, replayed when the
+            # queue drains or at the next boot).
+            self.spilled += 1
+            with self._mu:
+                self._pending[key] = False
 
     # -- persistence ----------------------------------------------------
 
@@ -418,11 +434,12 @@ class MRFQueue:
                 except TypeError:
                     continue
         for (b, o, v) in entries:
+            self._pending[(b, o, v)] = True
             try:
                 self.q.put_nowait((b, o, v, 0))
-                self._pending[(b, o, v)] = 1
             except queue.Full:
-                self.dropped += 1
+                self.spilled += 1
+                self._pending[(b, o, v)] = False   # re-fed as q drains
 
     def _save(self) -> None:
         import json
@@ -457,6 +474,22 @@ class MRFQueue:
 
     # -- worker ---------------------------------------------------------
 
+    def _refill_one(self) -> None:
+        """Promote one overflow-spilled pending entry into the bounded
+        queue now that it has room."""
+        with self._mu:
+            key = next((k for k, queued in self._pending.items()
+                        if not queued), None)
+            if key is None:
+                return
+            self._pending[key] = True
+        try:
+            self.q.put_nowait((*key, 0))
+        except queue.Full:
+            with self._mu:
+                if key in self._pending:
+                    self._pending[key] = False
+
     def _run(self) -> None:
         while not self._stop.is_set():
             try:
@@ -466,6 +499,7 @@ class MRFQueue:
             try:
                 bucket, object_, vid, attempt = self.q.get(timeout=0.2)
             except queue.Empty:
+                self._refill_one()
                 continue
             try:
                 # MRF entries come from observed failures (degraded reads,
@@ -481,7 +515,12 @@ class MRFQueue:
                     try:
                         self.q.put_nowait((bucket, object_, vid, attempt + 1))
                     except queue.Full:
-                        self.dropped += 1
+                        # Spill back to pending: retried on a later
+                        # boot/save cycle rather than silently lost.
+                        self.spilled += 1
+                        with self._mu:
+                            if (bucket, object_, vid) in self._pending:
+                                self._pending[(bucket, object_, vid)] = False
                 else:
                     self.dropped += 1
                     with self._mu:
@@ -489,6 +528,12 @@ class MRFQueue:
                         self._dirty = True
             finally:
                 self.q.task_done()
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {"healed": self.healed, "spilled": self.spilled,
+                    "dropped": self.dropped,
+                    "pending": len(self._pending)}
 
     def drain(self, timeout: float = 10.0) -> None:
         """Testing hook: wait until queued AND in-flight items finish."""

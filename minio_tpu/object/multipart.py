@@ -347,7 +347,7 @@ def complete_multipart_upload(es, bucket: str, object_: str, upload_id: str,
     def commit_one(disk_idx: int):
         d = es.disks[disk_idx]
         shard_idx = dist[disk_idx] - 1
-        staging = f"{eo.STAGING_PREFIX}/{new_uuid()}"
+        staging = eo.new_staging()
         for num, _ in parts:
             d.rename_file(eo.SYS_VOL, f"{updir}/{part_files[num]}",
                           eo.SYS_VOL, f"{staging}/{data_dir}/part.{num}")

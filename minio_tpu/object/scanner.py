@@ -78,14 +78,16 @@ class DataUsage:
         return u
 
 
-def _walk_all_drives(es, bucket: str):
+def _walk_all_drives(es, bucket: str, forward_from: str = ""):
     """Merged sorted walk over ALL of the set's drives.
 
     Yields (path, [(disk_idx, xlmeta_blob), ...]) per key — presence per
-    drive falls out of the merge, no extra stat calls."""
+    drive falls out of the merge, no extra stat calls. `forward_from`
+    resumes the walk at a key (inclusive): checkpointed sweeps — the
+    bulk drive heal — restart where they stopped instead of at 'a'."""
     def tagged(i, d):
         try:
-            for path, blob in d.walk_dir(bucket):
+            for path, blob in d.walk_dir(bucket, forward_from=forward_from):
                 yield path, i, blob
         except Exception:  # noqa: BLE001 - offline drive: contributes nothing
             return
@@ -216,6 +218,18 @@ def check_drive_formats(sets: Sequence, set_size: int = 0) -> int:
                 healed += 1
             except Exception:  # noqa: BLE001 - still dead: next cycle
                 continue
+            # A replaced drive misses every object committed before the
+            # swap: mark it healing so the drive lifecycle manager
+            # (object/drive_heal) owns bringing it back with a
+            # checkpointed bulk heal. Best effort — without the marker
+            # the per-object scanner heals still converge, just without
+            # resume/progress.
+            try:
+                from minio_tpu.object.drive_heal import mark_healing
+                mark_healing(d, donor_pos[0], q,
+                             getattr(d, "endpoint", ""))
+            except Exception:  # noqa: BLE001 - marker is an optimization
+                pass
     return healed
 
 

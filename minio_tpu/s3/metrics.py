@@ -163,6 +163,30 @@ class Metrics:
             metric("minio_tpu_metacache_misses_total",
                    "Listing pages that required a drive walk", "counter",
                    [({}, misses)])
+            # MRF queue health: drops must be VISIBLE — a heal that
+            # silently vanishes is a future quorum loss (s._mrf, not
+            # s.mrf: rendering metrics must not start a worker).
+            mrf = {"healed": 0, "spilled": 0, "dropped": 0, "pending": 0}
+            for s in layer_sets(object_layer):
+                q = getattr(s, "_mrf", None)
+                if q is not None:
+                    st = q.stats()
+                    for key in mrf:
+                        mrf[key] += st[key]
+            metric("minio_tpu_mrf_healed_total",
+                   "Objects healed off the MRF retry queue", "counter",
+                   [({}, mrf["healed"])])
+            metric("minio_tpu_mrf_spilled_total",
+                   "MRF entries that overflowed the bounded queue into "
+                   "the persisted pending set (replayed, not lost)",
+                   "counter", [({}, mrf["spilled"])])
+            metric("minio_tpu_mrf_dropped_total",
+                   "MRF heals abandoned after exhausting retries "
+                   "(real loss — alert on this)", "counter",
+                   [({}, mrf["dropped"])])
+            metric("minio_tpu_mrf_pending",
+                   "Heal entries awaiting MRF repair", "gauge",
+                   [({}, mrf["pending"])])
 
         if server is not None:
             adm = getattr(server, "admission", None)
@@ -230,6 +254,72 @@ class Metrics:
                        "Batch jobs by status", "gauge",
                        [({"status": s2}, v)
                         for s2, v in sorted(by_status.items())])
+            dh = getattr(server, "drive_heal", None)
+            st = None
+            if peer_states:
+                # Pre-forked mode: bulk heals run on worker 0 only,
+                # but scrapes land on any worker — render the FLEET's
+                # drive-heal state so every scrape sees the heal.
+                merged = {"formats_restored": 0, "drives": []}
+                found = False
+                for p in peer_states:
+                    pst = p.get("drive_heal")
+                    if isinstance(pst, dict):
+                        found = True
+                        merged["formats_restored"] += \
+                            pst.get("formats_restored", 0)
+                        merged["drives"].extend(pst.get("drives", []))
+                if found:
+                    st = merged
+            if st is None and dh is not None:
+                st = dh.status()
+            if st is not None:
+                # Drive replacement bulk-heal progress: one sample per
+                # healing (or recently finished) drive, so operators
+                # can watch a swap converge from any dashboard.
+                samples = {"scanned": [], "healed": [], "failed": [],
+                           "bytes": [], "eta": []}
+                healing_now = 0
+                for entry in st.get("drives", []):
+                    lab = {"set": entry.get("set", 0),
+                           "drive": entry.get("drive", 0)}
+                    if entry.get("state") != "done":
+                        healing_now += 1
+                    samples["scanned"].append(
+                        (lab, entry.get("objects_scanned", 0)))
+                    samples["healed"].append(
+                        (lab, entry.get("objects_healed", 0)))
+                    samples["failed"].append(
+                        (lab, entry.get("objects_failed", 0)))
+                    samples["bytes"].append(
+                        (lab, entry.get("bytes_healed", 0)))
+                    if "eta_seconds" in entry:
+                        samples["eta"].append(
+                            (lab, entry["eta_seconds"]))
+                metric("minio_tpu_drives_healing",
+                       "Drives currently under bulk heal", "gauge",
+                       [({}, healing_now)])
+                metric("minio_tpu_drive_heal_objects_scanned",
+                       "Objects scanned by each drive's bulk heal",
+                       "gauge", samples["scanned"])
+                metric("minio_tpu_drive_heal_objects_healed",
+                       "Objects repaired onto each replaced drive",
+                       "gauge", samples["healed"])
+                metric("minio_tpu_drive_heal_objects_failed",
+                       "Objects the bulk heal failed to repair "
+                       "(MRF/scanner retry later)", "gauge",
+                       samples["failed"])
+                metric("minio_tpu_drive_heal_bytes_healed",
+                       "Logical bytes repaired onto each replaced "
+                       "drive", "gauge", samples["bytes"])
+                metric("minio_tpu_drive_heal_eta_seconds",
+                       "Estimated seconds to bulk-heal completion "
+                       "(rate-based; needs a scanner object count)",
+                       "gauge", samples["eta"])
+                metric("minio_tpu_drive_formats_restored_total",
+                       "Fresh drives re-formatted into their slot at "
+                       "runtime", "counter",
+                       [({}, st.get("formats_restored", 0))])
             decom_status = getattr(server.object_layer,
                                    "decommission_status", None) \
                 if getattr(server, "object_layer", None) is not None \
@@ -416,6 +506,11 @@ def node_info(server) -> dict:
         "usage": usage,
         "heal": server.heal_status,
     }
+    if getattr(server, "drive_heal", None) is not None:
+        try:
+            info["drive_heal"] = server.drive_heal.status()
+        except Exception:  # noqa: BLE001 - status best effort
+            pass
     adm = getattr(server, "admission", None)
     if adm is not None:
         # Shed/queue/deadline counters per request class: the operator-
@@ -447,7 +542,7 @@ def node_info(server) -> dict:
             info["workers"] = [
                 {k: p.get(k) for k in ("worker", "pid", "in_flight",
                                        "unreachable", "bufpool",
-                                       "fileinfo_cache")
+                                       "fileinfo_cache", "drive_heal")
                  if k in p}
                 for p in cluster()]
         except Exception:  # noqa: BLE001 - control plane down; own view

@@ -151,6 +151,10 @@ class S3Server:
         self.heal_status: dict = {"state": "idle"}
         self._heal_thread: threading.Thread | None = None
         self._heal_lock = threading.Lock()
+        # Drive lifecycle manager (object/drive_heal.DriveHealManager):
+        # hot-replacement detection + checkpointed bulk heals. Wired by
+        # minio_tpu.server boot; None = feature idle (tests, bare sets).
+        self.drive_heal = None
         # Event notifier (events.EventNotifier); None = no targets.
         self.notifier = None
         # KMS for SSE-S3 (None until configured via MTPU_KMS_SECRET_KEY).
@@ -2505,20 +2509,44 @@ def _make_handler(server: S3Server):
             return self._send(200 if status == "200" else 204)
 
         def _health_ready(self):
-            """Readiness: every erasure set must keep a read quorum
-            (n - parity responding drives; probed in parallel) —
-            reference: ClusterCheckHandler, cmd/healthcheck-handler.go."""
+            """Readiness: honest about degradation. 503 with a JSON
+            body NAMING the degraded sets when any erasure set is below
+            write quorum or still bulk-healing a replaced drive —
+            orchestrators keep traffic off a node that would fail or
+            slow-path writes (reference: ClusterCheckHandler,
+            cmd/healthcheck-handler.go, plus the maintenance probe's
+            healing awareness)."""
+            import json as _json
             sets = _layer_sets(server.object_layer)
             if not sets:
-                return self._send(503)
+                return self._send(503, _json.dumps(
+                    {"ready": False, "reason": "no erasure sets"}
+                ).encode(), content_type="application/json")
             probes = _probe_disks(server.object_layer)
+            degraded = []
             for si, s in enumerate(sets):
-                ok = sum(1 for psi, _, di in probes
-                         if psi == si and di is not None)
-                need = len(s.disks) - getattr(s, "default_parity", 0)
-                if ok < max(need, len(s.disks) // 2):
-                    return self._send(503)
-            return self._send(200)
+                infos = [di for psi, _, di in probes if psi == si]
+                ok = sum(1 for di in infos if di is not None)
+                healing = sum(1 for di in infos
+                              if di is not None
+                              and getattr(di, "healing", False))
+                n = len(s.disks)
+                parity = getattr(s, "default_parity", 0)
+                k = n - parity
+                write_quorum = max(k + (1 if k == parity else 0),
+                                   n // 2 + (1 if n > 1 else 0))
+                if ok < write_quorum or healing:
+                    degraded.append({
+                        "set": si, "drives_online": ok, "drives": n,
+                        "write_quorum": write_quorum,
+                        "healing_drives": healing,
+                    })
+            if degraded:
+                return self._send(503, _json.dumps(
+                    {"ready": False, "degraded_sets": degraded}
+                ).encode(), content_type="application/json")
+            return self._send(200, _json.dumps({"ready": True}).encode(),
+                              content_type="application/json")
 
         def _admin_speedtest(self, q1):
             """Self-measured object throughput (reference: `mc admin
@@ -2679,8 +2707,41 @@ def _make_handler(server: S3Server):
                                                            daemon=True)
                     server._heal_thread.start()
             return self._send(200, _json.dumps(
-                server.heal_status).encode(),
+                self._heal_payload()).encode(),
                 content_type="application/json")
+
+        def _heal_payload(self):
+            """Admin heal status: the sweep slot plus, when the drive
+            lifecycle manager is wired, per-drive bulk-heal progress
+            (scanned/healed/failed/bytes/ETA + checkpoint). In
+            pre-forked mode the bulk heal lives in worker 0 while this
+            request may land on any worker, so the fleet's snapshots
+            are merged when the control plane is up."""
+            payload = dict(server.heal_status)
+            merged = None
+            if server.cluster_stats is not None:
+                try:
+                    agg = {"formats_restored": 0, "drives": []}
+                    found = False
+                    for p in server.cluster_stats():
+                        pst = p.get("drive_heal")
+                        if isinstance(pst, dict):
+                            found = True
+                            agg["formats_restored"] += \
+                                pst.get("formats_restored", 0)
+                            agg["drives"].extend(pst.get("drives", []))
+                    if found:
+                        merged = agg
+                except Exception:  # noqa: BLE001 - control plane down
+                    merged = None
+            if merged is None and server.drive_heal is not None:
+                try:
+                    merged = server.drive_heal.status()
+                except Exception:  # noqa: BLE001 - status best effort
+                    merged = None
+            if merged is not None:
+                payload["drive_heal"] = merged
+            return payload
 
         # -- admin API (/minio/admin/v3/...) ---------------------------
 
@@ -2702,7 +2763,7 @@ def _make_handler(server: S3Server):
                 return self._admin_heal(query)
             if op == "heal" and method == "GET":
                 return self._send(200,
-                                  _json.dumps(server.heal_status).encode(),
+                                  _json.dumps(self._heal_payload()).encode(),
                                   content_type="application/json")
             body = self._read_body()
             q1 = {k: v[0] for k, v in query.items()}

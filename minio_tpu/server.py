@@ -222,6 +222,9 @@ def main(argv=None) -> int:
     pools = []
     deployment_id = None
     n_sets = n_drives = 0
+    # (pool_idx, bucket, path) found damaged by the mount-time recovery
+    # sweep — enqueued onto the owning set's MRF once sets exist.
+    pending_heals: list[tuple] = []
     for spec in pool_eps:
         disks = [make_disk(ep) for ep in spec]
         # Set-size/divisibility/parity were validated pre-fork above
@@ -262,18 +265,37 @@ def main(argv=None) -> int:
         deployment_id = deployment_id or fmt.deployment_id
         ordered = [d if d is not None else OfflineDisk(f"pos-{i}")
                    for i, d in enumerate(ordered)]
-        # Boot janitor: crashed PUTs leave staged shards under the
-        # system volume; sweep them before serving (reference sweeps
-        # .minio.sys/tmp at startup). First-boot worker 0 only:
-        # siblings (and a RESPAWNED worker 0) boot while others are
-        # already serving, and sweeping then would destroy their
-        # in-flight staged writes.
+        # Boot janitor + crash recovery: crashed PUTs leave staged
+        # shards under the system volume and interrupted rename_data
+        # commits leave dangling data dirs / journals referencing lost
+        # data (reference sweeps .minio.sys/tmp at startup). The
+        # recovery sweep purges the former, removes the latter's
+        # orphans, and reports journal-vs-data mismatches for MRF
+        # repair. First-boot worker 0 only: siblings (and a RESPAWNED
+        # worker 0) boot while others are already serving — pid-tagged
+        # staging names and the age gate add a second line of defense
+        # (storage/local.sweep_stale_tmp). MTPU_RECOVERY_SWEEP=off
+        # falls back to the plain tmp/staging purge.
         if worker_id in ("", "0") \
                 and not os.environ.get("MTPU_WORKER_RESPAWN"):
-            from minio_tpu.storage.local import sweep_stale_tmp
+            from minio_tpu.storage.local import (consume_clean_shutdown,
+                                                 recovery_sweep,
+                                                 sweep_stale_tmp)
+            deep_sweep = os.environ.get(
+                "MTPU_RECOVERY_SWEEP", "on").lower() not in ("0", "off",
+                                                             "false")
             for d in ordered:
                 try:
-                    sweep_stale_tmp(d)
+                    # The deep sweep walks the whole namespace — only
+                    # worth it when the previous stop was NOT graceful
+                    # (crash/power cut). Clean restarts take the cheap
+                    # tmp/staging purge.
+                    if deep_sweep and not consume_clean_shutdown(d):
+                        rep = recovery_sweep(d)
+                        for vol, path in rep["heal"]:
+                            pending_heals.append((len(pools), vol, path))
+                    else:
+                        sweep_stale_tmp(d)
                 except Exception:  # noqa: BLE001 - janitor never blocks boot
                     pass
         # Deadline + circuit-breaker wrapper: a hung (not dead) drive
@@ -328,6 +350,15 @@ def main(argv=None) -> int:
                 print("resuming interrupted pool rebalance", flush=True)
         except Exception as e:  # noqa: BLE001 - must not block boot
             print(f"WARN: rebalance resume failed: {e}", file=sys.stderr)
+    # Crash-recovery repairs found by the mount-time sweep: route each
+    # damaged object to its owning set's MRF (heals are idempotent and
+    # deep-verified there).
+    for pool_idx, vol, path in pending_heals:
+        try:
+            p = pools[pool_idx]
+            p.sets[p.set_index(path)].mrf.enqueue(vol, path)
+        except Exception:  # noqa: BLE001 - scanner converges it later
+            pass
     # Background data scanner: usage accounting, 1/1024 deep-heal
     # sampling, replaced-drive format restore (reference:
     # cmd/data-scanner.go's scanner loop).
@@ -344,6 +375,19 @@ def main(argv=None) -> int:
     if args.scanner_interval > 0 and worker_id in ("", "0"):
         scanner.start()
     layer.scanner = scanner
+    # Drive lifecycle manager: detect hot-replaced (fresh) drives while
+    # serving, restore their slot format, and run checkpointed bulk
+    # heals that resume across restarts (object/drive_heal). Worker-0
+    # gated like the scanner — n workers bulk-healing shared drives
+    # would multiply every repair by n.
+    from minio_tpu.object.drive_heal import (DriveHealManager,
+                                             admission_pressure)
+    drive_heal = DriveHealManager(
+        all_sets, total_hint=lambda: scanner.usage.objects)
+    layer.drive_heal = drive_heal
+    if worker_id in ("", "0"):
+        drive_heal.start(interval=args.scanner_interval
+                         if args.scanner_interval > 0 else 10.0)
     # IAM: users/service-accounts/policies, replicated on pool 0's
     # drives (reference: cmd/iam.go bootstrap).
     from minio_tpu.iam import IAMSys
@@ -352,6 +396,10 @@ def main(argv=None) -> int:
     srv = S3Server(layer, address=args.address, credentials=creds)
     # Quota enforcement reads the scanner's usage accounting.
     srv.scanner = scanner
+    # Drive-heal progress in admin heal status + Prometheus; the bulk
+    # heal sheds while admission control reports client queueing.
+    srv.drive_heal = drive_heal
+    drive_heal.pressure = lambda: admission_pressure(srv.admission)
     # Warm tiers: registry on pool 0's drives, resolved by every set's
     # read/transition paths (reference: globalTierConfigMgr).
     from minio_tpu.object.tier import TierRegistry
@@ -495,6 +543,7 @@ def main(argv=None) -> int:
             time.sleep(3600)
     except KeyboardInterrupt:
         scanner.stop()
+        drive_heal.stop()
         if ftp is not None:
             # Gateways stop BEFORE the S3 server closes the object
             # layer (their in-flight transfers use it).
@@ -502,6 +551,11 @@ def main(argv=None) -> int:
         srv.stop()
         if grid_srv is not None:
             grid_srv.stop()
+        # Graceful exit: stamp every local drive so the next boot skips
+        # the deep crash-recovery sweep (storage/local.recovery_sweep).
+        from minio_tpu.storage.local import mark_clean_shutdown
+        for d in local_disks.values():
+            mark_clean_shutdown(d)
     return 0
 
 

@@ -1,0 +1,332 @@
+"""CrashDisk: a power-loss fault double (sibling of NaughtyDisk).
+
+NaughtyDisk models a drive that ERRORS; CrashDisk models the node
+LOSING POWER mid-write: every drive of the node stops at the same
+instant (one shared CrashClock), the in-flight mutation is torn or
+dropped according to what the syscall sequence had durably committed,
+and every call after the cut fails with PowerCut — the process is gone.
+
+The clock ticks once per durable MUTATION SUB-STEP, so a crash point
+can land BETWEEN the halves of a composite commit (rename_data moves
+the data dir, then writes xl.meta — the reference's RenameData,
+cmd/xl-storage.go:2557; delete_version rewrites the journal, then
+reclaims shard data). Sweeping crash_at over 1..N therefore walks
+every interesting interleaving of a PUT/multipart/delete/heal commit
+fan-out, which is exactly what the crash-point matrix tests do.
+
+Tear modes (what the platter holds for the interrupted write):
+  * "drop" — buffered bytes never hit the platter: the mutation has
+    no effect (the page cache died with the power);
+  * "tear" — a prefix of the in-flight write landed: torn shard files
+    appear in staging, torn journal writes appear as tmp files (the
+    protocol stages both; a torn file never sits at a commit
+    destination), and an interrupted rename_data leaves its data dir
+    moved in with no journal claim;
+  * "lose_entry" — a non-journaling filesystem without directory
+    fsync: in addition to dropping the in-flight write, the LAST
+    completed-but-unsynced rename on every drive is rolled back (its
+    directory entry was still in the cache). MTPU_FS_OSYNC exists
+    precisely because this mode can surface the OLD version of a
+    quorum-acknowledged write — the matrix asserts old-or-new here,
+    never durability.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from typing import Optional
+
+from minio_tpu.storage.local import SYS_VOL, TMP_DIR, StorageError
+
+TEAR_MODES = ("drop", "tear", "lose_entry")
+
+
+class PowerCut(StorageError):
+    """The node lost power: this and every later call cannot happen."""
+
+
+class CrashClock:
+    """Shared mutation counter across all of one node's CrashDisks.
+
+    crash_at: the 1-based mutation sub-step at which power dies
+    (0 = never). Registered disks get their lose_entry rollback applied
+    the moment the clock fires, from whichever thread fired it.
+    """
+
+    def __init__(self, crash_at: int = 0):
+        self.crash_at = crash_at
+        self.count = 0
+        self.fired = False
+        self._mu = threading.Lock()
+        self._disks: list = []
+
+    def register(self, disk: "CrashDisk") -> None:
+        with self._mu:
+            self._disks.append(disk)
+
+    def dead(self) -> bool:
+        with self._mu:
+            return self.fired
+
+    def tick(self) -> bool:
+        """Advance one mutation sub-step. True = the power dies ON this
+        sub-step (the caller applies its partial effect, then raises).
+        Raises PowerCut when the node is ALREADY dead — an op that was
+        mid-flight when the power died cannot perform its remaining
+        sub-steps."""
+        with self._mu:
+            if self.fired:
+                raise PowerCut("node lost power")
+            self.count += 1
+            if self.crash_at and self.count == self.crash_at:
+                self.fired = True
+                disks = list(self._disks)
+            else:
+                return False
+        for d in disks:
+            d._on_power_cut()
+        return True
+
+
+# Ops that mutate durable state, with their sub-step count. Everything
+# else passes through while the node is alive.
+_MUTATORS = {
+    "create_file": 1, "write_all": 1, "write_metadata": 1,
+    "update_metadata": 1, "write_format": 1, "rename_file": 1,
+    "make_vol": 1, "make_vol_if_missing": 1, "delete_vol": 1,
+    "delete": 1,
+    "rename_data": 2,       # data-dir move | journal commit
+    "delete_version": 2,    # journal rewrite | data-dir reclaim
+}
+
+
+class CrashDisk:
+    """Wraps a LocalStorage with the power-cut model above. The double
+    knows LocalStorage's on-disk layout (it must, to fabricate the
+    partial states a real cut leaves behind)."""
+
+    def __init__(self, disk, clock: CrashClock, mode: str = "drop"):
+        if mode not in TEAR_MODES:
+            raise ValueError(f"unknown tear mode {mode!r}")
+        self._disk = disk
+        self._clock = clock
+        self.mode = mode
+        self._mu = threading.Lock()
+        # (dest_path, prior_bytes_or_None) of the most recent atomic
+        # rename-commit — the un-fsynced directory entry lose_entry
+        # rolls back when the power dies.
+        self._last_commit: Optional[tuple] = None
+        clock.register(self)
+
+    @property
+    def wrapped(self):
+        return self._disk
+
+    @property
+    def endpoint(self):
+        return getattr(self._disk, "endpoint", "crash")
+
+    @property
+    def root(self):
+        return getattr(self._disk, "root", None)
+
+    # -- power-cut effects ----------------------------------------------
+
+    def _check_alive(self) -> None:
+        if self._clock.dead():
+            raise PowerCut(f"drive {self.endpoint}: node lost power")
+
+    def _on_power_cut(self) -> None:
+        """Called once when the clock fires (any disk, any thread)."""
+        if self.mode != "lose_entry":
+            return
+        with self._mu:
+            last, self._last_commit = self._last_commit, None
+        if last is None:
+            return
+        dest, prior = last
+        try:
+            if prior is None:
+                if os.path.isdir(dest):
+                    shutil.rmtree(dest, ignore_errors=True)
+                else:
+                    os.remove(dest)
+            else:
+                with open(dest, "wb") as f:
+                    f.write(prior)
+        except OSError:
+            pass
+
+    def _note_commit_file(self, dest: str, prior: Optional[bytes]) -> None:
+        """Record a completed journal rename-commit (dest + the bytes
+        it replaced, None = fresh file) so lose_entry can void the
+        un-fsynced directory entry when the power dies."""
+        if self.mode != "lose_entry":
+            return
+        with self._mu:
+            self._last_commit = (dest, prior)
+
+    def _tear_tmp(self, payload: bytes) -> None:
+        """Leave a torn tmp file behind (mode=tear): the half-written
+        staging file of an interrupted atomic write."""
+        if self.mode != "tear" or self.root is None:
+            return
+        import uuid
+        tmp = os.path.join(self.root, SYS_VOL, TMP_DIR,
+                           f"torn-{uuid.uuid4()}")
+        try:
+            os.makedirs(os.path.dirname(tmp), exist_ok=True)
+            with open(tmp, "wb") as f:
+                f.write(payload[:max(0, len(payload) // 2)])
+        except OSError:
+            pass
+
+    # -- mutators --------------------------------------------------------
+
+    def _meta_prior(self, volume: str, path: str) -> Optional[bytes]:
+        """Current journal bytes (None = absent) for lose_entry."""
+        if self.mode != "lose_entry" or self.root is None:
+            return None
+        try:
+            with open(os.path.join(self.root, volume, path,
+                                   "xl.meta"), "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def create_file(self, volume, path, data):
+        self._check_alive()
+        if self._clock.tick():
+            if self.mode == "tear":
+                # A prefix of the shard stream made it to the platter.
+                blob = data if isinstance(data, (bytes, bytearray)) \
+                    else b"".join(bytes(c) for c in data)
+                dest = self._disk._obj_dir(volume, path)
+                try:
+                    os.makedirs(os.path.dirname(dest), exist_ok=True)
+                    with open(dest, "wb") as f:
+                        f.write(blob[:max(0, len(blob) - 1) // 2])
+                except OSError:
+                    pass
+            raise PowerCut(f"{self.endpoint}: power cut in create_file")
+        return self._disk.create_file(volume, path, data)
+
+    def _simple_atomic(self, op, volume, path, payload, *args, **kwargs):
+        self._check_alive()
+        if self._clock.tick():
+            self._tear_tmp(payload)
+            raise PowerCut(f"{self.endpoint}: power cut in {op}")
+        prior = self._meta_prior(volume, path) \
+            if op in ("write_metadata", "update_metadata") else None
+        result = getattr(self._disk, op)(volume, path, *args, **kwargs)
+        if op in ("write_metadata", "update_metadata"):
+            self._note_commit_file(
+                os.path.join(self.root, volume, path, "xl.meta"), prior)
+        return result
+
+    def write_all(self, volume, path, data):
+        return self._simple_atomic("write_all", volume, path, data, data)
+
+    def write_metadata(self, volume, path, fi):
+        return self._simple_atomic("write_metadata", volume, path, b"",
+                                   fi)
+
+    def update_metadata(self, volume, path, fi):
+        return self._simple_atomic("update_metadata", volume, path, b"",
+                                   fi)
+
+    def rename_data(self, src_volume, src_path, fi, dst_volume, dst_path):
+        self._check_alive()
+        d = self._disk
+        dst_dir = d._obj_dir(dst_volume, dst_path)
+        # Sub-step 1: the data-dir move. In tear mode the rename's
+        # entry is taken as durable (journaled), so an interrupted
+        # commit leaves the moved-in data dir with no journal claim —
+        # the dangling state recovery_sweep must undo.
+        if self._clock.tick():
+            if self.mode == "tear" and fi.data_dir:
+                try:
+                    src_data = os.path.join(
+                        d._obj_dir(src_volume, src_path), fi.data_dir)
+                    os.makedirs(dst_dir, exist_ok=True)
+                    os.replace(src_data,
+                               os.path.join(dst_dir, fi.data_dir))
+                except OSError:
+                    pass
+            raise PowerCut(
+                f"{self.endpoint}: power cut moving data dir")
+        # Sub-step 2: the journal commit (the commit point).
+        if self._clock.tick():
+            if fi.data_dir:
+                try:
+                    src_data = os.path.join(
+                        d._obj_dir(src_volume, src_path), fi.data_dir)
+                    os.makedirs(dst_dir, exist_ok=True)
+                    os.replace(src_data,
+                               os.path.join(dst_dir, fi.data_dir))
+                except OSError:
+                    pass
+            self._tear_tmp(b"x" * 256)
+            raise PowerCut(
+                f"{self.endpoint}: power cut committing journal")
+        prior = self._meta_prior(dst_volume, dst_path)
+        result = d.rename_data(src_volume, src_path, fi, dst_volume,
+                               dst_path)
+        self._note_commit_file(os.path.join(dst_dir, "xl.meta"), prior)
+        return result
+
+    def delete_version(self, volume, path, version_id="",
+                       force_del_marker=False):
+        self._check_alive()
+        # Sub-step 1: the journal rewrite.
+        if self._clock.tick():
+            raise PowerCut(
+                f"{self.endpoint}: power cut before journal rewrite")
+        # Sub-step 2: shard-data reclaim. A cut here = journal already
+        # rewritten (the delete IS committed) but the version's data
+        # dir survives as garbage — the dangling state the recovery
+        # sweep removes.
+        if self._clock.tick():
+            self._partial_delete_version(volume, path, version_id)
+            raise PowerCut(
+                f"{self.endpoint}: power cut reclaiming data dir")
+        return self._disk.delete_version(volume, path, version_id,
+                                         force_del_marker)
+
+    def _partial_delete_version(self, volume, path, version_id) -> None:
+        """Journal rewritten, data dir left behind."""
+        from minio_tpu.storage import meta as metafmt
+        d = self._disk
+        try:
+            with d._path_lock(volume, path):
+                xl = d._read_meta(volume, path)
+                xl.delete_version(version_id)
+                meta_path = d._meta_path(volume, path)
+                if not xl.versions:
+                    os.remove(meta_path)
+                else:
+                    d._atomic_write(meta_path, xl.dump())
+        except (OSError, metafmt.MetaError, metafmt.FileNotFoundErr,
+                metafmt.VersionNotFoundErr):
+            pass
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._disk, name)
+        if not callable(attr):
+            return attr
+
+        if name in _MUTATORS:
+            def mutate(*args, **kwargs):
+                self._check_alive()
+                if self._clock.tick():
+                    raise PowerCut(
+                        f"{self.endpoint}: power cut in {name}")
+                return attr(*args, **kwargs)
+            return mutate
+
+        def passthrough(*args, **kwargs):
+            self._check_alive()
+            return attr(*args, **kwargs)
+        return passthrough

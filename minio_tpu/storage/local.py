@@ -25,6 +25,7 @@ import json
 import os
 import shutil
 import threading
+import time
 import uuid as uuid_mod
 from dataclasses import dataclass, field
 from typing import Iterator, Optional
@@ -37,6 +38,11 @@ SYS_VOL = ".mtpu.sys"
 META_FILE = "xl.meta"
 TMP_DIR = "tmp"
 FORMAT_FILE = "format.json"
+# Healing marker (the analogue of the reference's .healing.bin,
+# cmd/background-newdisks-heal-ops.go): present on a drive that was
+# re-formatted into its slot at runtime and has not finished its bulk
+# heal. Holds the checkpointed HealingTracker JSON (object/drive_heal).
+HEALING_FILE = "healing.json"
 
 # Directory-entry fsync after rename commits. The reference syncs file
 # CONTENTS (Fdatasync, cmd/xl-storage.go:2195) on every commit but syncs
@@ -778,19 +784,117 @@ class LocalStorage:
         st = os.statvfs(self.root)
         total = st.f_blocks * st.f_frsize
         free = st.f_bavail * st.f_frsize
+        healing = os.path.exists(
+            os.path.join(self.root, SYS_VOL, HEALING_FILE))
         return DiskInfo(total=total, free=free, used=total - free,
-                        endpoint=self.endpoint, disk_id=self.disk_id())
+                        healing=healing, endpoint=self.endpoint,
+                        disk_id=self.disk_id())
 
 
-def sweep_stale_tmp(disk) -> int:
+# -- healing marker (drive replacement lifecycle) -----------------------
+# Duck-typed over the StorageAPI (read_all/write_all/delete) so the
+# same helpers work on LocalStorage, RemoteStorage and health-wrapped
+# drives. The tracker JSON itself is owned by object/drive_heal.
+
+
+def read_healing(disk) -> Optional[dict]:
+    """The drive's healing tracker, or None (absent / unreachable)."""
+    try:
+        return json.loads(disk.read_all(SYS_VOL, HEALING_FILE))
+    except Exception:  # noqa: BLE001 - no marker == not healing
+        return None
+
+
+def write_healing(disk, tracker: dict) -> None:
+    disk.write_all(SYS_VOL, HEALING_FILE,
+                   json.dumps(tracker, indent=1).encode())
+
+
+def clear_healing(disk) -> None:
+    try:
+        disk.delete(SYS_VOL, HEALING_FILE)
+    except Exception:  # noqa: BLE001 - already gone / offline
+        pass
+
+
+# Graceful-stop stamp: present only when the previous process exited
+# through its shutdown path. Its ABSENCE at boot means a crash/power
+# cut, which is what gates the (O(namespace)) deep recovery sweep —
+# clean restarts skip straight to the cheap tmp/staging purge. The
+# failure direction is safe: a lost stamp only costs an extra sweep.
+CLEAN_SHUTDOWN_FILE = "clean.shutdown"
+
+
+def mark_clean_shutdown(disk) -> None:
+    root = getattr(disk, "root", None)
+    if root is None:
+        return
+    try:
+        with open(os.path.join(root, SYS_VOL, CLEAN_SHUTDOWN_FILE),
+                  "wb") as f:
+            f.write(b"1")
+    except OSError:
+        pass
+
+
+def consume_clean_shutdown(disk) -> bool:
+    """True when the previous stop was graceful. Consumes the stamp so
+    the next boot re-evaluates from scratch."""
+    root = getattr(disk, "root", None)
+    if root is None:
+        return False
+    try:
+        os.remove(os.path.join(root, SYS_VOL, CLEAN_SHUTDOWN_FILE))
+        return True
+    except OSError:
+        return False
+
+
+def _staging_owner_pid(name: str) -> Optional[int]:
+    """Pid embedded in a pid-tagged staging/tmp entry name
+    (erasure_object.new_staging writes `p<pid>-<uuid>`)."""
+    if not name.startswith("p"):
+        return None
+    head = name[1:].split("-", 1)[0]
+    return int(head) if head.isdigit() else None
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True        # EPERM: exists, owned by someone else
+
+
+def sweep_stale_tmp(disk, min_age: Optional[float] = None) -> int:
     """Boot-time janitor: remove crash leftovers under the system
     volume's tmp/ and staging/ dirs (the reference sweeps .minio.sys/tmp
     at startup; without this, every crashed PUT's staged shards
-    accumulate forever). Only safe before the drive starts serving.
-    Returns the number of entries removed."""
+    accumulate forever). Returns the number of entries removed.
+
+    Safety gates (a worker-0 sweep runs while sibling pre-forked
+    workers may already be serving):
+      * pid-tagged staging entries (`p<pid>-<uuid>`, see
+        erasure_object.new_staging) belonging to a LIVE process other
+        than this one are skipped — they are a sibling's in-flight
+        PUT; a tag whose owner is dead is a crash leftover at any age;
+      * untagged entries are age-gated by `min_age` (default
+        MTPU_SWEEP_MIN_AGE, seconds): a freshly-modified legacy entry
+        survives the sweep.
+    """
     root = getattr(disk, "root", None)
     if root is None:
         return 0
+    if min_age is None:
+        try:
+            min_age = float(os.environ.get("MTPU_SWEEP_MIN_AGE", "0"))
+        except ValueError:
+            min_age = 0.0
+    now = time.time()
+    me = os.getpid()
     removed = 0
     for sub in (TMP_DIR, "staging"):
         base = os.path.join(root, SYS_VOL, sub)
@@ -800,6 +904,19 @@ def sweep_stale_tmp(disk) -> int:
             continue
         for name in entries:
             full = os.path.join(base, name)
+            pid = _staging_owner_pid(name)
+            if pid is not None:
+                # Pid tag is authoritative: a live sibling's entry is
+                # untouchable at any age; a dead owner's entry is a
+                # crash leftover at any age.
+                if pid != me and _pid_alive(pid):
+                    continue
+            elif min_age > 0:
+                try:
+                    if now - os.lstat(full).st_mtime < min_age:
+                        continue
+                except OSError:
+                    continue
             try:
                 if os.path.isdir(full):
                     shutil.rmtree(full)
@@ -809,3 +926,121 @@ def sweep_stale_tmp(disk) -> int:
             except OSError:
                 continue
     return removed
+
+
+def _is_uuid_name(n: str) -> bool:
+    try:
+        uuid_mod.UUID(n)
+        return True
+    except ValueError:
+        return False
+
+
+def _only_part_files(d: str) -> bool:
+    """True when `d` holds nothing but shard part files — the shape of
+    a version data dir, never of a user key prefix."""
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return False
+    return bool(names) and all(
+        n.startswith("part.") and os.path.isfile(os.path.join(d, n))
+        for n in names)
+
+
+def recovery_sweep(disk, min_age: Optional[float] = None) -> dict:
+    """Mount-time crash recovery (extends sweep_stale_tmp): after a
+    power cut, bring this drive back to a state where every object is
+    either the complete old or the complete new version.
+
+      1. stale tmp/staging purge (torn in-flight writes live there —
+         the tmp+fdatasync+rename protocol never exposes a torn file
+         at its destination);
+      2. dangling data-dir repair: a UUID-named, part-files-only child
+         that no xl.meta version references is the first half of an
+         interrupted rename_data commit — the journal (= the commit
+         point) never flipped, so the orphan is removed and the old
+         version stands;
+      3. a corrupt (torn) xl.meta is quarantined and the object is
+         reported for heal — peers hold the quorum copy;
+      4. an xl.meta version whose data dir is MISSING (a lost,
+         un-fsynced directory entry) is reported for heal so the MRF
+         can rebuild the shards from peers.
+
+    Returns {"removed": int, "dangling": int, "heal": [(bucket, path)]}
+    — the caller enqueues the heal list onto the owning set's MRF.
+    Only safe before the drive starts serving.
+    """
+    out = {"removed": sweep_stale_tmp(disk, min_age),
+           "dangling": 0, "heal": []}
+    root = getattr(disk, "root", None)
+    if root is None:
+        return out
+
+    def scan(vol: str, rel: str) -> None:
+        base = os.path.join(root, vol, rel) if rel else os.path.join(root,
+                                                                     vol)
+        meta_path = os.path.join(base, META_FILE)
+        refs: Optional[frozenset] = None
+        if os.path.isfile(meta_path):
+            try:
+                with open(meta_path, "rb") as f:
+                    xl = XLMeta.load(f.read())
+                refs = frozenset(v.get("ddir", "") for v in xl.versions
+                                 if v.get("ddir"))
+                # A version whose shard data should exist locally but
+                # does not (lost directory entry): rebuildable from
+                # peers. Delete markers carry no ddir; inline versions
+                # live in the journal itself; tier-transitioned
+                # versions reclaimed their local data on purpose.
+                if any(v.get("ddir") and not v.get("inline")
+                       and not (v.get("meta") or {}).get(
+                           "x-internal-tier-name")  # tier.META_TIER
+                       and not os.path.isdir(os.path.join(base, v["ddir"]))
+                       for v in xl.versions):
+                    out["heal"].append((vol, rel))
+            except (OSError, MetaError):
+                # Torn journal: quarantine — an unreadable commit point
+                # serves nothing; heal rewrites it from the quorum.
+                try:
+                    os.remove(meta_path)
+                except OSError:
+                    pass
+                out["heal"].append((vol, rel))
+                refs = frozenset()
+        try:
+            names = os.listdir(base)
+        except OSError:
+            return
+        for n in names:
+            if n == META_FILE:
+                continue
+            full = os.path.join(base, n)
+            if not os.path.isdir(full):
+                continue
+            child = f"{rel}/{n}" if rel else n
+            if _is_uuid_name(n) and _only_part_files(full) \
+                    and (refs is None or n not in refs):
+                # Data dir without a journal claim: the un-committed
+                # half of an interrupted rename_data. Remove; the old
+                # version (or nothing, for a fresh PUT) stands.
+                shutil.rmtree(full, ignore_errors=True)
+                out["dangling"] += 1
+                continue
+            scan(vol, child)
+        try:
+            if not os.listdir(base) and rel:
+                os.rmdir(base)
+        except OSError:
+            pass
+
+    try:
+        vols = sorted(os.listdir(root))
+    except OSError:
+        return out
+    for vol in vols:
+        if vol == SYS_VOL or not _is_valid_volname(vol):
+            continue
+        if os.path.isdir(os.path.join(root, vol)):
+            scan(vol, "")
+    return out

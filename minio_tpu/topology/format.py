@@ -142,8 +142,32 @@ def load_and_order(disks: Sequence, set_size: int) -> tuple[list, FormatInfo]:
                 d.write_format(fmt.to_json())
             except Exception:  # noqa: BLE001 - unreachable/readonly drive
                 d = None
+            if d is not None:
+                # A fresh drive adopting a previously-formatted slot is
+                # a REPLACED drive: every object committed before the
+                # swap is missing from it. Mark it healing so the drive
+                # lifecycle manager (object/drive_heal) runs — and, via
+                # the persisted tracker, RESUMES — a bulk heal; reads
+                # meanwhile reconstruct around the hole and writes land
+                # on it immediately.
+                _mark_fresh_healing(d, pos, set_size)
         ordered.append(d)
     return ordered, ref
+
+
+def _mark_fresh_healing(d, pos: int, set_size: int) -> None:
+    """Write the healing marker for a freshly-adopted drive (boot-time
+    analogue of the reference's initHealingTracker on a fresh disk,
+    cmd/background-newdisks-heal-ops.go). Indices are the pool-local
+    (row, column); the lifecycle manager re-stamps them when it adopts
+    the tracker. Best effort: a marker that cannot be written only
+    costs the bulk heal its restart resume."""
+    try:
+        from minio_tpu.object.drive_heal import mark_healing
+        mark_healing(d, pos // set_size, pos % set_size,
+                     getattr(d, "endpoint", ""))
+    except Exception:  # noqa: BLE001 - marker is an optimization
+        pass
 
 
 def _safe_read(d) -> Optional[dict]:

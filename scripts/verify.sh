@@ -18,6 +18,18 @@ if [ "${MTPU_BENCH_SMOKE:-}" = "1" ]; then
     bash scripts/bench_smoke.sh || exit 1
 fi
 
+# Opt-in crash-consistency sweep (MTPU_CRASH_SWEEP=1): the full
+# power-cut crash-point matrix (tests/test_crash_matrix.py, marked
+# slow) — every injection point in the PUT/multipart/delete/heal
+# commit paths, asserted old-or-new after remount + recovery sweep.
+# Off by default: ~200 crash-point runs keep it out of the tier-1
+# wall-time budget (a cheap smoke subset stays in tier-1).
+if [ "${MTPU_CRASH_SWEEP:-}" = "1" ]; then
+    echo "== crash-point matrix =="
+    env JAX_PLATFORMS=cpu python -m pytest tests/test_crash_matrix.py \
+        -q -p no:cacheprovider || exit 1
+fi
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \

@@ -1,0 +1,514 @@
+"""Drive lifecycle: hot replacement + checkpointed bulk heal, plus the
+satellite hardening (MRF overflow spill, sweep safety, readiness
+honesty, heal-vs-overwrite under NSLock, fi_cache invalidation after
+heal). Reference patterns: cmd/background-newdisks-heal-ops.go,
+cmd/global-heal.go, cmd/mrf.go."""
+
+import json
+import os
+import shutil
+import threading
+import time
+
+import pytest
+
+from minio_tpu.object.drive_heal import (DriveHealManager, admission_pressure,
+                                         bulk_heal_drive, new_tracker)
+from minio_tpu.object.erasure_object import ErasureSet
+from minio_tpu.object.healing import DRIVE_STATE_OK, MRFQueue
+from minio_tpu.storage.local import (SYS_VOL, LocalStorage, clear_healing,
+                                     read_healing, sweep_stale_tmp,
+                                     write_healing)
+
+BKT = "bkt"
+
+
+def make_set(tmp_path, n=4):
+    disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(n)]
+    es = ErasureSet(disks)
+    es.make_bucket(BKT)
+    return es
+
+
+def _replace_drive(tmp_path, i):
+    """Swap drive i for a factory-fresh one (empty dir, no format)."""
+    shutil.rmtree(tmp_path / f"d{i}")
+    os.makedirs(tmp_path / f"d{i}")
+
+
+def _init_formats(es):
+    from minio_tpu.topology.format import init_formats
+    init_formats(es.disks, len(es.disks))
+
+
+# ---------------------------------------------------------------------------
+# hot replacement e2e
+# ---------------------------------------------------------------------------
+
+def test_hot_replacement_converges_under_load(tmp_path):
+    es = make_set(tmp_path)
+    _init_formats(es)
+    objs = {f"pre-{i:03d}": os.urandom(40_000 + i) for i in range(12)}
+    for k, v in objs.items():
+        es.put_object(BKT, k, v)
+
+    _replace_drive(tmp_path, 1)
+    # Concurrent traffic while the manager detects + bulk-heals: PUTs
+    # land new data on the replaced drive immediately, GETs reconstruct
+    # around the hole — both at quorum throughout.
+    stop = threading.Event()
+    failures: list = []
+
+    def writer(tid):
+        # Read-your-writes traffic: GETs stay off the pre-swap keys so
+        # the degraded-read MRF hook cannot race the bulk heal to them
+        # (the heal-count assertions below need the bulk sweep to be
+        # the thing that repairs `objs`).
+        i = 0
+        while not stop.is_set():
+            key = f"live-{tid}-{i:03d}"
+            try:
+                body = os.urandom(20_000)
+                es.put_object(BKT, key, body)
+                _, got = es.get_object(BKT, key)
+                assert got == body
+            except Exception as e:  # noqa: BLE001 - collected for assert
+                failures.append((key, e))
+            i += 1
+
+    threads = [threading.Thread(target=writer, args=(t,), daemon=True)
+               for t in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        mgr = DriveHealManager([es], throttle=0.0, checkpoint_every=4)
+        started = mgr.poll_once()
+        assert started == 1 and mgr.formats_restored == 1
+        # The replaced drive got its slot identity back immediately.
+        assert es.disks[1].read_format() is not None
+        st = mgr.status()
+        assert st["drives"] and st["drives"][0]["state"] in ("healing",
+                                                            "done")
+        assert mgr.wait(60)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    assert not failures, f"traffic failed during heal: {failures[:3]}"
+
+    # Marker cleared, tracker finished with real progress counted.
+    assert read_healing(es.disks[1]) is None
+    st = mgr.status()["drives"][0]
+    assert st["state"] == "done" and st["finished"]
+    assert st["objects_healed"] >= len(objs)
+    assert st["bytes_healed"] >= sum(len(v) for v in objs.values())
+
+    # Convergence: zero missing/stale shards for the pre-swap objects
+    # on the replaced drive — a re-heal finds nothing to do.
+    for k, v in objs.items():
+        r = es.heal_object(BKT, k)
+        assert r.healed == 0 and r.before[1] == DRIVE_STATE_OK
+        _, got = es.get_object(BKT, k)
+        assert got == v
+    mgr.stop()
+    es.close()
+
+
+def test_bulk_heal_checkpoint_resumes_across_restart(tmp_path):
+    es = make_set(tmp_path)
+    _init_formats(es)
+    for i in range(30):
+        es.put_object(BKT, f"o-{i:04d}", os.urandom(30_000))
+    _replace_drive(tmp_path, 2)
+
+    mgr = DriveHealManager([es], throttle=0.0, checkpoint_every=3)
+    assert mgr.poll_once() == 1
+
+    # "Crash" the process mid-heal: stop the manager once a checkpoint
+    # landed on the drive, before the sweep finishes.
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        t = read_healing(es.disks[2])
+        if t and t.get("checkpoint_object"):
+            break
+        time.sleep(0.005)
+    mgr._stop.set()
+    mgr.wait(30)
+    persisted = read_healing(es.disks[2])
+    if persisted is None:
+        # The heal outran the stop signal — nothing left to resume;
+        # the run above still validated checkpoint persistence.
+        pytest.skip("bulk heal finished before the simulated crash")
+    assert persisted["checkpoint_object"] and not persisted["finished"]
+
+    # "Restart": a fresh manager resumes FROM the checkpoint, not from
+    # scratch — and converges.
+    mgr2 = DriveHealManager([es], throttle=0.0, checkpoint_every=3)
+    assert mgr2.poll_once() == 1
+    assert mgr2.wait(60)
+    assert read_healing(es.disks[2]) is None
+    done = mgr2.status()["drives"][0]
+    assert done["state"] == "done"
+    # Resumed sweep scanned from the checkpoint forward: strictly fewer
+    # walks than the full namespace plus the pre-crash progress.
+    assert done["objects_scanned"] <= 30
+    assert done["checkpoint_object"] >= persisted["checkpoint_object"]
+    for i in range(30):
+        r = es.heal_object(BKT, f"o-{i:04d}")
+        assert r.healed == 0 and r.before[2] == DRIVE_STATE_OK
+    mgr2.stop()
+    es.close()
+
+
+def test_bulk_heal_restores_every_version(tmp_path):
+    from minio_tpu.object.types import DeleteOptions, PutOptions
+    from minio_tpu.storage.meta import XLMeta
+    es = make_set(tmp_path)
+    _init_formats(es)
+    v1 = es.put_object(BKT, "ver", os.urandom(200_000),
+                       PutOptions(versioned=True))
+    v2 = es.put_object(BKT, "ver", os.urandom(210_000),
+                       PutOptions(versioned=True))
+    es.delete_object(BKT, "ver", DeleteOptions(versioned=True))
+
+    _replace_drive(tmp_path, 1)
+    mgr = DriveHealManager([es], throttle=0.0)
+    assert mgr.poll_once() == 1 and mgr.wait(60)
+
+    # The replaced drive holds the FULL version stack again: both data
+    # versions and the delete marker — not just the latest.
+    xl = XLMeta.load(open(tmp_path / "d1" / BKT / "ver" / "xl.meta",
+                          "rb").read())
+    vids = {v.get("vid") for v in xl.versions}
+    assert v1.version_id in vids and v2.version_id in vids
+    assert len(xl.versions) == 3
+    for vid in (v1.version_id, v2.version_id):
+        r = es.heal_object(BKT, "ver", vid)
+        assert r.healed == 0 and r.before[1] == DRIVE_STATE_OK
+    mgr.stop()
+    es.close()
+
+
+def test_clean_shutdown_stamp(tmp_path):
+    from minio_tpu.storage.local import (consume_clean_shutdown,
+                                         mark_clean_shutdown)
+    d = LocalStorage(str(tmp_path / "d0"))
+    assert not consume_clean_shutdown(d), "no stamp after a cold start"
+    mark_clean_shutdown(d)
+    assert consume_clean_shutdown(d), "graceful stop leaves the stamp"
+    assert not consume_clean_shutdown(d), "the stamp is single-use"
+
+
+def test_boot_time_fresh_drive_gets_healing_marker(tmp_path):
+    from minio_tpu.topology import format as fmt_mod
+    disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    fmt_mod.init_formats(disks, 4)
+    shutil.rmtree(tmp_path / "d3")
+    os.makedirs(tmp_path / "d3")
+    disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    ordered, _ = fmt_mod.load_and_order(disks, 4)
+    assert all(d is not None for d in ordered)
+    marked = [d for d in ordered if read_healing(d) is not None]
+    assert len(marked) == 1
+    t = read_healing(marked[0])
+    assert t["disk_index"] == 3 and not t["finished"]
+    # The marker surfaces on disk_info so readiness can see it.
+    assert marked[0].disk_info().healing
+
+
+def test_bulk_heal_sheds_under_admission_pressure(tmp_path):
+    es = make_set(tmp_path)
+    for i in range(4):
+        es.put_object(BKT, f"o-{i}", os.urandom(10_000))
+    _replace_drive(tmp_path, 1)
+    es.disks[1].write_format({"xl": {"this": "x"}})  # slot restored
+
+    pressured = {"on": True, "polls": 0}
+
+    def pressure():
+        pressured["polls"] += 1
+        return pressured["on"]
+
+    tracker = new_tracker(0, 1)
+    stop = threading.Event()
+    th = threading.Thread(
+        target=bulk_heal_drive,
+        args=(es, 1, tracker),
+        kwargs={"stop": stop, "pressure": pressure}, daemon=True)
+    th.start()
+    deadline = time.time() + 10
+    while pressured["polls"] == 0 and time.time() < deadline:
+        time.sleep(0.005)
+    assert pressured["polls"] > 0
+    # While shedding, no object progress happens.
+    time.sleep(0.1)
+    assert tracker["objects_scanned"] <= 1
+    pressured["on"] = False       # pressure clears -> heal proceeds
+    th.join(timeout=30)
+    assert tracker["finished"]
+    es.close()
+
+
+def test_admission_pressure_reads_snapshot():
+    class FakeAdm:
+        def __init__(self, waiting, in_flight=0, limit=0):
+            self._v = {"object": {"waiting": waiting,
+                                  "in_flight": in_flight,
+                                  "limit": limit}}
+
+        def snapshot(self):
+            return dict(self._v, deadline_exceeded_total=0)
+
+    assert not admission_pressure(None)
+    assert not admission_pressure(FakeAdm(0))
+    assert admission_pressure(FakeAdm(3))
+    assert admission_pressure(FakeAdm(0, in_flight=8, limit=8))
+
+
+# ---------------------------------------------------------------------------
+# readiness honesty + admin/metrics surfacing
+# ---------------------------------------------------------------------------
+
+def _raw_get(address, path):
+    import http.client
+    host, port = address.split(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=10)
+    try:
+        conn.request("GET", path)
+        r = conn.getresponse()
+        return r.status, r.read()
+    finally:
+        conn.close()
+
+
+def test_readiness_names_healing_sets(tmp_path):
+    from minio_tpu.s3.server import S3Server
+    es = make_set(tmp_path)
+    server = S3Server(es, address="127.0.0.1:0")
+    server.start()
+    try:
+        st, body = _raw_get(server.address, "/minio/health/ready")
+        assert st == 200 and json.loads(body)["ready"] is True
+
+        write_healing(es.disks[2], new_tracker(0, 2))
+        st, body = _raw_get(server.address, "/minio/health/ready")
+        assert st == 503
+        payload = json.loads(body)
+        assert payload["ready"] is False
+        assert payload["degraded_sets"][0]["set"] == 0
+        assert payload["degraded_sets"][0]["healing_drives"] == 1
+
+        clear_healing(es.disks[2])
+        st, _ = _raw_get(server.address, "/minio/health/ready")
+        assert st == 200
+    finally:
+        server.stop()
+
+
+def test_heal_endpoint_and_metrics_surface_drive_progress(tmp_path):
+    from minio_tpu.s3.server import S3Server
+    from tests.s3client import S3Client
+    es = make_set(tmp_path)
+    es.put_object(BKT, "o", os.urandom(10_000))
+    server = S3Server(es, address="127.0.0.1:0")
+    mgr = DriveHealManager([es])
+    tracker = dict(new_tracker(0, 1), objects_scanned=7,
+                   objects_healed=5, bytes_healed=12345, finished=True)
+    mgr._done[(0, 1)] = tracker
+    server.drive_heal = mgr
+    server.start()
+    try:
+        cli = S3Client(server.address)
+        st, _, body = cli.request("GET", "/minio/admin/v3/heal")
+        assert st == 200
+        payload = json.loads(body)
+        drives = payload["drive_heal"]["drives"]
+        assert drives[0]["objects_healed"] == 5
+        assert drives[0]["state"] == "done"
+
+        st, body = _raw_get(server.address, "/minio/v2/metrics/cluster")
+        text = body.decode()
+        assert "minio_tpu_drive_heal_objects_healed" in text
+        assert 'set="0",drive="1"} 5' in text
+        assert "minio_tpu_mrf_dropped_total" in text
+        assert "minio_tpu_drives_healing 0" in text
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# MRF overflow spill (satellite)
+# ---------------------------------------------------------------------------
+
+def test_mrf_overflow_spills_to_pending_and_replays(tmp_path):
+    es = make_set(tmp_path)
+    keys = []
+    for i in range(3):
+        k = f"mrf-{i}"
+        es.put_object(BKT, k, os.urandom(5_000))
+        keys.append(k)
+
+    q = MRFQueue(es, max_items=1, persist=True)
+    q._stop.set()
+    q._worker.join(timeout=5)
+    for k in keys:
+        q.enqueue(BKT, k, "")
+    st = q.stats()
+    assert st["pending"] == 3, "overflow entries must stay pending"
+    assert st["spilled"] == 2, "overflow must be visible as spills"
+    assert st["dropped"] == 0, "a spill is not a loss"
+    q.save_now()
+
+    # Next boot: the persisted spill replays in full, draining through
+    # the bounded queue as it frees up (the _refill_one path).
+    q2 = MRFQueue(es, max_items=1, persist=True)
+    deadline = time.time() + 30
+    while time.time() < deadline and q2.stats()["pending"]:
+        time.sleep(0.02)
+    assert q2.stats()["pending"] == 0
+    assert q2.healed == 3
+    q2.stop()
+    es.close()
+
+
+# ---------------------------------------------------------------------------
+# sweep safety (satellite)
+# ---------------------------------------------------------------------------
+
+def test_sweep_skips_live_workers_and_young_entries(tmp_path):
+    d = LocalStorage(str(tmp_path / "d0"))
+    staging = os.path.join(d.root, SYS_VOL, "staging")
+    os.makedirs(staging)
+    # A live sibling worker's in-flight PUT (pid 1 is always alive).
+    os.makedirs(os.path.join(staging, "p1-aaaa-bbbb"))
+    # A dead worker's leftover (pid far beyond pid_max growth in tests).
+    os.makedirs(os.path.join(staging, "p999999999-cccc"))
+    # Untagged legacy entry.
+    os.makedirs(os.path.join(staging, "dddd-eeee"))
+
+    removed = sweep_stale_tmp(d, min_age=3600)
+    assert removed == 1, "age gate must protect young untagged entries"
+    assert not os.path.isdir(os.path.join(staging, "p999999999-cccc"))
+    assert os.path.isdir(os.path.join(staging, "p1-aaaa-bbbb"))
+    assert os.path.isdir(os.path.join(staging, "dddd-eeee"))
+
+    removed = sweep_stale_tmp(d, min_age=0)
+    assert removed == 1
+    assert os.path.isdir(os.path.join(staging, "p1-aaaa-bbbb")), \
+        "a live sibling's staging must survive any sweep"
+    assert not os.path.isdir(os.path.join(staging, "dddd-eeee"))
+
+
+def test_recovery_sweep_classification(tmp_path):
+    import uuid
+    from minio_tpu.storage.local import recovery_sweep
+    es = make_set(tmp_path)
+    # Large enough that shards exceed the inline threshold: the
+    # journal must reference an on-disk data dir.
+    es.put_object(BKT, "whole", os.urandom(300_000))
+    es.put_object(BKT, "lost-data", os.urandom(300_000))
+    es.put_object(BKT, "torn-journal", os.urandom(300_000))
+    d0 = tmp_path / "d0"
+
+    # Lost directory entry: journal references a vanished data dir.
+    obj = d0 / BKT / "lost-data"
+    for child in os.listdir(obj):
+        if child != "xl.meta":
+            shutil.rmtree(obj / child)
+    # Interrupted rename_data: an unreferenced part-files-only UUID dir.
+    dangling = d0 / BKT / "whole" / str(uuid.uuid4())
+    os.makedirs(dangling)
+    (dangling / "part.1").write_bytes(b"half-written junk")
+    # Torn journal (never possible at a dest under the protocol, but
+    # the sweep must still recover a hand-broken drive).
+    (d0 / BKT / "torn-journal" / "xl.meta").write_bytes(b"\x85garbage")
+    # A UUID-named USER KEY prefix must never be reaped.
+    key_prefix = str(uuid.uuid4())
+    es.put_object(BKT, f"{key_prefix}/nested", os.urandom(9_000))
+
+    rep = recovery_sweep(LocalStorage(str(d0)), min_age=0)
+    # Two orphans reaped: the hand-made dangling dir, plus
+    # torn-journal's own data dir (once its journal is quarantined
+    # nothing references the data; heal rebuilds both from peers).
+    assert rep["dangling"] == 2 and not os.path.isdir(dangling)
+    assert (BKT, "lost-data") in rep["heal"]
+    assert (BKT, "torn-journal") in rep["heal"]
+    assert os.path.isdir(d0 / BKT / key_prefix)
+
+    # MRF-style repair of the findings restores full health.
+    for vol, path in rep["heal"]:
+        es.heal_object(vol, path, deep=True)
+    for key in ("whole", "lost-data", "torn-journal",
+                f"{key_prefix}/nested"):
+        r = es.heal_object(BKT, key)
+        assert r.healed == 0 and all(s == DRIVE_STATE_OK
+                                     for s in r.after), (key, r.after)
+    rep2 = recovery_sweep(LocalStorage(str(d0)), min_age=0)
+    assert rep2["dangling"] == 0 and rep2["heal"] == []
+    es.close()
+
+
+def test_staging_paths_are_pid_tagged():
+    from minio_tpu.object.erasure_object import new_staging
+    s = new_staging()
+    assert s.startswith(f"staging/p{os.getpid()}-")
+
+
+# ---------------------------------------------------------------------------
+# heal vs concurrent overwrite under NSLock; fi_cache invalidation
+# ---------------------------------------------------------------------------
+
+def test_heal_never_resurrects_old_version_under_overwrite(tmp_path):
+    es = make_set(tmp_path)
+    old = os.urandom(60_000)
+    es.put_object(BKT, "hot", old)
+    # Knock out one copy so the heal has real work racing the PUT.
+    shutil.rmtree(tmp_path / "d1" / BKT / "hot")
+    new = os.urandom(61_000)
+
+    results = {}
+
+    def healer():
+        try:
+            results["heal"] = es.heal_object(BKT, "hot", deep=True)
+        except Exception as e:  # noqa: BLE001 - asserted below
+            results["heal_err"] = e
+
+    t = threading.Thread(target=healer, daemon=True)
+    t.start()
+    es.put_object(BKT, "hot", new)       # races the heal under NSLock
+    t.join(timeout=30)
+    assert "heal_err" not in results, results.get("heal_err")
+
+    # Whatever interleaving won, the committed overwrite is what every
+    # read serves — the healed holder map never resurrects `old`.
+    _, got = es.get_object(BKT, "hot")
+    assert got == new
+    r = es.heal_object(BKT, "hot", deep=True)
+    assert r.healed == 0
+    _, got = es.get_object(BKT, "hot")
+    assert got == new
+    es.close()
+
+
+def test_fi_cache_invalidated_by_heal(tmp_path):
+    es = make_set(tmp_path)
+    data = os.urandom(50_000)
+    es.put_object(BKT, "c", data)
+    es.get_object(BKT, "c")
+    es.get_object(BKT, "c")
+    st0 = es.fi_cache.stats()
+    assert st0["hits"] >= 1 and st0["entries"] >= 1
+
+    # Stale drive repaired by heal -> the bump funnel must flush the
+    # cached holder map (a stale map would keep routing reads at the
+    # pre-heal shard layout).
+    shutil.rmtree(tmp_path / "d1" / BKT / "c")
+    r = es.heal_object(BKT, "c")
+    assert r.healed == 1
+    st1 = es.fi_cache.stats()
+    assert st1["invalidations"] > st0["invalidations"]
+    assert es.fi_cache.get(BKT, "c", "", need_data=False) is None
+    _, got = es.get_object(BKT, "c")
+    assert got == data
+    es.close()
