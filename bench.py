@@ -120,6 +120,8 @@ def main() -> None:
             _get_concurrent()
         if _want("range_get"):
             _range_get()
+        if _want("trace_overhead"):
+            _trace_overhead()
         return
 
     import jax
@@ -211,6 +213,10 @@ def main() -> None:
         _get_concurrent()
     if _want("range_get"):
         _range_get()
+
+    # ---- 8. Deep-tracing overhead: disarmed (default) vs armed --------
+    if _want("trace_overhead"):
+        _trace_overhead()
 
 
 def _put_latency() -> None:
@@ -545,6 +551,83 @@ def _range_get() -> None:
         }))
     finally:
         shutil.rmtree(root, ignore_errors=True)
+
+
+def _trace_overhead() -> None:
+    """Deep-tracing overhead: the same PUT/GET loops measured with span
+    collection DISARMED (the default — every call site reduces to one
+    module-attribute check) and ARMED (a bound TraceContext per op, the
+    shape a live `mc admin trace --types=all` subscriber induces).
+    Disarmed numbers are like-for-like with the put/get aggregate
+    sections, so the committed-artifact smoke gate
+    (scripts/bench_smoke.sh) doubles as the ≤2% disarmed-overhead
+    regression check across PRs; the armed column bounds the cost of
+    actually watching."""
+    import shutil
+    import tempfile
+
+    from minio_tpu.utils import tracing
+
+    rng = np.random.default_rng(7)
+    body = rng.integers(0, 256, size=1 << 20, dtype=np.uint8).tobytes()
+    n_objects = 16 if _SMALL else 48
+
+    def measure(armed: bool) -> tuple[float, float]:
+        root = tempfile.mkdtemp(prefix="bench-trace-")
+        try:
+            es = _bench_set(root, 0, b"")
+            if armed:
+                tracing.arm("bench")
+
+            def ctx():
+                return tracing.bind(tracing.TraceContext()) if armed \
+                    else tracing.bind(None)
+
+            t0 = time.perf_counter()
+            for i in range(n_objects):
+                with ctx():
+                    es.put_object("bench", f"o-{i}", body)
+            put_s = time.perf_counter() - t0
+            for i in range(n_objects):        # warm the read path
+                with ctx():
+                    es.get_object("bench", f"o-{i}")
+            t0 = time.perf_counter()
+            for _rep in range(2):
+                for i in range(n_objects):
+                    with ctx():
+                        _, got = es.get_object("bench", f"o-{i}")
+                        assert len(got) == len(body)
+            get_s = time.perf_counter() - t0
+            es.close()
+            total = n_objects * len(body)
+            return (total / put_s / (1 << 30),
+                    2 * total / get_s / (1 << 30))
+        finally:
+            if armed:
+                tracing.disarm("bench")
+            shutil.rmtree(root, ignore_errors=True)
+
+    # Disarmed twice (first run also warms pools/imports), keep best;
+    # armed between the two disarmed runs shares the warm state.
+    put_d1, get_d1 = measure(armed=False)
+    put_a, get_a = measure(armed=True)
+    put_d2, get_d2 = measure(armed=False)
+    put_d, get_d = max(put_d1, put_d2), max(get_d1, get_d2)
+    put_ovh = max(0.0, (1 - put_a / put_d) * 100)
+    get_ovh = max(0.0, (1 - get_a / get_d) * 100)
+    print(json.dumps({
+        "metric": "tracing_overhead_armed_vs_disarmed_pct",
+        "value": round(max(put_ovh, get_ovh), 2),
+        "unit": "%",
+        "vs_baseline": round(min(put_a / put_d, get_a / get_d), 3),
+        "put": {"disarmed_gibps": round(put_d, 3),
+                "armed_gibps": round(put_a, 3),
+                "overhead_pct": round(put_ovh, 2)},
+        "get": {"disarmed_gibps": round(get_d, 3),
+                "armed_gibps": round(get_a, 3),
+                "overhead_pct": round(get_ovh, 2)},
+        "objects": n_objects,
+    }))
 
 
 # One probe subprocess can serve several sections (PUT + GET

@@ -21,6 +21,7 @@ from typing import Iterator, Optional
 from minio_tpu.grid import wire
 from minio_tpu.grid.wire import GridError, RemoteCallError
 from minio_tpu.utils import deadline as deadline_mod
+from minio_tpu.utils import tracing
 from minio_tpu.utils.deadline import DeadlineExceeded
 
 _SENTINEL_ERR = "__conn_lost__"
@@ -199,27 +200,39 @@ class GridClient:
     def call(self, handler: str, payload=None,
              timeout: Optional[float] = None):
         """Unary call; raises RemoteCallError with the remote's code."""
-        mux, q = self._send_with_retry(wire.T_REQ, handler, payload)
-        try:
-            msg = self._recv(q, handler, timeout)
-            if msg["t"] == wire.T_RESP:
-                return msg.get("p")
-            code = msg.get("e", "Internal")
-            if code == _SENTINEL_ERR:
-                raise GridError("connection lost mid-call")
-            raise RemoteCallError(code, msg.get("msg", ""))
-        finally:
-            self._finish(mux)
+        with tracing.span("grid", f"grid.{handler}",
+                          {"peer": f"{self.host}:{self.port}"}) \
+                if tracing.ACTIVE else tracing.NOOP:
+            mux, q = self._send_with_retry(wire.T_REQ, handler, payload)
+            try:
+                msg = self._recv(q, handler, timeout)
+                if msg["t"] == wire.T_RESP:
+                    return msg.get("p")
+                code = msg.get("e", "Internal")
+                if code == _SENTINEL_ERR:
+                    raise GridError("connection lost mid-call")
+                raise RemoteCallError(code, msg.get("msg", ""))
+            finally:
+                self._finish(mux)
 
     def stream(self, handler: str, payload=None,
                timeout: Optional[float] = None) -> Iterator:
-        """Streaming call: yields items until EOF. Raises on error."""
+        """Streaming call: yields items until EOF. Raises on error.
+
+        The span is recorded manually at close (generator `with` would
+        leave the thread-local parent pointing into this stream between
+        pulls); it covers send through EOF/abandonment, chunk count in
+        tags."""
+        t_wall = time.time()
+        t0 = time.monotonic()
+        chunks = 0
         mux, q = self._send_with_retry(wire.T_SREQ, handler, payload)
         try:
             while True:
                 msg = self._recv(q, handler, timeout)
                 t = msg["t"]
                 if t == wire.T_CHUNK:
+                    chunks += 1
                     yield msg.get("p")
                 elif t == wire.T_EOF:
                     return
@@ -230,6 +243,12 @@ class GridClient:
                     raise RemoteCallError(code, msg.get("msg", ""))
         finally:
             self._finish(mux)
+            if tracing.ACTIVE:
+                tracing.record(
+                    "grid", f"grid.{handler}", t_wall,
+                    (time.monotonic() - t0) * 1000.0,
+                    tags={"peer": f"{self.host}:{self.port}",
+                          "stream": 1, "chunks": chunks})
 
     def ping(self, timeout: float = 2.0) -> bool:
         try:

@@ -30,7 +30,10 @@ from __future__ import annotations
 import os
 import queue as queue_mod
 import threading
+import time as _time_mod
 from concurrent.futures import Future
+
+from minio_tpu.utils.latency import Histogram, LastMinute, summarize
 
 IDLE_EXIT_S = 10.0
 
@@ -65,20 +68,28 @@ class DriveQueue:
         self.in_flight = 0
         self.submitted_total = 0
         self.rejected_total = 0
+        # Always-on per-drive latency attribution: service time (the op
+        # on the drive) split from queue wait (time parked behind the
+        # crew) — the split that tells a convoyed drive from a slow one.
+        # A histogram for all-time Prometheus buckets plus last-minute
+        # rings for "is it slow RIGHT NOW" p50/p99/max.
+        self.service_hist = Histogram()
+        self.service_minute = LastMinute()
+        self.wait_minute = LastMinute()
 
     def submit(self, fn) -> Future:
         """Queue `fn` for this drive; returns its Future. A full queue
         sheds immediately with EngineSaturated — bounded depth, and a
         saturated drive must not stall submissions to healthy ones."""
         f: Future = Future()
-        self._enqueue((f, fn))
+        self._enqueue((f, fn, _time_mod.perf_counter()))
         return f
 
     def submit_nowait(self, fn) -> None:
         """Fire-and-forget submission: `fn` owns its own result/error
         delivery (the erasure fan-out's latch slots). Saves the Future
         allocation + two lock/notify rounds per op on the hot path."""
-        self._enqueue((None, fn))
+        self._enqueue((None, fn, _time_mod.perf_counter()))
 
     def _enqueue(self, item) -> None:
         if self._closed:
@@ -129,11 +140,12 @@ class DriveQueue:
                 with self._mu:
                     self._alive -= 1
                 return
-            f, fn = item
+            f, fn, t_sub = item
             if f is not None and not f.set_running_or_notify_cancel():
                 continue
             with self._mu:
                 self.in_flight += 1
+            t0 = _time_mod.perf_counter()
             try:
                 if f is None:
                     fn()        # fire-and-forget: fn delivers its own
@@ -143,8 +155,13 @@ class DriveQueue:
                 if f is not None:
                     f.set_exception(e)
             finally:
+                t1 = _time_mod.perf_counter()
                 with self._mu:
                     self.in_flight -= 1
+                now = _time_mod.time()
+                self.service_hist.observe(t1 - t0)
+                self.service_minute.observe(t1 - t0, now=now)
+                self.wait_minute.observe(t0 - t_sub, now=now)
 
     def close(self) -> None:
         with self._mu:
@@ -155,7 +172,7 @@ class DriveQueue:
 
     def stats(self) -> dict:
         with self._mu:
-            return {
+            out = {
                 "queued": self._q.qsize(),
                 "in_flight": self.in_flight,
                 "depth": self.depth,
@@ -163,6 +180,17 @@ class DriveQueue:
                 "submitted_total": self.submitted_total,
                 "rejected_total": self.rejected_total,
             }
+        out["service_hist"] = self.service_hist.state()
+        svc_w = self.service_minute.window()
+        wait_w = self.wait_minute.window()
+        # Summaries for admin info; raw windows so a sibling worker's
+        # scrape can MERGE the fleet's per-drive view (percentiles do
+        # not merge from summaries, only from bucket counts).
+        out["last_minute"] = summarize(svc_w)
+        out["last_minute_wait"] = summarize(wait_w)
+        out["last_minute_window"] = svc_w
+        out["last_minute_wait_window"] = wait_w
+        return out
 
 
 class IOEngine:

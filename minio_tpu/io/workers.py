@@ -33,8 +33,10 @@ in-process mode, used by tests and distributed deployments).
 
 from __future__ import annotations
 
+import collections
 import errno
 import fcntl
+import itertools
 import json
 import os
 import signal
@@ -50,6 +52,12 @@ _NS_STRIPES = 128
 _READY_TIMEOUT = 60.0
 _DRAIN_TIMEOUT = 15.0
 _MAX_RESPAWNS = 10
+# Cross-worker trace streaming: the parent polls every worker's trace
+# relay on this cadence while any subscription is live, and buffers at
+# most this many entries per subscriber (slow stream clients drop
+# oldest, same policy as the in-process broadcaster).
+_TRACE_POLL_S = 0.2
+_TRACE_BUF = 4000
 
 
 def worker_count_from_env(env=os.environ) -> int:
@@ -231,16 +239,34 @@ def _recv_msg(sock: socket.socket, timeout: float = 5.0):
     return json.loads(blob)
 
 
+def _drain_stale(sock: socket.socket, grace: float = 0.25) -> None:
+    """Best-effort flush after an RPC timeout: a late (possibly
+    PARTIAL) reply frame left in the pipe would corrupt framing for
+    every later exchange. Give the peer a short grace to finish
+    writing, then discard whatever arrived."""
+    deadline = time.monotonic() + grace
+    try:
+        while time.monotonic() < deadline:
+            sock.settimeout(max(0.01, deadline - time.monotonic()))
+            if not sock.recv(65536):
+                return
+    except (socket.timeout, OSError):
+        pass
+
+
 def _worker_stat(server, worker_id: int) -> dict:
     """One worker's control-plane snapshot."""
     from minio_tpu.io.bufpool import global_pool
     from minio_tpu.s3.metrics import layer_sets
     engine = []
     fileinfo = []
-    for s in layer_sets(server.object_layer):
+    for si, s in enumerate(layer_sets(server.object_layer)):
         io_eng = getattr(s, "io", None)
         if io_eng is not None:
-            engine.extend(io_eng.stats())
+            # (set, drive)-labelled so any worker's scrape can merge
+            # the FLEET's per-drive latency, not just its own slice.
+            engine.extend({"set": si, "drive": di, **st}
+                          for di, st in enumerate(io_eng.stats()))
         fic = getattr(s, "fi_cache", None)
         if fic is not None:
             fileinfo.append(fic.stats())
@@ -277,6 +303,31 @@ class WorkerContext:
         self._query = query_sock       # parent asks US for stats
         self._hub = hub_sock           # we ask parent for cluster stats
         self._hub_mu = threading.Lock()
+        self._hub_rid = itertools.count(1)
+
+    def _hub_rpc(self, msg: dict, timeout: float = 5.0) -> dict:
+        """rid-tagged request/reply on the hub pipe: a reply landing
+        after its request timed out is discarded (or flushed on the
+        next timeout) instead of answering the NEXT request — one
+        stall must not desynchronize cluster stats / trace
+        subscriptions forever."""
+        rid = next(self._hub_rid)
+        msg = dict(msg)
+        msg["rid"] = rid
+        with self._hub_mu:
+            _send_msg(self._hub, msg)
+            deadline = time.monotonic() + timeout
+            try:
+                while True:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        raise socket.timeout("hub rpc timeout")
+                    reply = _recv_msg(self._hub, timeout=left)
+                    if reply.get("rid") == rid:
+                        return reply
+            except socket.timeout:
+                _drain_stale(self._hub)
+                raise
 
     def attach(self, server) -> None:
         """Wire the worker's server: control responder, cluster-stat
@@ -288,6 +339,9 @@ class WorkerContext:
         server.worker_total = self.total
         server.admission = server.admission.divided(self.total)
         server.cluster_stats = self.cluster_stats
+        # Fleet-wide trace subscriptions: the admin trace handler on
+        # ANY worker streams every sibling's entries via the parent.
+        server.cluster_trace = self
 
         root = _first_drive_root(server.object_layer)
         if root is not None:
@@ -325,10 +379,23 @@ class WorkerContext:
 
     def cluster_stats(self) -> list[dict]:
         """All workers' snapshots, via the parent hub (self included)."""
-        with self._hub_mu:
-            _send_msg(self._hub, {"op": "cluster_stats"})
-            reply = _recv_msg(self._hub, timeout=5.0)
-        return reply.get("stats", [])
+        return self._hub_rpc({"op": "cluster_stats"}).get("stats", [])
+
+    # -- fleet trace subscriptions (parent pump, see WorkerPool) --------
+
+    def trace_sub(self, types) -> int:
+        # Subscribing arms the whole fleet synchronously (the parent
+        # drains every worker once before replying), so this can take
+        # n_workers x the per-worker rpc budget.
+        return self._hub_rpc({"op": "trace_sub", "types": list(types)},
+                             timeout=15.0)["sub"]
+
+    def trace_poll(self, sub_id: int) -> list[dict]:
+        return self._hub_rpc({"op": "trace_poll", "sub": sub_id}) \
+            .get("entries", [])
+
+    def trace_unsub(self, sub_id: int) -> None:
+        self._hub_rpc({"op": "trace_unsub", "sub": sub_id})
 
     def _serve_queries(self, server) -> None:
         while True:
@@ -338,12 +405,27 @@ class WorkerContext:
                 continue
             except (ConnectionError, OSError):
                 return
-            if msg.get("op") == "stat":
-                try:
-                    _send_msg(self._query, _worker_stat(
-                        server, self.worker_id))
-                except OSError:
-                    return
+            op = msg.get("op")
+            rid = msg.get("rid")
+            try:
+                if op == "stat":
+                    reply = _worker_stat(server, self.worker_id)
+                elif op == "trace_drain":
+                    # Each drain re-arms (idempotent) so a respawned
+                    # worker starts relaying on the next poll tick
+                    # without any extra bookkeeping in the parent.
+                    server.tracer.arm_remote(msg.get("types") or ["s3"])
+                    reply = {"entries": server.tracer.drain_remote()}
+                elif op == "trace_stop":
+                    server.tracer.disarm_remote()
+                    reply = {"ok": 1}
+                else:
+                    continue
+                if rid is not None:
+                    reply["rid"] = rid
+                _send_msg(self._query, reply)
+            except OSError:
+                return
 
 
 def _first_drive_root(object_layer):
@@ -439,6 +521,17 @@ class WorkerPool:
         self._stopping = False
         self._respawns = 0
         self._mu = threading.Lock()
+        # Fleet trace subscriptions: sub id -> {types, buf}. While any
+        # exist, one pump thread drains every worker's relay and fans
+        # entries into each subscriber's bounded buffer.
+        self._trace_mu = threading.Lock()
+        self._trace_subs: dict[int, dict] = {}
+        self._trace_seq = 1
+        self._trace_pumping = False
+        # Request ids for query-pipe exchanges: a reply that arrives
+        # AFTER its request timed out must not be mistaken for the
+        # answer to the NEXT request on the same pipe.
+        self._rid = itertools.count(1)
 
     # -- child side ------------------------------------------------------
 
@@ -490,7 +583,8 @@ class WorkerPool:
                          daemon=True, name=f"hub-{worker_id}").start()
 
     def _serve_hub(self, rec) -> None:
-        """Answer one child's cluster-stat requests."""
+        """Answer one child's cluster-stat / trace-subscription
+        requests."""
         while True:
             try:
                 msg = _recv_msg(rec["hub"], timeout=3600.0)
@@ -498,12 +592,148 @@ class WorkerPool:
                 continue
             except (ConnectionError, OSError):
                 return
-            if msg.get("op") == "cluster_stats":
-                try:
-                    _send_msg(rec["hub"],
-                              {"stats": self._collect_stats()})
-                except OSError:
-                    return
+            op = msg.get("op")
+            try:
+                if op == "cluster_stats":
+                    reply = {"stats": self._collect_stats()}
+                elif op == "trace_sub":
+                    reply = {
+                        "sub": self._trace_sub(msg.get("types") or ["s3"])}
+                elif op == "trace_poll":
+                    reply = {"entries": self._trace_poll(msg.get("sub"))}
+                elif op == "trace_unsub":
+                    self._trace_unsub(msg.get("sub"))
+                    reply = {"ok": 1}
+                else:
+                    continue
+                if msg.get("rid") is not None:
+                    reply["rid"] = msg["rid"]
+                _send_msg(rec["hub"], reply)
+            except OSError:
+                return
+
+    # -- fleet trace pump ------------------------------------------------
+
+    def _trace_sub(self, types) -> int:
+        now = time.monotonic()
+        with self._trace_mu:
+            sid = self._trace_seq
+            self._trace_seq += 1
+            self._trace_subs[sid] = {
+                "types": set(types), "t": now,
+                "buf": collections.deque(maxlen=_TRACE_BUF)}
+            start = not self._trace_pumping
+            if start:
+                self._trace_pumping = True
+        # Arm the fleet SYNCHRONOUSLY before replying: entries for
+        # requests issued right after subscribe must not fall into the
+        # window before the pump's first tick reaches each worker.
+        self._trace_drain_once()
+        if start:
+            threading.Thread(target=self._trace_pump, daemon=True,
+                             name="trace-pump").start()
+        return sid
+
+    def _trace_poll(self, sid) -> list[dict]:
+        with self._trace_mu:
+            sub = self._trace_subs.get(sid)
+            if sub is None:
+                return []
+            sub["t"] = time.monotonic()
+            out = list(sub["buf"])
+            sub["buf"].clear()
+        return out
+
+    def _trace_unsub(self, sid) -> None:
+        with self._trace_mu:
+            self._trace_subs.pop(sid, None)
+
+    # A live stream handler polls several times per second; one that
+    # died without its finally (worker crash, SIGKILL) stops polling —
+    # expire it so the fleet disarms instead of pumping forever.
+    _TRACE_SUB_TTL = 30.0
+
+    def _trace_drain_once(self) -> None:
+        """One drain round over every worker: arms relays with the
+        current wanted-type union and fans drained entries into each
+        live subscriber's buffer."""
+        with self._trace_mu:
+            union = set()
+            for s in self._trace_subs.values():
+                union |= s["types"]
+        if not union:
+            return
+        with self._mu:
+            recs = list(self._children.values())
+        for rec in recs:
+            try:
+                reply = self._query_rpc(
+                    rec, {"op": "trace_drain",
+                          "types": sorted(union)}, timeout=2.0)
+            except (OSError, ConnectionError, socket.timeout):
+                continue
+            entries = reply.get("entries", [])
+            if not entries:
+                continue
+            with self._trace_mu:
+                for e in entries:
+                    et = e.get("trace_type", "s3")
+                    wild = e.get("broadcast", False)
+                    for s in self._trace_subs.values():
+                        if wild or et in s["types"]:
+                            s["buf"].append(e)
+
+    def _trace_pump(self) -> None:
+        """Drain every worker's trace relay while subscriptions exist;
+        disarm the fleet and exit when the last one goes. Each drain
+        message carries the wanted-type union, which doubles as the
+        arm signal — respawned workers heal on the next tick."""
+        while True:
+            now = time.monotonic()
+            with self._trace_mu:
+                self._trace_subs = {
+                    sid: s for sid, s in self._trace_subs.items()
+                    if now - s["t"] <= self._TRACE_SUB_TTL}
+                if not self._trace_subs:
+                    self._trace_pumping = False
+                    break
+            self._trace_drain_once()
+            time.sleep(_TRACE_POLL_S)
+        # Last subscriber gone: stop the relays so request paths disarm.
+        # Re-check first: a NEW subscription may have started a new pump
+        # between our break and here — its workers are (re)arming, and a
+        # late trace_stop would disarm them and clear their relay
+        # buffers under the new subscriber.
+        with self._mu:
+            recs = list(self._children.values())
+        for rec in recs:
+            with self._trace_mu:
+                if self._trace_pumping:
+                    return          # a successor pump owns arming now
+            try:
+                self._query_rpc(rec, {"op": "trace_stop"}, timeout=2.0)
+            except (OSError, ConnectionError, socket.timeout):
+                continue
+
+    def _query_rpc(self, rec, msg: dict, timeout: float) -> dict:
+        """One request/reply on a worker's query pipe, rid-tagged:
+        stale replies (their request timed out earlier) are discarded
+        instead of being served as the answer to THIS request — a
+        single timeout must not desynchronize the pipe forever."""
+        rid = next(self._rid)
+        msg = dict(msg)
+        msg["rid"] = rid
+        with rec["qmu"]:
+            _send_msg(rec["query"], msg)
+            deadline = time.monotonic() + timeout
+            while True:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise socket.timeout(
+                        f"worker {rec['worker']} rpc timeout")
+                reply = _recv_msg(rec["query"], timeout=left)
+                if reply.get("rid") == rid:
+                    return reply
 
     def _collect_stats(self) -> list[dict]:
         out = []
@@ -511,9 +741,9 @@ class WorkerPool:
             recs = list(self._children.values())
         for rec in sorted(recs, key=lambda r: r["worker"]):
             try:
-                with rec["qmu"]:
-                    _send_msg(rec["query"], {"op": "stat"})
-                    out.append(_recv_msg(rec["query"], timeout=3.0))
+                reply = self._query_rpc(rec, {"op": "stat"}, timeout=3.0)
+                reply.pop("rid", None)
+                out.append(reply)
             except (OSError, ConnectionError, socket.timeout):
                 out.append({"worker": rec["worker"], "pid": rec["pid"],
                             "unreachable": True})

@@ -37,6 +37,7 @@ from minio_tpu.erasure.codec import CodecError, Erasure, ceil_frac
 from minio_tpu.io.bufpool import global_pool
 from minio_tpu.io.engine import EngineSaturated, IOEngine
 from minio_tpu.utils import deadline as deadline_mod
+from minio_tpu.utils import tracing
 from minio_tpu.utils.deadline import DeadlineExceeded
 from minio_tpu.object.types import (BucketExists, BucketInfo, BucketNotEmpty,
                                     BucketNotFound, DeleteOptions,
@@ -350,11 +351,30 @@ class ErasureSet:
                 if latch[0] == 0:
                     all_done.set()
 
+        # Trace scope crosses the pool boundary the same way the
+        # deadline does: captured here, re-bound in the worker. The
+        # per-drive span wraps the whole queued op and carries the
+        # queue-wait vs in-span (service) split — the child storage
+        # span (health wrapper) then names the concrete disk op.
+        tctx, tparent = tracing.capture() if tracing.ACTIVE else (None, 0)
+
         def make_job(i, fn):
+            t_sub = _time_mod.perf_counter() if tctx is not None else 0.0
+
             def run():
                 try:
-                    with deadline_mod.bind(dl):
-                        results[i] = fn()
+                    with deadline_mod.bind(dl), \
+                            tracing.bind(tctx, tparent):
+                        if tctx is not None:
+                            wait_ms = (_time_mod.perf_counter() - t_sub) \
+                                * 1000.0
+                            with tracing.span(
+                                    "storage", "engine.op",
+                                    {"drive": i,
+                                     "queue_wait_ms": round(wait_ms, 3)}):
+                                results[i] = fn()
+                        else:
+                            results[i] = fn()
                 except BaseException as e:  # noqa: BLE001 - per-disk isolation
                     errors[i] = e
                 finally:
@@ -765,10 +785,13 @@ class ErasureSet:
             else np.zeros((0, k), dtype=np.uint8)
         out = (ctypes.c_uint8 * (n * span)).from_buffer(lease.raw)
         try:
-            lib.mtpu_put_frame(
-                native._u8(MAGIC_KEY), native._u8(pm),
-                src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-                full, k, m, shard_size, out)
+            with tracing.span("kernel", "mtpu_put_frame",
+                              {"blocks": full, "k": k, "m": m}) \
+                    if tracing.ACTIVE else tracing.NOOP:
+                lib.mtpu_put_frame(
+                    native._u8(MAGIC_KEY), native._u8(pm),
+                    src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                    full, k, m, shard_size, out)
         except BaseException:
             lease.release()
             raise
@@ -1148,6 +1171,7 @@ class ErasureSet:
         _SENTINEL = object()
 
         dl = deadline_mod.current()
+        tctx, tparent = tracing.capture() if tracing.ACTIVE else (None, 0)
 
         def got_sentinel(i: int, c) -> bool:
             """Sentinel handling shared by every consumer of qs[i]. The
@@ -1187,7 +1211,7 @@ class ErasureSet:
                         cb()
 
             try:
-                with deadline_mod.bind(dl):
+                with deadline_mod.bind(dl), tracing.bind(tctx, tparent):
                     disk, vol, path = path_for(i)
 
                     def gen():
@@ -1500,10 +1524,11 @@ class ErasureSet:
             return
         inline_cache: dict = {}
         dl = deadline_mod.current()
+        tctx, tparent = tracing.capture() if tracing.ACTIVE else (None, 0)
 
         def read_desc(desc):
             num, psize, rel, step = desc
-            with deadline_mod.bind(dl):
+            with deadline_mod.bind(dl), tracing.bind(tctx, tparent):
                 return self._read_part_window_pooled(
                     bucket, object_, fi, fis, num, psize, rel, step,
                     inline_cache=inline_cache)
@@ -1822,9 +1847,12 @@ class ErasureSet:
         lease = global_pool().lease(out_len)
         out = (ctypes.c_uint8 * out_len).from_buffer(lease.raw)
         try:
-            bad = lib.mtpu_get_frame(
-                native._u8(MAGIC_KEY), ptrs, k, shard_size, nb, slast,
-                BLOCK_SIZE, take_last, out)
+            with tracing.span("kernel", "mtpu_get_frame",
+                              {"blocks": nb, "k": k}) \
+                    if tracing.ACTIVE else tracing.NOOP:
+                bad = lib.mtpu_get_frame(
+                    native._u8(MAGIC_KEY), ptrs, k, shard_size, nb, slast,
+                    BLOCK_SIZE, take_last, out)
         except BaseException:
             lease.release()
             raise

@@ -64,7 +64,11 @@ def heal_object(es, bucket: str, object_: str, version_id: str = "",
     `deep=True` reads and bitrot-verifies every block (reference scanMode
     normal vs deep, cmd/erasure-healing.go:296).
     """
-    with es.ns.write(bucket, object_):
+    from minio_tpu.utils import tracing
+    with tracing.op_span("heal", "heal.object",
+                         {"bucket": bucket, "object": object_,
+                          "deep": int(deep)}), \
+            es.ns.write(bucket, object_):
         result = _heal_object_locked(es, bucket, object_, version_id, deep)
     if result.healed:
         # Drive journals changed under this key: cached quorum

@@ -303,6 +303,12 @@ class Scanner:
     def scan_cycle(self) -> DataUsage:
         """One full pass over every set: format checks, walk, heal,
         usage rollup, persist."""
+        from minio_tpu.utils import tracing
+        with tracing.op_span("scanner", "scanner.cycle",
+                             {"sets": len(self.sets)}):
+            return self._scan_cycle_inner()
+
+    def _scan_cycle_inner(self) -> DataUsage:
         check_drive_formats(self.sets, self.set_size)
         usage = DataUsage()
         state = {"deep_every": self.deep_every,

@@ -13,6 +13,9 @@ import os
 import threading
 import time
 
+from minio_tpu.utils import tracing as _tracing
+from minio_tpu.utils.latency import Histogram, LastMinute, summarize
+
 
 class Metrics:
     def __init__(self):
@@ -20,6 +23,13 @@ class Metrics:
         self._requests: dict[tuple[str, str], int] = {}
         self._latency_sum: dict[str, float] = {}
         self._latency_count: dict[str, int] = {}
+        # Bucketed + rolling latency per API: the sum/count pair above
+        # answers "average since boot"; the histogram answers
+        # percentiles-over-all-time and the last-minute ring answers
+        # "is THIS api slow right now" (reference: metrics-v3
+        # histograms + cmd/last-minute.gen.go windows).
+        self._latency_hist: dict[str, Histogram] = {}
+        self._last_minute: dict[str, LastMinute] = {}
         self._bytes_rx = 0
         self._bytes_tx = 0
         self._start = time.time()
@@ -34,12 +44,28 @@ class Metrics:
             self._latency_count[api] = self._latency_count.get(api, 0) + 1
             self._bytes_rx += rx
             self._bytes_tx += tx
+            hist = self._latency_hist.get(api)
+            if hist is None:
+                hist = self._latency_hist[api] = Histogram()
+                self._last_minute[api] = LastMinute()
+            minute = self._last_minute[api]
+        hist.observe(seconds)
+        minute.observe(seconds)
+
+    def last_minute(self) -> dict:
+        """Per-API last-minute summaries {api: {count,p50,p99,max}} —
+        the admin-info view."""
+        with self._mu:
+            minutes = dict(self._last_minute)
+        return {api: summarize(lm.window()) for api, lm in minutes.items()}
 
     def state(self) -> dict:
         """JSON-safe counter snapshot for cross-worker aggregation
         (io/workers.py control pipe)."""
         with self._mu:
-            return {
+            hists = dict(self._latency_hist)
+            minutes = dict(self._last_minute)
+            out = {
                 "requests": [[a, s, v]
                              for (a, s), v in self._requests.items()],
                 "latency_sum": dict(self._latency_sum),
@@ -47,6 +73,10 @@ class Metrics:
                 "rx": self._bytes_rx,
                 "tx": self._bytes_tx,
             }
+        out["latency_hist"] = {a: h.state() for a, h in hists.items()}
+        out["last_minute"] = {a: lm.window() for a, lm in minutes.items()}
+        out["slow_ops_total"] = _tracing.slow_total
+        return out
 
     # -- rendering -------------------------------------------------------
 
@@ -68,16 +98,38 @@ class Metrics:
                 else:
                     lines.append(f"{name} {value}")
 
+        def hist_metric(name, help_, samples):
+            """Prometheus histogram family: per-label-set cumulative
+            `_bucket{le=}` lines plus `_sum`/`_count`. `samples` is
+            [(labels, hist_state)]."""
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} histogram")
+            for labels, st in samples:
+                base = ",".join(f'{k}="{v}"' for k, v in labels.items())
+                for le, cum in Histogram.cumulative(st):
+                    lab = f'{base},le="{le}"' if base else f'le="{le}"'
+                    lines.append(f"{name}_bucket{{{lab}}} {cum}")
+                suffix = f"{{{base}}}" if base else ""
+                lines.append(f"{name}_sum{suffix} {st.get('sum', 0.0)}")
+                lines.append(f"{name}_count{suffix} {st.get('count', 0)}")
+
         with self._mu:
             reqs = dict(self._requests)
             lat_sum = dict(self._latency_sum)
             lat_count = dict(self._latency_count)
             rx, tx = self._bytes_rx, self._bytes_tx
+            hists = {a: h.state() for a, h in self._latency_hist.items()}
+            minutes = {a: lm.window()
+                       for a, lm in self._last_minute.items()}
+        slow_total = _tracing.slow_total
         peer_metrics = [p["metrics"] for p in (peer_states or [])
                         if isinstance(p.get("metrics"), dict)]
         if peer_metrics:
             reqs, lat_sum, lat_count = {}, {}, {}
             rx = tx = 0
+            slow_total = 0
+            hist_states: dict[str, list] = {}
+            minute_states: dict[str, list] = {}
             for st in peer_metrics:
                 for a, s, v in st.get("requests", []):
                     reqs[(a, s)] = reqs.get((a, s), 0) + v
@@ -85,8 +137,17 @@ class Metrics:
                     lat_sum[a] = lat_sum.get(a, 0.0) + v
                 for a, v in st.get("latency_count", {}).items():
                     lat_count[a] = lat_count.get(a, 0) + v
+                for a, hs in st.get("latency_hist", {}).items():
+                    hist_states.setdefault(a, []).append(hs)
+                for a, w in st.get("last_minute", {}).items():
+                    minute_states.setdefault(a, []).append(w)
                 rx += st.get("rx", 0)
                 tx += st.get("tx", 0)
+                slow_total += st.get("slow_ops_total", 0)
+            hists = {a: Histogram.merge(sts)
+                     for a, sts in hist_states.items()}
+            minutes = {a: LastMinute.merge(ws)
+                       for a, ws in minute_states.items()}
 
         metric("minio_tpu_http_requests_total",
                "HTTP requests by API and status class", "counter",
@@ -102,6 +163,26 @@ class Metrics:
                "Bytes received in request bodies", "counter", [({}, rx)])
         metric("minio_tpu_http_tx_bytes_total",
                "Bytes sent in response bodies", "counter", [({}, tx)])
+        hist_metric("minio_tpu_api_request_duration_seconds",
+                    "Bucketed request latency per API",
+                    [({"api": a}, st) for a, st in sorted(hists.items())])
+        lm_samples, lm_counts = [], []
+        for a, w in sorted(minutes.items()):
+            s = summarize(w)
+            lm_counts.append(({"api": a}, s["count"]))
+            for q in ("p50", "p99", "max"):
+                lm_samples.append(({"api": a, "q": q}, s[q]))
+        metric("minio_tpu_api_last_minute_seconds",
+               "Rolling last-minute request latency per API "
+               "(p50/p99/max over 60 one-second slots)", "gauge",
+               lm_samples)
+        metric("minio_tpu_api_last_minute_requests",
+               "Requests observed in the trailing minute per API",
+               "gauge", lm_counts)
+        metric("minio_tpu_slow_ops_total",
+               "Spans that crossed the MTPU_SLOW_OP_MS threshold "
+               "(slow-op log records emitted)", "counter",
+               [({}, slow_total)])
         metric("minio_tpu_process_uptime_seconds",
                "Seconds since server start", "gauge",
                [({}, round(time.time() - self._start, 1))])
@@ -221,6 +302,22 @@ class Metrics:
                        "Requests that exhausted their deadline budget "
                        "mid-flight (408)", "counter",
                        [({}, snap["deadline_exceeded_total"])])
+            aud = getattr(server, "audit", None)
+            if aud is not None:
+                # Audit delivery health: a full retry queue used to
+                # evict records with no visible trace — dropped MUST be
+                # exported (it is real audit loss, alert on it).
+                ast = aud.stats()
+                metric("minio_tpu_audit_sent_total",
+                       "Audit records delivered to the webhook target",
+                       "counter", [({}, ast["sent"])])
+                metric("minio_tpu_audit_dropped_total",
+                       "Audit records lost to retry-queue overflow or "
+                       "exhausted delivery attempts (alert on this)",
+                       "counter", [({}, ast["dropped"])])
+                metric("minio_tpu_audit_pending",
+                       "Audit records waiting in the retry queue",
+                       "gauge", [({}, ast["pending"])])
             repl = getattr(server, "replicator", None)
             if repl is not None:
                 metric("minio_tpu_replication_queued_total",
@@ -358,17 +455,55 @@ class Metrics:
                  "Bytes parked on pool free lists", "gauge",
                  "idle_bytes")):
             metric(name, help_, type_, [({}, bp[key])])
-        if object_layer is not None:
+        if object_layer is not None or peer_states:
+            # One row per (worker, set, drive). In pre-forked mode each
+            # worker runs its OWN queues over the same physical drives
+            # and a scrape lands on an arbitrary worker — merge the
+            # FLEET's rows (gauges sum, histograms/windows merge) so
+            # "which drive is the wall" is answered for the whole
+            # front-end, not this worker's 1/N slice.
+            rows = []
+            for p in (peer_states or []):
+                lst = p.get("engine")
+                if isinstance(lst, list):
+                    rows.extend(st for st in lst
+                                if isinstance(st, dict) and "drive" in st)
+            if not rows and object_layer is not None:
+                for si, s in enumerate(layer_sets(object_layer)):
+                    eng = getattr(s, "io", None)
+                    if eng is None:
+                        continue
+                    rows.extend({"set": si, "drive": di, **st}
+                                for di, st in enumerate(eng.stats()))
+            agg: dict = {}
+            for st in rows:
+                a = agg.setdefault(
+                    (st.get("set", 0), st.get("drive", 0)),
+                    {"queued": 0, "in_flight": 0, "rejected_total": 0,
+                     "hists": [], "svc": [], "wait": []})
+                for k in ("queued", "in_flight", "rejected_total"):
+                    a[k] += st.get(k, 0)
+                if "service_hist" in st:
+                    a["hists"].append(st["service_hist"])
+                if "last_minute_window" in st:
+                    a["svc"].append(st["last_minute_window"])
+                if "last_minute_wait_window" in st:
+                    a["wait"].append(st["last_minute_wait_window"])
             samples_q, samples_f, samples_r = [], [], []
-            for si, s in enumerate(layer_sets(object_layer)):
-                eng = getattr(s, "io", None)
-                if eng is None:
-                    continue
-                for di, st in enumerate(eng.stats()):
-                    lab = {"set": si, "drive": di}
-                    samples_q.append((lab, st["queued"]))
-                    samples_f.append((lab, st["in_flight"]))
-                    samples_r.append((lab, st["rejected_total"]))
+            samples_h, samples_lm, samples_lw = [], [], []
+            for (si, di), a in sorted(agg.items()):
+                lab = {"set": si, "drive": di}
+                samples_q.append((lab, a["queued"]))
+                samples_f.append((lab, a["in_flight"]))
+                samples_r.append((lab, a["rejected_total"]))
+                if a["hists"]:
+                    samples_h.append((lab, Histogram.merge(a["hists"])))
+                for wins, out in ((a["svc"], samples_lm),
+                                  (a["wait"], samples_lw)):
+                    if wins:
+                        s2 = summarize(LastMinute.merge(wins))
+                        for q in ("p50", "p99", "max"):
+                            out.append(({**lab, "q": q}, s2[q]))
             metric("minio_tpu_drive_queue_depth",
                    "Ops waiting in each drive's submission queue",
                    "gauge", samples_q)
@@ -378,6 +513,19 @@ class Metrics:
             metric("minio_tpu_drive_queue_rejected_total",
                    "Submissions shed by bounded drive queues",
                    "counter", samples_r)
+            # Per-drive latency attribution: which drive is the wall,
+            # now (last-minute ring) and cumulatively (histogram);
+            # queue-wait separately from service so a convoyed drive
+            # is distinguishable from a slow one.
+            hist_metric("minio_tpu_drive_op_duration_seconds",
+                        "Bucketed service time of drive-queue ops",
+                        samples_h)
+            metric("minio_tpu_drive_last_minute_seconds",
+                   "Rolling last-minute drive-op service time "
+                   "(p50/p99/max)", "gauge", samples_lm)
+            metric("minio_tpu_drive_queue_wait_last_minute_seconds",
+                   "Rolling last-minute queue wait before each drive op "
+                   "(p50/p99/max)", "gauge", samples_lw)
 
         # -- read path: quorum-fileinfo cache + fused GET kernel --------
         # Hit rate says whether repeat GETs skip the k-drive metadata
@@ -517,6 +665,18 @@ def node_info(server) -> dict:
         # facing view of admission control (reference: madmin info's
         # requests fields).
         info["admission"] = adm.snapshot()
+    aud = getattr(server, "audit", None)
+    if aud is not None:
+        info["audit"] = aud.stats()
+    # Rolling last-minute latency per API + the recent slow-op records
+    # (deep tracing's operator surface: a slow GET names its slow
+    # span ancestry here without any trace subscriber attached).
+    m = getattr(server, "metrics", None)
+    if m is not None:
+        info["last_minute"] = m.last_minute()
+    info["slow_ops"] = {"total": _tracing.slow_total,
+                        "threshold_ms": _tracing.slow_ms(),
+                        "recent": _tracing.slow_ops()[-20:]}
     # I/O engine: pool + per-drive queue health (and, in worker mode,
     # the whole fleet's per-worker snapshots via the control pipe).
     from minio_tpu.io.bufpool import global_pool

@@ -28,6 +28,7 @@ from minio_tpu.s3.admission import AdmissionController, AdmissionShed
 from minio_tpu.s3.admission import path_class as admission_path_class
 from minio_tpu.s3.errors import S3Error, from_exception
 from minio_tpu.utils import deadline as deadline_mod
+from minio_tpu.utils import tracing as tracing_mod
 from minio_tpu.s3.metrics import Metrics, layer_sets as _layer_sets, \
     node_info, probe_disks as _probe_disks
 from minio_tpu.utils.streams import (HashingReader, HttpChunkedReader,
@@ -137,6 +138,9 @@ class S3Server:
         self.worker_id = 0
         self.worker_total = 1
         self.cluster_stats = None
+        # Fleet-wide trace subscription hub (io/workers.WorkerContext);
+        # None = single-process mode, admin trace subscribes locally.
+        self.cluster_trace = None
         self._thread: threading.Thread | None = None
         # Serializes read-modify-write of bucket metadata (policy /
         # tagging / versioning toggles) within this process; cross-node
@@ -160,9 +164,12 @@ class S3Server:
         # KMS for SSE-S3 (None until configured via MTPU_KMS_SECRET_KEY).
         from minio_tpu.crypto.kms import KMS
         self.kms = KMS.from_env()
-        # Live request tracing + optional audit webhook.
+        # Live request tracing + optional audit webhook. Background
+        # spans (scanner/heal) and slow-op records publish through the
+        # module hook straight into this broadcaster.
         from minio_tpu.s3.trace import TraceBroadcaster
         self.tracer = TraceBroadcaster()
+        tracing_mod.set_publisher(self.tracer.publish)
         self.audit = None
         # Async bucket replication engine (replication.ReplicationEngine).
         self.replicator = None
@@ -507,6 +514,7 @@ def _make_handler(server: S3Server):
             with server._inflight_mu:
                 server._inflight += 1
             gate = None
+            tctx = None
             try:
                 # Admission: bounded in-flight slots per request class
                 # BEFORE any auth/body work — a saturated server sheds
@@ -533,7 +541,14 @@ def _make_handler(server: S3Server):
                 if server.admission.request_timeout > 0:
                     dl = deadline_mod.Deadline(
                         server.admission.request_timeout)
-                with deadline_mod.bind(dl), \
+                # Span context: armed only while somebody watches (a
+                # trace subscriber wanting internal types, a remote
+                # worker relay, or a slow-op threshold) — disarmed,
+                # requests pay one attribute check. It rides the same
+                # binding channel the deadline budget rides.
+                if tracing_mod.ACTIVE:
+                    tctx = tracing_mod.TraceContext()
+                with deadline_mod.bind(dl), tracing_mod.bind(tctx), \
                         server.profiler.request_profile():
                     self._route_inner(method, raw_path, query, bucket, key)
             finally:
@@ -557,7 +572,21 @@ def _make_handler(server: S3Server):
                         self.client_address[0] if self.client_address
                         else "", self._auth_key, rx=rx,
                         tx=self._sent_bytes)
-                    server.tracer.publish(entry)
+                    entry["worker"] = server.worker_id
+                    if tctx is not None:
+                        # The request record IS the trace root: span 0,
+                        # every internal span parents (transitively)
+                        # under it.
+                        entry["trace_type"] = "s3"
+                        entry["trace"] = tctx.trace_id
+                        entry["span"] = 0
+                        server.tracer.publish(entry)
+                        if server.tracer.wants_internal():
+                            for se in tracing_mod.entries_from(
+                                    tctx, worker=server.worker_id):
+                                server.tracer.publish(se)
+                    else:
+                        server.tracer.publish(entry)
                     if server.audit is not None:
                         server.audit.submit(entry)
 
@@ -2608,7 +2637,16 @@ def _make_handler(server: S3Server):
         def _admin_trace(self, query):
             """Live trace stream: chunked JSON lines until the client
             disconnects (reference: TraceHandler + pubsub; the `mc
-            admin trace` shape). ?count=N stops after N entries."""
+            admin trace` shape). ?count=N stops after N entries;
+            ?types=storage,grid,... filters (default `s3` — the
+            top-level request records; `all` = every type including
+            internal storage/grid/kernel/scanner/heal spans).
+
+            In pre-forked worker mode this request lands on ONE worker
+            while requests spread over ALL of them: the handler
+            subscribes fleet-wide through the parent control pipe
+            (io/workers.py trace pump) instead of its local
+            broadcaster, so entries from every sibling stream here."""
             import json as _json
             import queue as _queue
             limit = 0
@@ -2616,33 +2654,75 @@ def _make_handler(server: S3Server):
                 limit = int(query.get("count", ["0"])[0] or 0)
             except ValueError:
                 pass
-            sub = server.tracer.subscribe()
+            raw = (query.get("types", [""])[0] or "").strip()
+            if not raw:
+                types = {"s3"}
+            elif raw == "all":
+                types = set(tracing_mod.TRACE_TYPES)
+            else:
+                types = {t.strip() for t in raw.split(",") if t.strip()} \
+                    & set(tracing_mod.TRACE_TYPES)
+                if not types:
+                    types = {"s3"}
+
+            hub = getattr(server, "cluster_trace", None)
+            sub = sub_id = None
+            if hub is not None:
+                try:
+                    sub_id = hub.trace_sub(sorted(types))
+                except Exception:  # noqa: BLE001 - control plane down
+                    hub = None
+            if hub is None:
+                sub = server.tracer.subscribe(types)
             try:
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
                 sent = 0
+                idle_since = _time_mod.monotonic()
                 while not limit or sent < limit:
-                    try:
-                        entry = sub.get(timeout=1.0)
-                    except _queue.Empty:
-                        # Heartbeat chunk: on an idle server this is the
-                        # only way a disconnected client surfaces (EPIPE)
-                        # — without it the thread and subscription leak.
-                        self.wfile.write(b"1\r\n\n\r\n")
-                        self.wfile.flush()
-                        continue
-                    line = _json.dumps(entry).encode() + b"\n"
-                    self.wfile.write(b"%x\r\n" % len(line) + line
-                                     + b"\r\n")
+                    entries = []
+                    if hub is not None:
+                        entries = hub.trace_poll(sub_id)
+                        if not entries:
+                            if _time_mod.monotonic() - idle_since > 1.0:
+                                # Heartbeat chunk: on an idle server
+                                # this is the only way a disconnected
+                                # client surfaces (EPIPE) — without it
+                                # the thread and subscription leak.
+                                self.wfile.write(b"1\r\n\n\r\n")
+                                self.wfile.flush()
+                                idle_since = _time_mod.monotonic()
+                            _time_mod.sleep(0.2)
+                            continue
+                    else:
+                        try:
+                            entries = [sub.get(timeout=1.0)]
+                        except _queue.Empty:
+                            self.wfile.write(b"1\r\n\n\r\n")
+                            self.wfile.flush()
+                            continue
+                    idle_since = _time_mod.monotonic()
+                    for entry in entries:
+                        line = _json.dumps(entry).encode() + b"\n"
+                        self.wfile.write(b"%x\r\n" % len(line) + line
+                                         + b"\r\n")
+                        sent += 1
+                        if limit and sent >= limit:
+                            break
                     self.wfile.flush()
-                    sent += 1
                 self.wfile.write(b"0\r\n\r\n")
             except OSError:
                 pass        # client went away
             finally:
-                server.tracer.unsubscribe(sub)
+                if hub is not None:
+                    try:
+                        hub.trace_unsub(sub_id)
+                    except Exception:  # noqa: BLE001 - best effort
+                        pass
+                else:
+                    server.tracer.unsubscribe(sub)
                 self.close_connection = True
 
         def _admin_info(self):

@@ -24,10 +24,13 @@ def make_entry(api: str, method: str, path: str, bucket: str, key: str,
                status: int, duration_s: float, remote: str,
                access_key: str, rx: int = 0, tx: int = 0) -> dict:
     """One trace/audit record (the reference's madmin.TraceInfo /
-    audit.Entry shape, trimmed)."""
+    audit.Entry shape, trimmed). Timestamps carry millisecond
+    precision — whole-second stamps made entries from one burst
+    unsortable — in the same format span entries use."""
+    from minio_tpu.utils.tracing import _iso_ms
     return {
         "version": "1",
-        "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "time": _iso_ms(time.time()),
         "api": api,
         "method": method,
         "path": path,
@@ -43,36 +46,119 @@ def make_entry(api: str, method: str, path: str, bucket: str, key: str,
 
 
 class TraceBroadcaster:
-    """Bounded pub/sub: subscribers receive every published entry while
-    subscribed; slow subscribers drop oldest entries rather than
-    backpressuring the request path."""
+    """Bounded pub/sub with per-subscriber TYPE filters: subscribers
+    receive every published entry of the types they asked for
+    (`s3|storage|grid|kernel|scanner|heal`; default just the top-level
+    s3 records) while subscribed; slow subscribers drop oldest entries
+    rather than backpressuring the request path.
+
+    Deep (non-s3) span collection is armed only while somebody watches:
+    any subscription or remote relay wanting internal types holds an
+    utils/tracing arm() token, so the request path's span machinery is
+    a single attribute check when nobody does. The remote relay is the
+    pre-forked worker side of cross-process streaming (io/workers.py):
+    armed workers buffer matching entries in a bounded ring the parent
+    drains over the control pipe."""
 
     _DEPTH = 1000
+    _RELAY_DEPTH = 2000
+    # The remote relay self-disarms when no drain has refreshed it for
+    # this long (drains normally arrive every ~0.2 s): a parent whose
+    # trace_stop never reached this worker (timeout, respawn, parent
+    # death) must not leave span collection armed forever.
+    _REMOTE_TTL = 10.0
 
     def __init__(self):
         self._mu = threading.Lock()
-        self._subs: list[queue.Queue] = []
+        self._subs: list[tuple[queue.Queue, frozenset]] = []
+        self._remote_types: frozenset = frozenset()
+        self._remote_deadline = 0.0
+        self._relay: collections.deque = \
+            collections.deque(maxlen=self._RELAY_DEPTH)
+        # Plain bool refreshed under _mu, read WITHOUT it: every
+        # request completion checks `active` — a mutex there would tax
+        # the disarmed fast path the whole design protects.
+        self._active = False
 
     @property
     def active(self) -> bool:
-        return bool(self._subs)
+        return self._active
 
-    def subscribe(self) -> queue.Queue:
+    def _rearm_locked(self) -> None:
+        from minio_tpu.utils import tracing
+        self._active = bool(self._subs) or bool(self._remote_types)
+        wanted = set(self._remote_types)
+        for _, types in self._subs:
+            wanted |= types
+        if wanted - {"s3"}:
+            tracing.arm(self)
+        else:
+            tracing.disarm(self)
+
+    def wants_internal(self) -> bool:
+        """True when any subscriber (local or remote relay) asked for
+        non-s3 span types — the server only renders span entries then."""
+        with self._mu:
+            if self._remote_types - {"s3"}:
+                return True
+            return any(types - {"s3"} for _, types in self._subs)
+
+    def subscribe(self, types=None) -> queue.Queue:
         q: queue.Queue = queue.Queue(maxsize=self._DEPTH)
         with self._mu:
-            self._subs.append(q)
+            self._subs.append((q, frozenset(types or ("s3",))))
+            self._rearm_locked()
         return q
 
     def unsubscribe(self, q: queue.Queue) -> None:
         with self._mu:
-            try:
-                self._subs.remove(q)
-            except ValueError:
-                pass
+            self._subs = [(sq, t) for sq, t in self._subs if sq is not q]
+            self._rearm_locked()
+
+    # -- cross-worker relay (io/workers.py control pipes) ---------------
+
+    def arm_remote(self, types) -> None:
+        """Buffer matching entries for the parent's drain poll
+        (idempotent; each drain re-arms and refreshes the TTL, so
+        respawned workers heal and missed trace_stops age out)."""
+        with self._mu:
+            self._remote_types = frozenset(types or ("s3",))
+            self._remote_deadline = time.monotonic() + self._REMOTE_TTL
+            self._rearm_locked()
+
+    def disarm_remote(self) -> None:
+        with self._mu:
+            self._remote_types = frozenset()
+            self._relay.clear()
+            self._rearm_locked()
+
+    def drain_remote(self) -> list[dict]:
+        with self._mu:
+            out = list(self._relay)
+            self._relay.clear()
+        return out
+
+    def _remote_expired_locked(self) -> bool:
+        """Lazy TTL: a relay nobody drains (missed trace_stop, dead
+        parent) self-disarms rather than taxing every request forever."""
+        if self._remote_types \
+                and time.monotonic() > self._remote_deadline:
+            self._remote_types = frozenset()
+            self._relay.clear()
+            self._rearm_locked()
+            return True
+        return False
 
     def publish(self, entry: dict) -> None:
+        etype = entry.get("trace_type", "s3")
+        wild = entry.get("broadcast", False)
         with self._mu:
-            subs = list(self._subs)
+            if self._remote_types:
+                self._remote_expired_locked()
+            subs = [q for q, types in self._subs
+                    if wild or etype in types]
+            if self._remote_types and (wild or etype in self._remote_types):
+                self._relay.append(entry)
         for q in subs:
             try:
                 q.put_nowait(entry)
@@ -109,9 +195,18 @@ class AuditLogger:
 
     def submit(self, entry: dict) -> None:
         if len(self._q) == self._q.maxlen:
+            # Overflow evicts the OLDEST queued record; it must be
+            # counted (and is exported — minio_tpu_audit_dropped_total),
+            # never silently vanish.
             self.dropped += 1
         self._q.append((entry, 0))
         self._wake.set()
+
+    def stats(self) -> dict:
+        """Delivery counters for metrics/admin info: drops are real
+        audit loss and must be VISIBLE (alertable), not silent."""
+        return {"endpoint": self.endpoint, "sent": self.sent,
+                "dropped": self.dropped, "pending": len(self._q)}
 
     def _run(self) -> None:
         backoff = 0.5

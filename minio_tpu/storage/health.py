@@ -27,6 +27,7 @@ from minio_tpu.storage.local import (DiskAccessDenied, FaultyDisk,
 from minio_tpu.storage.meta import (FileNotFoundErr, MetaError,
                                     VersionNotFoundErr)
 from minio_tpu.utils import deadline as deadline_mod
+from minio_tpu.utils import tracing
 from minio_tpu.utils.deadline import DeadlineExceeded
 
 # Errors that mean "the drive answered correctly" — never breaker fuel.
@@ -214,6 +215,18 @@ class DiskHealthWrapper:
             self._half_open_probe = False
 
     def _call(self, op: str, fn, args, kwargs):
+        if tracing.ACTIVE:
+            # Every storage op becomes one span (drive + op name) —
+            # the per-drive attribution layer of the trace tree. The
+            # span covers admit + pool wait + the op itself; the
+            # engine-level span above it carries the queue-wait split.
+            with tracing.span("storage", f"disk.{op}",
+                              {"drive": str(self.endpoint
+                                            or self.root or "")}):
+                return self._call_inner(op, fn, args, kwargs)
+        return self._call_inner(op, fn, args, kwargs)
+
+    def _call_inner(self, op: str, fn, args, kwargs):
         # Deadline pre-check BEFORE _admit(): an already-exhausted
         # request must not consume the breaker's half-open probe slot.
         dl = deadline_mod.current()
@@ -232,13 +245,15 @@ class DiskHealthWrapper:
         if dl is not None:
             timeout = min(base, dl.remaining())
         t0 = time.monotonic()
-        if dl is None:
+        tctx, tparent = tracing.capture() if tracing.ACTIVE else (None, 0)
+        if dl is None and tctx is None:
             fut: Future = self._pool.submit(fn, *args, **kwargs)
         else:
-            # Re-bind the budget inside the pool worker so nested
-            # layers (remote drives -> grid calls) keep consuming it.
-            def run(_dl=dl):
-                with deadline_mod.bind(_dl):
+            # Re-bind the budget (and the trace scope) inside the pool
+            # worker so nested layers (remote drives -> grid calls)
+            # keep consuming it / parenting under this op's span.
+            def run(_dl=dl, _tc=tctx, _tp=tparent):
+                with deadline_mod.bind(_dl), tracing.bind(_tc, _tp):
                     return fn(*args, **kwargs)
             fut = self._pool.submit(run)
         try:

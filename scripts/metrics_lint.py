@@ -1,0 +1,133 @@
+#!/usr/bin/env python3
+"""Metrics-name lint (wired into scripts/verify.sh).
+
+Walks the source tree's ASTs for every registration call — `metric(...)`
+and `hist_metric(...)` in s3/metrics.py render paths — and asserts:
+
+  * every exported metric name is a string LITERAL (a computed name
+    can silently collide or escape this lint);
+  * every name is `minio_tpu_`-prefixed snake_case
+    (^minio_tpu_[a-z0-9]+(_[a-z0-9]+)*$);
+  * every name is registered exactly once across the tree (double
+    registration renders duplicate HELP/TYPE blocks, which Prometheus
+    scrapers reject).
+
+Exit 0 clean, 1 with one line per violation.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NAME_RE = re.compile(r"^minio_tpu_[a-z0-9]+(_[a-z0-9]+)*$")
+REGISTRARS = {"metric", "hist_metric"}
+
+
+def call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _loop_literal_names(tree: ast.AST) -> dict:
+    """Names registered via the `for name, ... in ((LITERAL, ...), ...):
+    metric(name, ...)` idiom: maps the id of each such Call node to the
+    list of (lineno, literal) names its loop iterates."""
+    out: dict[int, list] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.For):
+            continue
+        target = node.target
+        if not (isinstance(target, ast.Tuple) and target.elts
+                and isinstance(target.elts[0], ast.Name)):
+            continue
+        var = target.elts[0].id
+        names = []
+        if isinstance(node.iter, (ast.Tuple, ast.List)):
+            for elt in node.iter.elts:
+                if isinstance(elt, (ast.Tuple, ast.List)) and elt.elts \
+                        and isinstance(elt.elts[0], ast.Constant) \
+                        and isinstance(elt.elts[0].value, str):
+                    names.append((elt.elts[0].lineno, elt.elts[0].value))
+                else:
+                    names = None
+                    break
+        else:
+            names = None
+        if names is None:
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) \
+                    and call_name(sub) in REGISTRARS and sub.args \
+                    and isinstance(sub.args[0], ast.Name) \
+                    and sub.args[0].id == var:
+                out[id(sub)] = names
+    return out
+
+
+def lint_file(path: str, seen: dict, problems: list) -> None:
+    with open(path, encoding="utf-8") as fh:
+        try:
+            tree = ast.parse(fh.read(), filename=path)
+        except SyntaxError as e:
+            problems.append(f"{path}: syntax error: {e}")
+            return
+    rel = os.path.relpath(path, ROOT)
+    loop_names = _loop_literal_names(tree)
+
+    def check(name: str, loc: str) -> None:
+        if not NAME_RE.match(name):
+            problems.append(
+                f"{loc}: metric name {name!r} is not minio_tpu_-prefixed "
+                "snake_case")
+        if name in seen:
+            problems.append(
+                f"{loc}: metric {name!r} already registered at "
+                f"{seen[name]}")
+        else:
+            seen[name] = loc
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) \
+                or call_name(node) not in REGISTRARS or not node.args:
+            continue
+        first = node.args[0]
+        loc = f"{rel}:{node.lineno}"
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            check(first.value, loc)
+        elif id(node) in loop_names:
+            for lineno, name in loop_names[id(node)]:
+                check(name, f"{rel}:{lineno}")
+        else:
+            problems.append(f"{loc}: metric name is not a string literal")
+
+
+def main() -> int:
+    seen: dict = {}
+    problems: list = []
+    count = 0
+    for dirpath, dirnames, filenames in os.walk(
+            os.path.join(ROOT, "minio_tpu")):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if fn.endswith(".py"):
+                lint_file(os.path.join(dirpath, fn), seen, problems)
+                count += 1
+    if problems:
+        for p in problems:
+            print(f"metrics-lint: {p}", file=sys.stderr)
+        return 1
+    print(f"metrics-lint: {len(seen)} metric names across {count} files, "
+          "all minio_tpu_-prefixed snake_case, each registered once")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

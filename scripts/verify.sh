@@ -9,6 +9,11 @@ cd "$(dirname "$0")/.."
 echo "== compileall gate =="
 python -m compileall -q minio_tpu || exit 1
 
+# Metric-name hygiene: every exported name minio_tpu_-prefixed
+# snake_case and registered exactly once (scripts/metrics_lint.py).
+echo "== metrics lint =="
+python scripts/metrics_lint.py || exit 1
+
 # Opt-in bench smoke (MTPU_BENCH_SMOKE=1): the concurrent-PUT
 # aggregate at small budget, failing on >20% regression against the
 # committed BENCH_r*.json. Off by default — tier-1 wall time stays
