@@ -96,6 +96,7 @@ def _chain_time(step, x0):
 #   MTPU_BENCH_ONLY=put_latency,put_concurrent
 # MTPU_BENCH_SMALL=1 shrinks budgets (smoke-test scale) and skips the
 # forced-device and served-front-end columns.
+import contextlib as _contextlib
 import os as _os
 
 _ONLY = {s.strip() for s in _os.environ.get(
@@ -105,6 +106,28 @@ _SMALL = _os.environ.get("MTPU_BENCH_SMALL", "") in ("1", "on", "true")
 
 def _want(section: str) -> bool:
     return not _ONLY or section in _ONLY
+
+
+@_contextlib.contextmanager
+def _forced_device(k: int, m: int):
+    """Pin the (k, m) batcher AND the MTPU_BATCH_FORCE env knob to the
+    device route for a forced-device bench column, restoring both on
+    exit. The env knob rides along so the erasure layer's platform
+    gate also yields — on non-TPU hosts the column then measures the
+    REAL batched device route (XLA-CPU), not a silently identical
+    host path."""
+    from minio_tpu.object.erasure_object import _batcher_for
+    saved = _os.environ.get("MTPU_BATCH_FORCE")
+    _os.environ["MTPU_BATCH_FORCE"] = "device"
+    _batcher_for(k, m).force(True)
+    try:
+        yield
+    finally:
+        if saved is None:
+            _os.environ.pop("MTPU_BATCH_FORCE", None)
+        else:
+            _os.environ["MTPU_BATCH_FORCE"] = saved
+        _batcher_for(k, m).reset_calibration()
 
 
 def main() -> None:
@@ -122,6 +145,8 @@ def main() -> None:
             _range_get()
         if _want("trace_overhead"):
             _trace_overhead()
+        if _want("put_scaling"):
+            _put_scaling()
         return
 
     import jax
@@ -218,6 +243,10 @@ def main() -> None:
     if _want("trace_overhead"):
         _trace_overhead()
 
+    # ---- 9. Chip-count scaling of the batched device PUT route --------
+    if _want("put_scaling"):
+        _put_scaling()
+
 
 def _put_latency() -> None:
     """End-to-end PutObject p50/p99 through the real object layer on
@@ -232,7 +261,7 @@ def _put_latency() -> None:
     import shutil
     import tempfile
 
-    from minio_tpu.object.erasure_object import ErasureSet, _batcher_for
+    from minio_tpu.object.erasure_object import ErasureSet
     from minio_tpu.ops.rs_device import DeviceBackend
     from minio_tpu.storage.local import LocalStorage
 
@@ -264,14 +293,11 @@ def _put_latency() -> None:
     tpu = run(DeviceBackend("auto"))
     device = None
     if not _SMALL:
-        # Forced device path LAST: force() pins the shared per-(k, m)
+        # Forced device path LAST: the pin claims the shared per-(k, m)
         # batcher, so the calibrated run above must precede it (and
         # the pin is reset for the aggregate bench that follows).
-        _batcher_for(K, M).force(True)
-        try:
+        with _forced_device(K, M):
             device = run(DeviceBackend("auto"))
-        finally:
-            _batcher_for(K, M).reset_calibration()
     print(json.dumps({
         "metric": "put_object_p50_ec4_1mib_ms",
         "value": tpu["p50_ms"],
@@ -304,7 +330,7 @@ def _put_concurrent() -> None:
     import tempfile
     from concurrent.futures import ThreadPoolExecutor
 
-    from minio_tpu.object.erasure_object import ErasureSet, _batcher_for
+    from minio_tpu.object.erasure_object import ErasureSet
     from minio_tpu.ops.rs_device import DeviceBackend
     from minio_tpu.storage.local import LocalStorage
 
@@ -350,11 +376,8 @@ def _put_concurrent() -> None:
     tpu = run(DeviceBackend("auto"))
     device_forced = served = None
     if not _SMALL:
-        _batcher_for(K, M).force(True)
-        try:
+        with _forced_device(K, M):
             device_forced = run(DeviceBackend("auto"))
-        finally:
-            _batcher_for(K, M).reset_calibration()
 
         # Front-end aggregate in a clean subprocess (no inherited JAX);
         # the probe run is shared with the GET aggregate section.
@@ -630,6 +653,116 @@ def _trace_overhead() -> None:
     }))
 
 
+def _put_scaling() -> None:
+    """Chip-count scaling of the batched device PUT route: the 16-way
+    concurrent 1 MiB PUT aggregate with the batcher PINNED to the
+    device (MTPU_BATCH_FORCE=device) measured at 1/2/4/8 visible
+    devices. Each point runs in a clean subprocess because the device
+    count must be fixed before JAX initializes: TPU hosts cap the mesh
+    via MTPU_MESH_DEVICES over real chips; CPU-only containers
+    (JAX_PLATFORMS=cpu) get N virtual host devices via
+    --xla_force_host_platform_device_count — identical code path, but
+    the numbers there prove plumbing, not speedup (N schedulers share
+    the same cores). vs_baseline is the max-devices aggregate over the
+    1-device aggregate: near-linear scaling is the tentpole claim, and
+    this metric is what MULTICHIP_r06+ records."""
+    import subprocess
+    import sys as _sys
+    sweep: dict[str, float] = {}
+    devices: dict[str, int] = {}
+    dropped: list[str] = []
+    for n in (1, 2, 4, 8):
+        env = {**_os.environ, "MTPU_SCALING_N": str(n),
+               "MTPU_BATCH_FORCE": "device"}
+        try:
+            out = subprocess.run(
+                [_sys.executable, __file__, "--scaling-probe"],
+                capture_output=True, timeout=900, env=env)
+            for line in out.stdout.decode().splitlines():
+                if line.startswith("SCALING_GIBPS="):
+                    sweep[str(n)] = float(line.split("=", 1)[1])
+                elif line.startswith("SCALING_DEVICES="):
+                    devices[str(n)] = int(line.split("=", 1)[1])
+        except Exception:  # noqa: BLE001 - sweep point best-effort
+            pass
+        if str(n) not in sweep:
+            dropped.append(str(n))
+    if not sweep:
+        print(json.dumps({"metric": "put_scaling_aggregate_gibps",
+                          "value": None, "unit": "GiB/s",
+                          "error": "no sweep point completed"}))
+        return
+    ns = sorted(sweep, key=int)
+    base, top = sweep[ns[0]], sweep[ns[-1]]
+    # baseline_devices names the sweep point vs_baseline actually
+    # divides by: if the 1-device probe died, the ratio is top/2-device
+    # and must not read as chips-vs-one-chip scaling.
+    print(json.dumps({
+        "metric": "put_scaling_aggregate_gibps",
+        "value": round(top, 3),
+        "unit": "GiB/s",
+        "vs_baseline": round(top / max(base, 1e-9), 3),
+        "baseline_devices": int(ns[0]),
+        "sweep_gibps": {k: round(sweep[k], 3) for k in ns},
+        "dropped_points": dropped,
+        "mesh_devices": devices,
+        "route": "device_forced",
+        "concurrency": 16,
+    }))
+
+
+def _scaling_probe() -> None:
+    """Subprocess body for one put_scaling sweep point: pin the mesh
+    width (and, on CPU, materialize that many virtual host devices)
+    BEFORE JAX initializes, then measure the object-layer 16-way PUT
+    aggregate with the batcher forced to the device route."""
+    import os
+    import shutil
+    import tempfile
+    n = max(1, int(os.environ.get("MTPU_SCALING_N", "1") or 1))
+    os.environ["MTPU_MESH_DEVICES"] = str(n)
+    os.environ.setdefault("MTPU_BATCH_FORCE", "device")
+    if "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}").strip()
+    from concurrent.futures import ThreadPoolExecutor
+
+    from minio_tpu.object.erasure_object import ErasureSet
+    from minio_tpu.ops.rs_device import DeviceBackend, mesh_info
+    from minio_tpu.storage.local import LocalStorage
+
+    print(f"SCALING_DEVICES={mesh_info()['mesh_devices']}")
+    rng = np.random.default_rng(8)
+    body = rng.integers(0, 256, size=1 << 20, dtype=np.uint8).tobytes()
+    threads, per_thread = 16, (2 if _SMALL else 4)
+    root = tempfile.mkdtemp(prefix="bench-scale-")
+    try:
+        disks = [LocalStorage(f"{root}/d{i}") for i in range(12)]
+        for d in disks:
+            d.make_vol("bench")
+        es = ErasureSet(disks, parity=M, backend=DeviceBackend("auto"))
+        ex = ThreadPoolExecutor(max_workers=threads)
+
+        def worker(t):
+            for i in range(per_thread):
+                es.put_object("bench", f"o-{t}-{i}", body)
+
+        list(ex.map(worker, range(threads)))      # warm + compile pass
+        best = 0.0
+        for _rep in range(1 if _SMALL else 2):
+            t0 = time.perf_counter()
+            list(ex.map(worker, range(threads)))
+            wall = time.perf_counter() - t0
+            best = max(best, threads * per_thread * len(body) / wall
+                       / (1 << 30))
+        ex.shutdown(wait=False)
+        es.close()
+        print(f"SCALING_GIBPS={best:.4f}")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 # One probe subprocess can serve several sections (PUT + GET
 # aggregates): cache its parsed output for the process lifetime.
 _PROBE_LINES: dict | None = None
@@ -746,5 +879,7 @@ if __name__ == "__main__":
     import sys as _sys
     if "--serve-probe" in _sys.argv:
         _serve_probe()
+    elif "--scaling-probe" in _sys.argv:
+        _scaling_probe()
     else:
         main()

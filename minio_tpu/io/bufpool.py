@@ -94,6 +94,19 @@ class Lease:
         valid while this holder's reference is live."""
         return self._buf
 
+    def ndarray(self, shape, dtype="uint8"):
+        """A numpy view of the leased bytes shaped `shape` (must fit in
+        `size`). Only valid while this holder's reference is live — the
+        device-batching staging path retains the lease across the whole
+        host->HBM dispatch so a recycled buffer can never be rewritten
+        under an in-flight transfer."""
+        import numpy as _np
+        items = int(_np.prod(shape))
+        if items * _np.dtype(dtype).itemsize > self.size:
+            raise ValueError(f"lease of {self.size} cannot shape {shape}")
+        return _np.frombuffer(self._buf, dtype=dtype,
+                              count=items).reshape(shape)
+
     def retain(self) -> "Lease":
         with self._state.mu:
             if self._state.refs <= 0:

@@ -193,6 +193,31 @@ class DriveQueue:
         return out
 
 
+# -- the accelerator lane ---------------------------------------------------
+# The TPU is one shared resource exactly like a drive: concurrent device
+# dispatches (several EC configs' stripe batchers, solo device-sized
+# windows) contend for the same chip mesh, and uncoordinated submission
+# from many request threads interleaves compiles and transfers. One
+# process-wide single-worker DriveQueue serializes every device dispatch
+# and gives the same wait-vs-service attribution drives get — "is the
+# accelerator the wall" reads off the identical stats machinery.
+
+_kernel_lane: DriveQueue | None = None
+_kernel_mu = threading.Lock()
+
+
+def kernel_lane() -> DriveQueue:
+    """The process-wide device-dispatch queue (1 worker, deep enough
+    that coalesced bursts never shed — a shed dispatch would fail whole
+    PUT batches, unlike one drive op counted against quorum)."""
+    global _kernel_lane
+    if _kernel_lane is None:
+        with _kernel_mu:
+            if _kernel_lane is None:
+                _kernel_lane = DriveQueue("kernel", workers=1, depth=1024)
+    return _kernel_lane
+
+
 class IOEngine:
     """The per-drive queues of one erasure set."""
 

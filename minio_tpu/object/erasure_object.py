@@ -36,6 +36,7 @@ import numpy as np
 from minio_tpu.erasure.codec import CodecError, Erasure, ceil_frac
 from minio_tpu.io.bufpool import global_pool
 from minio_tpu.io.engine import EngineSaturated, IOEngine
+from minio_tpu.ops.batcher import batch_force_mode
 from minio_tpu.utils import deadline as deadline_mod
 from minio_tpu.utils import tracing
 from minio_tpu.utils.deadline import DeadlineExceeded
@@ -128,14 +129,27 @@ def _host_rows(k: int, m: int, stacked: np.ndarray) -> list[list]:
 
 
 @functools.lru_cache(maxsize=64)
+def _mesh_framer_for(k: int, m: int):
+    """Mesh-sharded cross-request framer for one EC config: the batch
+    dim ("stripes from many requests") is pjit-sharded over every
+    available chip with donated inputs (ops/hh_device.make_mesh_framer);
+    degrades to the single-chip fused framer on one device."""
+    from minio_tpu.ops.hh_device import make_mesh_framer
+    return make_mesh_framer(_parity_matrix(k, m))
+
+
+@functools.lru_cache(maxsize=64)
 def _batcher_for(k: int, m: int):
     """Cross-request stripe batcher for one EC config: coalesces
-    concurrent PUT windows into one device step when the measured
-    device round trip beats the host codec (ops/batcher.py)."""
+    concurrent PUT windows into one mesh-wide device step when the
+    measured device round trip beats the host codec (ops/batcher.py).
+    Staging rides the global buffer pool so the coalesced window is
+    one pooled host buffer donated into HBM."""
     from minio_tpu.ops.batcher import StripeBatcher
-    return StripeBatcher(_framer_for(k, m),
+    return StripeBatcher(_mesh_framer_for(k, m),
                          functools.partial(_host_rows, k, m),
-                         min_device_blocks=MIN_DEVICE_BLOCKS)
+                         min_device_blocks=MIN_DEVICE_BLOCKS,
+                         pool=global_pool(), name=f"{k}+{m}")
 
 
 def default_parity(set_size: int) -> int:
@@ -829,7 +843,15 @@ class ErasureSet:
         # calibration says the device link wins; otherwise — including
         # a lone PUT with nobody to batch with — the host codec runs
         # with zero added latency (ops/batcher.py).
-        use_device = (full >= 1 and m > 0 and _on_tpu()
+        # MTPU_BATCH_FORCE=device overrides the platform check: the
+        # reproducibility knob must reach the REAL batched device route
+        # on any host (CI plumbing proofs, the put_scaling sweep on
+        # virtual devices) — without it, a non-TPU backend silently
+        # measured the host path no matter what the batcher was forced
+        # to, which is exactly the invisible degradation the knob
+        # exists to rule out.
+        use_device = (full >= 1 and m > 0
+                      and (_on_tpu() or batch_force_mode() == "device")
                       and hasattr(self.backend, "apply_matrix_device")
                       and BLOCK_SIZE % k == 0 and shard_size % 1024 == 0
                       # Once the batcher's calibration resolves to

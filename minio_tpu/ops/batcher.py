@@ -1,14 +1,27 @@
-"""Cross-request stripe batching for the fused PUT pipeline.
+"""Device-resident cross-request stripe batching on the sharded codec.
 
 The blueprint's most TPU-native idea (BASELINE.json: "shard batches from
 parallelWriter ... are coalesced into HBM-resident tensors so a full
 erasure set's stripes encode in one pmap"): stripe windows from MANY
 concurrent PutObject calls coalesce into ONE device step — the batch
 dimension becomes "stripes from many requests" — and completions
-demultiplex back to the waiting writers. The reference's analogue is the
-opposite trade (each goroutine encodes its own blocks on its own core,
-cmd/erasure-encode.go:27 multiWriter); on a TPU the accelerator is one
-big shared core, so batching across requests is what fills it.
+demultiplex back to the waiting writers, whose per-drive shard writes
+then ride the io/engine drive queues exactly like solo PUTs. The
+reference's analogue is the opposite trade (each goroutine encodes its
+own blocks on its own core, cmd/erasure-encode.go:27 multiWriter); on a
+TPU the accelerator is one big shared mesh, so batching across requests
+is what fills it.
+
+What makes the batch DEVICE-resident (ops/hh_device.make_mesh_framer):
+the coalesced window is staged into ONE pooled bufpool buffer, padded to
+a fixed power-of-two bucket, and dispatched as a pjit-style sharded step
+— NamedSharding(mesh, P("stripe")) splits the batch dim over every
+available chip and `donate_argnums` hands the staged HBM buffer to the
+kernel so data flows host -> HBM -> parity with no defensive copy. One
+compiled executable exists per (bucket, EC config), never per
+concurrency level. All device dispatches in the process serialize
+through the shared io/engine kernel lane (the chip is one resource, like
+a drive), which also yields wait-vs-service attribution for free.
 
 Dispatch policy is MEASURED, not assumed: a one-time background probe
 times the device round trip (host->HBM transfer + fused kernel +
@@ -17,24 +30,60 @@ link is fast (PCIe-local TPU) batches beat the host and route to the
 device; where it is slow (e.g. a tunneled remote chip) everything stays
 on the host codec and the batcher degrades to a pass-through. A lone
 PUT with no concurrency never waits: frame() bypasses the queue
-entirely unless other requests are already in flight.
+entirely unless other requests are already in flight. The accumulation
+window is ADAPTIVE: it opens at the measured base wait, stretches while
+bursts keep filling whole buckets, shrinks toward zero while traffic is
+sparse, dispatches early the moment one mesh-filling batch is pending,
+and never holds a member past its request deadline (members whose
+budget is already spent fail alone — they are culled before dispatch
+and cannot poison batch-mates).
+
+Every batched dispatch is also one `kernel` span FANNED into each
+member request's span tree (utils/tracing.record_into): a traced PUT
+shows the shared dispatch it rode — batch size, bucket, mesh width,
+its own coalescing wait — not a gap.
+
+Environment:
+  MTPU_BATCH_FORCE    device|host|auto (default auto): pin the
+                      calibration verdict — reproducible benches/CI
+                      instead of a silent probe-dependent route.
+  MTPU_BATCH_WAIT_MS  base accumulation window in ms (default 2).
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
+import weakref
 from typing import Callable, Optional
 
 import numpy as np
 
+from minio_tpu.io.engine import EngineSaturated, kernel_lane
+from minio_tpu.utils import deadline as deadline_mod
+from minio_tpu.utils import tracing
+from minio_tpu.utils.deadline import DeadlineExceeded
+from minio_tpu.utils.latency import Histogram
+
 # Batch-dim padding buckets: one compiled device shape per bucket, not
-# one per distinct concurrency level.
+# one per distinct concurrency level. Powers of two so every bucket
+# divides evenly across a power-of-two chip mesh (hh_device
+# mesh_batch_devices) with zero per-chip remainder shapes.
 _BUCKETS = (8, 16, 32, 64, 128, 256)
-# How long the first window of a burst waits for company.
+# Base accumulation window (first window of a burst); the adaptive
+# controller moves the live value between _MIN_WAIT_S and this.
 _MAX_WAIT_S = 0.002
+_MIN_WAIT_S = 0.00025
 # Cap per dispatched device batch (VMEM/HBM bound upstream anyway).
 _MAX_BATCH_BLOCKS = 256
+# Stripe blocks per chip that saturate one chip's fused pipeline: the
+# accumulation window stops waiting the moment the pending total can
+# feed the whole mesh at this depth.
+_PER_CHIP_BLOCKS = 32
+# A member must dispatch at least this long before its deadline — the
+# device round trip plus demux must fit in what remains.
+_DEADLINE_SLACK_S = 0.005
 
 
 def _bucket(n: int) -> int:
@@ -44,42 +93,133 @@ def _bucket(n: int) -> int:
     return _BUCKETS[-1]
 
 
-class _Pending:
-    __slots__ = ("stacked", "rows", "exc", "event")
+def _env_wait_s() -> float:
+    try:
+        return max(0.0, float(
+            os.environ.get("MTPU_BATCH_WAIT_MS", "") or 2.0)) / 1000.0
+    except ValueError:
+        return _MAX_WAIT_S
 
-    def __init__(self, stacked: np.ndarray):
+
+def batch_force_mode() -> str:
+    """The MTPU_BATCH_FORCE verdict: "device", "host", or "auto"."""
+    v = os.environ.get("MTPU_BATCH_FORCE", "auto").strip().lower()
+    return v if v in ("device", "host") else "auto"
+
+
+class _Pending:
+    __slots__ = ("stacked", "count", "rows", "exc", "event", "expires_at",
+                 "tctx", "tparent", "t_enq")
+
+    def __init__(self, stacked: np.ndarray,
+                 dl: Optional[deadline_mod.Deadline]):
         self.stacked = stacked
+        self.count = stacked.shape[0]
         self.rows = None
         self.exc: Optional[BaseException] = None
         self.event = threading.Event()
+        self.expires_at = dl.expires_at if dl is not None else None
+        self.tctx, self.tparent = tracing.capture() if tracing.ACTIVE \
+            else (None, 0)
+        self.t_enq = time.perf_counter()
+
+
+# Live batchers, for fleet-wide occupancy metrics (s3/metrics.py
+# renders minio_tpu_batcher_* from aggregate_stats()).
+_REGISTRY: "weakref.WeakSet[StripeBatcher]" = weakref.WeakSet()
+
+
+def aggregate_stats() -> dict:
+    """Summed occupancy stats across every live batcher (all EC
+    configs): dispatch/route/bucket counters, fill accounting, the
+    coalescing wait histogram, deadline culls."""
+    out = {
+        "dispatches": {"device": 0, "host": 0},
+        "requests": {"device": 0, "host": 0, "bypass": 0},
+        "buckets": {},
+        "batched_blocks": 0,
+        "capacity_blocks": 0,
+        "deadline_failures": 0,
+        "mesh_devices": 0,
+        "wait_hist": None,
+        "forced": batch_force_mode(),
+    }
+    hists = []
+    for sb in list(_REGISTRY):
+        st = sb.stats()
+        for key in ("device", "host"):
+            out["dispatches"][key] += st["dispatches"][key]
+        for key in ("device", "host", "bypass"):
+            out["requests"][key] += st["requests"][key]
+        for b, v in st["buckets"].items():
+            out["buckets"][b] = out["buckets"].get(b, 0) + v
+        out["batched_blocks"] += st["batched_blocks"]
+        out["capacity_blocks"] += st["capacity_blocks"]
+        out["deadline_failures"] += st["deadline_failures"]
+        out["mesh_devices"] = max(out["mesh_devices"], st["mesh_devices"])
+        hists.append(st["wait_hist"])
+    out["wait_hist"] = Histogram.merge(hists) if hists \
+        else Histogram().state()
+    total = out["batched_blocks"]
+    cap = out["capacity_blocks"]
+    out["fill_ratio"] = (total / cap) if cap else 0.0
+    return out
 
 
 class StripeBatcher:
     """Coalesces concurrent frame() calls of one EC config.
 
     device_fn(stacked [B, k, L] u8) -> per-drive rows (the
-    make_encode_framer run() contract); host_fn(stacked) -> same rows
-    via the host codec. Both must be thread-safe.
+    make_mesh_framer / make_encode_framer run() contract);
+    host_fn(stacked) -> same rows via the host codec. Both must be
+    thread-safe. `pool` (io/bufpool.BufferPool) backs the coalesced
+    staging buffer — its lease is RETAINED for the whole dispatch, so a
+    donated host buffer can never be recycled under an in-flight
+    host->HBM transfer.
     """
 
     def __init__(self, device_fn: Callable, host_fn: Callable,
                  probe_fn: Optional[Callable] = None,
                  min_device_blocks: int = 8,
-                 max_wait_s: float = _MAX_WAIT_S):
+                 max_wait_s: Optional[float] = None,
+                 pool=None, name: str = ""):
         self._device_fn = device_fn
         self._host_fn = host_fn
         self._min_device_blocks = min_device_blocks
-        self._max_wait = max_wait_s
+        self._max_wait = _env_wait_s() if max_wait_s is None else max_wait_s
+        self._cur_wait = self._max_wait
+        self._pool = pool
+        self.name = name
+        self.mesh_devices = max(1, int(getattr(device_fn, "mesh_devices",
+                                               1) or 1))
         self._mu = threading.Condition()
         self._pending: list[_Pending] = []
-        self._deadline = 0.0
-        self._inflight = 0          # frame() calls currently active
+        self._deadline = 0.0            # current window's dispatch-by time
+        self._inflight = 0              # frame() calls currently active
         self._dispatcher: Optional[threading.Thread] = None
         self._closed = False
         # Calibration: None = unknown (host until probed), True/False.
         self._device_ok: Optional[bool] = None
         self._probe_fn = probe_fn
         self._probe_started = False
+        forced = batch_force_mode()
+        if forced != "auto":
+            self._probe_started = True
+            self._device_ok = forced == "device"
+        # Occupancy stats (own lock: the dispatcher holds _mu at the
+        # moments hot paths want to count).
+        self._stat_mu = threading.Lock()
+        self._dispatches = {"device": 0, "host": 0}
+        self._requests = {"device": 0, "host": 0, "bypass": 0}
+        # Calibrated-host bypass count: bumped WITHOUT _stat_mu on the
+        # zero-overhead pass-through, folded into stats() reads.
+        self._bypass_approx = 0
+        self._bucket_dispatches: dict[int, int] = {}
+        self._batched_blocks = 0
+        self._capacity_blocks = 0
+        self._deadline_failures = 0
+        self._wait_hist = Histogram()
+        _REGISTRY.add(self)
 
     # -- calibration ----------------------------------------------------
 
@@ -88,8 +228,8 @@ class StripeBatcher:
         request's config, widened to a device-worthy block count);
         True when the device round trip wins."""
         stacked = np.zeros(
-            (_bucket(self._min_device_blocks),) + sample.shape[1:],
-            dtype=np.uint8)
+            (_bucket(max(self._min_device_blocks, self.mesh_devices)),)
+            + sample.shape[1:], dtype=np.uint8)
         try:
             self._device_fn(stacked)           # compile
             t0 = time.perf_counter()
@@ -138,29 +278,87 @@ class StripeBatcher:
 
     def force(self, device_ok: bool) -> None:
         """Pin the calibration verdict (bench/tests): no probe runs,
-        dispatch follows `device_ok` unconditionally."""
+        dispatch follows `device_ok` unconditionally. The env knob
+        MTPU_BATCH_FORCE=device|host applies the same pin at
+        construction (CI/bench reproducibility: a slow-link probe must
+        not silently degrade a measured run to pass-through)."""
         with self._mu:
             self._probe_started = True
             self._device_ok = bool(device_ok)
 
     def reset_calibration(self) -> None:
-        """Back to unprobed (bench/tests cleanup after force())."""
+        """Back to the configured default (bench/tests cleanup after
+        force()): unprobed under auto, re-pinned under a
+        MTPU_BATCH_FORCE override."""
         with self._mu:
-            self._probe_started = False
-            self._device_ok = None
+            forced = batch_force_mode()
+            if forced != "auto":
+                self._probe_started = True
+                self._device_ok = forced == "device"
+            else:
+                self._probe_started = False
+                self._device_ok = None
+
+    # -- observability --------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._stat_mu:
+            requests = dict(self._requests)
+            requests["bypass"] += self._bypass_approx
+            return {
+                "name": self.name,
+                "mesh_devices": self.mesh_devices,
+                "dispatches": dict(self._dispatches),
+                "requests": requests,
+                "buckets": dict(self._bucket_dispatches),
+                "batched_blocks": self._batched_blocks,
+                "capacity_blocks": self._capacity_blocks,
+                "deadline_failures": self._deadline_failures,
+                "wait_hist": self._wait_hist.state(),
+                "window_s": self._cur_wait,
+            }
+
+    def _note_request(self, route: str, n: int = 1) -> None:
+        with self._stat_mu:
+            self._requests[route] += n
 
     # -- submission -----------------------------------------------------
 
     def frame(self, stacked: np.ndarray):
         """Frame one request's stripe window [B, k, L]; blocks until
         the (possibly coalesced) result is ready. Returns per-drive
-        rows for exactly this window's blocks."""
+        rows for exactly this window's blocks. Raises DeadlineExceeded
+        without touching the device when the caller's budget is
+        already spent."""
         if self._device_ok is False:
             # Calibration resolved to host: genuinely free pass-through
             # — no lock, no inflight bookkeeping, no condition-variable
             # hop, just the host codec (the unlocked read is safe: the
-            # verdict transitions once, None -> True/False).
+            # verdict transitions once, None -> True/False). The counter
+            # bump is unlocked too — approximate under races, and the
+            # only shared state this path touches.
+            self._bypass_approx += 1
             return self._host_fn(stacked)
+        if stacked.shape[0] > _MAX_BATCH_BLOCKS:
+            # An oversized window (whole-part framing of a huge
+            # multipart/copy part can exceed the largest padding
+            # bucket) must never reach _stage as one pending — the
+            # staging buffer is at most _BUCKETS[-1] rows, and a mesh
+            # dispatch needs a divisible batch. Dispatch bucket-sized
+            # chunks through the same path (each rides the device or
+            # host route on its own merits) and splice the per-drive
+            # rows back together.
+            rows = None
+            for off in range(0, stacked.shape[0], _MAX_BATCH_BLOCKS):
+                chunk = self.frame(stacked[off:off + _MAX_BATCH_BLOCKS])
+                rows = chunk if rows is None else [
+                    r + c for r, c in zip(rows, chunk)]
+            return rows
+        dl = deadline_mod.current()
+        if dl is not None and dl.expired():
+            with self._stat_mu:
+                self._deadline_failures += 1
+            raise DeadlineExceeded("request deadline exceeded")
         big = stacked.shape[0] >= self._min_device_blocks
         with self._mu:
             self._inflight += 1
@@ -177,32 +375,28 @@ class StripeBatcher:
                 if big and self._device_ok:
                     # A single device-sized window (e.g. a streaming
                     # PUT's 32-block window) needs no queue — dispatch
-                    # straight to the fused pipeline, padded to the
-                    # same fixed buckets as coalesced batches so a
-                    # ragged tail window can't compile a fresh shape.
-                    b = stacked.shape[0]
-                    pad = _bucket(b) - b
-                    if pad > 0:
-                        stacked = np.concatenate(
-                            [stacked,
-                             np.zeros((pad,) + stacked.shape[1:],
-                                      dtype=stacked.dtype)])
-                    rows = self._device_fn(stacked)
-                    return [drive[:b] for drive in rows] if pad > 0 \
-                        else rows
+                    # straight through the shared batch path (same
+                    # staging, padding buckets, kernel lane, tracing).
+                    p = _Pending(stacked, dl)
+                    self._run_batch([p])
+                    if p.exc is not None:
+                        raise p.exc
+                    return p.rows
+                self._note_request("bypass")
                 return self._host_fn(stacked)
             if self._device_ok is not True:
+                self._note_request("host")
                 return self._host_fn(stacked)
-            return self._enqueue(stacked)
+            return self._enqueue(stacked, dl)
         finally:
             with self._mu:
                 self._inflight -= 1
 
-    def _enqueue(self, stacked: np.ndarray):
-        p = _Pending(stacked)
+    def _enqueue(self, stacked: np.ndarray, dl):
+        p = _Pending(stacked, dl)
         with self._mu:
             if not self._pending:
-                self._deadline = time.monotonic() + self._max_wait
+                self._deadline = time.monotonic() + self._cur_wait
             self._pending.append(p)
             # _dispatcher is cleared (under this lock) by the loop
             # BEFORE it exits, so is_alive() can never claim a thread
@@ -213,7 +407,7 @@ class StripeBatcher:
                     name="stripe-batcher")
                 self._dispatcher.start()
             # Always wake the dispatcher: if it is parked in its idle
-            # 0.2 s poll, an un-notified append would stretch the 2 ms
+            # 0.2 s poll, an un-notified append would stretch the
             # coalescing window into a 200 ms latency spike.
             self._mu.notify_all()
         p.event.wait()
@@ -222,6 +416,23 @@ class StripeBatcher:
         return p.rows
 
     # -- dispatch -------------------------------------------------------
+
+    def _fill_target(self) -> int:
+        """Pending blocks that saturate the mesh: stop accumulating
+        the moment one dispatch can feed every chip at working depth."""
+        return min(_MAX_BATCH_BLOCKS,
+                   max(self._min_device_blocks,
+                       self.mesh_devices * _PER_CHIP_BLOCKS))
+
+    def _adapt_window(self, fill_ratio: float) -> None:
+        """Depth-aware accumulation: buckets dispatching full mean the
+        burst outruns the window — stretch it (more coalescing per
+        compile is paying for itself); sparse dispatches mean waiting
+        only adds latency — shrink toward pass-through."""
+        if fill_ratio >= 0.75:
+            self._cur_wait = min(self._max_wait, self._cur_wait * 1.5)
+        elif fill_ratio < 0.25:
+            self._cur_wait = max(_MIN_WAIT_S, self._cur_wait * 0.5)
 
     def _dispatch_loop(self) -> None:
         while True:
@@ -239,10 +450,19 @@ class StripeBatcher:
                     self._dispatcher = None
                     return
                 now = time.monotonic()
-                total = sum(e.stacked.shape[0] for e in self._pending)
-                if total < _MAX_BATCH_BLOCKS and now < self._deadline \
+                total = sum(p.count for p in self._pending)
+                # The window closes at the adaptive deadline, when the
+                # mesh can be fed at full depth, or in time for the
+                # EARLIEST member deadline — a coalesced batch must
+                # respect the most impatient request riding it.
+                bound = self._deadline
+                expiries = [p.expires_at for p in self._pending
+                            if p.expires_at is not None]
+                if expiries:
+                    bound = min(bound, min(expiries) - _DEADLINE_SLACK_S)
+                if total < self._fill_target() and now < bound \
                         and not self._closed:
-                    self._mu.wait(timeout=self._deadline - now)
+                    self._mu.wait(timeout=bound - now)
                     continue
                 # Drain at most one bucket's worth per dispatch; the
                 # remainder keeps its place for the next round (an
@@ -250,7 +470,7 @@ class StripeBatcher:
                 batch, rest = [], []
                 taken = 0
                 for p in self._pending:
-                    c = p.stacked.shape[0]
+                    c = p.count
                     if batch and taken + c > _MAX_BATCH_BLOCKS:
                         rest.append(p)
                     else:
@@ -261,31 +481,146 @@ class StripeBatcher:
                     self._deadline = now      # no extra wait for them
             self._run_batch(batch)
 
+    def _stage(self, live: list[_Pending], bucket: int):
+        """(lease, stacked [bucket, k, L]): members copied into ONE
+        pooled staging buffer, zero-padded to the bucket. The lease is
+        held by the caller for the whole dispatch — donation safety:
+        the buffer the device is still reading can never be recycled
+        into a new lease mid-transfer. Returns (None, member array)
+        when a lone member already fills the bucket exactly."""
+        if len(live) == 1 and live[0].count == bucket:
+            return None, live[0].stacked
+        shape = (bucket,) + live[0].stacked.shape[1:]
+        lease = None
+        stacked = None
+        if self._pool is not None:
+            try:
+                lease = self._pool.lease(int(np.prod(shape)))
+                stacked = lease.ndarray(shape)
+            except Exception:  # noqa: BLE001 - pool pressure -> fresh
+                lease = None
+        if stacked is None:
+            stacked = np.empty(shape, dtype=np.uint8)
+        off = 0
+        for p in live:
+            stacked[off:off + p.count] = p.stacked
+            off += p.count
+        if off < bucket:
+            # Zero the pad rows: a recycled pool buffer carries stale
+            # bytes, and deterministic pads keep batched output
+            # byte-stable run to run (the pad rows' parity/digests are
+            # sliced off either way).
+            stacked[off:] = 0
+        return lease, stacked
+
+    def _lane_dispatch(self, stacked: np.ndarray):
+        """Run the device framer through the process-wide kernel lane
+        (serialized device access + wait/service attribution); falls
+        back to a direct call if the lane is saturated or closed."""
+        try:
+            fut = kernel_lane().submit(lambda: self._device_fn(stacked))
+        except EngineSaturated:
+            return self._device_fn(stacked)
+        return fut.result()
+
     def _run_batch(self, batch: list[_Pending]) -> None:
-        counts = [p.stacked.shape[0] for p in batch]
+        # Cull members whose budget is already spent: they fail ALONE
+        # (DeadlineExceeded, counted) and never poison batch-mates —
+        # the dispatch proceeds without them.
+        now = time.monotonic()
+        live, dead = [], []
+        for p in batch:
+            if p.expires_at is not None \
+                    and now >= p.expires_at - 1e-9:
+                dead.append(p)
+            else:
+                live.append(p)
+        if dead:
+            with self._stat_mu:
+                self._deadline_failures += len(dead)
+            for p in dead:
+                p.exc = DeadlineExceeded(
+                    "request deadline exceeded before batch dispatch")
+                p.event.set()
+        if not live:
+            return
+        counts = [p.count for p in live]
         total = sum(counts)
+        # Never pick a bucket narrower than the mesh: the device run()
+        # requires batch % mesh_devices == 0, and small dispatches on a
+        # wide mesh (e.g. 8 blocks across 16 chips) would otherwise
+        # fail every batch member.
+        bucket = _bucket(max(total, self.mesh_devices))
+        route = "host"
+        t_wall = time.time()
+        t0 = time.perf_counter()
         try:
             if total >= self._min_device_blocks and self._device_ok:
-                stacked = np.concatenate([p.stacked for p in batch]) \
-                    if len(batch) > 1 else batch[0].stacked
-                pad = max(0, _bucket(total) - total)
-                if pad:
-                    stacked = np.concatenate(
-                        [stacked, np.zeros((pad,) + stacked.shape[1:],
-                                           dtype=stacked.dtype)])
-                rows_all = self._device_fn(stacked)
+                route = "device"
+                lease, stacked = self._stage(live, bucket)
+                try:
+                    rows_all = self._lane_dispatch(stacked)
+                finally:
+                    # The dispatch is synchronous through the readback
+                    # (the framer returns host numpy), so the staging
+                    # buffer is done feeding HBM here — and not before.
+                    if lease is not None:
+                        lease.release()
+                k = live[0].stacked.shape[1]
+                staged = lease is not None or len(live) > 1
                 off = 0
-                for p, c in zip(batch, counts):
-                    p.rows = [drive[off:off + c] for drive in rows_all]
+                for p, c in zip(live, counts):
+                    rows = [drive[off:off + c] for drive in rows_all]
+                    if staged:
+                        # Demultiplex data drives back onto each
+                        # member's OWN window: device rows view the
+                        # shared staging buffer whose lease just
+                        # returned to the pool; digests/parity are
+                        # fresh device output and stay as-is.
+                        for i in range(k):
+                            rows[i] = [(dig, p.stacked[bi, i])
+                                       for bi, (dig, _blk)
+                                       in enumerate(rows[i])]
+                    p.rows = rows
                     off += c
+                with self._stat_mu:
+                    self._dispatches["device"] += 1
+                    self._requests["device"] += len(live)
+                    self._bucket_dispatches[bucket] = \
+                        self._bucket_dispatches.get(bucket, 0) + 1
+                    self._batched_blocks += total
+                    self._capacity_blocks += bucket
+                self._adapt_window(total / bucket)
             else:
-                for p in batch:
+                for p in live:
                     p.rows = self._host_fn(p.stacked)
+                with self._stat_mu:
+                    self._dispatches["host"] += 1
+                    self._requests["host"] += len(live)
+                # Host-routed dispatches are the sparse case (total
+                # below min_device_blocks) — adapt here too, or light
+                # steady traffic pins _cur_wait at whatever a past
+                # burst stretched it to and every small PUT pays the
+                # full window forever.
+                self._adapt_window(total / bucket)
         except BaseException as e:  # noqa: BLE001 - deliver to waiters
-            for p in batch:
+            for p in live:
                 p.exc = e
         finally:
-            for p in batch:
+            dur_ms = (time.perf_counter() - t0) * 1000.0
+            for p in live:
+                wait_s = max(0.0, t0 - p.t_enq)
+                self._wait_hist.observe(wait_s)
+                if p.tctx is not None:
+                    # ONE kernel span fanned into each member's tree.
+                    tracing.record_into(
+                        p.tctx, p.tparent, "kernel", "batcher.dispatch",
+                        t_wall, dur_ms,
+                        tags={"blocks": p.count, "batch_blocks": total,
+                              "bucket": bucket, "members": len(live),
+                              "route": route,
+                              "mesh_devices": self.mesh_devices,
+                              "wait_ms": round(wait_s * 1000.0, 3)})
                 p.event.set()
 
     def close(self) -> None:

@@ -503,3 +503,17 @@ def make_encoder(matrix: np.ndarray, mode: str = "auto"):
     backend = DeviceBackend(mode)
     matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
     return lambda data: backend.apply_matrix_device(matrix, data)
+
+
+def mesh_info() -> dict:
+    """Accelerator-mesh summary for bench/admin surfaces: the resolved
+    JAX backend, total visible devices, and the power-of-two mesh width
+    the cross-request stripe batching shards over (the prefix
+    hh_device.mesh_batch_devices resolves, honoring MTPU_MESH_DEVICES).
+    Importing here (not at module top) keeps rs_device importable
+    before JAX platform selection is final."""
+    from minio_tpu.ops.hh_device import mesh_batch_devices
+    devs = jax.devices()
+    return {"backend": jax.default_backend(),
+            "devices": len(devs),
+            "mesh_devices": len(mesh_batch_devices(devs))}

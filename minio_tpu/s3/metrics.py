@@ -455,6 +455,69 @@ class Metrics:
                  "Bytes parked on pool free lists", "gauge",
                  "idle_bytes")):
             metric(name, help_, type_, [({}, bp[key])])
+
+        # -- cross-request stripe batcher (ops/batcher) -----------------
+        # Occupancy diagnosis: route counters say whether PUTs actually
+        # ride the device; bucket counters + fill ratio say whether
+        # coalescing fills the mesh-wide batches it compiles for; the
+        # wait histogram bounds the latency the accumulation window
+        # adds; deadline failures count members culled before dispatch.
+        from minio_tpu.ops import batcher as _batcher_mod
+        bst = _batcher_mod.aggregate_stats()
+        metric("minio_tpu_batcher_dispatches_total",
+               "Coalesced stripe-batch dispatches by route", "counter",
+               [({"route": r}, v)
+                for r, v in sorted(bst["dispatches"].items())])
+        metric("minio_tpu_batcher_requests_total",
+               "PUT stripe windows routed through the batcher "
+               "(bypass = calibrated host pass-through)", "counter",
+               [({"route": r}, v)
+                for r, v in sorted(bst["requests"].items())])
+        metric("minio_tpu_batcher_bucket_dispatches_total",
+               "Device dispatches per batch padding bucket", "counter",
+               [({"bucket": b}, v)
+                for b, v in sorted(bst["buckets"].items())])
+        metric("minio_tpu_batcher_batched_blocks_total",
+               "Stripe blocks carried by device dispatches", "counter",
+               [({}, bst["batched_blocks"])])
+        metric("minio_tpu_batcher_capacity_blocks_total",
+               "Padded bucket capacity of those dispatches "
+               "(batched/capacity = fill ratio)", "counter",
+               [({}, bst["capacity_blocks"])])
+        metric("minio_tpu_batcher_fill_ratio",
+               "Mean batch fill ratio (blocks dispatched / bucket "
+               "capacity) since boot", "gauge",
+               [({}, round(bst["fill_ratio"], 4))])
+        metric("minio_tpu_batcher_deadline_failures_total",
+               "Batch members failed for exhausted deadlines before "
+               "dispatch (batch-mates unaffected)", "counter",
+               [({}, bst["deadline_failures"])])
+        metric("minio_tpu_batcher_mesh_devices",
+               "Chips the batched dispatch shards over", "gauge",
+               [({}, bst["mesh_devices"])])
+        hist_metric("minio_tpu_batcher_wait_seconds",
+                    "Coalescing wait per batched stripe window "
+                    "(enqueue to dispatch start)",
+                    [({}, bst["wait_hist"])])
+        # Report the lane without CREATING it: kernel_lane() lazily
+        # spawns a worker thread, and a scrape on a host-codec-only
+        # process should not pay a permanent thread to export zeros.
+        from minio_tpu.io import engine as _engine
+        from minio_tpu.utils.latency import Histogram as _Hist
+        if _engine._kernel_lane is not None:
+            kst = _engine._kernel_lane.stats()
+        else:
+            kst = {"queued": 0, "submitted_total": 0,
+                   "service_hist": _Hist().state()}
+        metric("minio_tpu_kernel_lane_queued",
+               "Device dispatches waiting in the shared kernel lane",
+               "gauge", [({}, kst["queued"])])
+        metric("minio_tpu_kernel_lane_dispatches_total",
+               "Device dispatches submitted to the kernel lane",
+               "counter", [({}, kst["submitted_total"])])
+        hist_metric("minio_tpu_kernel_lane_op_duration_seconds",
+                    "Bucketed service time of kernel-lane device "
+                    "dispatches", [({}, kst["service_hist"])])
         if object_layer is not None or peer_states:
             # One row per (worker, set, drive). In pre-forked mode each
             # worker runs its OWN queues over the same physical drives

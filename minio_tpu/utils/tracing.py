@@ -405,9 +405,24 @@ def record(type_: str, name: str, start_wall: float, duration_ms: float,
     ctx = getattr(_local, "ctx", None)
     if ctx is None or not ACTIVE:
         return
-    par = current_parent() if parent is None else parent
+    record_into(ctx, current_parent() if parent is None else parent,
+                type_, name, start_wall, duration_ms, tags)
+
+
+def record_into(ctx: Optional[TraceContext], parent: int, type_: str,
+                name: str, start_wall: float, duration_ms: float,
+                tags: Optional[dict] = None) -> None:
+    """record() into an explicitly captured (ctx, parent) scope.
+
+    For work executed on a thread bound to no single request — e.g. one
+    coalesced device dispatch serving many PUTs at once: the batcher
+    captures each member's scope at submission and fans the ONE kernel
+    span into every member's span tree, so each request's trace shows
+    the shared dispatch it rode (with per-batch tags), not a gap."""
+    if ctx is None or not ACTIVE:
+        return
     rec = {"type": type_, "name": name, "span": ctx.next_id(),
-           "parent": par,
+           "parent": parent,
            "start": start_wall, "duration_ms": round(duration_ms, 3)}
     if tags:
         rec["tags"] = tags
@@ -415,7 +430,7 @@ def record(type_: str, name: str, start_wall: float, duration_ms: float,
     if thr > 0 and rec["duration_ms"] >= thr:
         rec["slow"] = True
         rec["threshold_ms"] = thr
-        rec["ancestry"] = ctx.ancestry(par)
+        rec["ancestry"] = ctx.ancestry(parent)
         slow = dict(rec)
         slow["trace"] = ctx.trace_id
         _record_slow(slow)
