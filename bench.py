@@ -378,9 +378,13 @@ def _put_concurrent() -> None:
     if not _SMALL:
         with _forced_device(K, M):
             device_forced = run(DeviceBackend("auto"))
-
+    if (_os.cpu_count() or 1) >= 2:
         # Front-end aggregate in a clean subprocess (no inherited JAX);
         # the probe run is shared with the GET aggregate section.
+        # Small-budget smoke runs probe too (fewer reps, same fleet):
+        # the served/object ratio must be a gateable column, never
+        # null, wherever the pre-forked fleet can actually boot
+        # (http_workers >= 2).
         served = _served_probe_value("SERVED_GIBPS")
 
     # Headline: the best measured aggregate among the store's serving
@@ -399,6 +403,11 @@ def _put_concurrent() -> None:
         "device_forced_gibps":
             None if device_forced is None else round(device_forced, 3),
         "served_gibps": None if served is None else round(served, 3),
+        # served/object like-for-like: the probe fleet boots with the
+        # default (auto) backend, which is what tpu_gibps measures on
+        # every host class — the gated front-end-tax ratio.
+        "served_ratio": None if served is None
+        else round(served / max(tpu, 1e-9), 3),
         "http_workers": _os.cpu_count(),
         "concurrency": threads,
     }))
@@ -506,7 +515,9 @@ def _get_concurrent() -> None:
         shutil.rmtree(root, ignore_errors=True)
 
     served = None
-    if not _SMALL:
+    if (_os.cpu_count() or 1) >= 2:
+        # Smoke-gateable like the PUT column: probed at every budget
+        # wherever the fleet boots (http_workers >= 2).
         served = _served_probe_value("SERVED_GET_GIBPS")
     value = max(v for v in (best, served) if v is not None)
     # vs_baseline mirrors the PUT metric's config-ratio shape:
@@ -520,6 +531,9 @@ def _get_concurrent() -> None:
                              / max(best, 1e-9), 3),
         "object_layer_gibps": round(best, 3),
         "served_gibps": None if served is None else round(served, 3),
+        # Gated front-end-tax ratio (see put_concurrent).
+        "served_ratio": None if served is None
+        else round(served / max(best, 1e-9), 3),
         "http_workers": _os.cpu_count(),
         "concurrency": threads,
     }))
@@ -831,14 +845,21 @@ def _serve_probe() -> None:
                 time.sleep(0.5)
         else:
             return          # never ready: parent records served=None
-        threads, per_thread = 16, 6
+        threads, per_thread = (16, 3) if _SMALL else (16, 6)
         body = np.random.default_rng(3).integers(
             0, 256, size=1 << 20, dtype=np.uint8).tobytes()
         cli0 = S3Client(f"127.0.0.1:{port}")
         assert cli0.request("PUT", "/bench")[0] == 200
 
+        # Persistent connections (the SDK connection-pool shape): each
+        # client thread keeps ONE connection hot across its requests,
+        # riding the serve loop's keep-alive fast path — a cold
+        # handshake per request would measure TCP setup, not serving.
+        clients = [S3Client(f"127.0.0.1:{port}", keepalive=True)
+                   for _ in range(threads)]
+
         def worker(tag, t):
-            cli = S3Client(f"127.0.0.1:{port}")
+            cli = clients[t]
             for i in range(per_thread):
                 st, _, _ = cli.request("PUT", f"/bench/{tag}-{t}-{i}",
                                        body=body)
@@ -846,24 +867,41 @@ def _serve_probe() -> None:
 
         ex = ThreadPoolExecutor(max_workers=threads)
         list(ex.map(lambda t: worker("w", t), range(threads)))  # warm
-        t0 = time.perf_counter()
-        list(ex.map(lambda t: worker("m", t), range(threads)))
-        wall = time.perf_counter() - t0
+        # Best-of-2 measured passes, mirroring the object-layer
+        # sections: aggregate numbers on a shared box are scheduler-
+        # noise-prone and the served/object RATIO is gated, so both
+        # sides of it deserve the same noise floor treatment.
+        wall = None
+        for _rep in range(2):
+            t0 = time.perf_counter()
+            list(ex.map(lambda t: worker("m", t), range(threads)))
+            dt = time.perf_counter() - t0
+            wall = dt if wall is None else min(wall, dt)
         print("SERVED_GIBPS="
               f"{threads * per_thread * len(body) / wall / (1 << 30):.4f}")
 
+        # One reusable receive buffer per client thread: the GET probe
+        # reads bodies via recv_into (S3Client.get_into), so the
+        # CLIENT costs per request are one small signed head + raw
+        # socket receives — the measured number is the server, not
+        # http.client object churn on the same cores.
+        bufs = [bytearray(len(body)) for _ in range(threads)]
+
         def getter(tag, t):
-            cli = S3Client(f"127.0.0.1:{port}")
+            cli = clients[t]
             for i in range(per_thread):
-                st, _, got = cli.request("GET", f"/bench/{tag}-{t}-{i}")
-                assert st == 200 and len(got) == len(body), st
+                st, n = cli.get_into(f"/bench/{tag}-{t}-{i}", bufs[t])
+                assert st == 200 and n == len(body), st
 
         # Served GET aggregate over the objects the measured pass wrote
         # (warm pass primes caches — repeat reads are the steady state).
         list(ex.map(lambda t: getter("m", t), range(threads)))  # warm
-        t0 = time.perf_counter()
-        list(ex.map(lambda t: getter("m", t), range(threads)))
-        wall = time.perf_counter() - t0
+        wall = None
+        for _rep in range(2):
+            t0 = time.perf_counter()
+            list(ex.map(lambda t: getter("m", t), range(threads)))
+            dt = time.perf_counter() - t0
+            wall = dt if wall is None else min(wall, dt)
         print("SERVED_GET_GIBPS="
               f"{threads * per_thread * len(body) / wall / (1 << 30):.4f}")
     finally:

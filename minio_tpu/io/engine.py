@@ -21,7 +21,11 @@ interleaved every request's shard ops across every drive. Properties:
     threads beyond a short tail.
 
 Environment:
-  MTPU_IO_WORKERS  worker crew size per drive (default 2)
+  MTPU_IO_WORKERS  worker crew size per drive (default: 2, dropping to
+                   1 when the host has fewer cores than the set has
+                   drives — 12 drives x 2 crews on a 2-core box is
+                   pure scheduler thrash, and every crew thread's
+                   wakeup steals GIL slices from the serve loop)
   MTPU_IO_DEPTH    submission queue depth per drive (default 64)
 """
 
@@ -223,8 +227,10 @@ class IOEngine:
 
     def __init__(self, names, workers: int | None = None,
                  depth: int | None = None):
-        workers = workers if workers is not None \
-            else _env_int("MTPU_IO_WORKERS", 2)
+        names = list(names)
+        if workers is None:
+            default = 2 if (os.cpu_count() or 1) >= len(names) else 1
+            workers = _env_int("MTPU_IO_WORKERS", default)
         depth = depth if depth is not None \
             else _env_int("MTPU_IO_DEPTH", 64)
         self.queues = [DriveQueue(str(nm), workers, depth) for nm in names]

@@ -188,10 +188,17 @@ class SharedGen:
     the last seen. Size inequality — not ordering — signals change, so
     even truncation/recreation invalidates."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, poll_interval: float = 0.0):
+        """poll_interval > 0 rate-limits the stat() behind changed():
+        calls inside the window reuse the last verdict (False). Only
+        for generations whose consumers tolerate that much staleness —
+        bucket-meta config, not the listing/fileinfo generation, whose
+        cross-worker read-after-write tests demand stat-per-lookup."""
         self.path = path
         os.makedirs(os.path.dirname(path), exist_ok=True)
         self._last = -1
+        self._poll_interval = poll_interval
+        self._polled_at = 0.0
 
     def bump(self) -> None:
         fd = os.open(self.path, os.O_CREAT | os.O_WRONLY | os.O_APPEND,
@@ -200,8 +207,19 @@ class SharedGen:
             os.write(fd, b".")
         finally:
             os.close(fd)
+        # Our own bump must be visible to our own next changed() only
+        # as a NO-change (we made it); more importantly it must not be
+        # masked for others — their stat sees the new size. Reset the
+        # local window so a bump+read sequence in THIS process observes
+        # its own write immediately.
+        self._polled_at = 0.0
 
     def changed(self) -> bool:
+        if self._poll_interval > 0.0:
+            now = time.monotonic()
+            if now - self._polled_at < self._poll_interval:
+                return False
+            self._polled_at = now
         try:
             size = os.stat(self.path).st_size
         except OSError:
@@ -349,7 +367,8 @@ class WorkerContext:
             server.bucket_meta_lock = FlockMutex(
                 os.path.join(shared, "bucket-meta.lock"))
             list_gen = SharedGen(os.path.join(shared, "list.gen"))
-            meta_gen = SharedGen(os.path.join(shared, "meta.gen"))
+            meta_gen = SharedGen(os.path.join(shared, "meta.gen"),
+                                 poll_interval=0.25)
             for s in layer_sets(server.object_layer):
                 _wire_set(s, shared, list_gen, meta_gen)
 

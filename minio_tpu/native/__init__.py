@@ -94,6 +94,14 @@ def _declare(lib) -> None:
         fn.restype = None
     lib.mtpu_xxh64.argtypes = [u8p, ctypes.c_size_t, ctypes.c_uint64]
     lib.mtpu_xxh64.restype = ctypes.c_uint64
+    # Serve hot loop: HTTP head framer + aws-chunked frame scanner.
+    lib.mtpu_http_head.argtypes = [u8p, ctypes.c_size_t,
+                                   ctypes.POINTER(ctypes.c_int32),
+                                   ctypes.c_size_t]
+    lib.mtpu_http_head.restype = ctypes.c_int64
+    lib.mtpu_chunk_head.argtypes = [u8p, ctypes.c_size_t, ctypes.c_size_t,
+                                    ctypes.POINTER(ctypes.c_int64)]
+    lib.mtpu_chunk_head.restype = ctypes.c_int64
     lib.mtpu_get_frame.argtypes = [u8p, ctypes.POINTER(u8p),
                                    ctypes.c_size_t, ctypes.c_size_t,
                                    ctypes.c_size_t, ctypes.c_size_t,

@@ -533,4 +533,177 @@ uint64_t mtpu_get_frame(const uint8_t* key32, const uint8_t* const* shards,
   return 0;
 }
 
+// ---------------------------------------------------------------------------
+// Serve hot loop: HTTP/1.1 request-head framer + aws-chunked frame scanner
+// ---------------------------------------------------------------------------
+//
+// The front-end's per-request parse cost in Python is readline-per-header
+// plus an email.Message build; these two functions replace that with one
+// GIL-free scan straight out of the worker's pooled recv buffer
+// (reference: the reference rides net/http's C-backed textproto reader;
+// this is our equivalent). The Python HTTP parser stays as the
+// conformance fallback for anything these reject.
+
+namespace {
+// Bounded forward search (memmem without the _GNU_SOURCE dependency).
+inline const uint8_t* FindSeq(const uint8_t* hay, size_t hay_len,
+                              const char* needle, size_t needle_len) {
+  if (hay_len < needle_len) return nullptr;
+  const uint8_t* end = hay + hay_len - needle_len;
+  for (const uint8_t* p = hay; p <= end; ++p) {
+    p = static_cast<const uint8_t*>(
+        std::memchr(p, needle[0], (size_t)(end - p) + 1));
+    if (!p) return nullptr;
+    if (std::memcmp(p, needle, needle_len) == 0) return p;
+  }
+  return nullptr;
+}
+}  // namespace
+
+// Parse one HTTP/1.x request head out of buf[0:len).
+//
+// On success header NAMES are lowercased IN PLACE (the caller owns the
+// recv buffer; SigV4 canonicalization wants lowercase anyway) and `out`
+// (int32, 6 + 4*max_headers entries) is filled:
+//   out[0]=method_off  out[1]=method_len
+//   out[2]=target_off  out[3]=target_len
+//   out[4]=version (10 | 11)
+//   out[5]=nheaders, then per header: name_off, name_len, val_off, val_len.
+// Returns the head length in bytes (through the final CRLFCRLF),
+// 0 if the head is still incomplete, -1 malformed (caller falls back to
+// the Python parser), -2 more than max_headers headers.
+int64_t mtpu_http_head(uint8_t* buf, size_t len, int32_t* out,
+                       size_t max_headers) {
+  const uint8_t* end4 = FindSeq(buf, len, "\r\n\r\n", 4);
+  if (!end4) return 0;
+  const size_t head_len = (size_t)(end4 - buf) + 4;
+  size_t p = 0;
+  // Request line: METHOD SP request-target SP HTTP/1.x CRLF
+  const size_t m0 = p;
+  while (p < head_len && buf[p] != ' ' && buf[p] != '\r') ++p;
+  if (p >= head_len || buf[p] != ' ' || p == m0 || p - m0 > 32) return -1;
+  for (size_t i = m0; i < p; ++i)
+    if (buf[i] <= ' ' || buf[i] >= 127) return -1;
+  const size_t mlen = p - m0;
+  ++p;
+  const size_t t0 = p;
+  while (p < head_len && buf[p] != ' ' && buf[p] != '\r' &&
+         buf[p] != '\n') ++p;
+  if (p >= head_len || buf[p] != ' ' || p == t0) return -1;
+  const size_t tlen = p - t0;
+  ++p;
+  if (p + 10 > head_len || std::memcmp(buf + p, "HTTP/1.", 7) != 0)
+    return -1;
+  const uint8_t v = buf[p + 7];
+  if (v != '0' && v != '1') return -1;
+  p += 8;
+  if (buf[p] != '\r' || buf[p + 1] != '\n') return -1;
+  p += 2;
+  size_t nh = 0;
+  while (p < head_len) {
+    if (buf[p] == '\r') {              // blank line terminates the head
+      if (p + 2 != head_len || buf[p + 1] != '\n') return -1;
+      break;
+    }
+    if (nh >= max_headers) return -2;
+    if (buf[p] == ' ' || buf[p] == '\t') return -1;   // obs-fold: refuse
+    const size_t n0 = p;
+    while (p < head_len && buf[p] != ':' && buf[p] != '\r') ++p;
+    if (p >= head_len || buf[p] != ':' || p == n0) return -1;
+    for (size_t i = n0; i < p; ++i) {
+      const uint8_t c = buf[i];
+      if (c <= ' ' || c >= 127) return -1;   // WS before ':' = smuggling
+      if (c >= 'A' && c <= 'Z') buf[i] = c + 32;
+    }
+    const size_t nlen = p - n0;
+    ++p;
+    while (p < head_len && (buf[p] == ' ' || buf[p] == '\t')) ++p;
+    const size_t v0 = p;
+    // A bare LF inside a field value is a request-smuggling primitive
+    // (line-based parsers would see two headers where we saw one):
+    // reject so the stock parser's line discipline decides.
+    while (p < head_len && buf[p] != '\r' && buf[p] != '\n') ++p;
+    if (p + 1 >= head_len || buf[p] != '\r' || buf[p + 1] != '\n')
+      return -1;
+    size_t v1 = p;
+    while (v1 > v0 && (buf[v1 - 1] == ' ' || buf[v1 - 1] == '\t')) --v1;
+    int32_t* h = out + 6 + 4 * nh;
+    h[0] = (int32_t)n0;
+    h[1] = (int32_t)nlen;
+    h[2] = (int32_t)v0;
+    h[3] = (int32_t)(v1 - v0);
+    ++nh;
+    p += 2;
+  }
+  out[0] = (int32_t)m0;
+  out[1] = (int32_t)mlen;
+  out[2] = (int32_t)t0;
+  out[3] = (int32_t)tlen;
+  out[4] = (v == '1') ? 11 : 10;
+  out[5] = (int32_t)nh;
+  return (int64_t)head_len;
+}
+
+// Scan one aws-chunked frame header (`hex-size[;ext]\r\n`) at
+// buf[pos:len). out (int64, 4 entries):
+//   out[0]=header length through its CRLF
+//   out[1]=declared chunk size
+//   out[2]=ABSOLUTE offset of the chunk-signature ext value (0 if none)
+//   out[3]=signature length
+// Returns 1 parsed, 0 incomplete (need more bytes), -1 malformed or
+// over the 4 KiB header / 16 MiB chunk bounds (the Python reader's own
+// discipline, cmd/streaming-signature-v4.go's maxLineLength).
+int64_t mtpu_chunk_head(const uint8_t* buf, size_t len, size_t pos,
+                        int64_t* out) {
+  const size_t kMaxHeader = 4096;
+  const int64_t kMaxChunk = 16ll << 20;
+  if (pos > len) return -1;
+  const size_t avail = len - pos;
+  const size_t scan = avail < kMaxHeader ? avail : kMaxHeader;
+  const uint8_t* nl = FindSeq(buf + pos, scan, "\r\n", 2);
+  if (!nl) return avail > kMaxHeader ? -1 : 0;
+  const size_t hlen = (size_t)(nl - (buf + pos)) + 2;
+  const size_t line_end = pos + hlen - 2;
+  size_t p = pos;
+  int64_t size = 0;
+  int digits = 0;
+  while (p < line_end) {
+    const uint8_t c = buf[p];
+    int dv;
+    if (c >= '0' && c <= '9') dv = c - '0';
+    else if (c >= 'a' && c <= 'f') dv = c - 'a' + 10;
+    else if (c >= 'A' && c <= 'F') dv = c - 'A' + 10;
+    else break;
+    size = size * 16 + dv;
+    ++digits;
+    ++p;
+    if (size > kMaxChunk) return -1;
+  }
+  if (!digits) return -1;
+  int64_t sig_off = 0, sig_len = 0;
+  while (p < line_end && buf[p] == ';') {
+    ++p;
+    const size_t k0 = p;
+    while (p < line_end && buf[p] != '=' && buf[p] != ';') ++p;
+    const size_t klen = p - k0;
+    size_t val0 = 0, vlen = 0;
+    if (p < line_end && buf[p] == '=') {
+      ++p;
+      val0 = p;
+      while (p < line_end && buf[p] != ';') ++p;
+      vlen = p - val0;
+    }
+    if (klen == 15 && std::memcmp(buf + k0, "chunk-signature", 15) == 0) {
+      sig_off = (int64_t)val0;
+      sig_len = (int64_t)vlen;
+    }
+  }
+  if (p != line_end) return -1;
+  out[0] = (int64_t)hlen;
+  out[1] = size;
+  out[2] = sig_off;
+  out[3] = sig_len;
+  return 1;
+}
+
 }  // extern "C"

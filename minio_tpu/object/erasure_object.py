@@ -221,9 +221,11 @@ class ErasureSet:
         from minio_tpu.object.fi_cache import FileInfoCache
         self.fi_cache = FileInfoCache()
         self.metacache.listeners.append(self.fi_cache.invalidate_bucket)
-        if any(_unwrap_disk(d).__class__.__module__
-               == "minio_tpu.storage.remote"
-               for d in self.disks if d is not None):
+        self._remote_set = any(
+            _unwrap_disk(d).__class__.__module__
+            == "minio_tpu.storage.remote"
+            for d in self.disks if d is not None)
+        if self._remote_set:
             # Distributed set: a PEER node's writes reach this cache
             # only via the coalesced best-effort listing broadcast —
             # too weak a coherence contract for metadata serving. The
@@ -507,15 +509,25 @@ class ErasureSet:
     _BUCKET_META_TTL = 2.0
 
     def get_bucket_meta(self, bucket: str) -> dict:
-        """Quorum-voted bucket metadata with a short in-memory TTL cache
+        """Quorum-voted bucket metadata with an in-memory TTL cache
         (the reference caches bucket metadata cluster-wide; without a
-        cache every object write pays an n-drive metadata fan-out)."""
+        cache every object write pays an n-drive metadata fan-out).
+
+        Local-only sets get a long TTL: in-process mutations call
+        invalidate_bucket_meta directly and pre-forked siblings are
+        covered by the meta generation file (io/workers._wire_set), so
+        the TTL is not a coherence mechanism there — the short 2 s
+        window is kept only for distributed sets, where a PEER node's
+        bucket-meta write reaches us through best-effort invalidation
+        and the TTL is the backstop."""
         import time as _time
         cache = getattr(self, "_bmeta_cache", None)
         if cache is None:
             cache = self._bmeta_cache = {}
+        ttl = self._BUCKET_META_TTL if getattr(self, "_remote_set", True) \
+            else 60.0
         hit = cache.get(bucket)
-        if hit is not None and _time.monotonic() - hit[0] < self._BUCKET_META_TTL:
+        if hit is not None and _time.monotonic() - hit[0] < ttl:
             return hit[1]
         meta = self._get_bucket_meta_uncached(bucket)
         cache[bucket] = (_time.monotonic(), meta)
@@ -1545,6 +1557,23 @@ class ErasureSet:
         if not descs:
             return
         inline_cache: dict = {}
+        if len(descs) == 1:
+            # Sub-window response (inline objects, small ranges, any
+            # GET that fits one window): read on the calling thread —
+            # there is nothing to prefetch, so the pool submit/join
+            # round-trip is pure overhead — and hand the pooled view
+            # straight to the socket, where the serve path gathers it
+            # with the response head into ONE sendmsg.
+            num, psize, rel, step = descs[0]
+            chunk, lease = self._read_part_window_pooled(
+                bucket, object_, fi, fis, num, psize, rel, step,
+                inline_cache=inline_cache)
+            try:
+                yield chunk
+            finally:
+                if lease is not None:
+                    lease.release()
+            return
         dl = deadline_mod.current()
         tctx, tparent = tracing.capture() if tracing.ACTIVE else (None, 0)
 

@@ -34,16 +34,26 @@ Coherence model (correctness first, three layers):
 
 Bounds: entry count AND resident bytes (inline objects carry their
 framed shard payloads in fis — a few hundred KiB each at the inline
-threshold), both LRU-evicted.
+threshold), both LRU-evicted. Cached entries keep only the k DATA
+shards' inline blobs: the GET fast path decodes from those alone,
+and the reconstruct path re-reads whatever it needs from the drives
+(`resolve_inline` treats the empty not-loaded sentinel as "fetch my
+journal"), so parity blobs in the cache would be m/n resident bytes
+that no hit ever reads.
 
 Environment:
   MTPU_FILEINFO_CACHE        "0"/"off" disables the cache entirely
   MTPU_FILEINFO_CACHE_MAX    max cached keys (default 4096)
-  MTPU_FILEINFO_CACHE_BYTES  max resident inline bytes (default 64 MiB)
+  MTPU_FILEINFO_CACHE_BYTES  max resident inline bytes (default 256 MiB
+                             — sized so a serving box's hot inline
+                             working set stays resident; at the 128 KiB
+                             shard threshold that is ~250 cached
+                             inline objects per process)
 """
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import threading
 from collections import OrderedDict
@@ -71,7 +81,7 @@ class FileInfoCache:
         self.max_entries = max_entries if max_entries is not None \
             else _env_int("MTPU_FILEINFO_CACHE_MAX", 4096)
         self.max_bytes = max_bytes if max_bytes is not None \
-            else _env_int("MTPU_FILEINFO_CACHE_BYTES", 64 << 20)
+            else _env_int("MTPU_FILEINFO_CACHE_BYTES", 256 << 20)
         self._mu = threading.Lock()
         self._map: OrderedDict = OrderedDict()   # key -> entry dict
         self._gens: dict[str, int] = {}          # bucket -> invalidation gen
@@ -153,6 +163,16 @@ class FileInfoCache:
             return
         self.maybe_flush()
         key = (bucket, object_, version_id)
+        # Strip parity holders' inline blobs down to the empty
+        # not-loaded sentinel (a COPY — the caller's in-flight read may
+        # still reconstruct from its own fis). Serving needs the k data
+        # shards; a demoted read re-fetches from the drives either way.
+        k = fi.erasure.data_blocks if fi is not None else 0
+        if k:
+            fis = [dataclasses.replace(f, inline_data=b"")
+                   if f is not None and f.inline_data
+                   and f.erasure.index > k else f
+                   for f in fis]
         size = sum(len(f.inline_data) for f in fis
                    if f is not None and f.inline_data)
         with self._mu:

@@ -58,6 +58,13 @@ def path_class(raw_path: str) -> str:
     return CLASS_S3
 
 
+def class_for(pc: str) -> str:
+    """Gate class for an already-computed path_class — the serve hot
+    loop classifies each request's path ONCE and shares the result
+    between admission, routing, and metrics labeling."""
+    return CLASS_ADMIN if pc != CLASS_S3 else CLASS_S3
+
+
 class AdmissionShed(Exception):
     """Request shed by admission control -> 503 SlowDown + Retry-After."""
 
@@ -229,8 +236,7 @@ class AdmissionController:
         behind the very traffic that overloaded it (path_class is the
         single shared pattern source, so router and gate cannot
         drift)."""
-        return CLASS_ADMIN if path_class(raw_path) != CLASS_S3 \
-            else CLASS_S3
+        return class_for(path_class(raw_path))
 
     def enter(self, klass: str) -> _Gate:
         """Admit or raise AdmissionShed; caller must leave() the

@@ -1,0 +1,319 @@
+"""Serve hot loop: native request framing + pooled connection buffers.
+
+The per-request cost of the stdlib front end is readline-per-header,
+an email.Message build, and a fresh BufferedReader per connection. This
+module replaces that hot path for the S3 handler (s3/server.py):
+
+  * ConnReader — one pooled recv buffer per connection (io/bufpool
+    lease, held hot across keep-alive requests). It serves the rfile
+    surface (read/readline/readinto) for EVERY parser, and exposes its
+    buffer to the native head framer so request heads are scanned
+    GIL-free straight out of the recv buffer (native/native.cc
+    mtpu_http_head) with header names lowercased in place.
+  * FastHeaders — the flat lowercase dict the native parse produces,
+    quacking like the email.Message the handlers index. Header-name
+    strings are interned per CONNECTION, so a keep-alive client's
+    repeated header sets reuse the same str objects request after
+    request (the "header parse memoized per connection" fast path).
+  * send_gathered — writev-style response writes: socket.sendmsg of
+    [header block, body view, ...] in ONE syscall, pooled GET window
+    memoryviews going to the wire with no Python-level bytes joins.
+
+Anything the native framer rejects (obs-fold, exotic framing, heads
+larger than the recv buffer) falls back to the stdlib Python parser on
+the SAME buffered bytes — a per-request decision, counted in
+`minio_tpu_http_parse_fallbacks_total`.
+
+MTPU_HTTP_NATIVE=off disables the native framer entirely (the stock
+BaseHTTPRequestHandler parse path, byte-for-byte).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import socket
+
+MAX_HEADERS = 100
+# Matches http.client's per-line bound; heads that exceed the recv
+# buffer take the Python fallback (which enforces stock limits).
+RECV_BUF = 64 << 10
+# Native head parse result codes (mtpu_http_head).
+_INCOMPLETE = 0
+_MALFORMED = -1
+_TOO_MANY = -2
+
+
+def native_enabled(env=os.environ) -> bool:
+    return env.get("MTPU_HTTP_NATIVE", "").lower() not in ("off", "0",
+                                                           "false")
+
+
+_LIB = None
+_LIB_TRIED = False
+
+
+def lib():
+    """The native library handle, or None (pure-Python fallback)."""
+    global _LIB, _LIB_TRIED
+    if not _LIB_TRIED:
+        from minio_tpu import native as native_mod
+        _LIB = native_mod.load()
+        _LIB_TRIED = True
+    return _LIB
+
+
+class FastHeaders:
+    """Case-insensitive header view over the native parse's flat
+    lowercase dict — the subset of email.Message the handlers use."""
+
+    __slots__ = ("d",)
+
+    def __init__(self, d: dict):
+        self.d = d
+
+    def get(self, name, default=None):
+        return self.d.get(name.lower(), default)
+
+    def __getitem__(self, name):
+        return self.d.get(name.lower())
+
+    def __contains__(self, name):
+        return name.lower() in self.d
+
+    def items(self):
+        return self.d.items()
+
+    def keys(self):
+        return self.d.keys()
+
+    def values(self):
+        return self.d.values()
+
+
+class ConnReader:
+    """Pooled-buffer connection reader, persistent across keep-alive
+    requests. File-like for every body/fallback consumer (BufferedReader
+    semantics: read(n) blocks for n bytes or EOF), while the native head
+    parser works on the underlying buffer directly between requests."""
+
+    def __init__(self, sock: socket.socket, pool=None):
+        from minio_tpu.io.bufpool import global_pool
+        self._sock = sock
+        self._lease = (pool or global_pool()).lease(RECV_BUF)
+        self._raw = self._lease.raw
+        self._cap = len(self._raw)
+        self._mv = memoryview(self._raw)
+        # ctypes view for the native framer (dropped before the lease
+        # returns — an exported buffer must never reach the free list).
+        self._arr = (ctypes.c_uint8 * self._cap).from_buffer(self._raw)
+        self._out = (ctypes.c_int32 * (6 + 4 * MAX_HEADERS))()
+        self._start = 0
+        self._end = 0
+        self._closed = False
+        # Per-connection header-name interning: bytes -> str survives
+        # across this connection's requests.
+        self.name_cache: dict[bytes, str] = {}
+
+    # -- buffer plumbing -------------------------------------------------
+
+    def _compact(self) -> None:
+        if self._start:
+            n = self._end - self._start
+            self._mv[:n] = self._mv[self._start:self._end]
+            self._start, self._end = 0, n
+
+    def _fill(self) -> int:
+        """recv into the buffer tail; returns bytes added (0 = EOF or
+        buffer full)."""
+        if self._end == self._cap:
+            self._compact()
+            if self._end == self._cap:
+                return 0
+        n = self._sock.recv_into(self._mv[self._end:], self._cap - self._end)
+        self._end += n
+        return n
+
+    @property
+    def buffered(self) -> int:
+        return self._end - self._start
+
+    # -- rfile surface ---------------------------------------------------
+
+    def read(self, n: int = -1) -> bytes:
+        if n is None or n < 0:
+            # Read-to-EOF: nothing on the serve path does this (bodies
+            # are Content-Length or chunk framed), but be correct.
+            parts = [bytes(self._mv[self._start:self._end])]
+            self._start = self._end = 0
+            while True:
+                chunk = self._sock.recv(65536)
+                if not chunk:
+                    break
+                parts.append(chunk)
+            return b"".join(parts)
+        if n == 0:
+            return b""
+        have = self.buffered
+        if have >= n:
+            out = bytes(self._mv[self._start:self._start + n])
+            self._start += n
+            if self._start == self._end:
+                self._start = self._end = 0
+            return out
+        parts = []
+        if have:
+            parts.append(bytes(self._mv[self._start:self._end]))
+            self._start = self._end = 0
+            n -= have
+        # Large remainders recv straight into caller-sized chunks —
+        # no bounce through the 64 KiB buffer.
+        while n > 0:
+            chunk = self._sock.recv(min(n, 1 << 20))
+            if not chunk:
+                break
+            parts.append(chunk)
+            n -= len(chunk)
+        return b"".join(parts)
+
+    def readinto(self, b) -> int:
+        mv = memoryview(b).cast("B")
+        want = len(mv)
+        done = 0
+        have = min(self.buffered, want)
+        if have:
+            mv[:have] = self._mv[self._start:self._start + have]
+            self._start += have
+            if self._start == self._end:
+                self._start = self._end = 0
+            done = have
+        while done < want:
+            n = self._sock.recv_into(mv[done:], want - done)
+            if not n:
+                break
+            done += n
+        return done
+
+    def readline(self, limit: int = 65537) -> bytes:
+        while True:
+            nl = self._raw.find(b"\n", self._start, self._end)
+            if nl >= 0:
+                take = min(nl + 1 - self._start, limit)
+                out = bytes(self._mv[self._start:self._start + take])
+                self._start += take
+                if self._start == self._end:
+                    self._start = self._end = 0
+                return out
+            if self.buffered >= limit:
+                out = bytes(self._mv[self._start:self._start + limit])
+                self._start += limit
+                return out
+            if not self._fill():
+                out = bytes(self._mv[self._start:self._end])
+                self._start = self._end = 0
+                return out
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        # Exported views go first: a ctypes array or memoryview still
+        # attached would alias a recycled pool buffer.
+        self._arr = None
+        self._mv.release()
+        self._lease.release()
+
+    # -- native head parse ----------------------------------------------
+
+    def parse_head(self, native_lib):
+        """Frame one request head out of the connection buffer.
+
+        Returns (headers_dict, method, target, version, keep_default)
+        on success (head bytes consumed), None at a clean EOF before
+        any byte of a request, or raises _Fallback when the Python
+        parser should take this request (bytes left buffered)."""
+        while True:
+            if self.buffered:
+                self._compact()
+                n = native_lib.mtpu_http_head(self._arr, self._end,
+                                              self._out, MAX_HEADERS)
+                if n > 0:
+                    return self._build_head(int(n))
+                if n != _INCOMPLETE:
+                    raise _Fallback()
+                if self._end == self._cap:
+                    raise _Fallback()      # head larger than the buffer
+            got = self._fill()
+            if not got:
+                if self.buffered:
+                    raise _Fallback()      # EOF mid-head: stock error path
+                return None                # clean close between requests
+
+    def _build_head(self, head_len: int):
+        out = self._out
+        mv = self._mv
+        method = bytes(mv[out[0]:out[0] + out[1]]).decode("latin-1")
+        target = bytes(mv[out[2]:out[2] + out[3]]).decode("latin-1")
+        version = "HTTP/1.1" if out[4] == 11 else "HTTP/1.0"
+        cache = self.name_cache
+        d: dict[str, str] = {}
+        for i in range(out[5]):
+            base = 6 + 4 * i
+            nb = bytes(mv[out[base]:out[base] + out[base + 1]])
+            name = cache.get(nb)
+            if name is None:
+                if len(cache) < 256:
+                    name = cache.setdefault(nb, nb.decode("latin-1"))
+                else:
+                    name = nb.decode("latin-1")
+            val = bytes(mv[out[base + 2]:out[base + 2] + out[base + 3]]) \
+                .decode("latin-1")
+            if name in d:
+                # SigV4 canonicalization folds repeats with a comma;
+                # match what signing clients produced.
+                d[name] = d[name] + "," + val
+            else:
+                d[name] = val
+        self._start += head_len
+        if self._start == self._end:
+            self._start = self._end = 0
+        return d, method, target, version, out[4] == 11
+
+
+class _Fallback(Exception):
+    """Native framer declined this request; run the Python parser."""
+
+
+def send_gathered(sock: socket.socket, bufs) -> int:
+    """writev-style send of several buffers in as few syscalls as the
+    kernel allows; returns bytes sent. Raises on a dead peer like
+    sendall. Pooled memoryviews go straight to the socket — no joins."""
+    bufs = [b if isinstance(b, memoryview) else memoryview(b)
+            for b in bufs if len(b)]
+    total = sum(len(b) for b in bufs)
+    if not bufs:
+        return 0
+    done = 0
+    try:
+        sent = sock.sendmsg(bufs)
+        done = sent
+        while done < total:
+            skip = sent              # last call's progress within bufs
+            rest = []
+            for b in bufs:
+                if skip >= len(b):
+                    skip -= len(b)
+                    continue
+                rest.append(b[skip:] if skip else b)
+                skip = 0
+            bufs = rest
+            sent = sock.sendmsg(bufs)
+            done += sent
+    except Exception as e:           # noqa: BLE001 - annotate progress
+        # Callers deciding between "send a clean error response" and
+        # "cut the connection" need to know whether ANY bytes hit the
+        # wire before this raise (a resume sendmsg can fail after a
+        # partial first call).
+        e.mtpu_sent = done
+        raise
+    return total

@@ -32,6 +32,12 @@ class Metrics:
         self._last_minute: dict[str, LastMinute] = {}
         self._bytes_rx = 0
         self._bytes_tx = 0
+        # Connection plane (serve hot loop, s3/hotloop.py): open
+        # connections, keep-alive reuse, and native-framer fallbacks to
+        # the Python parser.
+        self._conn_active = 0
+        self._keepalive_reuses = 0
+        self._parse_fallbacks = 0
         self._start = time.time()
 
     def record(self, api: str, status: int, seconds: float,
@@ -51,6 +57,28 @@ class Metrics:
             minute = self._last_minute[api]
         hist.observe(seconds)
         minute.observe(seconds)
+
+    def conn_open(self) -> None:
+        with self._mu:
+            self._conn_active += 1
+
+    def conn_close(self) -> None:
+        with self._mu:
+            self._conn_active -= 1
+
+    def keepalive_reuse(self) -> None:
+        with self._mu:
+            self._keepalive_reuses += 1
+
+    def parse_fallback(self) -> None:
+        with self._mu:
+            self._parse_fallbacks += 1
+
+    def http_conn_stats(self) -> dict:
+        with self._mu:
+            return {"connections_active": self._conn_active,
+                    "keepalive_reuses": self._keepalive_reuses,
+                    "parse_fallbacks": self._parse_fallbacks}
 
     def last_minute(self) -> dict:
         """Per-API last-minute summaries {api: {count,p50,p99,max}} —
@@ -72,6 +100,9 @@ class Metrics:
                 "latency_count": dict(self._latency_count),
                 "rx": self._bytes_rx,
                 "tx": self._bytes_tx,
+                "conn_active": self._conn_active,
+                "keepalive_reuses": self._keepalive_reuses,
+                "parse_fallbacks": self._parse_fallbacks,
             }
         out["latency_hist"] = {a: h.state() for a, h in hists.items()}
         out["last_minute"] = {a: lm.window() for a, lm in minutes.items()}
@@ -118,6 +149,9 @@ class Metrics:
             lat_sum = dict(self._latency_sum)
             lat_count = dict(self._latency_count)
             rx, tx = self._bytes_rx, self._bytes_tx
+            conn_active = self._conn_active
+            keepalive_reuses = self._keepalive_reuses
+            parse_fallbacks = self._parse_fallbacks
             hists = {a: h.state() for a, h in self._latency_hist.items()}
             minutes = {a: lm.window()
                        for a, lm in self._last_minute.items()}
@@ -127,6 +161,7 @@ class Metrics:
         if peer_metrics:
             reqs, lat_sum, lat_count = {}, {}, {}
             rx = tx = 0
+            conn_active = keepalive_reuses = parse_fallbacks = 0
             slow_total = 0
             hist_states: dict[str, list] = {}
             minute_states: dict[str, list] = {}
@@ -143,6 +178,9 @@ class Metrics:
                     minute_states.setdefault(a, []).append(w)
                 rx += st.get("rx", 0)
                 tx += st.get("tx", 0)
+                conn_active += st.get("conn_active", 0)
+                keepalive_reuses += st.get("keepalive_reuses", 0)
+                parse_fallbacks += st.get("parse_fallbacks", 0)
                 slow_total += st.get("slow_ops_total", 0)
             hists = {a: Histogram.merge(sts)
                      for a, sts in hist_states.items()}
@@ -163,6 +201,15 @@ class Metrics:
                "Bytes received in request bodies", "counter", [({}, rx)])
         metric("minio_tpu_http_tx_bytes_total",
                "Bytes sent in response bodies", "counter", [({}, tx)])
+        metric("minio_tpu_http_connections_active",
+               "Open front-end HTTP connections", "gauge",
+               [({}, conn_active)])
+        metric("minio_tpu_http_keepalive_reuses_total",
+               "Requests served on an already-open keep-alive connection",
+               "counter", [({}, keepalive_reuses)])
+        metric("minio_tpu_http_parse_fallbacks_total",
+               "Requests the native head framer declined to the Python "
+               "parser", "counter", [({}, parse_fallbacks)])
         hist_metric("minio_tpu_api_request_duration_seconds",
                     "Bucketed request latency per API",
                     [({"api": a}, st) for a, st in sorted(hists.items())])
@@ -737,6 +784,10 @@ def node_info(server) -> dict:
     m = getattr(server, "metrics", None)
     if m is not None:
         info["last_minute"] = m.last_minute()
+        # Connection plane (serve hot loop): open connections,
+        # keep-alive reuse, native-parse fallbacks. Fleet-merged below
+        # when the pre-forked control plane is up.
+        info["http"] = m.http_conn_stats()
     info["slow_ops"] = {"total": _tracing.slow_total,
                         "threshold_ms": _tracing.slow_ms(),
                         "recent": _tracing.slow_ops()[-20:]}
@@ -762,12 +813,28 @@ def node_info(server) -> dict:
     cluster = getattr(server, "cluster_stats", None)
     if cluster is not None:
         try:
+            peers = cluster()
             info["workers"] = [
                 {k: p.get(k) for k in ("worker", "pid", "in_flight",
                                        "unreachable", "bufpool",
                                        "fileinfo_cache", "drive_heal")
                  if k in p}
-                for p in cluster()]
+                for p in peers]
+            http_tot = {"connections_active": 0, "keepalive_reuses": 0,
+                        "parse_fallbacks": 0}
+            merged = False
+            for p in peers:
+                st = p.get("metrics")
+                if isinstance(st, dict):
+                    merged = True
+                    http_tot["connections_active"] += \
+                        st.get("conn_active", 0)
+                    http_tot["keepalive_reuses"] += \
+                        st.get("keepalive_reuses", 0)
+                    http_tot["parse_fallbacks"] += \
+                        st.get("parse_fallbacks", 0)
+            if merged:
+                info["http"] = http_tot
         except Exception:  # noqa: BLE001 - control plane down; own view
             info["workers"] = [{"worker": getattr(server, "worker_id", 0),
                                 "pid": os.getpid(),

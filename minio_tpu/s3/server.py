@@ -23,8 +23,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from minio_tpu.object.types import (DeleteOptions, GetOptions, InvalidArgument,
                                     ObjectNotFound, PutOptions)
-from minio_tpu.s3 import sigv4
+from minio_tpu.s3 import hotloop, sigv4
 from minio_tpu.s3.admission import AdmissionController, AdmissionShed
+from minio_tpu.s3.admission import class_for as admission_class_for
 from minio_tpu.s3.admission import path_class as admission_path_class
 from minio_tpu.s3.errors import S3Error, from_exception
 from minio_tpu.utils import deadline as deadline_mod
@@ -251,6 +252,21 @@ class S3Server:
 
 
 def _make_handler(server: S3Server):
+    # Native serve hot loop (s3/hotloop.py): request heads framed
+    # GIL-free out of a pooled per-connection recv buffer, kept hot
+    # across keep-alive requests. MTPU_HTTP_NATIVE=off (or a missing
+    # native lib) keeps the stock BaseHTTPRequestHandler parse path.
+    native_lib = hotloop.lib() if hotloop.native_enabled() else None
+    try:
+        keepalive_s = float(
+            os.environ.get("MTPU_HTTP_KEEPALIVE_S", "") or 75.0)
+    except ValueError:
+        keepalive_s = 75.0
+    if keepalive_s <= 0:
+        # <= 0 means "no idle timeout" — settimeout(0) would flip the
+        # socket non-blocking and drop every slow-arriving head.
+        keepalive_s = None
+
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
         server_version = "MinIO-TPU"
@@ -260,8 +276,165 @@ def _make_handler(server: S3Server):
         def log_message(self, fmt, *args):  # quiet; tracing subsystem logs
             pass
 
+        def setup(self):
+            super().setup()
+            self._requests_served = 0
+            self._h_lower = None
+            self._conn = None
+            self._body_reader = None
+            self._defer_head = False
+            self._deferred_head = None
+            if native_lib is not None:
+                # The pooled ConnReader replaces the per-connection
+                # BufferedReader for EVERY parser (the Python fallback
+                # reads lines from the same buffer), so fast path and
+                # fallback see one byte stream.
+                try:
+                    conn = hotloop.ConnReader(self.connection)
+                except Exception:  # noqa: BLE001 - pool/alloc failure
+                    conn = None
+                if conn is not None:
+                    try:
+                        self.rfile.close()
+                    except OSError:
+                        pass
+                    self.rfile = conn
+                    self._conn = conn
+            server.metrics.conn_open()
+
+        def finish(self):
+            try:
+                super().finish()
+            finally:
+                if self._conn is not None:
+                    self._conn.close()
+                server.metrics.conn_close()
+
+        def handle_one_request(self):
+            """Native fast path: frame the head out of the connection
+            buffer in one GIL-free scan; dispatch straight to do_*.
+            Anything the framer rejects is re-parsed by the stock
+            Python path from the SAME buffered bytes (counted)."""
+            self._h_lower = None
+            conn = self._conn
+            if conn is None:
+                return self._stock_request()
+            try:
+                # Idle keep-alive connections time out between requests
+                # (stock behavior blocks forever); mid-head timeouts
+                # close too — the deadline budget governs the rest of
+                # the request, not the socket.
+                self.connection.settimeout(keepalive_s)
+                try:
+                    head = conn.parse_head(native_lib)
+                finally:
+                    self.connection.settimeout(None)
+            except hotloop._Fallback:
+                server.metrics.parse_fallback()
+                return self._stock_request()
+            except (socket_mod.timeout, ConnectionError):
+                self.close_connection = True
+                return
+            except OSError:
+                self.close_connection = True
+                return
+            if head is None:                  # clean close between requests
+                self.close_connection = True
+                return
+            d, method, target, version, http11 = head
+            self.command = method
+            self.path = target
+            self.request_version = version
+            self.requestline = f"{method} {target} {version}"
+            self.headers = hotloop.FastHeaders(d)
+            conntype = d.get("connection", "").lower()
+            if conntype == "close":
+                self.close_connection = True
+            elif http11:
+                self.close_connection = False
+            else:
+                self.close_connection = conntype != "keep-alive"
+            if http11 and d.get("expect", "").lower() == "100-continue":
+                self.wfile.write(b"HTTP/1.1 100 Continue\r\n\r\n")
+            self._count_request()
+            mname = "do_" + method
+            if not hasattr(self, mname):
+                self.send_error(501, f"Unsupported method ({method!r})")
+                return
+            try:
+                getattr(self, mname)()
+                self.wfile.flush()
+            except (socket_mod.timeout, ConnectionError):
+                self.close_connection = True
+
+        def _count_request(self):
+            self._requests_served += 1
+            if self._requests_served > 1:
+                server.metrics.keepalive_reuse()
+
+        def _stock_request(self):
+            """Stock parse path (MTPU_HTTP_NATIVE=off or native-framer
+            fallback) with the same connection accounting as the fast
+            path: a non-empty request line means the connection served
+            one more request, so keepalive_reuses_total stays truthful
+            with the native framer disabled."""
+            self.raw_requestline = b""
+            rv = super().handle_one_request()
+            if getattr(self, "raw_requestline", b""):
+                self._count_request()
+            return rv
+
+        def flush_headers(self):
+            """Deferred-head hook for gathered writes: while
+            _defer_head is set the formatted header block is stashed so
+            the response path can sendmsg it WITH the first body bytes
+            in one syscall instead of a separate write."""
+            buf = b"".join(getattr(self, "_headers_buffer", []))
+            self._headers_buffer = []
+            if self._defer_head:
+                self._deferred_head = buf
+                self._defer_head = False
+            else:
+                self.wfile.write(buf)
+
+        def _take_head(self) -> bytes:
+            head, self._deferred_head = self._deferred_head, None
+            self._defer_head = False
+            return head or b""
+
+        def _send_bufs(self, bufs) -> None:
+            """Gathered zero-copy write: one sendmsg for head + body
+            views (pooled GET windows go to the wire as memoryviews,
+            no Python-level joins). Falls back to wfile on platforms
+            without sendmsg."""
+            try:
+                hotloop.send_gathered(self.connection, bufs)
+            except (AttributeError, NotImplementedError):
+                sent = 0
+                try:
+                    for b in bufs:
+                        if len(b):
+                            self.wfile.write(b)
+                            sent += len(b)
+                except Exception as e:  # noqa: BLE001 - annotate progress
+                    e.mtpu_sent = sent
+                    raise
+
         def _headers_lower(self) -> dict[str, str]:
-            return {k.lower(): v for k, v in self.headers.items()}
+            h = self.headers
+            d = getattr(h, "d", None)      # FastHeaders: already lowercase
+            if d is not None:
+                return d
+            if self._h_lower is None:
+                low: dict[str, str] = {}
+                for k, v in h.items():
+                    k = k.lower()
+                    # Repeats fold with a comma, matching both the
+                    # native framer and SigV4 canonicalization — the
+                    # two parse paths must verify identically.
+                    low[k] = low[k] + "," + v if k in low else v
+                self._h_lower = low
+            return self._h_lower
 
         def _parse(self):
             parsed = urllib.parse.urlsplit(self.path)
@@ -400,10 +573,15 @@ def _make_handler(server: S3Server):
                     raw = LimitedReader(self.rfile, encoded_len)
                 secret = server.credentials.secret_for(
                     auth.credential.access_key)
-                reader = sigv4.ChunkedPayloadReader(
+                # Native-scan pooled decoder when available (byte-
+                # identical to ChunkedPayloadReader, golden-tested);
+                # tracked on the handler so its recv-buffer lease
+                # returns deterministically even on error paths.
+                reader = sigv4.chunked_reader(
                     raw, auth, secret,
                     verify_signatures=auth.payload_hash
                     != sigv4.STREAMING_UNSIGNED_TRAILER)
+                self._body_reader = reader
                 return Payload(reader, declared, finish=reader.finalize)
             if "chunked" in te.lower():
                 # Plain HTTP chunked TE (no declared size): buffer it —
@@ -429,6 +607,7 @@ def _make_handler(server: S3Server):
 
         def _send(self, status: int, body: bytes = b"",
                   headers: dict | None = None, content_type="application/xml"):
+            self._defer_head = True
             self.send_response(status)
             self.send_header("x-amz-request-id", "0")
             if body or status not in (204, 304):
@@ -437,10 +616,13 @@ def _make_handler(server: S3Server):
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
             self.end_headers()
+            head = self._take_head()
             if body and self.command != "HEAD":
-                self.wfile.write(body)
+                self._send_bufs([head, body])
                 self._sent_bytes = getattr(self, "_sent_bytes", 0) \
                     + len(body)
+            else:
+                self._send_bufs([head])
 
         # Shed-path body drain cap: reading the remnant is cheap
         # network receive (the resource being protected is CPU/disk,
@@ -498,8 +680,10 @@ def _make_handler(server: S3Server):
             self._last_status = code
             super().send_response(code, message)
 
-        def _api_label(self, method, raw_path, bucket, key) -> str:
-            pc = admission_path_class(raw_path)
+        def _api_label(self, method, raw_path, bucket, key,
+                       pc=None) -> str:
+            if pc is None:
+                pc = admission_path_class(raw_path)
             if pc != "s3":
                 return f"{method}:{pc}"
             scope = "object" if key else ("bucket" if bucket else "service")
@@ -507,6 +691,11 @@ def _make_handler(server: S3Server):
 
         def _route(self, method: str):
             raw_path, query, bucket, key = self._parse()
+            # Classify the path ONCE per request; admission gating,
+            # dispatch, and the metrics label all consume this instead
+            # of re-running the pattern checks (the hot loop's
+            # "admission without re-entering the router slow path").
+            pc = admission_path_class(raw_path)
             self._last_status = 0
             self._sent_bytes = 0
             self._auth_key = ""
@@ -522,7 +711,7 @@ def _make_handler(server: S3Server):
                 # (reference: maxClients, cmd/generic-handlers.go).
                 try:
                     gate = server.admission.enter(
-                        server.admission.classify(raw_path))
+                        admission_class_for(pc))
                 except AdmissionShed as shed:
                     err = S3Error("SlowDown", str(shed))
                     err.headers = {"Retry-After": str(shed.retry_after)}
@@ -550,10 +739,17 @@ def _make_handler(server: S3Server):
                     tctx = tracing_mod.TraceContext()
                 with deadline_mod.bind(dl), tracing_mod.bind(tctx), \
                         server.profiler.request_profile():
-                    self._route_inner(method, raw_path, query, bucket, key)
+                    self._route_inner(method, raw_path, query, bucket, key,
+                                      pc)
             finally:
                 if gate is not None:
                     gate.leave()
+                reader = getattr(self, "_body_reader", None)
+                if reader is not None:
+                    self._body_reader = None
+                    close = getattr(reader, "close", None)
+                    if close is not None:
+                        close()
                 with server._inflight_mu:
                     server._inflight -= 1
                 try:
@@ -561,7 +757,7 @@ def _make_handler(server: S3Server):
                 except ValueError:
                     rx = 0
                 dt = _time_mod.perf_counter() - t0
-                api = self._api_label(method, raw_path, bucket, key)
+                api = self._api_label(method, raw_path, bucket, key, pc)
                 status = self._last_status or 500
                 server.metrics.record(api, status, dt,
                                       rx=rx, tx=self._sent_bytes)
@@ -590,7 +786,10 @@ def _make_handler(server: S3Server):
                     if server.audit is not None:
                         server.audit.submit(entry)
 
-        def _route_inner(self, method, raw_path, query, bucket, key):
+        def _route_inner(self, method, raw_path, query, bucket, key,
+                         pc=None):
+            if pc is None:
+                pc = admission_path_class(raw_path)
             try:
                 # Unauthenticated endpoints: health probes and metrics
                 # (reference: cmd/healthcheck-handler.go is authless;
@@ -602,7 +801,7 @@ def _make_handler(server: S3Server):
                     return self._send(200)
                 if raw_path == "/minio/health/ready":
                     return self._health_ready()
-                if admission_path_class(raw_path) == "metrics":
+                if pc == "metrics":
                     # Worker mode: whichever worker the kernel handed
                     # this scrape to aggregates the whole fleet via
                     # the parent control pipe (io/workers.py).
@@ -657,7 +856,7 @@ def _make_handler(server: S3Server):
                         if presented != tok:
                             raise S3Error("AccessDenied",
                                           "invalid session token")
-                if admission_path_class(raw_path) == "admin":
+                if pc == "admin":
                     if auth.anonymous:
                         raise S3Error("AccessDenied")
                     return self._admin_op(method, raw_path, query, auth)
@@ -2345,6 +2544,7 @@ def _make_handler(server: S3Server):
                 headers["Content-Range"] = \
                     f"bytes {start}-{start + length - 1}/{info.size}"
             try:
+                self._defer_head = True
                 self.send_response(status)
                 self.send_header("x-amz-request-id", "0")
                 self.send_header("Content-Type", ctype)
@@ -2352,16 +2552,39 @@ def _make_handler(server: S3Server):
                 for k2, v2 in headers.items():
                     self.send_header(k2, v2)
                 self.end_headers()
+                head = self._take_head()
                 if method == "HEAD":
-                    return
+                    return self._send_bufs([head])
                 sent = 0
                 try:
+                    # Gathered zero-copy streaming: the header block
+                    # rides the FIRST window's sendmsg; every window is
+                    # a pooled-buffer memoryview straight from the
+                    # engine's readahead (released when the generator
+                    # advances) — no Python-level joins or re-buffering.
                     for chunk in chunks:
-                        self.wfile.write(chunk)
+                        if head is not None:
+                            self._send_bufs([head, chunk])
+                            head = None
+                        else:
+                            self._send_bufs([chunk])
                         sent += len(chunk)
                         self._sent_bytes = getattr(
                             self, "_sent_bytes", 0) + len(chunk)
-                except Exception:  # noqa: BLE001 - headers already sent
+                    if head is not None:      # zero-length body
+                        self._send_bufs([head])
+                        head = None
+                except Exception as exc:  # noqa: BLE001 - headers may be sent
+                    if head is not None and \
+                            not getattr(exc, "mtpu_sent", 0):
+                        # Nothing hit the wire yet (the FIRST window's
+                        # produce failed, or its send died before any
+                        # byte went out): surface a proper S3 error
+                        # instead of a truncated 200. A partially-sent
+                        # first window (mtpu_sent > 0) must NOT re-raise
+                        # — a second full response after partial 200
+                        # bytes is protocol corruption; cut instead.
+                        raise
                     # Mid-stream failure (quorum loss, drive death) after
                     # the status line went out: all we can do is cut the
                     # connection short so the client sees a failed

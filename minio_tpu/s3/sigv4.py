@@ -538,6 +538,248 @@ class ChunkedPayloadReader:
                                "trailer signature")
 
 
+class PooledChunkedReader:
+    """Native-scan aws-chunked decoder over ONE pooled recv buffer.
+
+    Byte-identical in output, trailers and rejection behavior to
+    ChunkedPayloadReader (golden-tested in tests/test_native_http.py),
+    but the hot loop is different: frame headers and chunk-signature
+    extensions are located by a GIL-free native scan
+    (native/native.cc mtpu_chunk_head) straight out of a pooled
+    io/bufpool lease the socket bytes land in ONCE — no bytearray
+    append/delete churn per frame, chunk sha256 runs over a memoryview
+    of the same buffer, and the decoded bytes are sliced out exactly
+    once on their way to the frame kernel's staging window.
+
+    `close()` returns the buffer lease; the serve path calls it from
+    the request's finally (the reader may be dropped mid-body on error
+    paths, and the pool's leak net must stay at zero).
+    """
+
+    _FILL = 64 * 1024
+    _MAX_CHUNK = 16 << 20
+
+    def __init__(self, raw, auth: ParsedAuth, secret: str,
+                 verify_signatures: bool = True, lib=None):
+        import ctypes
+
+        from minio_tpu.io.bufpool import global_pool
+        if lib is None:
+            raise ValueError("native library required")
+        self._raw = raw
+        self._auth = auth
+        self._verify = verify_signatures
+        self._seed_key = signing_key(secret, auth.credential.date,
+                                     auth.credential.region,
+                                     auth.credential.service)
+        self._prev_sig = auth.signature
+        self._scope = auth.credential.scope()
+        self._lib = lib
+        self._ctypes = ctypes
+        self._pool = global_pool()
+        self._lease = self._pool.lease(256 << 10)
+        self._attach(self._lease)
+        self._pos = 0              # parse cursor
+        self._end = 0              # valid bytes
+        self._data_lo = 0          # current chunk's unread data span
+        self._data_hi = 0
+        self._done = False
+        self._closed = False
+        self.trailers: dict[str, str] = {}
+
+    # -- buffer plumbing -------------------------------------------------
+
+    def _attach(self, lease) -> None:
+        self._buf = lease.raw
+        self._cap = len(self._buf)
+        self._mv = memoryview(self._buf)
+        self._arr = (self._ctypes.c_uint8 * self._cap) \
+            .from_buffer(self._buf)
+        self._out = (self._ctypes.c_int64 * 4)()
+
+    def _detach(self) -> None:
+        # Exported views released BEFORE the lease returns: a live
+        # ctypes array over a free-listed buffer would alias the next
+        # lease.
+        self._arr = None
+        self._out = None
+        self._mv.release()
+
+    def _compact(self) -> None:
+        if self._pos:
+            n = self._end - self._pos
+            self._mv[:n] = self._mv[self._pos:self._end]
+            self._pos, self._end = 0, n
+
+    def _grow(self, need: int) -> None:
+        """Swap to a larger lease holding [pos, end) (a chunk bigger
+        than the buffer; bounded by the 16 MiB chunk cap)."""
+        old_lease, old_mv = self._lease, self._mv
+        data = bytes(old_mv[self._pos:self._end])
+        lease = self._pool.lease(need + self._FILL)
+        self._detach()
+        old_lease.release()
+        self._lease = lease
+        self._attach(lease)
+        self._mv[:len(data)] = data
+        self._pos, self._end = 0, len(data)
+
+    def _fill(self) -> int:
+        """Pull more raw bytes into the buffer tail (readinto straight
+        into the pooled buffer when the source supports it)."""
+        if self._end == self._cap:
+            self._compact()
+            if self._end == self._cap:
+                return 0
+        want = min(self._FILL, self._cap - self._end)
+        ri = getattr(self._raw, "readinto", None)
+        if ri is not None:
+            n = ri(self._mv[self._end:self._end + want])
+            n = n or 0
+        else:
+            data = self._raw.read(want)
+            n = len(data)
+            if n:
+                self._mv[self._end:self._end + n] = data
+        self._end += n
+        return n
+
+    def _ensure(self, need: int) -> None:
+        """Make buf[pos:pos+need) valid (fill/compact/grow)."""
+        if need > self._cap:
+            self._grow(need)
+        while self._end - self._pos < need:
+            if self._cap - self._pos < need:
+                self._compact()
+            if not self._fill():
+                raise SigError("IncompleteBody", "short chunk")
+
+    # -- frame parsing ---------------------------------------------------
+
+    def _next_frame(self) -> None:
+        while True:
+            r = self._lib.mtpu_chunk_head(self._arr, self._end, self._pos,
+                                          self._out)
+            if r == 1:
+                break
+            if r != 0:
+                raise SigError("InvalidChunkSizeError", "bad chunk header")
+            if self._end - self._pos > self._cap - 8:
+                self._compact()
+            if not self._fill():
+                raise SigError("IncompleteBody", "truncated chunk header")
+        hlen, size, sig_off, sig_len = (int(v) for v in self._out)
+        base = self._pos
+        self._ensure(hlen + size + (2 if size else 0))
+        if self._pos != base:
+            # _ensure compacted/regrew: the frame moved to offset 0 and
+            # the native offsets shifted with it.
+            shift = base - self._pos
+            if sig_off:
+                sig_off -= shift
+        doff = self._pos + hlen
+        if size and bytes(self._mv[doff + size:doff + size + 2]) != b"\r\n":
+            raise SigError("IncompleteBody", "bad chunk delimiter")
+        if self._verify and (
+                self._auth.payload_hash == STREAMING_PAYLOAD
+                or (self._auth.payload_hash == STREAMING_PAYLOAD_TRAILER
+                    and (size > 0 or sig_off > 0))):
+            chunk_sig = bytes(self._mv[sig_off:sig_off + sig_len]) \
+                .decode("latin-1") if sig_off else ""
+            sts = "\n".join([
+                "AWS4-HMAC-SHA256-PAYLOAD", self._auth.amz_date,
+                self._scope, self._prev_sig, EMPTY_SHA256,
+                hashlib.sha256(self._mv[doff:doff + size]).hexdigest()])
+            want = hmac.new(self._seed_key, sts.encode(),
+                            hashlib.sha256).hexdigest()
+            if not hmac.compare_digest(want, chunk_sig):
+                raise SigError("SignatureDoesNotMatch", "chunk signature")
+            self._prev_sig = want
+        self._pos = doff + size + (2 if size else 0)
+        if size == 0:
+            self._done = True
+        else:
+            self._data_lo, self._data_hi = doff, doff + size
+
+    def read(self, n: int) -> bytes:
+        while self._data_lo >= self._data_hi and not self._done:
+            self._next_frame()
+        if self._data_lo >= self._data_hi:
+            return b""
+        take = min(n, self._data_hi - self._data_lo) if n >= 0 else 0
+        out = bytes(self._mv[self._data_lo:self._data_lo + take])
+        self._data_lo += take
+        return out
+
+    def finalize(self) -> None:
+        """Consume the 0-chunk + trailer section (same semantics as
+        ChunkedPayloadReader.finalize: trailers parsed, signed-trailer
+        mode authenticated)."""
+        while not self._done:
+            self._next_frame()
+            if self._data_hi > self._data_lo:
+                raise SigError("IncompleteBody",
+                               "body exceeds decoded content length")
+        self.trailers = {}
+        trailer_raw = bytearray()
+        trailer_sig = ""
+        while True:
+            nl = self._buf.find(b"\r\n", self._pos, self._end)
+            if nl < 0:
+                if not self._fill():
+                    break
+                continue
+            line = bytes(self._mv[self._pos:nl])
+            self._pos = nl + 2
+            if not line:
+                continue
+            name, sep, value = line.partition(b":")
+            if not sep:
+                continue
+            lname = name.decode("latin-1").strip().lower()
+            if lname == "x-amz-trailer-signature":
+                trailer_sig = value.decode("latin-1").strip()
+                continue
+            trailer_raw += line + b"\n"
+            self.trailers[lname] = value.decode("latin-1").strip()
+        if self._verify \
+                and self._auth.payload_hash == STREAMING_PAYLOAD_TRAILER \
+                and (self.trailers or trailer_sig):
+            sts = "\n".join([
+                "AWS4-HMAC-SHA256-TRAILER", self._auth.amz_date,
+                self._scope, self._prev_sig,
+                hashlib.sha256(bytes(trailer_raw)).hexdigest()])
+            want = hmac.new(self._seed_key, sts.encode(),
+                            hashlib.sha256).hexdigest()
+            if not hmac.compare_digest(want, trailer_sig):
+                raise SigError("SignatureDoesNotMatch",
+                               "trailer signature")
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._detach()
+        self._lease.release()
+
+
+def chunked_reader(raw, auth: ParsedAuth, secret: str,
+                   verify_signatures: bool = True):
+    """The aws-chunked streaming decoder for the serve path: the
+    native-scan pooled reader when the native lib is loaded and
+    MTPU_HTTP_NATIVE is not off, else the pure-Python reader —
+    byte-identical either way."""
+    from minio_tpu.s3 import hotloop
+    lib = hotloop.lib() if hotloop.native_enabled() else None
+    if lib is not None:
+        try:
+            return PooledChunkedReader(raw, auth, secret,
+                                       verify_signatures, lib=lib)
+        except (ValueError, OSError):
+            pass
+    return ChunkedPayloadReader(raw, auth, secret, verify_signatures)
+
+
 def decode_chunked_payload(body: bytes, auth: ParsedAuth, secret: str,
                            verify_signatures: bool = True) -> bytes:
     """Decode STREAMING-AWS4-HMAC-SHA256-PAYLOAD framing.

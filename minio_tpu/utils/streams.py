@@ -144,6 +144,24 @@ class LimitedReader:
         self._remaining -= len(chunk)
         return chunk
 
+    def readinto(self, b) -> int:
+        """Limit-capped readinto so pooled-buffer consumers (sigv4
+        PooledChunkedReader) fill straight from the socket reader with
+        no intermediate bytes object."""
+        if self._remaining <= 0:
+            return 0
+        mv = memoryview(b).cast("B")
+        want = min(len(mv), self._remaining)
+        ri = getattr(self._raw, "readinto", None)
+        if ri is not None:
+            n = ri(mv[:want])
+        else:
+            chunk = self._raw.read(want)
+            n = len(chunk)
+            mv[:n] = chunk
+        self._remaining -= n
+        return n
+
 
 class HttpChunkedReader:
     """Incremental Transfer-Encoding: chunked decoder over a buffered

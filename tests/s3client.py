@@ -8,6 +8,7 @@ import datetime
 import hashlib
 import hmac
 import http.client
+import socket
 import urllib.parse
 
 from minio_tpu.s3 import sigv4
@@ -16,13 +17,118 @@ from minio_tpu.s3 import sigv4
 class S3Client:
     def __init__(self, address: str, access_key="minioadmin",
                  secret_key="minioadmin", region="us-east-1", timeout=30,
-                 session_token: str = ""):
+                 session_token: str = "", keepalive: bool = False):
+        """keepalive=True reuses ONE HTTP connection across request()
+        calls (reopened transparently if the server closes it) — the
+        SDK connection-pool shape, exercising the serve hot loop's
+        persistent-connection fast path instead of a fresh handshake
+        per request."""
         self.address = address
         self.access_key = access_key
         self.secret_key = secret_key
         self.region = region
         self.timeout = timeout
         self.session_token = session_token
+        self.keepalive = keepalive
+        self._conn: http.client.HTTPConnection | None = None
+        self._sock: socket.socket | None = None   # get_into fast path
+        self._spare = b""       # bytes read past the previous response
+
+    def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+        self._spare = b""
+
+    def get_into(self, path: str, buf) -> tuple[int, int]:
+        """Signed GET over a persistent raw socket, body received
+        straight into `buf` via recv_into — the thinnest client read
+        path there is (no http.client response machinery, no
+        per-request bytes join). For bench probes and throughput tests
+        where CLIENT-side Python costs must not pollute the measured
+        server number. Returns (status, body_len); body_len may exceed
+        len(buf) only on error statuses (the XML body is drained, not
+        stored)."""
+        now = datetime.datetime.now(datetime.timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        date = amz_date[:8]
+        scope = f"{date}/{self.region}/s3/aws4_request"
+        lower = {"host": self.address, "x-amz-date": amz_date,
+                 "x-amz-content-sha256": sigv4.EMPTY_SHA256}
+        if self.session_token:
+            lower["x-amz-security-token"] = self.session_token
+        signed = sorted(lower)
+        canon = sigv4.canonical_request("GET", path, {}, lower, signed,
+                                        sigv4.EMPTY_SHA256)
+        sts = sigv4.string_to_sign(amz_date, scope, canon)
+        key = sigv4.signing_key(self.secret_key, date, self.region)
+        sig = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+        url = sigv4.uri_encode(path, encode_slash=False)
+        req = (f"GET {url} HTTP/1.1\r\nHost: {self.address}\r\n"
+               f"x-amz-date: {amz_date}\r\n"
+               f"x-amz-content-sha256: {sigv4.EMPTY_SHA256}\r\n"
+               + (f"x-amz-security-token: {self.session_token}\r\n"
+                  if self.session_token else "")
+               + f"Authorization: {sigv4.ALGORITHM} "
+               f"Credential={self.access_key}/{scope}, "
+               f"SignedHeaders={';'.join(signed)}, Signature={sig}\r\n"
+               "\r\n").encode("latin-1")
+        for attempt in (0, 1):
+            if self._sock is None:
+                host, _, port = self.address.rpartition(":")
+                self._sock = socket.create_connection(
+                    (host, int(port)), timeout=self.timeout)
+                self._sock.setsockopt(socket.IPPROTO_TCP,
+                                      socket.TCP_NODELAY, 1)
+            try:
+                self._sock.sendall(req)
+                return self._read_response_into(buf)
+            except OSError:
+                if self._sock is not None:
+                    self._sock.close()
+                    self._sock = None
+                self._spare = b""
+                if attempt:
+                    raise
+        raise OSError("unreachable")
+
+    def _read_response_into(self, buf) -> tuple[int, int]:
+        sock = self._sock
+        head = self._spare
+        while True:
+            end = head.find(b"\r\n\r\n")
+            if end >= 0:
+                break
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("EOF in response head")
+            head += chunk
+        status = int(head[9:12])
+        clen = 0
+        for line in head[:end].split(b"\r\n")[1:]:
+            if line[:15].lower() == b"content-length:":
+                clen = int(line[15:])
+        rest = head[end + 4:]
+        body_mv = memoryview(buf)
+        got = min(len(rest), clen, len(buf))
+        body_mv[:got] = rest[:got]
+        drained = len(rest)
+        self._spare = rest[clen:] if clen <= len(rest) else b""
+        filled = got
+        while drained < clen:
+            if filled < min(clen, len(buf)):
+                n = sock.recv_into(body_mv[filled:],
+                                   min(clen - drained, len(buf) - filled))
+                filled += n
+            else:
+                n = len(sock.recv(min(clen - drained, 1 << 20)))
+            if not n:
+                raise ConnectionError("EOF in response body")
+            drained += n
+        return status, clen
 
     def request(self, method: str, path: str, query: dict | None = None,
                 body: bytes = b"", headers: dict | None = None,
@@ -76,20 +182,39 @@ class S3Client:
             [(k, v) for k, vs in query.items() for v in vs])
         # Send exactly the URI that was signed (raw-path verification).
         url = sigv4.uri_encode(path, encode_slash=False) + ("?" + qs if qs else "")
-        conn = http.client.HTTPConnection(self.address, timeout=self.timeout)
         if te_chunked:
             # An iterable body with no Content-Length makes http.client
             # use Transfer-Encoding: chunked.
             step = 256 * 1024
             body = iter([body[i:i + step]
                          for i in range(0, len(body), step)] or [b""])
-        try:
-            conn.request(method, url, body=body, headers=send_headers)
-            resp = conn.getresponse()
-            data = resp.read()
-            return resp.status, dict(resp.getheaders()), data
-        finally:
-            conn.close()
+        if not self.keepalive:
+            conn = http.client.HTTPConnection(self.address,
+                                              timeout=self.timeout)
+            try:
+                conn.request(method, url, body=body, headers=send_headers)
+                resp = conn.getresponse()
+                data = resp.read()
+                return resp.status, dict(resp.getheaders()), data
+            finally:
+                conn.close()
+        for attempt in (0, 1):
+            if self._conn is None:
+                self._conn = http.client.HTTPConnection(
+                    self.address, timeout=self.timeout)
+            try:
+                self._conn.request(method, url, body=body,
+                                   headers=send_headers)
+                resp = self._conn.getresponse()
+                data = resp.read()
+                return resp.status, dict(resp.getheaders()), data
+            except (http.client.HTTPException, OSError):
+                # Server closed the idle connection (keep-alive timeout
+                # or drain): reopen once. Iterable bodies can't be
+                # replayed — surface those.
+                self.close()
+                if attempt or te_chunked:
+                    raise
 
     def _chunk_body(self, body: bytes, seed_sig: str, amz_date: str,
                     scope: str, trailers: dict | None = None,

@@ -249,6 +249,49 @@ def test_cache_invalidation_overwrite_delete(es6):
         es.get_object("b", "k")
 
 
+def test_cache_strips_parity_inline_blobs(es6):
+    """Cached entries keep only the k DATA shards' inline payloads
+    (the serve fast path); parity holders are stripped to the empty
+    not-loaded sentinel, and a read that needs them (cached data blob
+    failing digest verification) re-resolves them from the drives and
+    still returns correct bytes."""
+    import dataclasses
+
+    es, disks = es6
+    body = RNG.integers(0, 256, size=90_000, dtype=np.uint8).tobytes()
+    es.put_object("b", "striped", body)
+    assert es.get_object("b", "striped")[1] == body     # populates cache
+    key = ("b", "striped", "")
+    entry = es.fi_cache._map[key]
+    k = entry["fi"].erasure.data_blocks
+    data = [f for f in entry["fis"] if f is not None
+            and f.erasure.index <= k]
+    parity = [f for f in entry["fis"] if f is not None
+              and f.erasure.index > k]
+    assert parity and all(f.inline_data == b"" for f in parity), \
+        "parity holders must carry only the not-loaded sentinel"
+    assert all(f.inline_data for f in data), \
+        "data holders must keep their inline payloads resident"
+    assert entry["bytes"] == sum(len(f.inline_data) for f in data)
+    # Hot path still serves byte-identical from the stripped entry.
+    before = sum(d.read_version_calls for d in disks)
+    assert es.get_object("b", "striped")[1] == body
+    assert sum(d.read_version_calls for d in disks) == before
+    # Corrupt ONE cached data blob: digest verification demotes that
+    # shard, and the reconstruct path must re-read parity journals
+    # from the drives (they are not in the cache) and still rebuild.
+    victim = next(i for i, f in enumerate(entry["fis"])
+                  if f is not None and f.erasure.index <= k
+                  and f.inline_data)
+    f = entry["fis"][victim]
+    bad = bytearray(f.inline_data)
+    bad[len(bad) // 2] ^= 0xFF
+    entry["fis"][victim] = dataclasses.replace(f, inline_data=bytes(bad))
+    assert es.get_object("b", "striped")[1] == body, \
+        "reconstruct around a corrupt cached shard must re-resolve " \
+        "stripped parity from the drives"
+
+
 def test_cache_invalidation_heal(es6):
     es, disks = es6
     body = RNG.integers(0, 256, size=(1 << 20) + 5,

@@ -427,3 +427,27 @@ def test_workers_metrics_aggregate(worker_server):
                 and 'api="PUT:object"' in line:
             total += int(float(line.rsplit(" ", 1)[1]))
     assert total >= 4, text[:1000]
+
+
+def test_shared_gen_poll_interval(tmp_path):
+    """Rate-limited SharedGen (the bucket-meta generation): calls
+    inside the window reuse the last verdict, a sibling's bump is
+    observed once the window expires, and our OWN bump resets the
+    window so bump+check in one process never misses itself."""
+    from minio_tpu.io.workers import SharedGen
+
+    path = str(tmp_path / "meta.gen")
+    writer = SharedGen(path)
+    observer = SharedGen(path, poll_interval=3600.0)
+    assert observer.changed() is True        # first look always syncs
+    writer.bump()
+    assert observer.changed() is False, \
+        "inside the poll window the cached verdict must be reused"
+    observer._polled_at = 0.0                # window expiry
+    assert observer.changed() is True
+    assert observer.changed() is False       # re-armed, no new bump
+    observer.bump()                          # own bump resets window
+    assert observer.changed() is True
+    # The un-rate-limited writer still observes every change.
+    observer.bump()
+    assert writer.changed() is True
