@@ -147,6 +147,8 @@ def main() -> None:
             _trace_overhead()
         if _want("put_scaling"):
             _put_scaling()
+        if _want("meta_listing"):
+            _meta_listing()
         return
 
     import jax
@@ -246,6 +248,10 @@ def main() -> None:
     # ---- 9. Chip-count scaling of the batched device PUT route --------
     if _want("put_scaling"):
         _put_scaling()
+
+    # ---- 10. Metadata plane: LIST/HEAD at high cardinality ------------
+    if _want("meta_listing"):
+        _meta_listing()
 
 
 def _put_latency() -> None:
@@ -775,6 +781,239 @@ def _scaling_probe() -> None:
         print(f"SCALING_GIBPS={best:.4f}")
     finally:
         shutil.rmtree(root, ignore_errors=True)
+
+
+def _meta_listing() -> None:
+    """Metadata plane at high cardinality: LIST/HEAD scenarios over a
+    fabricated namespace (scripts/namespace_gen.py — direct-to-drive
+    xl.meta journals, mixed kv/deep/flat/versioned profile).
+
+    Scenarios (p50/p99 ms each):
+      list_cold    first page of a kv/<aa>/ prefix right after a
+                   metacache bump (fresh drive walk — the per-key
+                   decode hot loop)
+      list_root_cold  first page of the whole bucket (walks into the
+                   flat-dir pathology)
+      list_warm    the same page again while the walk stream is alive
+      deep_page    first page under a 6-deep prefix chain
+      head_storm   get_object_info over K distinct keys, two passes —
+                   cold fan-out vs repeat (cache-class) behavior at a
+                   cardinality far past the data-cache entry cap
+      versioned    include_versions first page over the churn prefix
+      persist_warm first page via a FRESH set over the same drives
+                   after a completed walk persisted (restart warm
+                   start / segment seek)
+
+    Environment:
+      MTPU_META_NS_ROOT     reuse an existing generated namespace
+      MTPU_META_NS_OBJECTS  namespace size (default 10M; SMALL: 20k)
+      MTPU_META_NS_DRIVES   drive count (default 1 at 10M — a 10M
+                            namespace is inode-bound; SMALL: 4)
+    Emits two metric lines (gated by scripts/bench_smoke.sh):
+    meta_listing_list_cold_p50_ms and meta_listing_head_p50_ms; on
+    hosts where the fixture cannot build, both carry value null and
+    the smoke gate skips cleanly.
+    """
+    import shutil
+    import tempfile
+
+    sys_path_root = _os.path.dirname(_os.path.abspath(__file__))
+    import sys as _sys
+    if sys_path_root not in _sys.path:
+        _sys.path.insert(0, sys_path_root)
+    from scripts.namespace_gen import attach, generate, key_at
+
+    # Wide persisted-walk warm-start window for the persist_warm
+    # scenario (the default 2 s cross-restart contract would expire
+    # between reps). Patched on the MODULE, not via the env knob: in a
+    # multi-section bench run an earlier section already imported
+    # metacache, which binds its TTL at import time.
+    from minio_tpu.object import metacache as _mc_mod
+    saved_ttl = _mc_mod._PERSIST_TTL
+    _mc_mod._PERSIST_TTL = max(saved_ttl, 600.0)
+
+    objects = int(_os.environ.get("MTPU_META_NS_OBJECTS", 0) or
+                  (20_000 if _SMALL else 10_000_000))
+    drives = int(_os.environ.get("MTPU_META_NS_DRIVES", 0) or
+                 (4 if _SMALL else 1))
+    root = _os.environ.get("MTPU_META_NS_ROOT", "")
+    built_here = False
+
+    def emit_skip(reason: str) -> None:
+        # Explicit nulls for every gated column: scripts/bench_smoke.sh
+        # skips a gate on an explicit null, hard-fails on a missing one.
+        for m in ("meta_listing_list_cold_p50_ms",
+                  "meta_listing_head_p50_ms"):
+            print(json.dumps({"metric": m, "value": None,
+                              "cold_p50_ms": None, "unit": "ms",
+                              "skipped": reason}))
+
+    if not root:
+        # The fixture lives on /dev/shm or not at all: it needs ~6 KB
+        # of tmpfs per object per drive, and syscall-cost on overlay
+        # /tmp mounts is so high that a disk-built namespace measures
+        # the mount, not the metadata plane. Tiny hosts skip cleanly
+        # (the smoke gate treats the null value as "not measurable
+        # here").
+        try:
+            st = _os.statvfs("/dev/shm")
+            free = st.f_bavail * st.f_frsize
+        except OSError:
+            free = 0
+        if free < objects * drives * 6144 + (1 << 30):
+            emit_skip(f"namespace of {objects} objects x {drives} "
+                      "drives does not fit this host's /dev/shm")
+            return
+        root = tempfile.mkdtemp(prefix="bench-ns-", dir="/dev/shm")
+        built_here = True
+        try:
+            generate(root, objects, drives=drives)
+        except Exception as e:  # noqa: BLE001 - fixture is best-effort
+            shutil.rmtree(root, ignore_errors=True)
+            emit_skip(f"namespace build failed: {e}")
+            return
+
+    def pct(ts, p):
+        ts = sorted(ts)
+        return round(ts[min(len(ts) - 1, len(ts) * p // 100)] * 1e3, 2)
+
+    scen: dict = {}
+    es = attach(root, drives)
+    try:
+        bucket = "ns"
+        reps = 5 if _SMALL else 12
+        # Prefixes with real population under the mixed profile: kv
+        # second hex digit cycles fastest with index.
+        kv_prefixes = [f"kv/{h}{h2}/" for h in "0123456789abcdef"
+                       for h2 in "0369cf"]
+
+        def cold_pages(prefixes, n, **kw):
+            lat = []
+            for p in prefixes[:n]:
+                es.metacache.bump(bucket)
+                t0 = time.perf_counter()
+                page = es.list_objects(bucket, prefix=p, max_keys=1000,
+                                       **kw)
+                lat.append(time.perf_counter() - t0)
+                assert page.objects or page.prefixes, p
+            return lat
+
+        lat = cold_pages(kv_prefixes, reps)
+        scen["list_cold"] = {"p50_ms": pct(lat, 50), "p99_ms": pct(lat, 99)}
+
+        # Whole-bucket first page (walks into the flat/ pathology).
+        lat = []
+        for _ in range(max(3, reps // 3)):
+            es.metacache.bump(bucket)
+            t0 = time.perf_counter()
+            page = es.list_objects(bucket, max_keys=1000)
+            lat.append(time.perf_counter() - t0)
+            assert page.objects
+        scen["list_root_cold"] = {"p50_ms": pct(lat, 50),
+                                  "p99_ms": pct(lat, 99)}
+
+        # Warm: same prefix, walk stream alive.
+        es.metacache.bump(bucket)
+        es.list_objects(bucket, prefix=kv_prefixes[0], max_keys=1000)
+        lat = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            es.list_objects(bucket, prefix=kv_prefixes[0], max_keys=1000)
+            lat.append(time.perf_counter() - t0)
+        scen["list_warm"] = {"p50_ms": pct(lat, 50), "p99_ms": pct(lat, 99)}
+
+        # Deep-prefix page (a = (i>>8)&7, b = (i>>12)&7: these combos
+        # are populated from a few thousand objects up).
+        deep_prefixes = [f"deep/{a}/{b}/" for b in "012"
+                         for a in "02461357"]
+        lat = cold_pages(deep_prefixes, max(3, reps // 2))
+        scen["deep_page"] = {"p50_ms": pct(lat, 50), "p99_ms": pct(lat, 99)}
+
+        # Delimiter browse one level under kv/ (the S3-console shape):
+        # the shallow walk answers from O(page) probes; a deep walk
+        # must stream the whole subtree into the collapse. One rep at
+        # full scale — the pre-optimization cost is the finding.
+        lat = []
+        for _ in range(1 if objects > 1_000_000 else max(2, reps // 3)):
+            es.metacache.bump(bucket)
+            t0 = time.perf_counter()
+            page = es.list_objects(bucket, prefix="kv/", delimiter="/",
+                                   max_keys=1000)
+            lat.append(time.perf_counter() - t0)
+            assert page.prefixes
+        scen["browse_delim"] = {"p50_ms": pct(lat, 50),
+                                "p99_ms": pct(lat, 99),
+                                "prefixes": len(page.prefixes),
+                                "truncated": page.is_truncated}
+
+        # Versioned listing over the churn prefix.
+        lat = cold_pages(["ver/"], 1, include_versions=True)
+        for _ in range(max(2, reps // 2) - 1):
+            lat += cold_pages(["ver/"], 1, include_versions=True)
+        scen["versioned"] = {"p50_ms": pct(lat, 50), "p99_ms": pct(lat, 99)}
+
+        # HEAD storm: cardinality far past the data-class cache cap.
+        es.metacache.bump(bucket)        # cancel walks, flush caches
+        nkeys = min(2000 if _SMALL else 20_000, max(objects // 4, 100))
+        stride = max(1, objects // nkeys)
+        keys = [key_at(i * stride, objects) for i in range(nkeys)
+                if i * stride < objects]
+        cold_lat, hot_lat = [], []
+        for k in keys:
+            t0 = time.perf_counter()
+            es.get_object_info(bucket, k)
+            cold_lat.append(time.perf_counter() - t0)
+        for k in keys:
+            t0 = time.perf_counter()
+            es.get_object_info(bucket, k)
+            hot_lat.append(time.perf_counter() - t0)
+        scen["head_storm"] = {
+            "keys": len(keys),
+            "cold_p50_ms": pct(cold_lat, 50), "cold_p99_ms": pct(cold_lat, 99),
+            "hot_p50_ms": pct(hot_lat, 50), "hot_p99_ms": pct(hot_lat, 99)}
+
+        # Persisted warm start: complete a small prefix walk, let it
+        # persist, then a FRESH set over the same drives pages it.
+        warm_prefix = "kv/00/"
+        es.metacache.bump(bucket)
+        marker = ""
+        while True:
+            page = es.list_objects(bucket, prefix=warm_prefix,
+                                   marker=marker, max_keys=1000)
+            if not page.is_truncated:
+                break
+            marker = page.next_marker
+        time.sleep(0.3)        # persist runs before done; small safety
+        lat = []
+        for _ in range(max(3, reps // 2)):
+            es2 = attach(root, drives)
+            t0 = time.perf_counter()
+            page = es2.list_objects(bucket, prefix=warm_prefix,
+                                    max_keys=1000)
+            lat.append(time.perf_counter() - t0)
+            assert page.objects
+            es2.close()
+        scen["persist_warm"] = {"p50_ms": pct(lat, 50),
+                                "p99_ms": pct(lat, 99)}
+    finally:
+        es.close()
+        _mc_mod._PERSIST_TTL = saved_ttl
+        if built_here and _os.environ.get("MTPU_META_NS_KEEP", "") != "1":
+            shutil.rmtree(root, ignore_errors=True)
+
+    common = {"unit": "ms", "vs_baseline": None, "objects": objects,
+              "drives": drives}
+    print(json.dumps({
+        "metric": "meta_listing_list_cold_p50_ms",
+        "value": scen["list_cold"]["p50_ms"],
+        **common, "scenarios": scen,
+    }))
+    print(json.dumps({
+        "metric": "meta_listing_head_p50_ms",
+        "value": scen["head_storm"]["hot_p50_ms"],
+        "cold_p50_ms": scen["head_storm"]["cold_p50_ms"],
+        **common,
+    }))
 
 
 # One probe subprocess can serve several sections (PUT + GET

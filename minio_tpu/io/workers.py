@@ -483,7 +483,7 @@ def _wire_set(s, shared_dir: str, list_gen: SharedGen,
 
     orig_walk = mc.walk_for
 
-    def walk_for(es, bucket: str, prefix: str, start: str = ""):
+    def walk_for(es, bucket: str, prefix: str, start: str = "", **kw):
         if list_gen.changed():
             # Another worker mutated some namespace since we last
             # looked: orphan EVERY cached walk stream (coarse, but a
@@ -495,7 +495,7 @@ def _wire_set(s, shared_dir: str, list_gen: SharedGen,
                 | {bucket}
             for b in buckets:
                 orig_bump(b, False)
-        return orig_walk(es, bucket, prefix, start=start)
+        return orig_walk(es, bucket, prefix, start=start, **kw)
     mc.walk_for = walk_for
 
     orig_set_meta = s.set_bucket_meta

@@ -107,6 +107,11 @@ def _declare(lib) -> None:
                                    ctypes.c_size_t, ctypes.c_size_t,
                                    ctypes.c_size_t, ctypes.c_size_t, u8p]
     lib.mtpu_get_frame.restype = ctypes.c_uint64
+    # Metadata plane: batched xl.meta journal scan (storage/meta_scan).
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.mtpu_meta_scan.argtypes = [u8p, i64p, ctypes.c_int64,
+                                   ctypes.c_int64, i64p]
+    lib.mtpu_meta_scan.restype = ctypes.c_int64
 
 
 def _u8(arr) -> "ctypes.POINTER(ctypes.c_uint8)":

@@ -706,4 +706,301 @@ int64_t mtpu_chunk_head(const uint8_t* buf, size_t len, size_t pos,
   return 1;
 }
 
+// ---------------------------------------------------------------------------
+// Batched xl.meta journal scan
+// ---------------------------------------------------------------------------
+//
+// The listing walk's per-object hot loop: given N concatenated xl.meta
+// blobs (magic + msgpack, storage/meta.py layout) in one buffer, extract
+// for each blob the per-version fields the metadata plane needs —
+// delete-marker/inline flags, mod-time, size, version id, data dir, and
+// the three listing metadata values (etag, content-type, x-amz-tagging)
+// — in one GIL-free call. Anything the scanner does not fully
+// understand (unknown msgpack types where a known one is required,
+// journals longer than `maxv` versions, meta maps carrying keys beyond
+// the three captured ones) is REJECTED per blob: the caller falls back
+// to the Python XLMeta.load path for that blob alone, so the scan can
+// stay a strict, simple subset of msgpack while the slow path keeps
+// full fidelity.
+//
+// Out records (int64), stride 2 + 13*maxv per blob:
+//   [0] status: 0 parsed; -1 malformed/unsupported; -2 over maxv
+//   [1] nversions
+//   per version v at 2 + 13*v:
+//     [+0] flags: bit0 delete-marker, bit1 inline, bit2 meta-extra
+//          (meta holds keys/value-types beyond the captured three — the
+//          summary is not sufficient to rebuild listing metadata)
+//     [+1] mod-time   [+2] size
+//     [+3..4]   vid  (absolute offset, length into buf)
+//     [+5..6]   ddir
+//     [+7..8]   etag
+//     [+9..10]  content-type
+//     [+11..12] x-amz-tagging
+// Returns the number of blobs with status == 0.
+
+namespace {
+
+struct Mp {
+  const uint8_t* p;
+  const uint8_t* end;
+
+  bool ok(size_t n) const { return size_t(end - p) >= n; }
+  uint64_t be(size_t n) {
+    uint64_t v = 0;
+    for (size_t i = 0; i < n; ++i) v = (v << 8) | p[i];
+    p += n;
+    return v;
+  }
+};
+
+// Read any msgpack value header we might see; for containers returns the
+// element count, for str/bin the byte length (and leaves p at payload).
+enum MpType { MP_ERR, MP_NIL, MP_BOOL, MP_INT, MP_STR, MP_BIN, MP_ARR,
+              MP_MAP, MP_FLOAT, MP_EXT };
+
+MpType mp_head(Mp* m, int64_t* val) {
+  if (!m->ok(1)) return MP_ERR;
+  const uint8_t c = *m->p++;
+  if (c <= 0x7f) { *val = c; return MP_INT; }             // pos fixint
+  if (c >= 0xe0) { *val = int8_t(c); return MP_INT; }     // neg fixint
+  if ((c & 0xf0) == 0x80) { *val = c & 0x0f; return MP_MAP; }
+  if ((c & 0xf0) == 0x90) { *val = c & 0x0f; return MP_ARR; }
+  if ((c & 0xe0) == 0xa0) { *val = c & 0x1f; return MP_STR; }
+  switch (c) {
+    case 0xc0: return MP_NIL;
+    case 0xc2: *val = 0; return MP_BOOL;
+    case 0xc3: *val = 1; return MP_BOOL;
+    case 0xc4: if (!m->ok(1)) return MP_ERR; *val = int64_t(m->be(1));
+               return MP_BIN;
+    case 0xc5: if (!m->ok(2)) return MP_ERR; *val = int64_t(m->be(2));
+               return MP_BIN;
+    case 0xc6: if (!m->ok(4)) return MP_ERR; *val = int64_t(m->be(4));
+               return MP_BIN;
+    case 0xca: if (!m->ok(4)) return MP_ERR; m->p += 4; return MP_FLOAT;
+    case 0xcb: if (!m->ok(8)) return MP_ERR; m->p += 8; return MP_FLOAT;
+    case 0xcc: if (!m->ok(1)) return MP_ERR; *val = int64_t(m->be(1));
+               return MP_INT;
+    case 0xcd: if (!m->ok(2)) return MP_ERR; *val = int64_t(m->be(2));
+               return MP_INT;
+    case 0xce: if (!m->ok(4)) return MP_ERR; *val = int64_t(m->be(4));
+               return MP_INT;
+    case 0xcf: {
+      if (!m->ok(8)) return MP_ERR;
+      const uint64_t u = m->be(8);
+      if (u > uint64_t(INT64_MAX)) return MP_ERR;   // out of our range
+      *val = int64_t(u);
+      return MP_INT;
+    }
+    case 0xd0: if (!m->ok(1)) return MP_ERR; *val = int8_t(m->be(1));
+               return MP_INT;
+    case 0xd1: if (!m->ok(2)) return MP_ERR; *val = int16_t(m->be(2));
+               return MP_INT;
+    case 0xd2: if (!m->ok(4)) return MP_ERR; *val = int32_t(m->be(4));
+               return MP_INT;
+    case 0xd3: if (!m->ok(8)) return MP_ERR; *val = int64_t(m->be(8));
+               return MP_INT;
+    case 0xd4: case 0xd5: case 0xd6: case 0xd7: case 0xd8: {
+      const size_t n = size_t(1) << (c - 0xd4);
+      if (!m->ok(1 + n)) return MP_ERR;
+      m->p += 1 + n;
+      return MP_EXT;
+    }
+    case 0xc7: if (!m->ok(1)) return MP_ERR; *val = int64_t(m->be(1));
+               if (!m->ok(size_t(*val) + 1)) return MP_ERR;
+               m->p += *val + 1; return MP_EXT;
+    case 0xc8: if (!m->ok(2)) return MP_ERR; *val = int64_t(m->be(2));
+               if (!m->ok(size_t(*val) + 1)) return MP_ERR;
+               m->p += *val + 1; return MP_EXT;
+    case 0xc9: if (!m->ok(4)) return MP_ERR; *val = int64_t(m->be(4));
+               if (!m->ok(size_t(*val) + 1)) return MP_ERR;
+               m->p += *val + 1; return MP_EXT;
+    case 0xd9: if (!m->ok(1)) return MP_ERR; *val = int64_t(m->be(1));
+               return MP_STR;
+    case 0xda: if (!m->ok(2)) return MP_ERR; *val = int64_t(m->be(2));
+               return MP_STR;
+    case 0xdb: if (!m->ok(4)) return MP_ERR; *val = int64_t(m->be(4));
+               return MP_STR;
+    case 0xdc: if (!m->ok(2)) return MP_ERR; *val = int64_t(m->be(2));
+               return MP_ARR;
+    case 0xdd: if (!m->ok(4)) return MP_ERR; *val = int64_t(m->be(4));
+               return MP_ARR;
+    case 0xde: if (!m->ok(2)) return MP_ERR; *val = int64_t(m->be(2));
+               return MP_MAP;
+    case 0xdf: if (!m->ok(4)) return MP_ERR; *val = int64_t(m->be(4));
+               return MP_MAP;
+    default: return MP_ERR;   // reserved / never-used (0xc1)
+  }
+}
+
+bool mp_skip(Mp* m, int depth = 0) {
+  if (depth > 32) return false;
+  int64_t v = 0;
+  switch (mp_head(m, &v)) {
+    case MP_ERR: return false;
+    case MP_NIL: case MP_BOOL: case MP_INT: case MP_FLOAT: case MP_EXT:
+      return true;
+    case MP_STR: case MP_BIN:
+      if (!m->ok(size_t(v))) return false;
+      m->p += v;
+      return true;
+    case MP_ARR:
+      for (int64_t i = 0; i < v; ++i)
+        if (!mp_skip(m, depth + 1)) return false;
+      return true;
+    case MP_MAP:
+      for (int64_t i = 0; i < 2 * v; ++i)
+        if (!mp_skip(m, depth + 1)) return false;
+      return true;
+  }
+  return false;
+}
+
+bool mp_str(Mp* m, const uint8_t** s, int64_t* len) {
+  int64_t v = 0;
+  if (mp_head(m, &v) != MP_STR || !m->ok(size_t(v))) return false;
+  *s = m->p;
+  *len = v;
+  m->p += v;
+  return true;
+}
+
+bool key_is(const uint8_t* s, int64_t len, const char* k) {
+  const size_t kl = strlen(k);
+  return size_t(len) == kl && std::memcmp(s, k, kl) == 0;
+}
+
+enum { MSCAN_FLAG_DELETED = 1, MSCAN_FLAG_INLINE = 2, MSCAN_FLAG_EXTRA = 4 };
+
+// One version map -> out[0..12]; offsets absolute against `base`.
+bool scan_version(Mp* m, const uint8_t* base, int64_t* o) {
+  int64_t nfields = 0;
+  if (mp_head(m, &nfields) != MP_MAP) return false;
+  int64_t flags = 0, mt = 0, size = 0, kind = 0;
+  bool saw_kind = false, saw_vid = false, saw_mt = false;
+  for (int i = 0; i < 13; ++i) o[i] = 0;
+  for (int64_t f = 0; f < nfields; ++f) {
+    const uint8_t* ks;
+    int64_t klen = 0, v = 0;
+    if (!mp_str(m, &ks, &klen)) return false;
+    if (key_is(ks, klen, "kind")) {
+      if (mp_head(m, &v) != MP_INT) return false;
+      kind = v;
+      saw_kind = true;
+    } else if (key_is(ks, klen, "vid")) {
+      const uint8_t* s;
+      int64_t len;
+      if (!mp_str(m, &s, &len)) return false;
+      o[3] = s - base;
+      o[4] = len;
+      saw_vid = true;
+    } else if (key_is(ks, klen, "mt")) {
+      if (mp_head(m, &v) != MP_INT) return false;
+      mt = v;
+      saw_mt = true;
+    } else if (key_is(ks, klen, "ddir")) {
+      const uint8_t* s;
+      int64_t len;
+      if (!mp_str(m, &s, &len)) return false;
+      o[5] = s - base;
+      o[6] = len;
+    } else if (key_is(ks, klen, "size")) {
+      if (mp_head(m, &v) != MP_INT) return false;
+      size = v;
+    } else if (key_is(ks, klen, "inline")) {
+      MpType t = mp_head(m, &v);
+      if (t != MP_BOOL && t != MP_NIL) return false;
+      if (t == MP_BOOL && v) flags |= MSCAN_FLAG_INLINE;
+    } else if (key_is(ks, klen, "meta")) {
+      int64_t nm = 0;
+      if (mp_head(m, &nm) != MP_MAP) return false;
+      for (int64_t j = 0; j < nm; ++j) {
+        const uint8_t* ms;
+        int64_t mlen = 0;
+        if (!mp_str(m, &ms, &mlen)) return false;
+        int slot = -1;
+        if (key_is(ms, mlen, "etag")) slot = 7;
+        else if (key_is(ms, mlen, "content-type")) slot = 9;
+        else if (key_is(ms, mlen, "x-amz-tagging")) slot = 11;
+        if (slot < 0) {
+          flags |= MSCAN_FLAG_EXTRA;       // key beyond the captured set
+          if (!mp_skip(m)) return false;
+          continue;
+        }
+        const uint8_t* vs;
+        int64_t vlen = 0;
+        Mp save = *m;
+        if (!mp_str(m, &vs, &vlen)) {
+          // Captured key with a non-string value: keep parsing (the
+          // Python path will rebuild it), but flag the summary as
+          // insufficient.
+          *m = save;
+          if (!mp_skip(m)) return false;
+          flags |= MSCAN_FLAG_EXTRA;
+          continue;
+        }
+        o[slot] = vs - base;
+        o[slot + 1] = vlen;
+      }
+    } else {
+      // parts / ec / future keys: skipped, same as the Python reader.
+      if (!mp_skip(m)) return false;
+    }
+  }
+  if (!saw_kind || !saw_vid || !saw_mt) return false;
+  if (kind == 2) flags |= MSCAN_FLAG_DELETED;
+  else if (kind != 1) return false;
+  o[0] = flags;
+  o[1] = mt;
+  o[2] = size;
+  return true;
+}
+
+int64_t scan_one(const uint8_t* blob, size_t len, const uint8_t* base,
+                 int64_t maxv, int64_t* out) {
+  const int64_t stride_v = 13;
+  out[0] = -1;
+  out[1] = 0;
+  if (len < 4 || std::memcmp(blob, "XTP1", 4) != 0) return -1;
+  Mp m{blob + 4, blob + len};
+  int64_t ntop = 0;
+  if (mp_head(&m, &ntop) != MP_MAP) return -1;
+  int64_t nver = -1;
+  for (int64_t t = 0; t < ntop; ++t) {
+    const uint8_t* ks;
+    int64_t klen = 0;
+    if (!mp_str(&m, &ks, &klen)) return -1;
+    if (key_is(ks, klen, "versions")) {
+      if (mp_head(&m, &nver) != MP_ARR) return -1;
+      out[1] = nver;
+      if (nver > maxv) { out[0] = -2; return -2; }
+      for (int64_t v = 0; v < nver; ++v)
+        if (!scan_version(&m, base, out + 2 + stride_v * v)) return -1;
+    } else {
+      if (!mp_skip(&m)) return -1;
+    }
+  }
+  if (nver < 0) return -1;
+  out[0] = 0;
+  return 0;
+}
+
+}  // namespace
+
+int64_t mtpu_meta_scan(const uint8_t* buf, const int64_t* offs,
+                       int64_t nblobs, int64_t maxv, int64_t* out) {
+  const int64_t stride = 2 + 13 * maxv;
+  int64_t okcnt = 0;
+  for (int64_t i = 0; i < nblobs; ++i) {
+    const int64_t lo = offs[i], hi = offs[i + 1];
+    int64_t* rec = out + i * stride;
+    if (lo < 0 || hi < lo) {
+      rec[0] = -1;
+      rec[1] = 0;
+      continue;
+    }
+    if (scan_one(buf + lo, size_t(hi - lo), buf, maxv, rec) == 0) ++okcnt;
+  }
+  return okcnt;
+}
+
 }  // extern "C"

@@ -25,6 +25,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import hashlib
+import os
 import threading
 import time as _time_mod
 import zlib
@@ -50,6 +51,7 @@ from minio_tpu.object.types import (BucketExists, BucketInfo, BucketNotEmpty,
 from minio_tpu.storage import bitrot
 from minio_tpu.storage.local import (SYS_VOL, StorageError, VolumeExists,
                                      VolumeNotEmpty, VolumeNotFound)
+from minio_tpu.storage import meta as metafmt
 from minio_tpu.storage.meta import (ErasureInfo, FileInfo, FileNotFoundErr,
                                     MetaError, ObjectPartInfo,
                                     VersionNotFoundErr, new_uuid, now_ns)
@@ -640,7 +642,8 @@ class ErasureSet:
         return fis[best[1][0]], best[1]
 
     def _get_object_fileinfo(self, bucket: str, object_: str,
-                             version_id: str = "", read_data: bool = False):
+                             version_id: str = "", read_data: bool = False,
+                             stat_only: bool = False):
         """(fi, per-disk fis, errors) with read-quorum enforcement.
 
         Repeat lookups of an unchanged key are memory hits in the
@@ -649,12 +652,23 @@ class ErasureSet:
         object/fi_cache.py). Only fully-healthy reads (every drive
         answered, quorum found) are cached: a degraded read must keep
         re-reading so heal progress is observed and the MRF hook in
-        callers keeps firing."""
-        cached = self.fi_cache.get(bucket, object_, version_id,
-                                   need_data=read_data)
-        if cached is not None:
-            fi, fis = cached
-            return fi, fis, [None] * len(self.disks)
+        callers keeps firing.
+
+        `stat_only` is the HEAD path: lookups and inserts ride the
+        cache's large stat class (quorum fi only — fis comes back
+        None), so metadata storms at high key cardinality neither
+        evict the GET fast path's data-class entries nor pay repeat
+        fan-outs."""
+        if stat_only:
+            fi = self.fi_cache.get_stat(bucket, object_, version_id)
+            if fi is not None:
+                return fi, None, [None] * len(self.disks)
+        else:
+            cached = self.fi_cache.get(bucket, object_, version_id,
+                                       need_data=read_data)
+            if cached is not None:
+                fi, fis = cached
+                return fi, fis, [None] * len(self.disks)
         token = self.fi_cache.token(bucket)
         fis, errors = self._read_version_all(bucket, object_, version_id,
                                              read_data=read_data)
@@ -696,8 +710,12 @@ class ErasureSet:
             _raise_for_quorum(errors, ReadQuorumError(bucket, object_),
                               quorum=quorum)
         if all(e is None for e in errors):
-            self.fi_cache.put(bucket, object_, version_id, fi, fis,
-                              read_data, token)
+            if stat_only:
+                self.fi_cache.put_stat(bucket, object_, version_id, fi,
+                                       token)
+            else:
+                self.fi_cache.put(bucket, object_, version_id, fi, fis,
+                                  read_data, token)
         return fi, fis, errors
 
     def _reap_dangling(self, bucket: str, object_: str) -> None:
@@ -1921,7 +1939,9 @@ class ErasureSet:
     def get_object_info(self, bucket: str, object_: str,
                         opts: Optional[GetOptions] = None) -> ObjectInfo:
         opts = opts or GetOptions()
-        fi, _, _ = self._get_object_fileinfo(bucket, object_, opts.version_id)
+        fi, _, _ = self._get_object_fileinfo(bucket, object_,
+                                             opts.version_id,
+                                             stat_only=True)
         if fi.deleted:
             # Same AWS mapping as get_object: 404 for latest-is-marker,
             # 405 when the marker's version is named explicitly.
@@ -2196,17 +2216,22 @@ class ErasureSet:
         return DeletedObject(object_name=object_, version_id=opts.version_id)
 
     def _walk_resolved(self, bucket: str, prefix: str,
-                       start: str = ""):
-        """Sorted (path, version_maps) stream — the metacache's
-        production side. Per-drive sorted walks (reference: WalkDir,
-        cmd/metacache-walk.go:73) over a MAJORITY of drives (any write
-        quorum intersects the walked set, so committed objects are
-        never invisible even when some drives missed the write), k-way
-        merged, each key resolved from its journal copies. The walked
-        set rotates per walk (reference askDisks rotation) so a drive
-        failing mid-walk only shadows objects for some walks."""
+                       start: str = "", shallow: bool = False):
+        """Sorted (path, entry) stream — the metacache's production
+        side. Per-drive sorted SCAN walks (storage/local.walk_scan:
+        batched native journal decode; plain walk_dir for drives
+        without it) over a MAJORITY of drives (any write quorum
+        intersects the walked set, so committed objects are never
+        invisible even when some drives missed the write), k-way
+        merged, each key resolved from its journal copies into a
+        trimmed stream entry. The walked set rotates per walk
+        (reference askDisks rotation) so a drive failing mid-walk only
+        shadows objects for some walks. `shallow` walks one level and
+        passes subtree markers through (delimiter pages)."""
         import heapq
         from itertools import groupby
+
+        from minio_tpu.storage.meta_scan import PREFIX_MARK
 
         base_dir = ""
         if "/" in prefix:
@@ -2214,8 +2239,19 @@ class ErasureSet:
 
         def disk_iter(d):
             try:
-                yield from d.walk_dir(bucket, base_dir=base_dir,
-                                      forward_from=max(start, prefix))
+                ws = getattr(d, "walk_scan", None)
+                if ws is not None:
+                    yield from ws(bucket, base_dir=base_dir,
+                                  forward_from=max(start, prefix),
+                                  shallow=shallow)
+                else:
+                    # Remote / legacy drives: stream raw journals; the
+                    # resolver summarizes per blob (shallow callers
+                    # gate on every drive supporting walk_scan).
+                    for path, blob in d.walk_dir(
+                            bucket, base_dir=base_dir,
+                            forward_from=max(start, prefix)):
+                        yield path, None, blob
             except Exception:  # noqa: BLE001 - drive loss tolerated
                 return
 
@@ -2228,42 +2264,129 @@ class ErasureSet:
         iters = [disk_iter(d) for d in walk_disks if d is not None]
         merged = heapq.merge(*iters, key=lambda kv: kv[0])
         for path, grp in groupby(merged, key=lambda kv: kv[0]):
-            maps = self._resolve_walked(bucket, path,
-                                        [b for _, b in grp], len(iters))
-            if maps is not None:
-                yield path, maps
-
-    def _resolve_walked(self, bucket, path, blobs, total_walked):
-        """Resolve one walked key to its version maps.
-
-        When every walked drive has the key and they agree, the parsed
-        journal is authoritative (no extra I/O — the hot path).
-        Otherwise the entry is ambiguous (a drive missed a
-        delete/overwrite, or the object never reached all walked
-        drives) and resolution falls back to a full quorum metadata
-        read, exactly how the reference's metacache resolver escalates
-        disagreements — a lone stale copy must not resurrect deleted
-        objects, and a quorum-thin write must still be listed."""
-        from minio_tpu.storage.meta import XLMeta
-        parsed = []
-        for blob in blobs:
-            try:
-                xl = XLMeta.load(blob)
-                fi = xl.to_fileinfo(bucket, path)
-                parsed.append((xl, fi))
-            except Exception:  # noqa: BLE001 - unreadable copy
+            items = [(v, b) for _, v, b in grp]
+            if any(v is PREFIX_MARK for v, _ in items):
+                # Shallow subtree marker: present on ANY walked drive
+                # => the prefix exists (same union the merged deep walk
+                # would produce).
+                yield path, PREFIX_MARK
                 continue
-        agree = (len(parsed) == total_walked and len({
-            (fi.mod_time, fi.version_id, fi.deleted, fi.data_dir)
-            for _, fi in parsed}) == 1)
+            entry = self._resolve_walked(bucket, path, items, len(iters))
+            if entry is not None:
+                yield path, entry
+
+    def _resolve_walked(self, bucket, path, items, total_walked):
+        """Resolve one walked key's per-drive (summary, blob) copies to
+        a stream entry.
+
+        When every walked drive has the key and the copies agree on
+        the latest version, the journal is authoritative (no extra I/O
+        — the hot path): a summary covering listing needs becomes a
+        trimmed ("s", vlist) entry with no Python journal parse at
+        all; otherwise ONE copy's blob is parsed into a full ("m",
+        maps) entry. Disagreement (a drive missed a delete/overwrite,
+        or the object never reached all walked drives) falls back to a
+        full quorum metadata read, exactly how the reference's
+        metacache resolver escalates — a lone stale copy must not
+        resurrect deleted objects, and a quorum-thin write must still
+        be listed."""
+        from minio_tpu.storage.meta import XLMeta
+        from minio_tpu.storage.meta_scan import (FLAG_DELETED,
+                                                 summary_sufficient)
+        parsed = []      # (latest-key, vlist|None, blob|None, xl|None)
+        for vlist, blob in items:
+            if vlist is not None:
+                if not vlist:
+                    continue             # empty journal: nothing listed
+                lv = vlist[0]
+                latest = (lv[1], lv[3], bool(lv[0] & FLAG_DELETED),
+                          lv[4])
+                parsed.append((latest, vlist, blob, None))
+            else:
+                try:
+                    xl = XLMeta.load(blob)
+                    v0 = xl.versions[0]
+                except Exception:  # noqa: BLE001 - unreadable copy
+                    continue
+                latest = (v0["mt"], v0["vid"],
+                          v0.get("kind") == metafmt.KIND_DELETE_MARKER,
+                          v0.get("ddir", "") or "")
+                parsed.append((latest, None, blob, xl))
+        agree = (len(parsed) == total_walked
+                 and len({p[0] for p in parsed}) == 1)
         if agree:
-            return list(parsed[0][0].versions)
+            for _, vlist, _, _ in parsed:
+                if vlist is not None and summary_sufficient(vlist):
+                    return ("s", vlist)
+            for _, _, blob, xl in parsed:
+                if xl is None and blob is not None:
+                    try:
+                        xl = XLMeta.load(blob)
+                    except Exception:  # noqa: BLE001
+                        continue
+                if xl is not None:
+                    return ("m", list(xl.versions))
         try:
             fi, _, _ = self._get_object_fileinfo(bucket, path)
         except Exception:  # noqa: BLE001 - dangling / below quorum
             return None
         # Walked copies disagreed — only the quorum fi is trustworthy.
-        return [fi.to_version_map()]
+        return ("m", [fi.to_version_map()])
+
+    def _shallow_ok(self, delimiter: str) -> bool:
+        """Delimiter pages ride a one-level shallow walk when the
+        delimiter is the path separator (collapse boundaries ==
+        directory boundaries) and every drive can shallow-walk
+        (storage/local.walk_scan; remote drives stream deep walks)."""
+        if delimiter != "/" or os.environ.get(
+                "MTPU_LIST_SHALLOW", "on").lower() in ("0", "off",
+                                                       "false"):
+            return False
+        return all(d is not None
+                   and getattr(d, "walk_scan", None) is not None
+                   for d in self.disks)
+
+    def _entry_fileinfos(self, bucket: str, path: str,
+                         entry) -> list[FileInfo]:
+        """Stream entry -> per-version FileInfos, latest first.
+
+        Trimmed ("s") entries rebuild exactly the fields listings
+        consume (identity with the full-journal path is golden-tested
+        with the scanner on and off; `parts` is deliberately absent —
+        no listing surface reads it)."""
+        from minio_tpu.storage.meta_scan import (FLAG_DELETED,
+                                                 FLAG_INLINE)
+        kind, payload = entry
+        if kind == "m":
+            xl = metafmt.XLMeta()
+            xl.versions = list(payload)
+            try:
+                return xl.list_versions(bucket, path)
+            except Exception:  # noqa: BLE001 - empty maps
+                return []
+        out = []
+        for i, (flags, mt, size, vid, ddir, etag, ctype, tags) in \
+                enumerate(payload):
+            fi = FileInfo(
+                volume=bucket, name=path,
+                version_id="" if vid == metafmt.NULL_VERSION_ID else vid,
+                is_latest=(i == 0),
+                deleted=bool(flags & FLAG_DELETED), mod_time=mt)
+            meta = {}
+            if etag:
+                meta["etag"] = etag
+            if ctype:
+                meta["content-type"] = ctype
+            if tags:
+                meta["x-amz-tagging"] = tags
+            fi.metadata = meta
+            if not fi.deleted:
+                fi.data_dir = ddir
+                fi.size = size
+                if flags & FLAG_INLINE:
+                    fi.inline_data = b""     # marker: inline, not loaded
+            out.append(fi)
+        return out
 
     def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
                      delimiter: str = "", max_keys: int = 1000,
@@ -2274,34 +2397,55 @@ class ErasureSet:
         of the same prefix, and every follow-up within the reuse window
         consumes ONE background walk — a large bucket walks once, not
         once per page. Writes bump the bucket generation, orphaning the
-        stream (object/metacache.py)."""
+        stream (object/metacache.py). "/"-delimiter pages use a SHALLOW
+        stream (one directory level + subtree markers) so a browse page
+        costs O(page) instead of O(subtree)."""
         import bisect
 
         from minio_tpu.object.types import ListObjectsInfo
-        from minio_tpu.storage.meta import XLMeta
+        from minio_tpu.storage.meta_scan import PREFIX_MARK
 
         self._check_bucket(bucket)
         max_keys = max(1, min(max_keys, 1000))
-        walk = self.metacache.walk_for(self, bucket, prefix)
+        shallow = self._shallow_ok(delimiter)
+        floor = marker if marker > prefix else prefix
+        # A marker strictly INSIDE a collapsed subtree must re-surface
+        # that subtree's common prefix (S3 semantics). The deep stream
+        # does this naturally (later keys re-collapse); the shallow
+        # stream holds ONE entry per subtree, sorted before such a
+        # marker — widen the page scan floor back to it.
+        page_floor, floor_left = marker, False
+        if shallow and marker and marker.startswith(prefix):
+            di = marker[len(prefix):].find("/")
+            if di >= 0:
+                cp = marker[:len(prefix) + di + 1]
+                if cp != marker:
+                    page_floor, floor_left = cp, True
+        walk = self.metacache.walk_for(
+            self, bucket, prefix, shallow=shallow,
+            seek=page_floor if floor_left else marker)
         if walk.truncated and walk.done and walk.keys and \
                 marker >= walk.keys[-1]:
             # Continuing past a capped stream: a start-floored walk
             # (shared by further continuations) keeps pagination
             # moving instead of re-walking into the same cap.
             walk = self.metacache.walk_for(self, bucket, prefix,
-                                           start=marker)
-        floor = marker if marker > prefix else prefix
+                                           start=marker, shallow=shallow)
         need = max_keys + 1
         while True:
             count, done = walk.wait_past(floor, need)
-            keys, maps_list = walk.keys, walk.maps   # append-only; read
+            keys, entries = walk.keys, walk.entries  # append-only; read
             # only indices < count (stable)
             info = ListObjectsInfo()
             seen_prefixes: set[str] = set()
             last_added = ""
             complete = False     # page filled or range exhausted
-            idx = bisect.bisect_right(keys, marker, 0, count) \
-                if marker else 0
+            if not marker:
+                idx = 0
+            elif floor_left:
+                idx = bisect.bisect_left(keys, page_floor, 0, count)
+            else:
+                idx = bisect.bisect_right(keys, marker, 0, count)
             for i in range(idx, count):
                 path = keys[i]
                 if not path.startswith(prefix):
@@ -2332,12 +2476,13 @@ class ErasureSet:
                         seen_prefixes.add(cp)
                         last_added = cp
                         continue
-                xl = XLMeta()
-                xl.versions = list(maps_list[i])
-                try:
-                    fi = xl.to_fileinfo(bucket, path)
-                except Exception:  # noqa: BLE001 - empty maps
+                entry = entries[i]
+                if entry is PREFIX_MARK:
+                    continue     # only reachable with a delimiter set
+                fis = self._entry_fileinfos(bucket, path, entry)
+                if not fis:
                     continue
+                fi = fis[0]
                 if fi.deleted and not include_versions:
                     continue
                 if len(info.objects) + len(seen_prefixes) >= max_keys:
@@ -2346,7 +2491,7 @@ class ErasureSet:
                     complete = True
                     break
                 if include_versions:
-                    for v in xl.list_versions(bucket, path):
+                    for v in fis:
                         info.objects.append(
                             self._to_object_info(bucket, path, v))
                 else:

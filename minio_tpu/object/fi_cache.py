@@ -41,6 +41,16 @@ and the reconstruct path re-reads whatever it needs from the drives
 journal"), so parity blobs in the cache would be m/n resident bytes
 that no hit ever reads.
 
+HEAD traffic gets its own STAT class: a HEAD needs only the
+quorum-agreed fi (no per-drive fis, no inline payloads), so stat
+entries live in a separate, much larger LRU — a HEAD storm over
+hundreds of thousands of keys fills the stat map without evicting a
+single data-class entry the GET fast path depends on, and a stat
+entry costs ~1 KB instead of up to an inline payload. Lookups check
+the stat map first, then fall through to the data map (a data entry
+answers a HEAD for free); inserts from the HEAD path only ever touch
+the stat map.
+
 Environment:
   MTPU_FILEINFO_CACHE        "0"/"off" disables the cache entirely
   MTPU_FILEINFO_CACHE_MAX    max cached keys (default 4096)
@@ -49,6 +59,7 @@ Environment:
                              working set stays resident; at the 128 KiB
                              shard threshold that is ~250 cached
                              inline objects per process)
+  MTPU_FILEINFO_STAT_MAX     max stat-class keys (default 65536)
 """
 
 from __future__ import annotations
@@ -73,7 +84,8 @@ class FileInfoCache:
 
     def __init__(self, max_entries: int | None = None,
                  max_bytes: int | None = None,
-                 enabled: bool | None = None):
+                 enabled: bool | None = None,
+                 max_stat: int | None = None):
         if enabled is None:
             enabled = os.environ.get("MTPU_FILEINFO_CACHE", "").lower() \
                 not in ("0", "off", "false")
@@ -82,8 +94,11 @@ class FileInfoCache:
             else _env_int("MTPU_FILEINFO_CACHE_MAX", 4096)
         self.max_bytes = max_bytes if max_bytes is not None \
             else _env_int("MTPU_FILEINFO_CACHE_BYTES", 256 << 20)
+        self.max_stat = max_stat if max_stat is not None \
+            else _env_int("MTPU_FILEINFO_STAT_MAX", 65536)
         self._mu = threading.Lock()
         self._map: OrderedDict = OrderedDict()   # key -> entry dict
+        self._stat: OrderedDict = OrderedDict()  # key -> quorum fi only
         self._gens: dict[str, int] = {}          # bucket -> invalidation gen
         self._bytes = 0
         # Cross-process invalidation observer (io/workers.SharedGen or
@@ -94,6 +109,9 @@ class FileInfoCache:
         self.misses = 0
         self.evictions = 0
         self.invalidations = 0
+        self.stat_hits = 0
+        self.stat_misses = 0
+        self.stat_evictions = 0
 
     # -- coherence -------------------------------------------------------
 
@@ -124,16 +142,21 @@ class FileInfoCache:
             stale = [k for k in self._map if k[0] == bucket]
             for k in stale:
                 self._drop(k)
-            if stale:
+            sstale = [k for k in self._stat if k[0] == bucket]
+            for k in sstale:
+                self._stat.pop(k, None)
+            if stale or sstale:
                 self.invalidations += 1
 
     def invalidate_all(self) -> None:
         with self._mu:
-            for b in set(self._gens) | {k[0] for k in self._map}:
+            for b in set(self._gens) | {k[0] for k in self._map} \
+                    | {k[0] for k in self._stat}:
                 self._gens[b] = self._gens.get(b, 0) + 1
-            if self._map:
+            if self._map or self._stat:
                 self.invalidations += 1
             self._map.clear()
+            self._stat.clear()
             self._bytes = 0
 
     # -- lookup / insert -------------------------------------------------
@@ -197,6 +220,55 @@ class FileInfoCache:
         if e is not None:
             self._bytes -= e["bytes"]
 
+    # -- stat class (HEAD traffic) ---------------------------------------
+
+    def get_stat(self, bucket: str, object_: str, version_id: str):
+        """Quorum fi for a HEAD, or None. Checks the stat map first,
+        then the data map (either class answers a stat); only the stat
+        counters move, so the two classes' hit rates stay separately
+        observable."""
+        if not self.enabled:
+            return None
+        self.maybe_flush()
+        key = (bucket, object_, version_id)
+        with self._mu:
+            fi = self._stat.get(key)
+            if fi is not None:
+                self._stat.move_to_end(key)
+                self.stat_hits += 1
+                return fi
+            e = self._map.get(key)
+            if e is not None:
+                self.stat_hits += 1
+                return e["fi"]
+            self.stat_misses += 1
+            return None
+
+    def put_stat(self, bucket: str, object_: str, version_id: str,
+                 fi, token: int) -> None:
+        """Insert a HEAD result into the STAT class only — a HEAD storm
+        can never evict data-class entries. Same token protocol as
+        put()."""
+        if not self.enabled or fi is None:
+            return
+        self.maybe_flush()
+        if fi.inline_data:
+            # Defensive: stat entries never carry payload bytes.
+            fi = dataclasses.replace(fi, inline_data=b"")
+        key = (bucket, object_, version_id)
+        with self._mu:
+            if self._gens.get(bucket, 0) != token:
+                return        # a mutation landed during the fan-out
+            self._stat[key] = fi
+            self._stat.move_to_end(key)
+            while len(self._stat) > self.max_stat:
+                # Stat-class trims count separately: the shared
+                # evictions counter is documented as DATA-cache thrash
+                # pressure, and a big HEAD storm trimming stat entries
+                # is healthy, not a thrash signal.
+                self._stat.popitem(last=False)
+                self.stat_evictions += 1
+
     # -- observability ---------------------------------------------------
 
     def stats(self) -> dict:
@@ -211,4 +283,8 @@ class FileInfoCache:
                 "bytes": self._bytes,
                 "evictions": self.evictions,
                 "invalidations": self.invalidations,
+                "stat_hits": self.stat_hits,
+                "stat_misses": self.stat_misses,
+                "stat_entries": len(self._stat),
+                "stat_evictions": self.stat_evictions,
             }

@@ -5,66 +5,113 @@ The analogue of the reference's metacache subsystem
 cmd/metacache-walk.go:73): a listing starts ONE background walk of the
 erasure set — per-drive sorted journal walks, k-way merged, each key
 quorum-resolved — whose sorted entry stream accumulates in memory and
-persists in blocks on the set's first drive. Every page of that
-listing, every concurrent listing of the same prefix, and every
-follow-up listing within the reuse window serves from the SAME stream:
-a 50k-object bucket walks once, not once per page.
+persists on the set's first drive. Every page of that listing, every
+concurrent listing of the same prefix, and every follow-up listing
+within the reuse window serves from the SAME stream: a 50k-object
+bucket walks once, not once per page.
+
+Stream entries are TRIMMED: the common case is a native-scanned
+summary tuple (storage/meta_scan) holding only the fields listings
+need, not a full parsed journal — at 10M objects the difference is
+gigabytes of dict trees. Entry classes:
+
+    ("s", vlist)   trimmed per-version summary tuples
+    ("m", maps)    full version maps (scanner fallback, metadata past
+                   the summary, quorum-resolved disagreements)
+    PREFIX_MARK    shallow (delimiter) walks: a key prefix marker
+
+Persistence (format v2): a completed walk writes fixed-size sorted
+SEGMENTS plus a head carrying a first/last-key index per segment, so a
+continuation page in a fresh process SEEKS to its marker's segment
+instead of re-reading the whole stream, and a truncated walk's
+continuation walk COMPACTS in place onto the base run (appended
+segments + updated index) once it goes idle. A restarted process
+warm-starts from persisted runs inside MTPU_META_PERSIST_TTL (default
+2 s — the same cross-restart staleness contract the bucket-metadata
+cache uses; raising it trades a wider unclean-handoff staleness window
+for more warm starts).
 
 Invalidation is generation-based: any namespace mutation in the bucket
 bumps its generation, orphaning walks started before it (correctness
 first — a cached stream can never serve names from before a change).
 In distributed mode the `on_bump` hook broadcasts the bump to peer
-nodes (grid/peers KIND_LISTING) with leading-edge coalescing, so a
-peer's next listing after a remote write re-walks immediately instead
-of waiting out a TTL. Persisted blocks additionally let a RESTARTED
-process warm its first listing from the previous run's walk when the
-bucket has been quiet (age-bounded — a crash loses only cache, never
-correctness).
+nodes (grid/peers KIND_LISTING) with leading-edge coalescing.
 """
 
 from __future__ import annotations
 
 import bisect
+import json
+import os
 import threading
 import time
 from typing import Callable, Optional
 
-# Entries per persisted block.
-_BLOCK = 4096
+from minio_tpu.storage.meta_scan import PREFIX_MARK
+
+
+def _env_num(key: str, default, cast=float):
+    try:
+        v = cast(os.environ.get(key, "") or default)
+        return v if v > 0 else default
+    except ValueError:
+        return default
+
+
+# Entries per persisted segment (the prefix index is one (first, last,
+# count) triple per segment, so seeks are O(log segments) + one segment
+# read).
+_SEG = _env_num("MTPU_META_SEG_ENTRIES", 4096, int)
 # A completed walk is reusable this long after its last touch; an
 # ACTIVE walk is always reusable (generation still governs validity).
 _IDLE_TTL = 30.0
-# Persisted-walk warm-start window for a fresh process: the same 2 s
-# cross-restart staleness contract the bucket-metadata cache uses.
-_PERSIST_TTL = 2.0
+# Persisted-walk warm-start window for a fresh process.
+_PERSIST_TTL = _env_num("MTPU_META_PERSIST_TTL", 2.0)
 # Per-bucket leading-edge coalescing window for peer bump broadcasts.
 _BUMP_COALESCE = 0.1
-# Cap on in-memory entries per walk (~100 MB worst case); beyond it the
-# walk marks itself truncated and later listings fall back to fresh
-# walks — bounded memory beats completeness here.
-_MAX_ENTRIES = 500_000
+# Cap on in-memory entries per walk; beyond it the walk marks itself
+# truncated and later pages continue via start-floored walks — bounded
+# memory beats completeness here.
+_MAX_ENTRIES = _env_num("MTPU_META_MAX_ENTRIES", 500_000, int)
 
 META_DIR = "listcache"         # under SYS_VOL on the first drive
 SYS_VOL_ = ".mtpu.sys"
+_FMT = 2
+
+
+def _canon_entry(e):
+    """Canonical in-memory form of a (possibly msgpack-round-tripped)
+    stream entry: summaries are tuples-of-tuples, markers are THE
+    module sentinel."""
+    if isinstance(e, (list, tuple)):
+        if len(e) == 1 and e[0] == PREFIX_MARK[0]:
+            return PREFIX_MARK
+        if len(e) == 2 and e[0] == "s":
+            return ("s", tuple(tuple(v) for v in e[1]))
+        if len(e) == 2 and e[0] == "m":
+            return ("m", list(e[1]))
+    return None
 
 
 class WalkStream:
     """One background merged+resolved walk of (bucket, prefix)."""
 
     def __init__(self, bucket: str, prefix: str, gen: int,
-                 start: str = ""):
+                 start: str = "", shallow: bool = False):
         self.bucket = bucket
         self.prefix = prefix
         # Walks normally start at the prefix; a continuation PAST a
         # truncated stream's cap starts at that listing's marker so
         # pagination always progresses.
         self.start_after = start
+        self.shallow = shallow
         self.gen = gen
         self.keys: list[str] = []          # sorted walked keys
-        self.maps: list[list] = []         # per-key resolved version maps
+        self.entries: list = []            # per-key stream entries
         self.done = False
         self.error: Optional[Exception] = None
         self.truncated = False             # hit _MAX_ENTRIES
+        self.persisted_from = 0            # segments skipped by a seek
         self.last_touch = time.monotonic()
         self.cond = threading.Condition()
         self._cancel = threading.Event()
@@ -72,16 +119,17 @@ class WalkStream:
 
     # -- production (walk thread) --------------------------------------
 
-    def start(self, es) -> None:
+    def start(self, es, mc: Optional["MetaCache"] = None) -> None:
         self._thread = threading.Thread(
-            target=self._run, args=(es,), daemon=True,
+            target=self._run, args=(es, mc), daemon=True,
             name=f"metacache-walk-{self.bucket}")
         self._thread.start()
 
-    def _run(self, es) -> None:
+    def _run(self, es, mc) -> None:
         try:
-            for path, maps in es._walk_resolved(
-                    self.bucket, self.prefix, self.start_after):
+            for path, entry in es._walk_resolved(
+                    self.bucket, self.prefix, self.start_after,
+                    shallow=self.shallow):
                 if self._cancel.is_set():
                     # Orphaned by a bump/eviction: stop burning drive
                     # I/O and memory on a stream nobody can read.
@@ -89,13 +137,13 @@ class WalkStream:
                     break
                 with self.cond:
                     self.keys.append(path)
-                    self.maps.append(maps)
+                    self.entries.append(entry)
                     self.cond.notify_all()
                     if len(self.keys) >= _MAX_ENTRIES:
                         self.truncated = True
                         break
-            if not self.truncated:
-                self._persist(es)
+            if not self._cancel.is_set() and not self.shallow:
+                self._persist(es, mc)
         except Exception as e:  # noqa: BLE001 - reported to waiters
             self.error = e
         finally:
@@ -103,53 +151,123 @@ class WalkStream:
                 self.done = True
                 self.cond.notify_all()
 
-    def _persist(self, es) -> None:
-        """Write the completed stream to the first drive in blocks so a
-        restarted process can warm-start (best-effort)."""
-        import json
+    # -- persistence (format v2: segments + prefix index) --------------
 
+    @staticmethod
+    def _dir(bucket: str, prefix: str) -> str:
+        return f"{META_DIR}/{_safe(bucket)}/{_safe(prefix)}"
+
+    def _persist(self, es, mc) -> None:
+        """Write the completed stream to the first drive as fixed-size
+        sorted segments + an indexed head (best-effort). Continuation
+        walks COMPACT onto the base run in place when contiguous;
+        without a base to extend they are not persisted."""
         import msgpack
-        if not es.disks:
+        if not es.disks or not self.keys:
             return
         d = es.disks[0]
-        base = f"{META_DIR}/{_safe(self.bucket)}/{_safe(self.prefix)}"
+        base = self._dir(self.bucket, self.prefix)
         try:
-            for i in range(0, max(len(self.keys), 1), _BLOCK):
+            if self.start_after:
+                self._compact_onto(d, base, mc)
+                return
+            seg_index = []
+            for s, i in enumerate(range(0, len(self.keys), _SEG)):
+                keys = self.keys[i:i + _SEG]
                 blob = msgpack.packb(
-                    list(zip(self.keys[i:i + _BLOCK],
-                             self.maps[i:i + _BLOCK])))
-                d.write_all(SYS_VOL_, f"{base}/blk-{i // _BLOCK:06d}",
-                            blob)
+                    list(zip(keys, self.entries[i:i + _SEG])))
+                d.write_all(SYS_VOL_, f"{base}/seg-{s:06d}", blob)
+                seg_index.append([keys[0], keys[-1], len(keys)])
             d.write_all(SYS_VOL_, f"{base}/head", json.dumps({
+                "v": _FMT,
                 "created_ns": time.time_ns(),
-                "blocks": (len(self.keys) + _BLOCK - 1) // _BLOCK,
-                "count": len(self.keys)}).encode())
+                "count": len(self.keys),
+                "start": "",
+                "truncated": self.truncated,
+                "seg": seg_index}).encode())
         except Exception:  # noqa: BLE001 - cache persistence is optional
             pass
 
-    @classmethod
-    def load_persisted(cls, es, bucket: str, prefix: str,
-                       gen: int) -> Optional["WalkStream"]:
-        """A previous process's completed walk, if fresh enough."""
-        import json
+    def _compact_onto(self, d, base: str, mc) -> None:
+        """Append this continuation stream's entries to the persisted
+        base run (segments + index updated in place; the head rewrite
+        is the commit point — a crash leaves stray seg files that the
+        head's count check ignores)."""
+        import msgpack
+        try:
+            head = json.loads(d.read_all(SYS_VOL_, f"{base}/head"))
+        except Exception:  # noqa: BLE001 - no base run to extend
+            return
+        if head.get("v") != _FMT or not head.get("truncated") or \
+                not head.get("seg"):
+            return
+        last = head["seg"][-1][1]
+        if self.start_after < last:
+            return                      # not contiguous with the base
+        # Boundary dedup: a start-floored walk re-emits its floor key.
+        keys, entries = self.keys, self.entries
+        lo = bisect.bisect_right(keys, last)
+        if lo >= len(keys):
+            return
+        seg_index = list(head["seg"])
+        s = len(seg_index)
+        for i in range(lo, len(keys), _SEG):
+            kseg = keys[i:i + _SEG]
+            blob = msgpack.packb(list(zip(kseg, entries[i:i + _SEG])))
+            d.write_all(SYS_VOL_, f"{base}/seg-{s:06d}", blob)
+            seg_index.append([kseg[0], kseg[-1], len(kseg)])
+            s += 1
+        head.update({
+            "count": head["count"] + len(keys) - lo,
+            "truncated": self.truncated,
+            "seg": seg_index})
+        d.write_all(SYS_VOL_, f"{base}/head", json.dumps(head).encode())
+        if mc is not None:
+            mc.compactions += 1
 
+    @classmethod
+    def load_persisted(cls, es, bucket: str, prefix: str, gen: int,
+                       marker: str = "") -> Optional["WalkStream"]:
+        """A previous process's persisted run, if fresh enough. With a
+        marker, only the segments covering keys past it are read (the
+        seek the prefix index exists for); the loaded stream then
+        starts at the marker like a start-floored walk would."""
         import msgpack
         if not es.disks:
             return None
         d = es.disks[0]
-        base = f"{META_DIR}/{_safe(bucket)}/{_safe(prefix)}"
+        base = cls._dir(bucket, prefix)
         try:
             head = json.loads(d.read_all(SYS_VOL_, f"{base}/head"))
+            if head.get("v") != _FMT:
+                return None
             if time.time_ns() - head["created_ns"] > _PERSIST_TTL * 1e9:
                 return None
-            w = cls(bucket, prefix, gen)
-            for i in range(head["blocks"]):
-                for path, maps in msgpack.unpackb(
-                        d.read_all(SYS_VOL_, f"{base}/blk-{i:06d}")):
+            seg_index = head.get("seg") or []
+            first = 0
+            if marker:
+                # Seek: skip whole segments whose last key <= marker.
+                while first < len(seg_index) and \
+                        seg_index[first][1] <= marker:
+                    first += 1
+                if first >= len(seg_index):
+                    return None     # run ends at/before the marker
+            w = cls(bucket, prefix, gen, start=marker)
+            w.persisted_from = first
+            want = 0
+            for s in range(first, len(seg_index)):
+                want += seg_index[s][2]
+                for path, entry in msgpack.unpackb(
+                        d.read_all(SYS_VOL_, f"{base}/seg-{s:06d}"),
+                        raw=False, strict_map_key=False):
+                    entry = _canon_entry(entry)
+                    if entry is None:
+                        return None
                     w.keys.append(path)
-                    w.maps.append(maps)
-            if len(w.keys) != head["count"]:
+                    w.entries.append(entry)
+            if len(w.keys) != want or want == 0:
                 return None
+            w.truncated = bool(head.get("truncated"))
             w.done = True
             return w
         except Exception:  # noqa: BLE001 - absent / stale / corrupt
@@ -165,9 +283,10 @@ class WalkStream:
     def wait_past(self, key: str, need: int, timeout: float = 60.0):
         """Block until the walk has produced `need` entries strictly
         after `key` (or finished); returns (count, done) — a stable
-        VIEW bound: keys/maps are append-only, so indices below count
-        never change and readers need no copy (a full-list snapshot
-        per page would make pagination of a big walk quadratic)."""
+        VIEW bound: keys/entries are append-only, so indices below
+        count never change and readers need no copy (a full-list
+        snapshot per page would make pagination of a big walk
+        quadratic)."""
         deadline = time.monotonic() + timeout
         with self.cond:
             while True:
@@ -194,9 +313,12 @@ class MetaCache:
     def __init__(self):
         self._mu = threading.Lock()
         self._gen: dict[str, int] = {}            # bucket -> generation
-        self._walks: dict[tuple, WalkStream] = {}  # (bucket,prefix) -> walk
+        self._walks: dict[tuple, WalkStream] = {}  # key -> walk
         self.hits = 0
         self.misses = 0
+        self.persisted_loads = 0
+        self.compactions = 0
+        self.walks_started = 0
         # Distributed boot installs a broadcaster(bucket) here; bumps
         # fan out to peers with leading-edge coalescing.
         self.on_bump: Optional[Callable] = None
@@ -212,6 +334,20 @@ class MetaCache:
     def generation(self, bucket: str) -> int:
         with self._mu:
             return self._gen.get(bucket, 0)
+
+    def walks_active(self) -> int:
+        with self._mu:
+            return sum(1 for w in self._walks.values() if not w.done)
+
+    def stats(self) -> dict:
+        with self._mu:
+            active = sum(1 for w in self._walks.values() if not w.done)
+            walks = len(self._walks)
+        return {"hits": self.hits, "misses": self.misses,
+                "walks_active": active, "walks_cached": walks,
+                "walks_started": self.walks_started,
+                "persisted_loads": self.persisted_loads,
+                "compactions": self.compactions}
 
     def bump(self, bucket: str, broadcast: bool = True) -> None:
         """Any namespace mutation in the bucket orphans its walks."""
@@ -278,14 +414,21 @@ class MetaCache:
                     w.cancel()
 
     def walk_for(self, es, bucket: str, prefix: str,
-                 start: str = "") -> WalkStream:
+                 start: str = "", shallow: bool = False,
+                 seek: str = "") -> WalkStream:
         """Find-or-start the shared walk of (bucket, prefix) at the
         current generation; concurrent and follow-up listings share it
         (reference: cmd/metacache-set.go lookup before starting a new
-        listing)."""
+        listing).
+
+        `seek` is the requesting page's scan floor: on a miss it (a)
+        re-uses any COMPLETED stream floored at or below it that still
+        covers it, and (b) lets a fresh process's deep continuation
+        page load only the persisted segments past it instead of the
+        whole run."""
         with self._mu:
             gen = self._gen.get(bucket, 0)
-            key = (bucket, prefix, start)
+            key = (bucket, prefix, start, shallow)
             w = self._walks.get(key)
             now = time.monotonic()
             cancelled = w is not None and w._cancel.is_set()
@@ -299,15 +442,43 @@ class MetaCache:
                 # buckets re-walking into the same cap forever).
                 self.hits += 1
                 return w
+            if seek and not start:
+                # Coverage scan: a done stream floored at/below the
+                # page (e.g. an earlier seek-load) serves it directly.
+                best = None
+                for (b2, p2, _, sh2), cand in self._walks.items():
+                    if b2 != bucket or p2 != prefix or sh2 != shallow:
+                        continue
+                    if cand.gen != gen or cand.error is not None or \
+                            cand._cancel.is_set() or not cand.done or \
+                            now - cand.last_touch >= _IDLE_TTL:
+                        continue
+                    if cand.start_after <= seek and \
+                            (not cand.truncated
+                             or (cand.keys and cand.keys[-1] > seek)) \
+                            and (best is None
+                                 or cand.start_after > best.start_after):
+                        best = cand
+                if best is not None:
+                    self.hits += 1
+                    best.last_touch = now
+                    return best
             self.misses += 1
             w = None
-            if gen == 0 and not start:
-                # Quiet bucket, fresh process: a recent persisted walk
-                # warm-starts the first listing.
-                w = WalkStream.load_persisted(es, bucket, prefix, gen)
+            if gen == 0 and not shallow:
+                # Quiet bucket, fresh process: a recent persisted run
+                # warm-starts the first listing — and SEEKS to the
+                # page's segment for deep/continuation pages.
+                w = WalkStream.load_persisted(es, bucket, prefix, gen,
+                                              marker=start or seek)
+                if w is not None:
+                    self.persisted_loads += 1
+                    key = (bucket, prefix, w.start_after, shallow)
             if w is None:
-                w = WalkStream(bucket, prefix, gen, start=start)
-                w.start(es)
+                w = WalkStream(bucket, prefix, gen, start=start,
+                               shallow=shallow)
+                self.walks_started += 1
+                w.start(es, self)
             self._walks[key] = w
             while len(self._walks) > self.MAX_WALKS:
                 oldest = min(self._walks,

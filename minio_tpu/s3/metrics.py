@@ -279,18 +279,41 @@ class Metrics:
                    "Raw free capacity across online drives", "gauge",
                    [({}, free_cap)])
             # Metacache effectiveness across the layer's sets.
-            hits = misses = 0
+            mcs = {"hits": 0, "misses": 0, "walks_active": 0,
+                   "walks_started": 0, "persisted_loads": 0,
+                   "compactions": 0}
             for s in layer_sets(object_layer):
                 mc = getattr(s, "metacache", None)
                 if mc is not None:
-                    hits += mc.hits
-                    misses += mc.misses
+                    st = mc.stats()
+                    for key in mcs:
+                        mcs[key] += st[key]
             metric("minio_tpu_metacache_hits_total",
                    "Listing pages served from cache", "counter",
-                   [({}, hits)])
+                   [({}, mcs["hits"])])
             metric("minio_tpu_metacache_misses_total",
                    "Listing pages that required a drive walk", "counter",
-                   [({}, misses)])
+                   [({}, mcs["misses"])])
+            metric("minio_tpu_metacache_walks_active",
+                   "Background listing walks currently producing",
+                   "gauge", [({}, mcs["walks_active"])])
+            metric("minio_tpu_metacache_walks_started_total",
+                   "Background listing walks started", "counter",
+                   [({}, mcs["walks_started"])])
+            metric("minio_tpu_metacache_persisted_loads_total",
+                   "Listings warm-started from persisted walk segments",
+                   "counter", [({}, mcs["persisted_loads"])])
+            metric("minio_tpu_metacache_compactions_total",
+                   "Continuation walks compacted onto persisted base "
+                   "runs", "counter", [({}, mcs["compactions"])])
+            # Native journal-scan split: fallbacks are blobs the native
+            # scanner handed back to the Python parser.
+            from minio_tpu.storage import meta_scan as _ms
+            metric("minio_tpu_meta_scan_blobs_total",
+                   "xl.meta journals decoded by the listing walk, by "
+                   "path", "counter",
+                   [({"path": p}, _ms.counters[p])
+                    for p in ("native", "fallback")])
             # MRF queue health: drops must be VISIBLE — a heal that
             # silently vanishes is a future quorum loss (s._mrf, not
             # s.mrf: rendering metrics must not start a worker).
@@ -643,7 +666,9 @@ class Metrics:
         # kernel split says whether reads ride the native fast path.
         if object_layer is not None:
             fic = {"hits": 0, "misses": 0, "evictions": 0,
-                   "invalidations": 0, "entries": 0, "bytes": 0}
+                   "invalidations": 0, "entries": 0, "bytes": 0,
+                   "stat_hits": 0, "stat_misses": 0, "stat_entries": 0,
+                   "stat_evictions": 0}
             gk = {"native": 0, "numpy": 0, "demoted": 0}
             for s in layer_sets(object_layer):
                 cache = getattr(s, "fi_cache", None)
@@ -670,7 +695,20 @@ class Metrics:
                      "Keys currently cached", "gauge", "entries"),
                     ("minio_tpu_fileinfo_cache_bytes",
                      "Resident inline bytes held by cached fileinfo",
-                     "gauge", "bytes")):
+                     "gauge", "bytes"),
+                    ("minio_tpu_fileinfo_cache_stat_hits_total",
+                     "HEADs served from the stat class (or a data "
+                     "entry)", "counter", "stat_hits"),
+                    ("minio_tpu_fileinfo_cache_stat_misses_total",
+                     "HEADs that paid the drive fan-out", "counter",
+                     "stat_misses"),
+                    ("minio_tpu_fileinfo_cache_stat_entries",
+                     "Stat-class keys currently cached", "gauge",
+                     "stat_entries"),
+                    ("minio_tpu_fileinfo_cache_stat_evictions_total",
+                     "Stat-class entries LRU-trimmed (healthy under "
+                     "HEAD storms — distinct from data-class thrash)",
+                     "counter", "stat_evictions")):
                 metric(name, help_, type_, [({}, fic[key])])
             metric("minio_tpu_get_kernel_windows_total",
                    "GET windows decoded, by path",
@@ -797,6 +835,7 @@ def node_info(server) -> dict:
     info["bufpool"] = global_pool().stats()
     engine = []
     fileinfo = []
+    metacache = []
     get_kernel = {"native": 0, "numpy": 0, "demoted": 0}
     for si, s in enumerate(sets):
         eng = getattr(s, "io", None)
@@ -805,10 +844,15 @@ def node_info(server) -> dict:
         cache = getattr(s, "fi_cache", None)
         if cache is not None:
             fileinfo.append({"set": si, **cache.stats()})
+        mc = getattr(s, "metacache", None)
+        if mc is not None:
+            metacache.append({"set": si, **mc.stats()})
         for key in get_kernel:
             get_kernel[key] += getattr(s, "get_kernel", {}).get(key, 0)
     info["io_engine"] = engine
     info["fileinfo_cache"] = fileinfo
+    from minio_tpu.storage import meta_scan as _ms
+    info["metacache"] = {"sets": metacache, "scan": dict(_ms.counters)}
     info["get_kernel"] = get_kernel
     cluster = getattr(server, "cluster_stats", None)
     if cluster is not None:

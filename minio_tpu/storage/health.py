@@ -44,7 +44,7 @@ _DOMAIN_ERRORS = (FileNotFoundErr, VersionNotFoundErr, MetaError,
 _BULK_OPS = {"create_file", "read_file", "rename_data"}
 # Ops returning lazy iterators: each next() must go through the
 # deadline/breaker machinery, not just the (instant) generator creation.
-_GENERATOR_OPS = {"walk_dir"}
+_GENERATOR_OPS = {"walk_dir", "walk_scan"}
 
 
 class _DaemonPool:

@@ -777,6 +777,166 @@ class LocalStorage:
         yield from walk(base_dir, base_is_obj)
 
     # ------------------------------------------------------------------
+    # scanning walk (metadata plane: batched native journal decode)
+    # ------------------------------------------------------------------
+
+    def walk_scan(self, volume: str, base_dir: str = "",
+                  forward_from: str = "", shallow: bool = False):
+        """The listing walk's per-drive primitive: like walk_dir, but
+        journals are read in pooled-lease batches and decoded by ONE
+        GIL-free native scan per batch (storage/meta_scan) instead of
+        one msgpack unpack per object. Yields, in global key order:
+
+            (path, vlist, None)   summarized object (trimmed entry)
+            (path, vlist, blob)   summarized, but a version's metadata
+                                  exceeds the summary — blob rides
+                                  along for full-fidelity resolution
+            (path, None, blob)    scanner rejected the journal; the
+                                  caller runs the XLMeta.load path
+            (path + "/", PREFIX_MARK, None)   shallow mode only: a key
+                                  prefix with evidence of keys below it
+
+        `shallow=True` walks ONE directory level under base_dir and
+        emits subtree markers instead of descending — the delimiter
+        ("/") listing shape: a browse page costs O(page), not
+        O(subtree). Marker evidence is one probe scandir per child
+        subtree (first grandchild with a journal or a directory), so a
+        directory chain holding no keys at all may surface a transient
+        empty prefix — dirs are pruned on delete, and the reference's
+        non-recursive WalkDir accepts the same ambiguity.
+
+        Unlike walk_dir, this walk never parses journals to classify
+        data dirs: it descends everywhere, and a version data dir
+        (part files only, never a journal or a subdirectory) simply
+        yields nothing. Nested keys shadowed by a same-named data dir
+        are therefore listed here — strictly more visible, never less.
+        """
+        vol = self._vol_dir(volume)
+        if not os.path.isdir(vol):
+            raise VolumeNotFound(volume)
+        from minio_tpu.storage.meta_scan import BlobScanner
+        scanner = BlobScanner()
+        try:
+            if shallow:
+                yield from self._walk_shallow(vol, base_dir, forward_from)
+                return
+
+            def rec(rel):
+                full = os.path.join(vol, rel) if rel else vol
+                try:
+                    with os.scandir(full) as it:
+                        dirs = sorted(
+                            e.name for e in it
+                            if e.is_dir(follow_symlinks=False))
+                except (FileNotFoundError, NotADirectoryError):
+                    return
+                events = []
+                for n in dirs:
+                    events.append((n, n, True))
+                    events.append((n + "/", n, False))
+                events.sort()
+                for _, n, obj_slot in events:
+                    child = f"{rel}/{n}" if rel else n
+                    if obj_slot:
+                        if not (child >= forward_from
+                                or forward_from.startswith(child)):
+                            continue
+                        try:
+                            fd = os.open(os.path.join(full, n, META_FILE),
+                                         os.O_RDONLY)
+                        except OSError:
+                            continue    # not an object (or vanished)
+                        try:
+                            if scanner.full():
+                                yield from scanner.flush()
+                            scanner.add(child, fd)
+                        finally:
+                            os.close(fd)
+                    else:
+                        subtree = child + "/"
+                        if subtree < forward_from and \
+                                not forward_from.startswith(subtree):
+                            continue
+                        yield from rec(child)
+
+            yield from rec(base_dir)
+            yield from scanner.flush()
+        finally:
+            scanner.close()
+
+    def _walk_shallow(self, vol: str, base_dir: str, forward_from: str):
+        """One level under base_dir: objects at this level plus subtree
+        markers (see walk_scan). Unbatched — shallow pages are small
+        and each child's journal feeds both its entry and its marker
+        decision."""
+        from minio_tpu.storage.meta_scan import (PREFIX_MARK, scan_blob,
+                                                 summary_sufficient)
+        full = os.path.join(vol, base_dir) if base_dir else vol
+        try:
+            with os.scandir(full) as it:
+                dirs = sorted(e.name for e in it
+                              if e.is_dir(follow_symlinks=False))
+        except (FileNotFoundError, NotADirectoryError):
+            return
+        events = []
+        for n in dirs:
+            events.append((n, n, True))
+            events.append((n + "/", n, False))
+        events.sort()
+        probes: dict[str, list] = {}    # child -> its subdir names
+
+        def probe(n: str) -> list:
+            if n in probes:
+                return probes.pop(n)
+            try:
+                with os.scandir(os.path.join(full, n)) as it:
+                    sub = sorted(e.name for e in it
+                                 if e.is_dir(follow_symlinks=False))
+            except OSError:
+                sub = []
+            return sub
+
+        def has_keys_below(n: str, subdirs: list) -> bool:
+            # Evidence probe: a grandchild holding a journal (a key) or
+            # any directory (a deeper tree). Stops at first evidence.
+            for s in subdirs:
+                try:
+                    with os.scandir(os.path.join(full, n, s)) as it:
+                        for e in it:
+                            if e.name == META_FILE or \
+                                    e.is_dir(follow_symlinks=False):
+                                return True
+                except OSError:
+                    continue
+            return False
+
+        for _, n, obj_slot in events:
+            child = f"{base_dir}/{n}" if base_dir else n
+            if obj_slot:
+                if not (child >= forward_from
+                        or forward_from.startswith(child)):
+                    continue
+                sub = probe(n)
+                if len(probes) < 128:
+                    probes[n] = sub
+                try:
+                    with open(os.path.join(full, n, META_FILE),
+                              "rb") as f:
+                        blob = f.read()
+                except OSError:
+                    continue
+                vlist = scan_blob(blob)
+                need_blob = vlist is None or not summary_sufficient(vlist)
+                yield child, vlist, (blob if need_blob else None)
+            else:
+                subtree = child + "/"
+                if subtree < forward_from and \
+                        not forward_from.startswith(subtree):
+                    continue
+                if has_keys_below(n, probe(n)):
+                    yield subtree, PREFIX_MARK, None
+
+    # ------------------------------------------------------------------
     # health / usage
     # ------------------------------------------------------------------
 

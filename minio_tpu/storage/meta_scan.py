@@ -1,0 +1,309 @@
+"""Batched xl.meta journal scanning for the listing walk.
+
+The metadata plane's hot loop is "read a few hundred bytes of journal,
+extract the handful of fields the walk needs" repeated per object per
+walked drive. Doing that with `msgpack.unpackb` + a full `XLMeta` build
+costs a Python dict tree and a FileInfo per key; at 10M objects the
+interpreter time dwarfs the field extraction. `native/native.cc
+mtpu_meta_scan` does the extraction GIL-free over a BATCH of blobs
+packed into one pooled buffer; this module owns the batching, the
+summary format, and the per-blob fallback to the Python parser for
+anything the scanner rejects (counted — watch
+minio_tpu_meta_scan_fallback_blobs_total).
+
+Summary format (the walk stream's trimmed entry payload): a tuple of
+per-version 8-tuples, latest first, exactly as stored in the journal:
+
+    (flags, mod_time, size, version_id, data_dir, etag, content_type,
+     tags)
+
+flags: 1 = delete marker, 2 = inline, 4 = meta-extra (the version's
+metadata carries keys beyond etag/content-type/x-amz-tagging, so the
+summary cannot rebuild listing metadata by itself — resolution must use
+the full journal for this key). Versioned journals longer than
+MTPU_META_SCAN_MAXV (default 8) versions are not summarized at all;
+they take the full-fidelity path.
+
+A summary is byte-derived only: whichever side produced it (native scan
+or `summarize_xl` over a Python-parsed journal), the same blob yields
+the same tuple — golden-tested both ways in tests/test_meta_scan.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+from minio_tpu.storage.meta import XLMeta
+
+FLAG_DELETED = 1
+FLAG_INLINE = 2
+FLAG_EXTRA = 4
+
+# Shallow-walk subtree marker (walk_scan(shallow=True) yields it in
+# place of a summary for a key prefix with evidence of keys below).
+PREFIX_MARK = ("__prefix__",)
+
+_CAPTURED_META = ("etag", "content-type", "x-amz-tagging")
+
+
+def _env_int(key: str, default: int) -> int:
+    try:
+        v = int(os.environ.get(key, "") or default)
+        return v if v > 0 else default
+    except ValueError:
+        return default
+
+
+MAXV = _env_int("MTPU_META_SCAN_MAXV", 8)
+_VSTRIDE = 13
+_STRIDE = 2 + _VSTRIDE * MAXV
+
+# Module counters (GIL-atomic +=; aggregated into Prometheus/admin by
+# s3/metrics.py): blobs summarized natively vs blobs that took the
+# Python parser (scanner rejection, oversized journals, or no native
+# lib at all).
+counters = {"native": 0, "fallback": 0}
+
+_NATIVE_OFF = os.environ.get("MTPU_META_SCAN", "").lower() in (
+    "0", "off", "false")
+
+
+def _lib():
+    if _NATIVE_OFF:
+        return None
+    from minio_tpu import native
+    return native.load()
+
+
+def summarize_xl(xl: XLMeta, maxv: int = MAXV) -> Optional[tuple]:
+    """Summary tuple from a parsed journal — the Python mirror of the
+    native scanner, field-identical by construction. None = this
+    journal is not summarizable (same cases the native scanner
+    rejects: over maxv versions, unknown kinds, missing core fields),
+    so both paths classify every blob identically."""
+    if len(xl.versions) > maxv:
+        return None
+    out = []
+    for v in xl.versions:
+        kind = v.get("kind")
+        if kind not in (1, 2):
+            return None
+        vid, mt = v.get("vid"), v.get("mt")
+        if not isinstance(vid, str) or not isinstance(mt, int):
+            return None
+        flags = FLAG_DELETED if kind == 2 else 0
+        if v.get("inline"):
+            flags |= FLAG_INLINE
+        meta = v.get("meta") or {}
+        cap = {}
+        for k, val in meta.items():
+            if k in _CAPTURED_META and isinstance(val, str):
+                cap[k] = val
+            else:
+                flags |= FLAG_EXTRA
+        out.append((flags, mt, v.get("size", 0) or 0, vid,
+                    v.get("ddir", "") or "", cap.get("etag", ""),
+                    cap.get("content-type", ""),
+                    cap.get("x-amz-tagging", "")))
+    return tuple(out)
+
+
+def summary_sufficient(vlist: tuple) -> bool:
+    """True when the trimmed summary alone can serve listings for this
+    key (no version needs the full journal's metadata)."""
+    return all(not (v[0] & FLAG_EXTRA) for v in vlist)
+
+
+def summary_data_dirs(vlist: tuple) -> frozenset:
+    return frozenset(v[4] for v in vlist if v[4])
+
+
+class BlobScanner:
+    """Accumulates xl.meta blobs into one pooled lease and scans them
+    in a single native call per batch.
+
+    add(path, fd) reads the (already open) journal straight into the
+    pooled buffer — no intermediate bytes object in the common case.
+    flush() returns [(path, vlist_or_None, blob_or_None)] in add()
+    order: vlist None means the scanner rejected the blob and `blob`
+    carries its bytes for the XLMeta.load path; a vlist with any
+    meta-extra flag also carries `blob` so resolution can re-read full
+    fidelity without another drive round trip.
+    """
+
+    # A journal larger than this skips the pooled buffer entirely
+    # (giant inline payloads / pathological version counts go straight
+    # to the fallback path with their own bytes).
+    MAX_POOLED = 256 << 10
+
+    def __init__(self, maxv: int = MAXV, max_items: int = 64,
+                 buf_bytes: int = 1 << 20):
+        self.maxv = maxv
+        self.max_items = max_items
+        self.buf_bytes = buf_bytes
+        self._lease = None
+        self._view = None
+        self._fill = 0
+        self._items: list = []      # (path, off, end) or (path, None, blob)
+        self._lib = _lib()
+
+    # -- feeding -----------------------------------------------------------
+
+    def _ensure_lease(self):
+        if self._lease is None:
+            from minio_tpu.io.bufpool import global_pool
+            self._lease = global_pool().lease(self.buf_bytes)
+            self._view = memoryview(self._lease.raw)
+            self._fill = 0
+
+    def room(self) -> int:
+        size = len(self._view) if self._view is not None else self.buf_bytes
+        return size - self._fill
+
+    def full(self) -> bool:
+        return len(self._items) >= self.max_items or \
+            (self._lease is not None and self.room() < self.MAX_POOLED)
+
+    def add(self, path: str, fd: int) -> None:
+        """Read fd's full content into the batch (caller closes fd)."""
+        self._ensure_lease()
+        space = self.room()
+        n = os.preadv(fd, [self._view[self._fill:]], 0)
+        if n < 0:
+            raise OSError("preadv failed")
+        if n == space:
+            # Blob may exceed the remaining buffer: slow-path re-read.
+            blob = bytearray(self._view[self._fill:self._fill + n])
+            while True:
+                chunk = os.pread(fd, 1 << 20, len(blob))
+                if not chunk:
+                    break
+                blob += chunk
+            self._items.append((path, None, bytes(blob)))
+            return
+        self._items.append((path, self._fill, self._fill + n))
+        self._fill += n
+
+    def add_bytes(self, path: str, blob: bytes) -> None:
+        self._items.append((path, None, bytes(blob)))
+
+    # -- scanning ----------------------------------------------------------
+
+    def _fallback(self, path: str, blob: bytes):
+        counters["fallback"] += 1
+        try:
+            xl = XLMeta.load(blob)
+        except Exception:  # noqa: BLE001 - unreadable copy
+            return (path, None, blob)
+        vlist = summarize_xl(xl, self.maxv)
+        if vlist is None:
+            return (path, None, blob)
+        return (path, vlist, blob if not summary_sufficient(vlist)
+                else None)
+
+    def flush(self) -> list:
+        if not self._items:
+            return []
+        items, self._items = self._items, []
+        out: list = []
+        lib = self._lib
+        pooled = [(i, it) for i, it in enumerate(items)
+                  if it[1] is not None]
+        results: dict[int, tuple] = {}
+        if pooled and lib is not None:
+            import numpy as np
+            nb = len(pooled)
+            # Boundary layout for the C call is [o0, o1, ..., on]: blob
+            # i is buf[offs[i]:offs[i+1]] — pooled blobs are contiguous
+            # in add() order, so boundaries are just the fills.
+            bounds = (ctypes.c_int64 * (nb + 1))()
+            for j, (_, it) in enumerate(pooled):
+                bounds[j] = it[1]
+            bounds[nb] = pooled[-1][1][2]
+            rec = (ctypes.c_int64 * (_STRIDE * nb))()
+            buf = np.frombuffer(self._view, dtype=np.uint8,
+                                count=self._fill)
+            lib.mtpu_meta_scan(
+                buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                bounds, nb, self.maxv, rec)
+            arr = list(rec)
+            mv = self._view
+            for j, (i, (path, off, end)) in enumerate(pooled):
+                base = j * _STRIDE
+                status, nver = arr[base], arr[base + 1]
+                if status != 0:
+                    results[i] = self._fallback(path, bytes(mv[off:end]))
+                    continue
+                counters["native"] += 1
+                vlist = []
+                suff = True
+                for v in range(nver):
+                    o = base + 2 + _VSTRIDE * v
+                    flags = arr[o]
+                    if flags & FLAG_EXTRA:
+                        suff = False
+
+                    def s(slot):
+                        a, ln = arr[o + slot], arr[o + slot + 1]
+                        return mv[a:a + ln].tobytes().decode(
+                            "utf-8", "surrogateescape") if ln else ""
+                    vlist.append((flags, arr[o + 1], arr[o + 2],
+                                  s(3), s(5), s(7), s(9), s(11)))
+                results[i] = (path, tuple(vlist),
+                              None if suff else bytes(mv[off:end]))
+        elif pooled:
+            for i, (path, off, end) in pooled:
+                results[i] = self._fallback(
+                    path, bytes(self._view[off:end]))
+        for i, it in enumerate(items):
+            if it[1] is None:
+                out.append(self._fallback(it[0], it[2]))
+            else:
+                out.append(results[i])
+        self._fill = 0
+        return out
+
+    def close(self) -> None:
+        self._items = []
+        self._view = None
+        if self._lease is not None:
+            self._lease.release()
+            self._lease = None
+
+
+def scan_blob(blob: bytes, maxv: int = MAXV) -> Optional[tuple]:
+    """Single-blob summary (shallow listing walks, tests): native when
+    available, Python mirror otherwise; None when not summarizable."""
+    lib = _lib()
+    if lib is not None:
+        nb = 1
+        bounds = (ctypes.c_int64 * 2)(0, len(blob))
+        rec = (ctypes.c_int64 * _STRIDE)()
+        cbuf = (ctypes.c_uint8 * len(blob)).from_buffer_copy(blob)
+        if lib.mtpu_meta_scan(
+                ctypes.cast(cbuf, ctypes.POINTER(ctypes.c_uint8)),
+                bounds, nb, maxv, rec) == 1:
+            counters["native"] += 1
+            vlist = []
+            for v in range(rec[1]):
+                o = 2 + _VSTRIDE * v
+
+                def s(slot):
+                    a, ln = rec[o + slot], rec[o + slot + 1]
+                    return bytes(cbuf[a:a + ln]).decode(
+                        "utf-8", "surrogateescape") if ln else ""
+                vlist.append((rec[o], rec[o + 1], rec[o + 2],
+                              s(3), s(5), s(7), s(9), s(11)))
+            return tuple(vlist)
+        counters["fallback"] += 1
+        try:
+            return summarize_xl(XLMeta.load(blob), maxv)
+        except Exception:  # noqa: BLE001 - unreadable blob
+            return None
+    counters["fallback"] += 1
+    try:
+        return summarize_xl(XLMeta.load(blob), maxv)
+    except Exception:  # noqa: BLE001 - unreadable blob
+        return None

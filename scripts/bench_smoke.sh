@@ -18,7 +18,7 @@ fi
 
 echo "== bench smoke (baseline: $latest) =="
 out=$(JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} \
-      MTPU_BENCH_ONLY=put_latency,put_concurrent,get_concurrent \
+      MTPU_BENCH_ONLY=put_latency,put_concurrent,get_concurrent,meta_listing \
       MTPU_BENCH_SMALL=1 \
       python bench.py)
 echo "$out"
@@ -42,12 +42,20 @@ import sys
 # far more stable than either column). A regression here means the
 # serve hot loop (native framer, keep-alive path, zero-copy writes)
 # got slower relative to the object layer it fronts.
+# The meta_listing gates ("lower") watch the metadata plane: cold-walk
+# first-page LIST p50, and the HEAD cold (drive fan-out) p50 — the
+# repeat/hot p50 is a few microseconds of dict hit and would gate on
+# rounding noise. On hosts where the fixture cannot build (no /dev/shm
+# capacity) the bench emits the metrics with value null and the gates
+# skip cleanly.
 GATES = [
     ("put_concurrent_aggregate_gibps", "host_gibps", "higher"),
     ("put_concurrent_aggregate_gibps", "served_ratio", "higher"),
     ("get_concurrent_aggregate_gibps", "object_layer_gibps", "higher"),
     ("get_concurrent_aggregate_gibps", "served_ratio", "higher"),
     ("put_object_p50_ec4_1mib_ms", "value", "lower"),
+    ("meta_listing_list_cold_p50_ms", "value", "lower"),
+    ("meta_listing_head_p50_ms", "cold_p50_ms", "lower"),
 ]
 
 

@@ -1,0 +1,353 @@
+"""Metadata plane: native batched xl.meta scan, trimmed walk entries,
+shallow delimiter walks, and the fileinfo cache's stat class.
+
+The load-bearing guarantee: every listing surface is FIELD-IDENTICAL
+with the native scanner on and off — the scanner is an accelerator, not
+a second source of truth. Journals the scanner rejects must flow
+through the Python parser and land in the fallback counter, never
+change results.
+"""
+
+import os
+import random
+
+import pytest
+
+from minio_tpu.object.erasure_object import ErasureSet
+from minio_tpu.object.types import DeleteOptions, PutOptions
+from minio_tpu.storage import meta_scan
+from minio_tpu.storage.local import LocalStorage
+from minio_tpu.storage.meta import (ErasureInfo, FileInfo, ObjectPartInfo,
+                                    XLMeta)
+
+RND = random.Random(1234)
+
+
+def _fi(name, vid="", deleted=False, meta=None, inline=None, ddir="",
+        mt=None):
+    fi = FileInfo(
+        volume="b", name=name, version_id=vid, deleted=deleted,
+        data_dir=ddir, mod_time=mt or RND.randrange(1, 1 << 62),
+        size=RND.randrange(0, 1 << 40),
+        metadata=meta if meta is not None else
+        {"etag": "e" * 32, "content-type": "text/plain"},
+        inline_data=inline)
+    if not deleted:
+        fi.parts = [ObjectPartInfo(number=1, size=fi.size,
+                                   actual_size=fi.size, etag="p" * 8)]
+        fi.erasure = ErasureInfo(data_blocks=2, parity_blocks=1,
+                                 block_size=1 << 20, index=1,
+                                 distribution=(1, 2, 3))
+    return fi
+
+
+def _corpus():
+    """(name, blob) journals covering every scanner decision path."""
+    out = []
+    x = XLMeta()
+    x.add_version(_fi("a", inline=b"xyz"))
+    out.append(("single-inline", x.dump()))
+
+    x = XLMeta()
+    x.add_version(_fi("a", ddir="11111111-1111-4111-8111-111111111111"))
+    out.append(("single-ddir", x.dump()))
+
+    x = XLMeta()
+    x.add_version(_fi("a", vid="22222222-2222-4222-8222-222222222222",
+                      mt=5))
+    x.add_version(_fi("a", vid="33333333-3333-4333-8333-333333333333",
+                      deleted=True, mt=9, meta={}))
+    out.append(("delete-marker-latest", x.dump()))
+
+    x = XLMeta()
+    x.add_version(_fi("a", meta={"etag": "e", "x-amz-meta-user": "v",
+                                 "content-type": "x"}))
+    out.append(("user-meta", x.dump()))
+
+    x = XLMeta()
+    x.add_version(_fi("a", meta={"etag": "e",
+                                 "x-internal-sse-size": "123"}))
+    out.append(("internal-meta", x.dump()))
+
+    x = XLMeta()
+    x.add_version(_fi("日本/キー", vid="null",
+                      meta={"etag": "é" * 40,
+                            "x-amz-tagging": "k=v&a=b"}))
+    out.append(("unicode", x.dump()))
+
+    x = XLMeta()
+    for v in range(5):
+        x.add_version(_fi("a", vid=f"{v:08d}-0000-4000-8000-"
+                                   "000000000000", mt=100 + v))
+    out.append(("five-versions", x.dump()))
+
+    x = XLMeta()
+    for v in range(meta_scan.MAXV + 1):
+        x.add_version(_fi("a", vid=f"{v:08d}-0000-4000-8000-"
+                                   "000000000001", mt=200 + v))
+    out.append(("over-maxv", x.dump()))
+
+    x = XLMeta()
+    x.add_version(_fi("a", meta={}, mt=1))
+    out.append(("empty-meta", x.dump()))
+
+    out.append(("bad-magic", b"NOPE" + b"\x00" * 16))
+    out.append(("truncated", XLMeta().dump()[:-1] if XLMeta().dump()
+                else b"XTP1"))
+    out.append(("torn", b"XTP1\x81\xa8versions\xc1"))
+    return out
+
+
+def test_native_scan_matches_python_mirror():
+    """scan_blob (native when built) and summarize_xl (pure Python)
+    classify and summarize every corpus blob identically."""
+    for name, blob in _corpus():
+        got = meta_scan.scan_blob(blob)
+        try:
+            ref = meta_scan.summarize_xl(XLMeta.load(blob))
+        except Exception:  # noqa: BLE001 - unreadable blob
+            ref = None
+        assert got == ref, (name, got, ref)
+
+
+def test_native_scan_fuzz_random_journals():
+    rnd = random.Random(99)
+    for trial in range(60):
+        x = XLMeta()
+        for v in range(rnd.randrange(1, 6)):
+            meta = {"etag": "%032x" % rnd.getrandbits(128)}
+            if rnd.random() < 0.4:
+                meta["content-type"] = "application/x-" + str(trial)
+            if rnd.random() < 0.3:
+                meta["x-amz-tagging"] = "a=b"
+            if rnd.random() < 0.25:
+                meta["x-amz-meta-k"] = "v" * rnd.randrange(1, 50)
+            x.add_version(_fi(
+                f"k{trial}", deleted=rnd.random() < 0.2,
+                vid=f"{v:08d}-{trial:04d}-4000-8000-000000000000",
+                meta=meta,
+                inline=b"d" if rnd.random() < 0.5 else None,
+                ddir="" if rnd.random() < 0.5 else
+                "44444444-4444-4444-8444-444444444444"))
+        blob = x.dump()
+        assert meta_scan.scan_blob(blob) == \
+            meta_scan.summarize_xl(XLMeta.load(blob)), trial
+
+
+def test_scan_counters_move():
+    before_n = meta_scan.counters["native"]
+    before_f = meta_scan.counters["fallback"]
+    good = _corpus()[0][1]
+    meta_scan.scan_blob(good)
+    meta_scan.scan_blob(b"XTP1\x81\xa8versions\xc1")
+    moved = (meta_scan.counters["native"] - before_n) + \
+        (meta_scan.counters["fallback"] - before_f)
+    assert moved >= 2
+    assert meta_scan.counters["fallback"] > before_f
+
+
+def test_blob_scanner_batch_order_and_blob_policy(tmp_path):
+    """BlobScanner returns results in add() order; rejected blobs and
+    insufficient summaries carry bytes, clean summaries do not."""
+    blobs = _corpus()
+    paths = []
+    for i, (name, blob) in enumerate(blobs):
+        p = tmp_path / f"blob-{i:02d}"
+        p.write_bytes(blob)
+        paths.append((f"key-{i:02d}-{name}", str(p), blob))
+    sc = meta_scan.BlobScanner(max_items=4)
+    out = []
+    for key, p, _ in paths:
+        if sc.full():
+            out.extend(sc.flush())
+        fd = os.open(p, os.O_RDONLY)
+        try:
+            sc.add(key, fd)
+        finally:
+            os.close(fd)
+    out.extend(sc.flush())
+    sc.close()
+    assert [o[0] for o in out] == [k for k, _, _ in paths]
+    for (key, _, blob), (okey, vlist, oblob) in zip(paths, out):
+        ref = meta_scan.scan_blob(blob)
+        assert vlist == ref, key
+        if vlist is None:
+            assert oblob == blob, key      # fallback needs the bytes
+        elif not meta_scan.summary_sufficient(vlist):
+            assert oblob == blob, key      # full fidelity rides along
+        else:
+            assert oblob is None, key
+
+
+# ---------------------------------------------------------------------------
+# listing identity: scanner on vs off, shallow vs deep
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def es4(tmp_path):
+    disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    s = ErasureSet(disks)
+    s.make_bucket("b")
+    yield s
+    s.close()
+
+
+def _seed_namespace(es):
+    es.put_object("b", "plain", b"p" * 100)
+    es.put_object("b", "tagged", b"t" * 100,
+                  PutOptions(tags="team=x&env=y"))
+    es.put_object("b", "withmeta", b"m" * 100,
+                  PutOptions(user_metadata={"x-amz-meta-k": "v"}))
+    es.put_object("b", "a/nested/one", b"1")
+    es.put_object("b", "a/nested/two", b"2")
+    es.put_object("b", "a/other", b"3")
+    es.put_object("b", "zz/deep/deeper/leaf", b"4")
+    # An object that is also a prefix (nested keys under an object).
+    es.put_object("b", "obj", b"o" * 100)
+    es.put_object("b", "obj/child", b"c")
+    # Versioned stack + delete marker.
+    es.put_object("b", "ver/k", b"v1", PutOptions(versioned=True))
+    es.put_object("b", "ver/k", b"v2", PutOptions(versioned=True))
+    es.put_object("b", "ver/dead", b"x", PutOptions(versioned=True))
+    es.delete_object("b", "ver/dead",
+                     DeleteOptions(versioned=True))
+
+
+def _snap_listing(es, **kw):
+    info = es.list_objects("b", **kw)
+    objs = [(o.name, o.version_id, o.is_latest, o.delete_marker,
+             o.etag, o.size, o.mod_time, o.content_type, o.user_tags,
+             dict(o.user_metadata), dict(o.internal_metadata))
+            for o in info.objects]
+    return objs, list(info.prefixes), info.is_truncated, info.next_marker
+
+
+def _walk_all_pages(es, **kw):
+    pages = []
+    marker = ""
+    for _ in range(100):
+        objs, prefixes, trunc, nm = _snap_listing(es, marker=marker, **kw)
+        pages.append((objs, prefixes))
+        if not trunc:
+            return pages
+        marker = nm
+    raise AssertionError("listing did not terminate")
+
+
+LISTING_SHAPES = [
+    {},
+    {"prefix": "a/"},
+    {"prefix": "a/nested/"},
+    {"delimiter": "/"},
+    {"prefix": "a/", "delimiter": "/"},
+    {"prefix": "zz/", "delimiter": "/"},
+    {"prefix": "obj", "delimiter": "/"},
+    {"include_versions": True},
+    {"prefix": "ver/", "include_versions": True},
+    {"delimiter": "/", "max_keys": 2},
+    {"max_keys": 3},
+]
+
+
+def test_listing_identity_scanner_on_off(es4, monkeypatch):
+    """Every listing shape returns identical fields with the native
+    scanner enabled and disabled (Python fallback)."""
+    _seed_namespace(es4)
+    snaps = {}
+    for native_off in (False, True):
+        monkeypatch.setattr(meta_scan, "_NATIVE_OFF", native_off)
+        for i, shape in enumerate(LISTING_SHAPES):
+            es4.metacache.bump("b")      # force a fresh walk each way
+            snaps.setdefault(i, []).append(_walk_all_pages(es4, **shape))
+    for i, (on, off) in snaps.items():
+        assert on == off, (LISTING_SHAPES[i], on, off)
+
+
+def test_listing_identity_shallow_vs_deep(es4, monkeypatch):
+    """Delimiter pages via the shallow one-level walk match the deep
+    recursive walk exactly, page by page."""
+    _seed_namespace(es4)
+    shapes = [s for s in LISTING_SHAPES if s.get("delimiter")]
+    got = {}
+    for mode in ("on", "off"):
+        monkeypatch.setenv("MTPU_LIST_SHALLOW", mode)
+        for i, shape in enumerate(shapes):
+            es4.metacache.bump("b")
+            got.setdefault(i, []).append(_walk_all_pages(es4, **shape))
+    for i, (shallow, deep) in got.items():
+        assert shallow == deep, (shapes[i], shallow, deep)
+
+
+def test_shallow_marker_inside_collapsed_prefix(es4):
+    """A marker strictly inside a collapsed subtree re-surfaces that
+    subtree's common prefix (S3 semantics) on the shallow path."""
+    _seed_namespace(es4)
+    objs, prefixes, _, _ = _snap_listing(
+        es4, delimiter="/", marker="a/nested/one")
+    assert "a/" in prefixes
+
+
+def test_walk_scan_matches_walk_dir(es4):
+    _seed_namespace(es4)
+    d = es4.disks[0]
+    old = [p for p, _ in d.walk_dir("b")]
+    new = [p for p, _, _ in d.walk_scan("b")]
+    assert old == new
+    mid = old[len(old) // 2]
+    assert [p for p, _ in d.walk_dir("b", forward_from=mid)] == \
+        [p for p, _, _ in d.walk_scan("b", forward_from=mid)]
+
+
+# ---------------------------------------------------------------------------
+# fileinfo cache: stat class under HEAD storms
+# ---------------------------------------------------------------------------
+
+
+def test_head_storm_does_not_evict_data_class(tmp_path):
+    disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    es = ErasureSet(disks)
+    try:
+        es.make_bucket("b")
+        es.fi_cache.max_entries = 4      # tiny data class
+        es.fi_cache.max_stat = 4096
+        for i in range(40):
+            es.put_object("b", f"k{i:03d}", b"x" * 64)
+        # Hot GET entries for 3 keys (data class).
+        for k in ("k000", "k001", "k002"):
+            es.get_object("b", k)
+            es.get_object("b", k)
+        base_entries = es.fi_cache.stats()["entries"]
+        assert base_entries >= 3
+        # HEAD storm over every key: fills the stat class only.
+        for i in range(40):
+            es.get_object_info("b", f"k{i:03d}")
+        st = es.fi_cache.stats()
+        assert st["entries"] == base_entries, \
+            "HEAD storm must not evict data-class entries"
+        assert st["stat_entries"] >= 30
+        # Second pass: storm is served from cache (no fan-out).
+        misses_before = es.fi_cache.stats()["stat_misses"]
+        for i in range(40):
+            es.get_object_info("b", f"k{i:03d}")
+        st = es.fi_cache.stats()
+        assert st["stat_misses"] == misses_before
+        assert st["stat_hits"] >= 40
+    finally:
+        es.close()
+
+
+def test_stat_class_invalidated_by_writes(tmp_path):
+    disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    es = ErasureSet(disks)
+    try:
+        es.make_bucket("b")
+        es.put_object("b", "k", b"v1")
+        info1 = es.get_object_info("b", "k")
+        assert es.fi_cache.stats()["stat_entries"] >= 1
+        es.put_object("b", "k", b"v2" * 10)
+        info2 = es.get_object_info("b", "k")
+        assert info2.size == 20
+        assert info2.etag != info1.etag
+    finally:
+        es.close()

@@ -66,7 +66,7 @@ def test_multipart_and_bucket_delete_invalidate(es, tmp_path):
 
 
 def _counting(disks):
-    """Wrap drives so walk_dir invocations are counted."""
+    """Wrap drives so walk invocations (either primitive) are counted."""
     counter = {"walks": 0}
 
     class W:
@@ -76,6 +76,10 @@ def _counting(disks):
         def walk_dir(self, *a, **k):
             counter["walks"] += 1
             return self._inner.walk_dir(*a, **k)
+
+        def walk_scan(self, *a, **k):
+            counter["walks"] += 1
+            return self._inner.walk_scan(*a, **k)
 
         def __getattr__(self, name):
             return getattr(self._inner, name)
@@ -164,6 +168,198 @@ def test_peer_bump_invalidates_other_nodes_walk(tmp_path):
             break
         time.sleep(0.02)
     assert [o.name for o in b.list_objects("xn").objects] == ["two"]
+
+
+def test_continuation_past_truncation_cap(tmp_path, monkeypatch):
+    """Pagination must keep progressing past a stream's in-memory cap:
+    pages beyond it ride start-floored continuation walks, and every
+    key surfaces exactly once."""
+    from minio_tpu.object import metacache
+    monkeypatch.setattr(metacache, "_MAX_ENTRIES", 120)
+    disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    es = ErasureSet(disks)
+    try:
+        es.make_bucket("cap")
+        for i in range(300):
+            es.put_object("cap", f"o{i:05d}", b"")
+        names, marker, pages = [], "", 0
+        while True:
+            page = es.list_objects("cap", marker=marker, max_keys=50)
+            names.extend(o.name for o in page.objects)
+            pages += 1
+            assert pages < 50
+            if not page.is_truncated:
+                break
+            marker = page.next_marker
+        assert names == [f"o{i:05d}" for i in range(300)]
+    finally:
+        es.close()
+
+
+def _shm_root(tmp_path, need_bytes):
+    """A namespace root on /dev/shm (high-cardinality fixtures measure
+    syscalls, and overlay /tmp mounts are pathologically slow), or None
+    to skip."""
+    import tempfile
+    try:
+        st = os.statvfs("/dev/shm")
+        if st.f_bavail * st.f_frsize < need_bytes:
+            return None
+    except OSError:
+        return None
+    return tempfile.mkdtemp(prefix="mtpu-nstest-", dir="/dev/shm")
+
+
+def test_persisted_seek_and_warm_start_50k(monkeypatch, tmp_path):
+    """High-cardinality warm start: a fresh process's first listing —
+    and a deep continuation page — load persisted segments (seeking
+    past the marker's segment) instead of re-walking 50k keys."""
+    import shutil
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from scripts.namespace_gen import attach, generate
+
+    from minio_tpu.object import metacache
+    root = _shm_root(tmp_path, 2 << 30)
+    if root is None:
+        pytest.skip("no /dev/shm capacity for the 50k fixture")
+    monkeypatch.setattr(metacache, "_PERSIST_TTL", 600.0)
+    try:
+        # workers=1: forking under a JAX-loaded pytest process risks
+        # deadlock (os.fork + threads); serial fabrication is ~12 s.
+        generate(root, 50_000, drives=1, profile="flat", workers=1)
+        es = attach(root, 1)
+        marker = ""
+        while True:
+            page = es.list_objects("ns", prefix="flat/", marker=marker,
+                                   max_keys=1000)
+            if not page.is_truncated:
+                break
+            marker = page.next_marker
+        es.close()
+
+        # Fresh process, first page: served from persisted segments.
+        es2 = attach(root, 1)
+        wrapped, counter = _counting(es2.disks)
+        es2.disks[:] = wrapped
+        page = es2.list_objects("ns", prefix="flat/", max_keys=1000)
+        assert [o.name for o in page.objects] == \
+            [f"flat/o{i:08d}" for i in range(1000)]
+        assert counter["walks"] == 0, counter
+        assert es2.metacache.persisted_loads == 1
+        es2.close()
+
+        # Fresh process, DEEP continuation page: the segment index
+        # seeks — only the tail segments load, still zero drive walks.
+        es3 = attach(root, 1)
+        wrapped, counter = _counting(es3.disks)
+        es3.disks[:] = wrapped
+        deep_marker = f"flat/o{40_000 - 1:08d}"
+        page = es3.list_objects("ns", prefix="flat/",
+                                marker=deep_marker, max_keys=1000)
+        assert [o.name for o in page.objects] == \
+            [f"flat/o{i:08d}" for i in range(40_000, 41_000)]
+        assert counter["walks"] == 0, counter
+        assert es3.metacache.persisted_loads == 1
+        w = next(iter(es3.metacache._walks.values()))
+        assert w.persisted_from > 0, "seek should skip whole segments"
+        es3.close()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def test_truncated_walk_compacts_in_place(tmp_path, monkeypatch):
+    """A truncated persisted run + its continuation walks compact into
+    ONE segment run: a fresh process then serves the whole range from
+    segments, past the original cap."""
+    from minio_tpu.object import metacache
+    monkeypatch.setattr(metacache, "_MAX_ENTRIES", 100)
+    monkeypatch.setattr(metacache, "_SEG", 40)
+    monkeypatch.setattr(metacache, "_PERSIST_TTL", 600.0)
+    disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    es = ErasureSet(disks)
+    try:
+        es.make_bucket("cp")
+        for i in range(250):
+            es.put_object("cp", f"o{i:05d}", b"")
+        # Reset generation so walks persist under gen 0 semantics.
+        es.metacache._gen.clear()
+        names, marker = [], ""
+        while True:
+            page = es.list_objects("cp", marker=marker, max_keys=50)
+            names.extend(o.name for o in page.objects)
+            if not page.is_truncated:
+                break
+            marker = page.next_marker
+        assert names == [f"o{i:05d}" for i in range(250)]
+        import time as _t
+        deadline = _t.monotonic() + 10
+        while es.metacache.compactions < 1 and _t.monotonic() < deadline:
+            _t.sleep(0.05)
+        assert es.metacache.compactions >= 1
+    finally:
+        es.close()
+
+    # Fresh process: the compacted run serves EVERYTHING, no walks.
+    es2 = ErasureSet([LocalStorage(str(tmp_path / f"d{i}"))
+                      for i in range(4)])
+    try:
+        wrapped, counter = _counting(es2.disks)
+        es2.disks[:] = wrapped
+        names, marker = [], ""
+        while True:
+            page = es2.list_objects("cp", marker=marker, max_keys=50)
+            names.extend(o.name for o in page.objects)
+            if not page.is_truncated:
+                break
+            marker = page.next_marker
+        assert names == [f"o{i:05d}" for i in range(250)]
+        assert counter["walks"] == 0, counter
+        assert es2.metacache.persisted_loads >= 1
+    finally:
+        es2.close()
+
+
+@pytest.mark.slow
+def test_meta_10m_sweep(tmp_path):
+    """Full-cardinality sweep (10M objects by default; scale with
+    MTPU_SLOW_NS_OBJECTS): fabricate the namespace on /dev/shm, then
+    prove listing and HEAD correctness at depth — first pages at cold
+    prefixes, deep continuation, HEAD sampling."""
+    import shutil
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from scripts.namespace_gen import attach, generate, key_at
+
+    objects = int(os.environ.get("MTPU_SLOW_NS_OBJECTS", "10000000"))
+    root = os.environ.get("MTPU_META_NS_ROOT", "")
+    built = False
+    if not root:
+        root = _shm_root(tmp_path, objects * 6144 + (1 << 30))
+        if root is None:
+            pytest.skip("no /dev/shm capacity for the slow sweep")
+        generate(root, objects, drives=1)
+        built = True
+    es = attach(root, 1)
+    try:
+        for pfx in ("kv/a0/", "kv/ff/", "deep/0/1/"):
+            es.metacache.bump("ns")
+            page = es.list_objects("ns", prefix=pfx, max_keys=1000)
+            assert page.objects
+            got = [o.name for o in page.objects]
+            assert got == sorted(got)
+            assert all(o.name.startswith(pfx) for o in page.objects)
+        # HEAD sample across the namespace.
+        stride = max(1, objects // 500)
+        for i in range(0, objects, stride):
+            info = es.get_object_info("ns", key_at(i, objects))
+            assert info.size == 128
+    finally:
+        es.close()
+        if built:
+            shutil.rmtree(root, ignore_errors=True)
 
 
 def test_persisted_walk_warm_starts_fresh_process(tmp_path):
