@@ -147,6 +147,8 @@ def main() -> None:
             _trace_overhead()
         if _want("put_scaling"):
             _put_scaling()
+        if _want("get_scaling"):
+            _get_scaling()
         if _want("meta_listing"):
             _meta_listing()
         return
@@ -248,6 +250,10 @@ def main() -> None:
     # ---- 9. Chip-count scaling of the batched device PUT route --------
     if _want("put_scaling"):
         _put_scaling()
+
+    # ---- 9b. Chip-count scaling of the batched device GET route -------
+    if _want("get_scaling"):
+        _get_scaling()
 
     # ---- 10. Metadata plane: LIST/HEAD at high cardinality ------------
     if _want("meta_listing"):
@@ -436,13 +442,32 @@ def _bench_set(root, n_objects, body):
 
 def _get_latency() -> None:
     """End-to-end GetObject p50/p99 through the real object layer on
-    12 local drives, EC 8+4, 1 MiB bodies. Two columns: `cold` — the
-    first GET of each object (full quorum read_version fan-out) —
-    and `hot` — repeat GETs of already-read objects (the fileinfo-
-    cache + native-kernel path when present). The headline value is
-    the hot p50: repeat reads are the serving steady state."""
+    12 local drives, EC 8+4, 1 MiB bodies. Columns: `cold` — the first
+    GET of each object (full quorum read_version fan-out) — `hot` —
+    repeat GETs of already-read objects (the fileinfo-cache +
+    verify-kernel path) — and `reconstruct` — repeat GETs with one
+    drive's copies REMOVED, over only the keys whose lost shard was a
+    data shard, so every measured read pays the degraded-read rebuild
+    (device-batched where this host's decode calibration picks the
+    device). The headline value is the hot p50: repeat reads are the
+    serving steady state. Emits an explicit-null line when the fixture
+    cannot build on this host (gate skips cleanly)."""
+    try:
+        _get_latency_inner()
+    except (OSError, MemoryError) as e:
+        # Only environment failures (no space/fds/memory for the
+        # fixture) skip; correctness failures — e.g. a wrong-length
+        # reconstruct — must propagate and fail the bench loudly.
+        print(json.dumps({"metric": "get_object_p50_ec4_1mib_ms",
+                          "value": None, "unit": "ms",
+                          "skipped": f"fixture failed: {e}"}))
+
+
+def _get_latency_inner() -> None:
     import shutil
     import tempfile
+
+    from minio_tpu.object.erasure_object import hash_order
 
     rng = np.random.default_rng(4)
     body = rng.integers(0, 256, size=1 << 20, dtype=np.uint8).tobytes()
@@ -461,8 +486,27 @@ def _get_latency() -> None:
                 t0 = time.perf_counter()
                 es.get_object("bench", f"o-{i}")
                 hot.append(time.perf_counter() - t0)
+        # Degraded column: drive 0's copies vanish; keys whose shard on
+        # d0 was a DATA shard (index < k) now reconstruct every read.
+        # The MRF worker must be stopped FIRST: every degraded read
+        # enqueues a background heal that would restore d0's copies
+        # mid-measurement, silently turning later reps into hot-path
+        # reads.
+        es.mrf.stop()
+        shutil.rmtree(f"{root}/d0/bench", ignore_errors=True)
+        es.metacache.bump("bench")
+        rec_keys = [i for i in range(n_objects)
+                    if hash_order(f"bench/o-{i}", 12)[0] <= 12 - M]
+        rec = []
+        for _rep in range(2):
+            for i in rec_keys:
+                t0 = time.perf_counter()
+                _, got = es.get_object("bench", f"o-{i}")
+                rec.append(time.perf_counter() - t0)
+                assert len(got) == len(body)
         cold.sort()
         hot.sort()
+        rec.sort()
 
         def pct(ts, p):
             return round(ts[min(len(ts) - 1, len(ts) * p // 100)] * 1e3, 2)
@@ -476,6 +520,9 @@ def _get_latency() -> None:
                                  3),
             "cold": {"p50_ms": pct(cold, 50), "p99_ms": pct(cold, 99)},
             "hot": {"p50_ms": pct(hot, 50), "p99_ms": pct(hot, 99)},
+            "reconstruct": ({"p50_ms": pct(rec, 50),
+                             "p99_ms": pct(rec, 99),
+                             "keys": len(rec_keys)} if rec else None),
         }))
     finally:
         shutil.rmtree(root, ignore_errors=True)
@@ -515,6 +562,25 @@ def _get_concurrent() -> None:
             wall = time.perf_counter() - t0
             best = max(best, threads * per_thread * len(body) / wall
                        / (1 << 30))
+        # Degraded aggregate: the same 16-way re-read with one drive's
+        # copies removed — roughly k/n of the keys reconstruct their
+        # lost data shard every read (device-batched where calibrated),
+        # the rest lose only parity. The realistic one-dead-drive
+        # serving shape. MRF stops first or background heals would
+        # restore d0 mid-measurement (degraded reads enqueue heals).
+        import shutil as _sh
+        es.mrf.stop()
+        _sh.rmtree(f"{root}/d0/bench", ignore_errors=True)
+        es.metacache.bump("bench")
+        list(ex.map(worker, range(threads)))          # warm degraded
+        reconstruct = 0.0
+        for _rep in range(1 if _SMALL else 2):
+            t0 = time.perf_counter()
+            list(ex.map(worker, range(threads)))
+            wall = time.perf_counter() - t0
+            reconstruct = max(reconstruct,
+                              threads * per_thread * len(body) / wall
+                              / (1 << 30))
         ex.shutdown(wait=False)
         es.close()
     finally:
@@ -536,6 +602,7 @@ def _get_concurrent() -> None:
         "vs_baseline": round((served if served is not None else best)
                              / max(best, 1e-9), 3),
         "object_layer_gibps": round(best, 3),
+        "reconstruct_gibps": round(reconstruct, 3),
         "served_gibps": None if served is None else round(served, 3),
         # Gated front-end-tax ratio (see put_concurrent).
         "served_ratio": None if served is None
@@ -779,6 +846,117 @@ def _scaling_probe() -> None:
         ex.shutdown(wait=False)
         es.close()
         print(f"SCALING_GIBPS={best:.4f}")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _get_scaling() -> None:
+    """Chip-count scaling of the batched device GET route: the 16-way
+    concurrent 1 MiB GET aggregate with the decode routes PINNED to
+    the device (MTPU_BATCH_FORCE=get=device,reconstruct=device)
+    measured at 1/2/4/8 visible devices — the read-side mirror of
+    put_scaling, same clean-subprocess harness (the device count must
+    be fixed before JAX initializes; TPU hosts cap the mesh via
+    MTPU_MESH_DEVICES over real chips, CPU containers get virtual host
+    devices — plumbing proof, not speedup). Hot 1-block GETs only ride
+    the device when coalesced, so the 16-way concurrency IS the
+    measured cross-request batching. vs_baseline = max-devices over
+    1-device aggregate; recorded in MULTICHIP_r07+."""
+    import subprocess
+    import sys as _sys
+    sweep: dict[str, float] = {}
+    devices: dict[str, int] = {}
+    dropped: list[str] = []
+    for n in (1, 2, 4, 8):
+        env = {**_os.environ, "MTPU_SCALING_N": str(n),
+               "MTPU_BATCH_FORCE": "get=device,reconstruct=device"}
+        try:
+            out = subprocess.run(
+                [_sys.executable, __file__, "--get-scaling-probe"],
+                capture_output=True, timeout=900, env=env)
+            for line in out.stdout.decode().splitlines():
+                if line.startswith("SCALING_GET_GIBPS="):
+                    sweep[str(n)] = float(line.split("=", 1)[1])
+                elif line.startswith("SCALING_DEVICES="):
+                    devices[str(n)] = int(line.split("=", 1)[1])
+        except Exception:  # noqa: BLE001 - sweep point best-effort
+            pass
+        if str(n) not in sweep:
+            dropped.append(str(n))
+    if not sweep:
+        print(json.dumps({"metric": "get_scaling_aggregate_gibps",
+                          "value": None, "unit": "GiB/s",
+                          "error": "no sweep point completed"}))
+        return
+    ns = sorted(sweep, key=int)
+    base, top = sweep[ns[0]], sweep[ns[-1]]
+    print(json.dumps({
+        "metric": "get_scaling_aggregate_gibps",
+        "value": round(top, 3),
+        "unit": "GiB/s",
+        "vs_baseline": round(top / max(base, 1e-9), 3),
+        "baseline_devices": int(ns[0]),
+        "sweep_gibps": {k: round(sweep[k], 3) for k in ns},
+        "dropped_points": dropped,
+        "mesh_devices": devices,
+        "route": "device_forced",
+        "concurrency": 16,
+    }))
+
+
+def _get_scaling_probe() -> None:
+    """Subprocess body for one get_scaling sweep point: pin the mesh
+    width BEFORE JAX initializes, pre-put the working set, then
+    measure the object-layer 16-way GET aggregate with the decode
+    routes forced to the device."""
+    import os
+    import shutil
+    import tempfile
+    n = max(1, int(os.environ.get("MTPU_SCALING_N", "1") or 1))
+    os.environ["MTPU_MESH_DEVICES"] = str(n)
+    os.environ.setdefault("MTPU_BATCH_FORCE",
+                          "get=device,reconstruct=device")
+    if "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}").strip()
+    from concurrent.futures import ThreadPoolExecutor
+
+    from minio_tpu.object.erasure_object import ErasureSet
+    from minio_tpu.ops.rs_device import DeviceBackend, mesh_info
+    from minio_tpu.storage.local import LocalStorage
+
+    print(f"SCALING_DEVICES={mesh_info()['mesh_devices']}")
+    rng = np.random.default_rng(12)
+    body = rng.integers(0, 256, size=1 << 20, dtype=np.uint8).tobytes()
+    threads, per_thread = 16, (2 if _SMALL else 4)
+    root = tempfile.mkdtemp(prefix="bench-getscale-")
+    try:
+        disks = [LocalStorage(f"{root}/d{i}") for i in range(12)]
+        for d in disks:
+            d.make_vol("bench")
+        es = ErasureSet(disks, parity=M, backend=DeviceBackend("auto"))
+        for t in range(threads):
+            for i in range(per_thread):
+                es.put_object("bench", f"o-{t}-{i}", body)
+        ex = ThreadPoolExecutor(max_workers=threads)
+
+        def worker(t):
+            for i in range(per_thread):
+                _, got = es.get_object("bench", f"o-{t}-{i}")
+                assert len(got) == len(body)
+
+        list(ex.map(worker, range(threads)))      # warm + compile pass
+        best = 0.0
+        for _rep in range(1 if _SMALL else 2):
+            t0 = time.perf_counter()
+            list(ex.map(worker, range(threads)))
+            wall = time.perf_counter() - t0
+            best = max(best, threads * per_thread * len(body) / wall
+                       / (1 << 30))
+        ex.shutdown(wait=False)
+        es.close()
+        print(f"SCALING_GET_GIBPS={best:.4f}")
     finally:
         shutil.rmtree(root, ignore_errors=True)
 
@@ -1158,5 +1336,7 @@ if __name__ == "__main__":
         _serve_probe()
     elif "--scaling-probe" in _sys.argv:
         _scaling_probe()
+    elif "--get-scaling-probe" in _sys.argv:
+        _get_scaling_probe()
     else:
         main()

@@ -154,6 +154,101 @@ def _batcher_for(k: int, m: int):
                          pool=global_pool(), name=f"{k}+{m}")
 
 
+# -- the decode mirror: GET verify + reconstruct batchers -------------------
+
+def _get_batch_min_blocks() -> int:
+    try:
+        v = int(os.environ.get("MTPU_GET_BATCH_MIN_BLOCKS", "")
+                or MIN_DEVICE_BLOCKS)
+        return v if v > 0 else MIN_DEVICE_BLOCKS
+    except ValueError:
+        return MIN_DEVICE_BLOCKS
+
+
+def _host_deframe(stacked: np.ndarray):
+    """Host twin of the device de-framer (the get batcher's fallback
+    and calibration rival): vectorized HighwayHash of every framed
+    block in `stacked` [B, k, 32+S], verdicts [B, k] plus the data
+    payload as zero-copy views — field-identical to
+    hh_device.make_mesh_deframer's run() + the get split_fn."""
+    b, k, f = stacked.shape
+    s = f - 32
+    digs = bitrot.hash_blocks_many(
+        bitrot.DEFAULT_ALGORITHM, stacked[:, :, 32:].reshape(b * k, s))
+    want = stacked[:, :, :32].reshape(b * k, 32)
+    ok = (digs == want).all(axis=1).reshape(b, k)
+    return ok, stacked[:, :, 32:]
+
+
+def _get_split(ok, off, c, member):
+    """Demux one coalesced GET verify dispatch: the member's verdict
+    rows plus its payload as views of its OWN framed window (the
+    device returns only the B*k verdicts — blocks never ride the
+    device->host link back)."""
+    return ok[off:off + c], member[:, :, 32:]
+
+
+def _get_concat(a, b):
+    return (np.concatenate([a[0], b[0]]),
+            np.concatenate([a[1], b[1]]))
+
+
+@functools.lru_cache(maxsize=64)
+def _get_batcher_for(k: int, m: int):
+    """Cross-request GET verify batcher for one EC config: stacked
+    framed windows [B, k, 32+shard] from concurrent GETs coalesce into
+    one device de-framer dispatch (ops/hh_device.make_mesh_deframer)
+    when the decode-route calibration says the device wins; the
+    vectorized host hash is the byte-identical fallback. k == 1 is the
+    shard-file verifier heal rides (one member per drive blob)."""
+    from minio_tpu.ops.batcher import StripeBatcher
+    from minio_tpu.ops.hh_device import make_mesh_deframer
+    return StripeBatcher(make_mesh_deframer(k), _host_deframe,
+                         min_device_blocks=_get_batch_min_blocks(),
+                         pool=global_pool(), name=f"get:{k}+{m}",
+                         route="get", split_fn=_get_split,
+                         concat_fn=_get_concat)
+
+
+def _host_apply_rows(rows: np.ndarray, stacked: np.ndarray) -> np.ndarray:
+    """Host GF application of `rows` [r, k] to a stripe batch
+    [B, k, S] -> [B, r, S] (the reconstruct batcher's fallback): the
+    transform is per byte column, so the batch flattens into one wide
+    host-codec call."""
+    from minio_tpu.erasure.codec import _HOST
+    b, k, s = stacked.shape
+    flat = np.ascontiguousarray(stacked.transpose(1, 0, 2)) \
+        .reshape(k, b * s)
+    out = np.asarray(_HOST.apply_matrix(rows, flat))
+    return np.ascontiguousarray(
+        out.reshape(rows.shape[0], b, s).transpose(1, 0, 2))
+
+
+@functools.lru_cache(maxsize=256)
+def _reconstruct_batcher_for(k: int, m: int, use: tuple,
+                             missing_data: tuple):
+    """Batched device reconstruct for one (EC config, surviving-shard
+    set): degraded-read windows stack their survivors [B, k, S] and the
+    decode-matrix rows for the missing data shards apply across the
+    mesh in one dispatch (ops/rs_device.make_mesh_matrix). One batcher
+    per survivor set — the common case is exactly one set per dead
+    drive, so concurrent degraded GETs of that drive's objects coalesce
+    cross-request just like healthy-path PUT/GET windows."""
+    from minio_tpu.ops import gf256
+    from minio_tpu.ops.batcher import StripeBatcher
+    from minio_tpu.ops.rs_device import make_mesh_matrix
+    dec = gf256.decode_matrix(k, m, use)
+    rows = np.ascontiguousarray(dec[list(missing_data), :])
+    return StripeBatcher(
+        make_mesh_matrix(rows), functools.partial(_host_apply_rows, rows),
+        min_device_blocks=_get_batch_min_blocks(),
+        pool=global_pool(),
+        name=f"rec:{k}+{m}:" + ",".join(map(str, use)),
+        route="reconstruct",
+        split_fn=lambda out, off, c, _member: out[off:off + c],
+        concat_fn=lambda a, b: np.concatenate([a, b]))
+
+
 def default_parity(set_size: int) -> int:
     """Default EC parity by set size (reference storage-class defaults:
     internal/config/storageclass/storage-class.go:355-367):
@@ -239,7 +334,8 @@ class ErasureSet:
         # verifies that demoted to reconstruction. Incremented from
         # concurrent request/prefetch threads — dict += is a
         # read-modify-write, so a lock keeps the counts honest.
-        self.get_kernel = {"native": 0, "numpy": 0, "demoted": 0}
+        self.get_kernel = {"native": 0, "numpy": 0, "demoted": 0,
+                           "device": 0}
         self._gk_mu = threading.Lock()
 
     def close(self) -> None:
@@ -1820,16 +1916,35 @@ class ErasureSet:
         results, ferrs = fetch_many(range(k))
         skip = offset - start_b * BLOCK_SIZE
 
-        # Fast path: all k data shards present and whole -> ONE native
-        # call verifies every block digest and interleaves straight
-        # into a pooled buffer. A nonzero bad-mask means bitrot: demote
-        # those shards to missing and take the reconstruct path below
-        # (which re-verifies, rebuilds, and enqueues the MRF heal).
-        native_got = self._native_get_window(results, k, shard_size,
-                                             win_len, start_b, end_b,
-                                             part_size)
-        if native_got is not None:
-            view, lease, bad = native_got
+        # Fast path: all k data shards present and whole -> ONE
+        # verify+interleave pass over the window. Per-host calibration
+        # picks between the batched DEVICE route (cross-request
+        # coalesced de-framer dispatch, ops/batcher get route) and the
+        # fused native host kernel — byte-identical outputs. A nonzero
+        # bad-mask either way means bitrot: demote those shards to
+        # missing and take the reconstruct path below (which
+        # re-verifies, rebuilds, and enqueues the MRF heal).
+        dev_got = self._device_get_window(results, k, m, shard_size,
+                                          win_len, start_b, end_b,
+                                          part_size)
+        got = None
+        if dev_got is not None:
+            view, lease, bad, route = dev_got
+            if not bad:
+                # A coalesced batch below min_device_blocks resolves to
+                # the batcher's vectorized host fallback even under a
+                # device calibration — count it as the numpy path, not
+                # a device window.
+                self._count_get("device" if route == "device"
+                                else "numpy")
+                return view[skip:skip + length], lease
+            got = (view, lease, bad)
+        else:
+            got = self._native_get_window(results, k, shard_size,
+                                          win_len, start_b, end_b,
+                                          part_size)
+        if got is not None:
+            view, lease, bad = got
             if not bad:
                 self._count_get("native")
                 return view[skip:skip + length], lease
@@ -1853,7 +1968,7 @@ class ErasureSet:
                     ReadQuorumError(bucket, object_,
                                     f"{available}/{n} shards readable"),
                     quorum=k, ok=available)
-            e.decode_data_blocks(shards)
+            self._decode_missing(e, k, m, shards, shard_size)
             # Bytes were served from reconstruction: heal in background
             # (reference: MRF enqueue on degraded reads,
             # cmd/erasure-object.go:399-417).
@@ -1874,6 +1989,218 @@ class ErasureSet:
     def _count_get(self, path: str) -> None:
         with self._gk_mu:
             self.get_kernel[path] += 1
+
+    def _wants_device_route(self, route: str) -> bool:
+        """Platform gate for a decode-route device dispatch: the set
+        must run a device-capable backend, and either this host is a
+        TPU host or MTPU_BATCH_FORCE pins the route (the
+        reproducibility knob must reach the REAL batched device route
+        on any host — see _frame_windows' identical PUT gate)."""
+        return (hasattr(self.backend, "apply_matrix_device")
+                and (_on_tpu() or batch_force_mode(route) == "device"))
+
+    def _device_get_window(self, results, k: int, m: int,
+                           shard_size: int, win_len: int, start_b: int,
+                           end_b: int, part_size: int):
+        """Batched device verify of k fetched shard windows — the
+        device twin of _native_get_window, riding the cross-request
+        get batcher. The window's FULL frames stack into one member
+        [full, k, 32+shard_size]; concurrent GETs' members coalesce
+        into one mesh de-framer dispatch that recomputes every digest
+        on device. The ragged tail frame (a part's short last block)
+        verifies on host. Verified payload interleaves block-major
+        into a pooled lease from the member's own bytes (views — the
+        payload never rides the device link back).
+
+        None when the route does not apply (calibration resolved to
+        host, non-default algorithm, missing/short shards, no full
+        frames); otherwise (view, lease, 0, route) on success or
+        (None, None, bad_mask, route) — route is the dispatch path the
+        batcher actually took ("device", or "host"/"bypass" when a
+        coalesced batch fell below the device threshold), so the
+        caller's path metrics stay honest."""
+        if bitrot.DEFAULT_ALGORITHM != bitrot.HIGHWAYHASH256S \
+                or win_len <= 0 or not self._wants_device_route("get"):
+            return None
+        sb = _get_batcher_for(k, m)
+        nb = end_b - start_b + 1
+        slast = win_len - (nb - 1) * shard_size
+        hsize = bitrot.digest_size(bitrot.DEFAULT_ALGORITHM)
+        frame = hsize + shard_size
+        expect = nb * hsize + win_len
+        blobs = []
+        for r in results:
+            if r is None or len(r) != expect:
+                return None
+            blobs.append(np.frombuffer(
+                r if isinstance(r, (bytes, bytearray)) else bytes(r),
+                dtype=np.uint8))
+        full = nb if slast == shard_size else nb - 1
+        if full < 1 or not sb.worth_batching(full):
+            # Solo sub-threshold windows (the hot 1 MiB repeat GET with
+            # no concurrency) keep the fused native kernel — the
+            # batcher only wins when there is a device-sized window or
+            # company to coalesce with.
+            return None
+        stacked = np.empty((full, k, frame), dtype=np.uint8)
+        for i, arr in enumerate(blobs):
+            stacked[:, i, :] = arr[:full * frame].reshape(full, frame)
+        try:
+            ok, data = sb.frame(stacked)
+        except DeadlineExceeded:
+            raise
+        except Exception:  # noqa: BLE001 - device trouble != corruption
+            return None
+        route = sb.last_route()
+        bad = 0
+        for i in range(k):
+            if not ok[:, i].all():
+                bad |= 1 << i
+        if full < nb:
+            off = full * frame
+            for i, arr in enumerate(blobs):
+                want = arr[off:off + hsize].tobytes()
+                tail = arr[off + hsize:off + hsize + slast]
+                if bitrot.hash_block(bitrot.DEFAULT_ALGORITHM,
+                                     tail) != want:
+                    bad |= 1 << i
+        if bad:
+            return None, None, bad, route
+        take_last = min(BLOCK_SIZE, part_size - end_b * BLOCK_SIZE)
+        out_len = (nb - 1) * BLOCK_SIZE + min(take_last, k * slast)
+        lease = global_pool().lease(out_len)
+        try:
+            out = lease.ndarray((out_len,))
+            pos = 0
+            for b in range(full):
+                take = min(BLOCK_SIZE, out_len - pos)
+                out[pos:pos + take] = data[b].reshape(-1)[:take]
+                pos += take
+            if full < nb:
+                off = full * frame + hsize
+                take = out_len - pos
+                tail = np.empty(k * slast, dtype=np.uint8)
+                for i, arr in enumerate(blobs):
+                    tail[i * slast:(i + 1) * slast] = \
+                        arr[off:off + slast]
+                out[pos:pos + take] = tail[:take]
+                pos += take
+        except BaseException:
+            lease.release()
+            raise
+        return lease.view(out_len), lease, 0, route
+
+    def _decode_missing(self, e, k: int, m: int, shards, shard_size: int):
+        """Fill missing DATA shards from k survivors, routing the GF
+        rebuild through the batched device reconstruct
+        (ops/rs_device.make_mesh_matrix via the reconstruct batcher)
+        when this host's decode calibration says the device wins; the
+        host codec path (e.decode_data_blocks) is the byte-identical
+        fallback and still owns every edge shape (short survivor sets,
+        zero-length shards, ragged-only windows)."""
+        missing_data = [i for i in range(k)
+                        if shards[i] is None or shards[i].size == 0]
+        if not missing_data:
+            return
+        if not (m > 0 and self._wants_device_route("reconstruct")):
+            e.decode_data_blocks(shards)
+            return
+        present = [i for i, s in enumerate(shards)
+                   if s is not None and s.size > 0]
+        if len(present) < k:
+            e.decode_data_blocks(shards)     # surfaces ReconstructError
+            return
+        use = tuple(present[:k])             # same pick as the codec
+        shard_len = shards[use[0]].shape[0]
+        if any(shards[i].shape[0] != shard_len for i in use):
+            e.decode_data_blocks(shards)     # surfaces ShardSizeError
+            return
+        full = shard_len // shard_size
+        sb = _reconstruct_batcher_for(k, m, use, tuple(missing_data))
+        if full < 1 or not sb.worth_batching(full):
+            e.decode_data_blocks(shards)
+            return
+        stacked = np.empty((full, k, shard_size), dtype=np.uint8)
+        for j, i in enumerate(use):
+            stacked[:, j, :] = \
+                shards[i][:full * shard_size].reshape(full, shard_size)
+        try:
+            out = sb.frame(stacked)          # [full, r, shard_size]
+        except DeadlineExceeded:
+            raise
+        except Exception:  # noqa: BLE001 - device trouble -> host codec
+            e.decode_data_blocks(shards)
+            return
+        tail = shard_len - full * shard_size
+        rebuilt = [np.empty(shard_len, dtype=np.uint8)
+                   for _ in missing_data]
+        for r_i in range(len(missing_data)):
+            rebuilt[r_i][:full * shard_size] = out[:, r_i, :].reshape(-1)
+        if tail:
+            from minio_tpu.ops import gf256
+            dec = gf256.decode_matrix(k, m, use)
+            tail_in = np.stack([shards[i][full * shard_size:]
+                                for i in use])
+            tout = np.asarray(e.backend.apply_matrix(
+                dec[list(missing_data), :], tail_in))
+            for r_i in range(len(missing_data)):
+                rebuilt[r_i][full * shard_size:] = tout[r_i]
+        for r_i, i in enumerate(missing_data):
+            shards[i] = rebuilt[r_i]
+
+    def _verify_shard_blob(self, blob, shard_size: int, data_size: int):
+        """Verified un-framed data of ONE framed shard blob, or None on
+        bitrot/short read — bitrot.read_framed_blocks_many's per-blob
+        contract, with the full frames routed through the batched
+        device verify (k=1 members of the get batcher) when calibration
+        says the device wins. Heal's deep verification — including the
+        drive-replacement bulk heal — fans one call per drive through
+        the engine crews, so concurrent shard files coalesce into
+        shared de-framer dispatches."""
+        hsize = bitrot.digest_size(bitrot.DEFAULT_ALGORITHM)
+        frame = hsize + shard_size
+        nb = (data_size + shard_size - 1) // shard_size if shard_size \
+            else 0
+        full = nb if data_size == nb * shard_size else nb - 1
+        use_device = hasattr(self.backend, "apply_matrix_device")
+        if bitrot.DEFAULT_ALGORITHM != bitrot.HIGHWAYHASH256S \
+                or full < 1 or not self._wants_device_route("get") \
+                or len(blob) != bitrot.shard_file_size(data_size,
+                                                       shard_size):
+            arr, = bitrot.read_framed_blocks_many(
+                [blob], shard_size, data_size, device=use_device)
+            return arr
+        sb = _get_batcher_for(1, 0)
+        if not sb.worth_batching(full):
+            arr, = bitrot.read_framed_blocks_many(
+                [blob], shard_size, data_size, device=use_device)
+            return arr
+        arr8 = np.frombuffer(blob, dtype=np.uint8)
+        member = arr8[:full * frame].reshape(full, 1, frame)
+        try:
+            ok, data = sb.frame(member)
+        except DeadlineExceeded:
+            raise
+        except Exception:  # noqa: BLE001 - device trouble -> host path
+            arr, = bitrot.read_framed_blocks_many(
+                [blob], shard_size, data_size, device=use_device)
+            return arr
+        if not ok.all():
+            return None
+        tail = data_size - full * shard_size
+        if tail:
+            off = full * frame
+            want = arr8[off:off + hsize].tobytes()
+            tdat = arr8[off + hsize:off + hsize + tail]
+            if bitrot.hash_block(bitrot.DEFAULT_ALGORITHM, tdat) != want:
+                return None
+        out = np.empty(data_size, dtype=np.uint8)
+        out[:full * shard_size] = data.reshape(full, shard_size) \
+            .reshape(-1)
+        if tail:
+            off = full * frame + hsize
+            out[full * shard_size:] = arr8[off:off + tail]
+        return out
 
     def _native_get_window(self, results, k: int, shard_size: int,
                            win_len: int, start_b: int, end_b: int,

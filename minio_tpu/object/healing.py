@@ -161,8 +161,6 @@ def _heal_object_locked(es, bucket: str, object_: str, version_id: str,
     part_shards: list[list[Optional[np.ndarray]]] = \
         [[None] * (k + m) for _ in parts]
 
-    use_device = hasattr(es.backend, "apply_matrix_device")
-
     def load_all_parts(disk_idx: int) -> Optional[list[np.ndarray]]:
         d = es.disks[disk_idx]
         dfi = fis[disk_idx]
@@ -175,12 +173,14 @@ def _heal_object_locked(es, bucket: str, object_: str, version_id: str,
                 else:
                     blob = d.read_file(
                         bucket, f"{object_}/{fi.data_dir}/part.{p.number}")
-                # Batched bitrot verify: all of this shard file's blocks
-                # hash in one pass (device when the set runs the TPU
-                # backend and the file is large enough — deep heal reads
-                # whole shard files, the best-case batch).
-                arr, = bitrot.read_framed_blocks_many(
-                    [blob], shard_size, plen, device=use_device)
+                # Batched bitrot verify: all of this shard file's full
+                # blocks hash in one pass, routed through the batched
+                # device verify (the get-route batcher, k=1 members)
+                # when this host's decode calibration picks the device
+                # — deep heal reads whole shard files, the best-case
+                # batch, and the drive-replacement bulk heal fans one
+                # load per drive so shard files coalesce cross-drive.
+                arr = es._verify_shard_blob(blob, shard_size, plen)
                 if arr is None:
                     return None
                 out.append(arr)

@@ -43,11 +43,39 @@ member request's span tree (utils/tracing.record_into): a traced PUT
 shows the shared dispatch it rode — batch size, bucket, mesh width,
 its own coalescing wait — not a gap.
 
+The same machinery runs the READ path in reverse (the decode mirror,
+PR "device-resident read path"): a batcher carries a `route` —
+  * "put"          — encode+frame windows (the original),
+  * "get"          — framed-window bitrot verification (the device
+                     de-framer, hh_device.make_mesh_deframer; members
+                     are [B, k, 32+shard] stacked on-disk frames),
+  * "reconstruct"  — batched GF decode-matrix application for degraded
+                     reads / heal rebuilds (rs_device.make_mesh_matrix;
+                     members are [B, k, shard] survivor stripes).
+Routes calibrate INDEPENDENTLY (one batcher instance per route and
+config): a host whose device link wins on encode but loses on decode —
+or vice versa — routes each direction on its own measurement, and
+MTPU_BATCH_FORCE accepts per-route pins. Non-put routes plug in a
+`split_fn` that demultiplexes the shared dispatch result back to
+member-sized results (the PUT-specific digest/block re-pointing stays
+the default), and a `concat_fn` that splices oversized windows'
+chunked results. Members whose trailing shapes differ (e.g. heal
+verify batches from objects of different EC configs through one
+verifier) never share a staging buffer: the dispatcher drains
+same-shape runs per batch.
+
 Environment:
   MTPU_BATCH_FORCE    device|host|auto (default auto): pin the
                       calibration verdict — reproducible benches/CI
                       instead of a silent probe-dependent route.
+                      Accepts per-route pins as a comma list, e.g.
+                      "put=device,get=host" (unnamed routes stay auto).
   MTPU_BATCH_WAIT_MS  base accumulation window in ms (default 2).
+  MTPU_GET_BATCH_WAIT_MS
+                      base window for the get/reconstruct routes
+                      (default: MTPU_BATCH_WAIT_MS) — read latency
+                      budgets are tighter than write ones, so the
+                      decode coalescing window tunes separately.
 """
 
 from __future__ import annotations
@@ -93,23 +121,45 @@ def _bucket(n: int) -> int:
     return _BUCKETS[-1]
 
 
-def _env_wait_s() -> float:
+def _env_wait_s(route: str = "put") -> float:
+    raw = os.environ.get("MTPU_BATCH_WAIT_MS", "")
+    if route in ("get", "reconstruct"):
+        raw = os.environ.get("MTPU_GET_BATCH_WAIT_MS", "") or raw
     try:
-        return max(0.0, float(
-            os.environ.get("MTPU_BATCH_WAIT_MS", "") or 2.0)) / 1000.0
+        return max(0.0, float(raw or 2.0)) / 1000.0
     except ValueError:
         return _MAX_WAIT_S
 
 
-def batch_force_mode() -> str:
-    """The MTPU_BATCH_FORCE verdict: "device", "host", or "auto"."""
+def batch_force_mode(route: str = "put") -> str:
+    """The MTPU_BATCH_FORCE verdict for `route`: "device", "host", or
+    "auto". A bare value pins every route; a comma list of
+    `route=value` pairs pins each independently (the encode/decode
+    small-fix: a host that wins on encode but loses on decode — or the
+    reverse — must be forceable per direction, and the auto
+    calibration already measures each route's own device_fn/host_fn
+    rivalry)."""
     v = os.environ.get("MTPU_BATCH_FORCE", "auto").strip().lower()
+    if "=" in v:
+        out = "auto"
+        for part in v.split(","):
+            r, _, m = part.partition("=")
+            if r.strip() == route and m.strip() in ("device", "host",
+                                                    "auto"):
+                out = m.strip()
+        return out
     return v if v in ("device", "host") else "auto"
+
+
+def _default_concat(rows, chunk):
+    """Oversized-window splice for the PUT rows contract: per-drive
+    lists of per-block piece tuples concatenate drive-wise."""
+    return [r + c for r, c in zip(rows, chunk)]
 
 
 class _Pending:
     __slots__ = ("stacked", "count", "rows", "exc", "event", "expires_at",
-                 "tctx", "tparent", "t_enq")
+                 "tctx", "tparent", "t_enq", "route_taken")
 
     def __init__(self, stacked: np.ndarray,
                  dl: Optional[deadline_mod.Deadline]):
@@ -122,6 +172,7 @@ class _Pending:
         self.tctx, self.tparent = tracing.capture() if tracing.ACTIVE \
             else (None, 0)
         self.t_enq = time.perf_counter()
+        self.route_taken = "host"      # resolved by _run_batch
 
 
 # Live batchers, for fleet-wide occupancy metrics (s3/metrics.py
@@ -129,40 +180,61 @@ class _Pending:
 _REGISTRY: "weakref.WeakSet[StripeBatcher]" = weakref.WeakSet()
 
 
-def aggregate_stats() -> dict:
-    """Summed occupancy stats across every live batcher (all EC
-    configs): dispatch/route/bucket counters, fill accounting, the
-    coalescing wait histogram, deadline culls."""
-    out = {
+ROUTES = ("put", "get", "reconstruct")
+
+
+def _route_zero() -> dict:
+    return {
         "dispatches": {"device": 0, "host": 0},
         "requests": {"device": 0, "host": 0, "bypass": 0},
         "buckets": {},
         "batched_blocks": 0,
         "capacity_blocks": 0,
         "deadline_failures": 0,
-        "mesh_devices": 0,
         "wait_hist": None,
-        "forced": batch_force_mode(),
+        "fill_ratio": 0.0,
     }
-    hists = []
+
+
+def aggregate_stats() -> dict:
+    """Occupancy stats across every live batcher, summed PER ROUTE
+    (put|get|reconstruct): dispatch/path/bucket counters, fill
+    accounting, the coalescing wait histogram, deadline culls — plus
+    the decode-route kernel-lane service histogram (the get/
+    reconstruct dispatches' share of the shared accelerator lane)."""
+    out = {
+        "routes": {r: _route_zero() for r in ROUTES},
+        "mesh_devices": 0,
+        "forced": {r: batch_force_mode(r) for r in ROUTES},
+        "decode_lane_hist": None,
+    }
+    hists: dict[str, list] = {r: [] for r in ROUTES}
+    decode_lane = []
     for sb in list(_REGISTRY):
         st = sb.stats()
+        route = st.get("route", "put")
+        agg = out["routes"].setdefault(route, _route_zero())
         for key in ("device", "host"):
-            out["dispatches"][key] += st["dispatches"][key]
+            agg["dispatches"][key] += st["dispatches"][key]
         for key in ("device", "host", "bypass"):
-            out["requests"][key] += st["requests"][key]
+            agg["requests"][key] += st["requests"][key]
         for b, v in st["buckets"].items():
-            out["buckets"][b] = out["buckets"].get(b, 0) + v
-        out["batched_blocks"] += st["batched_blocks"]
-        out["capacity_blocks"] += st["capacity_blocks"]
-        out["deadline_failures"] += st["deadline_failures"]
+            agg["buckets"][b] = agg["buckets"].get(b, 0) + v
+        agg["batched_blocks"] += st["batched_blocks"]
+        agg["capacity_blocks"] += st["capacity_blocks"]
+        agg["deadline_failures"] += st["deadline_failures"]
         out["mesh_devices"] = max(out["mesh_devices"], st["mesh_devices"])
-        hists.append(st["wait_hist"])
-    out["wait_hist"] = Histogram.merge(hists) if hists \
-        else Histogram().state()
-    total = out["batched_blocks"]
-    cap = out["capacity_blocks"]
-    out["fill_ratio"] = (total / cap) if cap else 0.0
+        hists.setdefault(route, []).append(st["wait_hist"])
+        if route in ("get", "reconstruct"):
+            decode_lane.append(st["lane_hist"])
+    for r, agg in out["routes"].items():
+        hs = hists.get(r, [])
+        agg["wait_hist"] = Histogram.merge(hs) if hs \
+            else Histogram().state()
+        cap = agg["capacity_blocks"]
+        agg["fill_ratio"] = (agg["batched_blocks"] / cap) if cap else 0.0
+    out["decode_lane_hist"] = Histogram.merge(decode_lane) \
+        if decode_lane else Histogram().state()
     return out
 
 
@@ -182,14 +254,25 @@ class StripeBatcher:
                  probe_fn: Optional[Callable] = None,
                  min_device_blocks: int = 8,
                  max_wait_s: Optional[float] = None,
-                 pool=None, name: str = ""):
+                 pool=None, name: str = "", route: str = "put",
+                 split_fn: Optional[Callable] = None,
+                 concat_fn: Optional[Callable] = None):
         self._device_fn = device_fn
         self._host_fn = host_fn
         self._min_device_blocks = min_device_blocks
-        self._max_wait = _env_wait_s() if max_wait_s is None else max_wait_s
+        self._max_wait = _env_wait_s(route) if max_wait_s is None \
+            else max_wait_s
         self._cur_wait = self._max_wait
         self._pool = pool
         self.name = name
+        self.route = route
+        # split_fn(result, off, count, member_stacked) -> member result:
+        # how one coalesced dispatch's output demultiplexes back to a
+        # member (None = the PUT per-drive rows contract). concat_fn
+        # splices chunked oversized-window results back together.
+        self._split_fn = split_fn
+        self._concat = concat_fn if concat_fn is not None \
+            else _default_concat
         self.mesh_devices = max(1, int(getattr(device_fn, "mesh_devices",
                                                1) or 1))
         self._mu = threading.Condition()
@@ -202,7 +285,7 @@ class StripeBatcher:
         self._device_ok: Optional[bool] = None
         self._probe_fn = probe_fn
         self._probe_started = False
-        forced = batch_force_mode()
+        forced = batch_force_mode(route)
         if forced != "auto":
             self._probe_started = True
             self._device_ok = forced == "device"
@@ -219,6 +302,18 @@ class StripeBatcher:
         self._capacity_blocks = 0
         self._deadline_failures = 0
         self._wait_hist = Histogram()
+        # Per-calling-thread record of the last frame() dispatch path
+        # (device|host|bypass): callers with their own fused host
+        # kernel read last_route() to keep path metrics honest — a
+        # coalesced batch below min_device_blocks resolves to the host
+        # fallback even under a device calibration, and that must not
+        # be counted as a device window.
+        self._local = threading.local()
+        # Kernel-lane service time of this batcher's device dispatches
+        # (submit-to-result through io/engine.kernel_lane). For decode
+        # routes this is the read path's share of the shared
+        # accelerator — exported as the decode-route lane histogram.
+        self._lane_hist = Histogram()
         _REGISTRY.add(self)
 
     # -- calibration ----------------------------------------------------
@@ -276,6 +371,20 @@ class StripeBatcher:
         probe settles."""
         return self._device_ok is not False
 
+    def worth_batching(self, blocks: int) -> bool:
+        """True when frame(`blocks`) could plausibly take the device
+        route RIGHT NOW: calibration has not resolved to host, and
+        either the window alone is device-sized or other requests are
+        in flight to coalesce with. Callers with a fused native host
+        kernel of their own (the GET window's mtpu_get_frame) consult
+        this before stacking a member — a solo sub-threshold window
+        should ride the native kernel, not the batcher's generic host
+        fallback."""
+        if self._device_ok is False:
+            return False
+        return blocks >= self._min_device_blocks or self._inflight > 0 \
+            or bool(self._pending)
+
     def force(self, device_ok: bool) -> None:
         """Pin the calibration verdict (bench/tests): no probe runs,
         dispatch follows `device_ok` unconditionally. The env knob
@@ -291,7 +400,7 @@ class StripeBatcher:
         force()): unprobed under auto, re-pinned under a
         MTPU_BATCH_FORCE override."""
         with self._mu:
-            forced = batch_force_mode()
+            forced = batch_force_mode(self.route)
             if forced != "auto":
                 self._probe_started = True
                 self._device_ok = forced == "device"
@@ -307,6 +416,7 @@ class StripeBatcher:
             requests["bypass"] += self._bypass_approx
             return {
                 "name": self.name,
+                "route": self.route,
                 "mesh_devices": self.mesh_devices,
                 "dispatches": dict(self._dispatches),
                 "requests": requests,
@@ -315,6 +425,7 @@ class StripeBatcher:
                 "capacity_blocks": self._capacity_blocks,
                 "deadline_failures": self._deadline_failures,
                 "wait_hist": self._wait_hist.state(),
+                "lane_hist": self._lane_hist.state(),
                 "window_s": self._cur_wait,
             }
 
@@ -338,6 +449,7 @@ class StripeBatcher:
             # bump is unlocked too — approximate under races, and the
             # only shared state this path touches.
             self._bypass_approx += 1
+            self._local.route = "bypass"
             return self._host_fn(stacked)
         if stacked.shape[0] > _MAX_BATCH_BLOCKS:
             # An oversized window (whole-part framing of a huge
@@ -349,10 +461,13 @@ class StripeBatcher:
             # host route on its own merits) and splice the per-drive
             # rows back together.
             rows = None
+            routes = set()
             for off in range(0, stacked.shape[0], _MAX_BATCH_BLOCKS):
                 chunk = self.frame(stacked[off:off + _MAX_BATCH_BLOCKS])
-                rows = chunk if rows is None else [
-                    r + c for r, c in zip(rows, chunk)]
+                routes.add(self.last_route())
+                rows = chunk if rows is None else self._concat(rows, chunk)
+            self._local.route = "device" if "device" in routes \
+                else routes.pop()
             return rows
         dl = deadline_mod.current()
         if dl is not None and dl.expired():
@@ -379,13 +494,16 @@ class StripeBatcher:
                     # staging, padding buckets, kernel lane, tracing).
                     p = _Pending(stacked, dl)
                     self._run_batch([p])
+                    self._local.route = p.route_taken
                     if p.exc is not None:
                         raise p.exc
                     return p.rows
                 self._note_request("bypass")
+                self._local.route = "bypass"
                 return self._host_fn(stacked)
             if self._device_ok is not True:
                 self._note_request("host")
+                self._local.route = "host"
                 return self._host_fn(stacked)
             return self._enqueue(stacked, dl)
         finally:
@@ -411,9 +529,19 @@ class StripeBatcher:
             # coalescing window into a 200 ms latency spike.
             self._mu.notify_all()
         p.event.wait()
+        self._local.route = p.route_taken
         if p.exc is not None:
             raise p.exc
         return p.rows
+
+    def last_route(self) -> str:
+        """The dispatch path the CALLING thread's last frame() took:
+        "device" (rode a device dispatch), "host" (served by the host
+        fallback — calibration unresolved, or a coalesced batch below
+        min_device_blocks), or "bypass" (calibrated-host pass-through
+        / lone small window). Callers with a fused native kernel of
+        their own use this to label path metrics honestly."""
+        return getattr(self._local, "route", "host")
 
     # -- dispatch -------------------------------------------------------
 
@@ -471,7 +599,15 @@ class StripeBatcher:
                 taken = 0
                 for p in self._pending:
                     c = p.count
-                    if batch and taken + c > _MAX_BATCH_BLOCKS:
+                    if batch and (taken + c > _MAX_BATCH_BLOCKS
+                                  or p.stacked.shape[1:]
+                                  != batch[0].stacked.shape[1:]):
+                        # Over the bucket cap, or a DIFFERENT member
+                        # geometry (heal verifies of mixed EC configs
+                        # share one route batcher): staging copies
+                        # members into one [bucket, *trail] buffer, so
+                        # a batch is one trailing shape — the rest
+                        # keeps its place for the next round.
                         rest.append(p)
                     else:
                         batch.append(p)
@@ -558,31 +694,43 @@ class StripeBatcher:
             if total >= self._min_device_blocks and self._device_ok:
                 route = "device"
                 lease, stacked = self._stage(live, bucket)
+                t_lane = time.perf_counter()
                 try:
                     rows_all = self._lane_dispatch(stacked)
                 finally:
                     # The dispatch is synchronous through the readback
                     # (the framer returns host numpy), so the staging
                     # buffer is done feeding HBM here — and not before.
+                    self._lane_hist.observe(time.perf_counter() - t_lane)
                     if lease is not None:
                         lease.release()
-                k = live[0].stacked.shape[1]
-                staged = lease is not None or len(live) > 1
-                off = 0
-                for p, c in zip(live, counts):
-                    rows = [drive[off:off + c] for drive in rows_all]
-                    if staged:
-                        # Demultiplex data drives back onto each
-                        # member's OWN window: device rows view the
-                        # shared staging buffer whose lease just
-                        # returned to the pool; digests/parity are
-                        # fresh device output and stay as-is.
-                        for i in range(k):
-                            rows[i] = [(dig, p.stacked[bi, i])
-                                       for bi, (dig, _blk)
-                                       in enumerate(rows[i])]
-                    p.rows = rows
-                    off += c
+                if self._split_fn is not None:
+                    # Route-specific demux (get: verdict slices + data
+                    # views of the member's OWN window; reconstruct:
+                    # rebuilt-row slices).
+                    off = 0
+                    for p, c in zip(live, counts):
+                        p.rows = self._split_fn(rows_all, off, c,
+                                                p.stacked)
+                        off += c
+                else:
+                    k = live[0].stacked.shape[1]
+                    staged = lease is not None or len(live) > 1
+                    off = 0
+                    for p, c in zip(live, counts):
+                        rows = [drive[off:off + c] for drive in rows_all]
+                        if staged:
+                            # Demultiplex data drives back onto each
+                            # member's OWN window: device rows view the
+                            # shared staging buffer whose lease just
+                            # returned to the pool; digests/parity are
+                            # fresh device output and stay as-is.
+                            for i in range(k):
+                                rows[i] = [(dig, p.stacked[bi, i])
+                                           for bi, (dig, _blk)
+                                           in enumerate(rows[i])]
+                        p.rows = rows
+                        off += c
                 with self._stat_mu:
                     self._dispatches["device"] += 1
                     self._requests["device"] += len(live)
@@ -609,6 +757,7 @@ class StripeBatcher:
         finally:
             dur_ms = (time.perf_counter() - t0) * 1000.0
             for p in live:
+                p.route_taken = route
                 wait_s = max(0.0, t0 - p.t_enq)
                 self._wait_hist.observe(wait_s)
                 if p.tctx is not None:

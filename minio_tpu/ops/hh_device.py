@@ -829,6 +829,73 @@ def make_encode_framer(matrix: np.ndarray, mode: str = "auto"):
 
 
 # ---------------------------------------------------------------------------
+# Fused GET verify (the device de-framer)
+# ---------------------------------------------------------------------------
+# The read-side mirror of make_encode_framer: the GET hot loop's cost on
+# the host is HighwayHashing every fetched framed shard block
+# (native.cc mtpu_get_frame does it GIL-free; the numpy path in
+# storage/bitrot.read_framed_blocks_many does it vectorized). The
+# de-framer moves that hashing onto the accelerator: ONE dispatch takes
+# a stacked window of on-disk frames (`digest || block`,
+# cmd/bitrot-streaming.go:44-75) across the k data shards, recomputes
+# every block digest on device, and returns the per-(block, shard)
+# verification verdicts. The interleaved plaintext is then served as
+# zero-copy views of the caller's own framed bytes at demux time
+# (ops/batcher split_fn) — the payload never rides the device->host
+# link back (the digests are 32 bytes/block; the blocks are 128 KiB),
+# which is strictly less PCIe than the PUT direction pays. Byte
+# identity with the host kernels is therefore exactly the question
+# "does the device hash agree", asserted by tests/test_decode_route.py.
+
+
+def make_deframer(k: int, mode: str = "auto"):
+    """Single-chip fused GET verifier for k-data-shard stripes.
+
+    Returns fn(framed uint8 [B, k, F]) -> ok bool numpy [B, k], where
+    F = 32 + shard_size and row b holds erasure block b's k on-disk
+    frames. ok[b, i] is True when shard i's block b digest verifies —
+    the same verdict mtpu_get_frame's bad-mask encodes, batched.
+    """
+    del k  # shape-generic: the stream count is B*k either way
+    on_tpu = jax.default_backend() == "tpu"
+
+    @functools.partial(jax.jit, static_argnames=("pchunk",))
+    def verify32(framed32, init, pchunk: int):
+        """u32 hot path: framed [B, k, F4] u32 -> ok bool [B, k]."""
+        b, kk, f4 = framed32.shape
+        words = framed32[:, :, 8:].reshape(b * kk, f4 - 8)
+        digs = _hash_words_pallas(words, init, pchunk=pchunk)  # [B*k, 8]
+        stored = framed32[:, :, :8].reshape(b * kk, 8)
+        return jnp.all(digs == stored, axis=1).reshape(b, kk)
+
+    @jax.jit
+    def verify8(framed, init):
+        """Portable byte path: framed [B, k, F] u8 -> ok bool [B, k]."""
+        b, kk, f = framed.shape
+        blocks = framed[:, :, 32:].reshape(b * kk, f - 32)
+        digs = _hash_impl(blocks, init, f - 32)                # [B*k, 32]
+        stored = framed[:, :, :32].reshape(b * kk, 32)
+        return jnp.all(digs == stored, axis=1).reshape(b, kk)
+
+    def run(framed) -> np.ndarray:
+        framed = np.ascontiguousarray(framed, dtype=np.uint8)
+        b, kk, f = framed.shape
+        s = f - 32
+        pchunk = _pick_pchunk(s // 32) if s and s % 32 == 0 else 0
+        if on_tpu and f % 4 == 0 and s % 1024 == 0 and pchunk >= 8:
+            f32 = jnp.asarray(framed.view(np.uint32))
+            ok = verify32(f32, jnp.asarray(_init_smem_np(MAGIC_KEY)),
+                          _pick_pchunk(s // 4 // 8))
+        else:
+            ok = verify8(jnp.asarray(framed),
+                         jnp.asarray(_init_state_np(MAGIC_KEY)))
+        return np.asarray(ok)
+
+    run.mesh_devices = 1
+    return run
+
+
+# ---------------------------------------------------------------------------
 # Mesh-sharded cross-request framer
 # ---------------------------------------------------------------------------
 
@@ -854,6 +921,25 @@ def mesh_batch_devices(devices=None) -> list:
     while p * 2 <= len(devs) and p * 2 <= 256:
         p *= 2
     return devs[:p]
+
+
+def _shard_map_compat():
+    """shard_map under its jax 0.6 top-level or 0.4 experimental home,
+    with the replication check disabled under whichever kwarg name
+    (check_rep -> check_vma rename) this jax spells."""
+    try:                                       # jax >= 0.6 top-level
+        from jax import shard_map as _shard_map
+    except ImportError:                        # 0.4.x experimental home
+        from jax.experimental.shard_map import shard_map as _shard_map
+    import inspect as _inspect
+    _sm_params = _inspect.signature(_shard_map).parameters
+    _sm_kw = {"check_vma": False} if "check_vma" in _sm_params \
+        else ({"check_rep": False} if "check_rep" in _sm_params else {})
+
+    def shard_map(body, mesh, in_specs, out_specs):
+        return _shard_map(body, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **_sm_kw)
+    return shard_map
 
 
 def make_mesh_framer(matrix: np.ndarray, mode: str = "auto", devices=None):
@@ -882,20 +968,7 @@ def make_mesh_framer(matrix: np.ndarray, mode: str = "auto", devices=None):
     if ndev <= 1:
         return make_encode_framer(matrix, mode=mode)
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    try:                                       # jax >= 0.6 top-level
-        from jax import shard_map as _shard_map
-    except ImportError:                        # 0.4.x experimental home
-        from jax.experimental.shard_map import shard_map as _shard_map
-    import inspect as _inspect
-    # The replication-check kwarg was renamed check_rep -> check_vma;
-    # disable it under whichever name this jax spells.
-    _sm_params = _inspect.signature(_shard_map).parameters
-    _sm_kw = {"check_vma": False} if "check_vma" in _sm_params \
-        else ({"check_rep": False} if "check_rep" in _sm_params else {})
-
-    def shard_map(body, mesh, in_specs, out_specs):
-        return _shard_map(body, mesh=mesh, in_specs=in_specs,
-                          out_specs=out_specs, **_sm_kw)
+    shard_map = _shard_map_compat()
     from minio_tpu.ops.rs_device import make_encoder, make_encoder32
     matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
     m, k = matrix.shape
@@ -965,6 +1038,75 @@ def make_mesh_framer(matrix: np.ndarray, mode: str = "auto", devices=None):
             + [parity[:, j] for j in range(m)]
         return [[(digests[bi, i], shards[i][bi]) for bi in range(b)]
                 for i in range(n)]
+
+    run.mesh_devices = ndev
+    return run
+
+
+def make_mesh_deframer(k: int, mode: str = "auto", devices=None):
+    """The cross-request device de-framer: make_deframer's run()
+    contract — framed u8 [B, k, F] -> ok bool [B, k] — with the batch
+    dimension ("erasure blocks from MANY concurrent GetObject windows",
+    coalesced by ops/batcher's get route) sharded over every available
+    chip via NamedSharding(mesh, P("stripe")), exactly the encode
+    framer's dispatch shape mirrored.
+
+    `donate_argnums=(0,)` on TPU donates the staged framed window (one
+    pooled bufpool lease, ops/batcher._stage) into HBM so the read-side
+    batch flows host->HBM copy-free; only the B*k verdicts ride back.
+    One compile per (padding bucket, k, frame width). On one device
+    (CPU tests, MTPU_MESH_DEVICES=1) this degrades to the single-chip
+    fused verifier — same verdicts, no mesh machinery.
+    """
+    devs = mesh_batch_devices(devices)
+    ndev = len(devs)
+    if ndev <= 1:
+        return make_deframer(k, mode=mode)
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    shard_map = _shard_map_compat()
+    mesh = Mesh(np.asarray(devs), ("stripe",))
+    sharding = NamedSharding(mesh, P("stripe"))
+    on_tpu = jax.default_backend() == "tpu"
+    donate = (0,) if on_tpu else ()
+
+    @functools.partial(jax.jit, static_argnames=("pchunk",),
+                       donate_argnums=donate)
+    def mesh_verify32(framed32, init, pchunk: int):
+        def body(fr, ini):
+            b, kk, f4 = fr.shape
+            words = fr[:, :, 8:].reshape(b * kk, f4 - 8)
+            digs = _hash_words_pallas(words, ini, pchunk=pchunk)
+            stored = fr[:, :, :8].reshape(b * kk, 8)
+            return jnp.all(digs == stored, axis=1).reshape(b, kk)
+        return shard_map(body, mesh=mesh, in_specs=(P("stripe"), P()),
+                         out_specs=P("stripe"))(framed32, init)
+
+    @functools.partial(jax.jit, donate_argnums=donate)
+    def mesh_verify8(framed, init):
+        def body(fr, ini):
+            b, kk, f = fr.shape
+            blocks = fr[:, :, 32:].reshape(b * kk, f - 32)
+            digs = _hash_impl(blocks, ini, f - 32)
+            stored = fr[:, :, :32].reshape(b * kk, 32)
+            return jnp.all(digs == stored, axis=1).reshape(b, kk)
+        return shard_map(body, mesh=mesh, in_specs=(P("stripe"), P()),
+                         out_specs=P("stripe"))(framed, init)
+
+    def run(framed) -> np.ndarray:
+        framed = np.ascontiguousarray(framed, dtype=np.uint8)
+        b, kk, f = framed.shape
+        assert b % ndev == 0, \
+            f"batch {b} not divisible by {ndev}-chip mesh (pad buckets)"
+        s = f - 32
+        pchunk = _pick_pchunk(s // 32) if s and s % 32 == 0 else 0
+        if on_tpu and f % 4 == 0 and s % 1024 == 0 and pchunk >= 8:
+            f32 = jax.device_put(framed.view(np.uint32), sharding)
+            ok = mesh_verify32(f32, jnp.asarray(_init_smem_np(MAGIC_KEY)),
+                               _pick_pchunk(s // 4 // 8))
+        else:
+            f8 = jax.device_put(framed, sharding)
+            ok = mesh_verify8(f8, jnp.asarray(_init_state_np(MAGIC_KEY)))
+        return np.asarray(ok)
 
     run.mesh_devices = ndev
     return run

@@ -505,6 +505,57 @@ def make_encoder(matrix: np.ndarray, mode: str = "auto"):
     return lambda data: backend.apply_matrix_device(matrix, data)
 
 
+def make_mesh_matrix(matrix: np.ndarray, mode: str = "auto", devices=None):
+    """Mesh-sharded batched GF(2^8) matrix application — the decode
+    mirror of hh_device.make_mesh_framer's parity step: stacked u8
+    [B, k, L] -> u8 [B, r, L] with the batch dim ("stripes from MANY
+    degraded GetObject / heal calls", coalesced by ops/batcher's
+    reconstruct route) sharded over the chips via
+    NamedSharding(mesh, P("stripe")).
+
+    `matrix` is any (r x k) GF matrix: decode-matrix rows
+    (gf256.decode_matrix gathered for the missing data shards — one
+    compiled route per surviving-shard set, the common case being ONE
+    set per dead drive) for degraded reads, parity rows for heal's
+    re-derive. `donate_argnums=(0,)` on TPU donates the staged survivor
+    batch. On one device this degrades to the single-chip encoder —
+    same bytes (gf256 bitplane transform, byte-identical to the host
+    codec by the rs_device contract).
+    """
+    from minio_tpu.ops.hh_device import _shard_map_compat, mesh_batch_devices
+    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+    devs = mesh_batch_devices(devices)
+    ndev = len(devs)
+    encode = make_encoder(matrix, mode=mode)
+    if ndev <= 1:
+        def run_solo(stacked) -> np.ndarray:
+            stacked = np.ascontiguousarray(stacked, dtype=np.uint8)
+            return np.asarray(encode(jnp.asarray(stacked)))
+        run_solo.mesh_devices = 1
+        return run_solo
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    shard_map = _shard_map_compat()
+    mesh = Mesh(np.asarray(devs), ("stripe",))
+    sharding = NamedSharding(mesh, P("stripe"))
+    donate = (0,) if _on_tpu() else ()
+
+    @functools.partial(jax.jit, donate_argnums=donate)
+    def mesh_apply(data):
+        return shard_map(lambda d: encode(d), mesh=mesh,
+                         in_specs=(P("stripe"),),
+                         out_specs=P("stripe"))(data)
+
+    def run(stacked) -> np.ndarray:
+        stacked = np.ascontiguousarray(stacked, dtype=np.uint8)
+        assert stacked.shape[0] % ndev == 0, \
+            f"batch {stacked.shape[0]} not divisible by {ndev}-chip mesh"
+        d = jax.device_put(stacked, sharding)
+        return np.asarray(mesh_apply(d))
+
+    run.mesh_devices = ndev
+    return run
+
+
 def mesh_info() -> dict:
     """Accelerator-mesh summary for bench/admin surfaces: the resolved
     JAX backend, total visible devices, and the power-of-two mesh width

@@ -534,41 +534,52 @@ class Metrics:
         # adds; deadline failures count members culled before dispatch.
         from minio_tpu.ops import batcher as _batcher_mod
         bst = _batcher_mod.aggregate_stats()
+        routes = sorted(bst["routes"].items())
         metric("minio_tpu_batcher_dispatches_total",
-               "Coalesced stripe-batch dispatches by route", "counter",
-               [({"route": r}, v)
-                for r, v in sorted(bst["dispatches"].items())])
+               "Coalesced stripe-batch dispatches by route "
+               "(put|get|reconstruct) and resolved path", "counter",
+               [({"route": r, "path": p}, v) for r, st in routes
+                for p, v in sorted(st["dispatches"].items())])
         metric("minio_tpu_batcher_requests_total",
-               "PUT stripe windows routed through the batcher "
+               "Stripe windows routed through the batcher by route "
                "(bypass = calibrated host pass-through)", "counter",
-               [({"route": r}, v)
-                for r, v in sorted(bst["requests"].items())])
+               [({"route": r, "path": p}, v) for r, st in routes
+                for p, v in sorted(st["requests"].items())])
         metric("minio_tpu_batcher_bucket_dispatches_total",
                "Device dispatches per batch padding bucket", "counter",
-               [({"bucket": b}, v)
-                for b, v in sorted(bst["buckets"].items())])
+               [({"route": r, "bucket": b}, v) for r, st in routes
+                for b, v in sorted(st["buckets"].items())])
         metric("minio_tpu_batcher_batched_blocks_total",
                "Stripe blocks carried by device dispatches", "counter",
-               [({}, bst["batched_blocks"])])
+               [({"route": r}, st["batched_blocks"])
+                for r, st in routes])
         metric("minio_tpu_batcher_capacity_blocks_total",
                "Padded bucket capacity of those dispatches "
                "(batched/capacity = fill ratio)", "counter",
-               [({}, bst["capacity_blocks"])])
+               [({"route": r}, st["capacity_blocks"])
+                for r, st in routes])
         metric("minio_tpu_batcher_fill_ratio",
                "Mean batch fill ratio (blocks dispatched / bucket "
                "capacity) since boot", "gauge",
-               [({}, round(bst["fill_ratio"], 4))])
+               [({"route": r}, round(st["fill_ratio"], 4))
+                for r, st in routes])
         metric("minio_tpu_batcher_deadline_failures_total",
                "Batch members failed for exhausted deadlines before "
                "dispatch (batch-mates unaffected)", "counter",
-               [({}, bst["deadline_failures"])])
+               [({"route": r}, st["deadline_failures"])
+                for r, st in routes])
         metric("minio_tpu_batcher_mesh_devices",
                "Chips the batched dispatch shards over", "gauge",
                [({}, bst["mesh_devices"])])
         hist_metric("minio_tpu_batcher_wait_seconds",
                     "Coalescing wait per batched stripe window "
                     "(enqueue to dispatch start)",
-                    [({}, bst["wait_hist"])])
+                    [({"route": r}, st["wait_hist"])
+                     for r, st in routes])
+        hist_metric("minio_tpu_kernel_lane_decode_service_seconds",
+                    "Kernel-lane service time of decode-route "
+                    "(get/reconstruct) device dispatches",
+                    [({}, bst["decode_lane_hist"])])
         # Report the lane without CREATING it: kernel_lane() lazily
         # spawns a worker thread, and a scrape on a host-codec-only
         # process should not pay a permanent thread to export zeros.
@@ -669,7 +680,7 @@ class Metrics:
                    "invalidations": 0, "entries": 0, "bytes": 0,
                    "stat_hits": 0, "stat_misses": 0, "stat_entries": 0,
                    "stat_evictions": 0}
-            gk = {"native": 0, "numpy": 0, "demoted": 0}
+            gk = {"native": 0, "numpy": 0, "demoted": 0, "device": 0}
             for s in layer_sets(object_layer):
                 cache = getattr(s, "fi_cache", None)
                 if cache is not None:
@@ -836,7 +847,7 @@ def node_info(server) -> dict:
     engine = []
     fileinfo = []
     metacache = []
-    get_kernel = {"native": 0, "numpy": 0, "demoted": 0}
+    get_kernel = {"native": 0, "numpy": 0, "demoted": 0, "device": 0}
     for si, s in enumerate(sets):
         eng = getattr(s, "io", None)
         if eng is not None:

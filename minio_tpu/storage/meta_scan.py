@@ -187,7 +187,23 @@ class BlobScanner:
         self._fill += n
 
     def add_bytes(self, path: str, blob: bytes) -> None:
-        self._items.append((path, None, bytes(blob)))
+        """Stage an already-materialized journal into the pooled batch
+        (walk paths that hold bytes rather than open fds — the
+        background scanner's merged drive walk): the blob copies into
+        the pooled lease so flush()'s ONE native call covers it too.
+        Oversized blobs (or a full buffer) take the per-blob fallback
+        with their own bytes."""
+        n = len(blob)
+        if n > self.MAX_POOLED:
+            self._items.append((path, None, bytes(blob)))
+            return
+        self._ensure_lease()
+        if n > self.room():
+            self._items.append((path, None, bytes(blob)))
+            return
+        self._view[self._fill:self._fill + n] = blob
+        self._items.append((path, self._fill, self._fill + n))
+        self._fill += n
 
     # -- scanning ----------------------------------------------------------
 

@@ -18,7 +18,7 @@ fi
 
 echo "== bench smoke (baseline: $latest) =="
 out=$(JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} \
-      MTPU_BENCH_ONLY=put_latency,put_concurrent,get_concurrent,meta_listing \
+      MTPU_BENCH_ONLY=put_latency,put_concurrent,get_latency,get_concurrent,meta_listing \
       MTPU_BENCH_SMALL=1 \
       python bench.py)
 echo "$out"
@@ -48,12 +48,18 @@ import sys
 # rounding noise. On hosts where the fixture cannot build (no /dev/shm
 # capacity) the bench emits the metrics with value null and the gates
 # skip cleanly.
+# The hot-GET p50 gate ("lower") watches the read hot path the decode
+# batcher PR must not regress: get_latency's headline is the repeat-GET
+# p50 (fileinfo cache + verify kernel — native host or batched device
+# per calibration). The bench emits an explicit null on hosts where the
+# fixture cannot build, and the gate skips cleanly there.
 GATES = [
     ("put_concurrent_aggregate_gibps", "host_gibps", "higher"),
     ("put_concurrent_aggregate_gibps", "served_ratio", "higher"),
     ("get_concurrent_aggregate_gibps", "object_layer_gibps", "higher"),
     ("get_concurrent_aggregate_gibps", "served_ratio", "higher"),
     ("put_object_p50_ec4_1mib_ms", "value", "lower"),
+    ("get_object_p50_ec4_1mib_ms", "value", "lower"),
     ("meta_listing_list_cold_p50_ms", "value", "lower"),
     ("meta_listing_head_p50_ms", "cold_p50_ms", "lower"),
 ]
