@@ -6,6 +6,24 @@ process to one peer share a single TCP connection; a background reader
 demultiplexes responses to per-call queues. Connection loss fails all
 in-flight calls (the storage layer treats that as a per-drive fault and
 its quorum logic absorbs it) and the next call reconnects.
+
+Peer health rides a per-peer circuit breaker mirroring the drive-health
+breaker (storage/health.DiskHealthWrapper): `trip_after` consecutive
+TRANSPORT failures open it, open calls fail in microseconds instead of
+paying a connect timeout each, and a single half-open probe per
+cooldown window re-closes it when the peer returns. The cooldown
+doubles (jittered, bounded) across consecutive failed probes so a
+long-dead peer is probed ever more lazily — the bounded reconnect
+backoff — while a peer that was merely restarting recovers within one
+base cooldown. Remote handler errors (RemoteCallError) never trip the
+breaker: the peer answered; the handler's exception is the caller's
+semantics, not peer death.
+
+Environment:
+  MTPU_GRID_TRIP_AFTER    consecutive transport faults that open the
+                          breaker (default 3)
+  MTPU_GRID_COOLDOWN      base breaker cooldown seconds (default 0.5)
+  MTPU_GRID_COOLDOWN_MAX  backoff ceiling seconds (default 15)
 """
 
 from __future__ import annotations
@@ -16,13 +34,14 @@ import random
 import socket
 import threading
 import time
-from typing import Iterator, Optional
+from typing import Callable, Iterator, Optional
 
-from minio_tpu.grid import wire
+from minio_tpu.grid import chaos, wire
 from minio_tpu.grid.wire import GridError, RemoteCallError
 from minio_tpu.utils import deadline as deadline_mod
 from minio_tpu.utils import tracing
 from minio_tpu.utils.deadline import DeadlineExceeded
+from minio_tpu.utils.env import env_num as _env_num
 
 _SENTINEL_ERR = "__conn_lost__"
 
@@ -30,7 +49,10 @@ _SENTINEL_ERR = "__conn_lost__"
 class GridClient:
     def __init__(self, host: str, port: int, connect_timeout: float = 5.0,
                  call_timeout: float = 60.0, send_retries: int = 2,
-                 retry_backoff: float = 0.05):
+                 retry_backoff: float = 0.05,
+                 trip_after: Optional[int] = None,
+                 cooldown: Optional[float] = None,
+                 cooldown_max: Optional[float] = None):
         self.host = host
         self.port = port
         self.connect_timeout = connect_timeout
@@ -58,20 +80,134 @@ class GridClient:
         self._pending: dict[int, tuple[socket.socket, "queue.Queue[dict]"]] \
             = {}
         self._reader: Optional[threading.Thread] = None
+        # -- circuit breaker (mirrors the drive-health breaker) --------
+        self.trip_after = trip_after if trip_after is not None \
+            else _env_num("MTPU_GRID_TRIP_AFTER", 3, int)
+        self.cooldown = cooldown if cooldown is not None \
+            else _env_num("MTPU_GRID_COOLDOWN", 0.5)
+        self.cooldown_max = cooldown_max if cooldown_max is not None \
+            else _env_num("MTPU_GRID_COOLDOWN_MAX", 15.0)
+        self._consecutive = 0
+        self._open_since = 0.0               # 0 = closed
+        self._open_for = 0.0                 # current (jittered) cooldown
+        self._probe_streak = 0               # consecutive failed probes
+        self._half_open_probe = False
+        self._probe_started = 0.0
+        self._probe_owner = 0                # thread holding the probe
+        # Monotonic counters (Prometheus + admin info).
+        self.connects_total = 0
+        self.reconnects_total = 0
+        self._conn_attempted = False
+        self.rpc_errors_total = 0
+        self.breaker_opens_total = 0
+        # Called (peer_key) from the reader when a live connection dies
+        # — coherence (grid/coherence.py) disarms the peer immediately
+        # instead of waiting for its next sync tick.
+        self.on_conn_lost: list[Callable[[], None]] = []
+
+    # -- breaker ---------------------------------------------------------
+
+    # A half-open probe that never reports back (its caller's deadline
+    # expired mid-call, or an abandoned stream) releases its slot after
+    # this long, so one lost probe can never wedge the breaker open
+    # against a healthy peer forever.
+    PROBE_TTL = 30.0
+
+    def _admit(self) -> None:
+        """Fail fast while the breaker is open; let one probe through
+        per cooldown window (half-open)."""
+        with self._mu:
+            if self._open_since == 0.0:
+                return
+            now = time.monotonic()
+            if now - self._open_since < self._open_for:
+                raise GridError(
+                    f"peer {self.host}:{self.port}: circuit open")
+            if self._half_open_probe and \
+                    now - self._probe_started < self.PROBE_TTL:
+                raise GridError(
+                    f"peer {self.host}:{self.port}: circuit half-open, "
+                    "probing")
+            self._half_open_probe = True
+            self._probe_started = now
+            self._probe_owner = threading.get_ident()
+
+    def _fault(self) -> None:
+        with self._mu:
+            self._consecutive += 1
+            self.rpc_errors_total += 1
+            if self._open_since != 0.0:
+                # Failed half-open PROBE: restart the cooldown, doubled
+                # (jittered, bounded) — the reconnect backoff. Without
+                # the restart every call after the first cooldown would
+                # become a probe and eat a connect timeout. Only the
+                # probe OWNER's failure counts: stragglers admitted
+                # before the breaker opened fault here as their
+                # timeouts land, and letting them take this branch
+                # would inflate the backoff toward the ceiling and
+                # release a live probe's slot mid-flight.
+                if not self._half_open_probe or \
+                        self._probe_owner != threading.get_ident():
+                    return
+                self._half_open_probe = False
+                self._probe_streak += 1
+                self._open_since = time.monotonic()
+                self._open_for = min(
+                    self.cooldown * (2 ** self._probe_streak),
+                    self.cooldown_max) * (0.75 + random.random() / 2)
+            elif self._consecutive >= self.trip_after:
+                self.breaker_opens_total += 1
+                self._open_since = time.monotonic()
+                self._probe_streak = 0
+                self._open_for = self.cooldown * \
+                    (0.75 + random.random() / 2)
+
+    def _ok(self) -> None:
+        with self._mu:
+            self._consecutive = 0
+            self._open_since = 0.0
+            self._open_for = 0.0
+            self._probe_streak = 0
+            self._half_open_probe = False
+
+    def breaker_state(self) -> str:
+        with self._mu:
+            if self._open_since == 0.0:
+                return "closed"
+            if time.monotonic() - self._open_since >= self._open_for:
+                return "half-open"
+            return "open"
+
+    def stats(self) -> dict:
+        return {"peer": f"{self.host}:{self.port}",
+                "state": self.breaker_state(),
+                "connects": self.connects_total,
+                "reconnects": self.reconnects_total,
+                "rpc_errors": self.rpc_errors_total,
+                "breaker_opens": self.breaker_opens_total}
 
     # -- connection management -----------------------------------------
 
     def _connect_locked(self) -> None:
         if self._sock is not None:
             return
+        # A "reconnect" is any successful connect that was not the
+        # client's very first attempt — whether the previous connection
+        # died or earlier attempts never got through.
+        was_attempted = self._conn_attempted
+        self._conn_attempted = True
         try:
+            chaos.net("connect")
             s = socket.create_connection((self.host, self.port),
                                          timeout=self.connect_timeout)
-        except OSError as e:
+        except (OSError, chaos.ChaosInjected) as e:
             raise GridError(f"connect {self.host}:{self.port}: {e}") from None
         s.settimeout(None)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = s
+        self.connects_total += 1
+        if was_attempted:
+            self.reconnects_total += 1
         self._reader = threading.Thread(target=self._read_loop, args=(s,),
                                         daemon=True)
         self._reader.start()
@@ -88,11 +224,17 @@ class GridClient:
             s.close()
         except OSError:
             pass
+        for cb in self.on_conn_lost:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 - observers must not break I/O
+                pass
 
     def _read_loop(self, s) -> None:
         try:
             while True:
                 msg = wire.read_frame(s)
+                chaos.net("recv")
                 t = msg.get("t")
                 if t == wire.T_PING:
                     with self._wmu:
@@ -106,7 +248,7 @@ class GridClient:
                 ent = self._pending.get(msg.get("m"))
                 if ent is not None:
                     ent[1].put(msg)
-        except (GridError, OSError):
+        except (GridError, OSError, chaos.ChaosInjected):
             self._drop_conn(s)
 
     def close(self) -> None:
@@ -128,13 +270,14 @@ class GridClient:
             self._pending[mux] = (s, q)
         try:
             with self._wmu:
+                chaos.net("send")
                 # Re-check under the write lock: a concurrent failure
                 # may have replaced the connection after registration.
                 with self._mu:
                     if self._sock is not s:
                         raise OSError("connection replaced")
                 s.sendall(frame)
-        except OSError as e:
+        except (OSError, chaos.ChaosInjected) as e:
             with self._mu:
                 self._pending.pop(mux, None)
             # Drop the connection fully (close the socket so the parked
@@ -154,7 +297,9 @@ class GridClient:
         Only the SEND phase retries: a frame that failed to leave (or
         a connection that died while it left) was never answered, so
         re-sending cannot double-apply. Retries stop the moment the
-        bound request deadline cannot afford another attempt."""
+        bound request deadline cannot afford another attempt — and the
+        moment the breaker opens (a dead peer costs ONE fast failure,
+        not a connect timeout per attempt per call)."""
         dl = deadline_mod.current()
         last: Optional[GridError] = None
         for attempt in range(self.send_retries + 1):
@@ -168,6 +313,7 @@ class GridClient:
                 raise DeadlineExceeded(
                     f"deadline exceeded calling {handler} on "
                     f"{self.host}:{self.port}")
+            self._admit()
             mux = next(self._mux)
             q: "queue.Queue[dict]" = queue.Queue()
             try:
@@ -177,6 +323,7 @@ class GridClient:
             except RemoteCallError:
                 raise
             except GridError as e:
+                self._fault()
                 last = e
         raise last if last is not None else GridError(
             f"send {handler} to {self.host}:{self.port} failed")
@@ -190,9 +337,23 @@ class GridClient:
             return q.get(timeout=eff)
         except queue.Empty:
             if dl is not None and eff < wait:
+                # The caller's budget ran out before the peer's window
+                # did — the request's problem, never breaker fuel. If
+                # THIS thread holds the half-open probe slot, release
+                # it (no verdict either way) so the next call can
+                # probe; a non-probe call must not release someone
+                # else's slot (two concurrent probes would each pay a
+                # connect timeout and double the backoff). Probes
+                # whose stream is pulled from another thread fall to
+                # the PROBE_TTL backstop in _admit.
+                with self._mu:
+                    if self._half_open_probe and \
+                            self._probe_owner == threading.get_ident():
+                        self._half_open_probe = False
                 raise DeadlineExceeded(
                     f"deadline exceeded awaiting {handler} from "
                     f"{self.host}:{self.port}") from None
+            self._fault()
             raise GridError(
                 f"call {handler} to {self.host}:{self.port} timed out") \
                 from None
@@ -207,10 +368,15 @@ class GridClient:
             try:
                 msg = self._recv(q, handler, timeout)
                 if msg["t"] == wire.T_RESP:
+                    self._ok()
                     return msg.get("p")
                 code = msg.get("e", "Internal")
                 if code == _SENTINEL_ERR:
+                    self._fault()
                     raise GridError("connection lost mid-call")
+                # The peer ANSWERED — its handler raised. Healthy
+                # transport; never breaker fuel.
+                self._ok()
                 raise RemoteCallError(code, msg.get("msg", ""))
             finally:
                 self._finish(mux)
@@ -235,11 +401,14 @@ class GridClient:
                     chunks += 1
                     yield msg.get("p")
                 elif t == wire.T_EOF:
+                    self._ok()
                     return
                 else:
                     code = msg.get("e", "Internal")
                     if code == _SENTINEL_ERR:
+                        self._fault()
                         raise GridError("connection lost mid-stream")
+                    self._ok()
                     raise RemoteCallError(code, msg.get("msg", ""))
         finally:
             self._finish(mux)
@@ -271,3 +440,11 @@ def client_for(host: str, port: int) -> GridClient:
         if c is None:
             c = _clients[key] = GridClient(host, port)
         return c
+
+
+def peer_stats() -> list[dict]:
+    """Breaker/counter snapshot of every shared peer client, for the
+    Prometheus render and admin info."""
+    with _clients_mu:
+        clients = list(_clients.values())
+    return [c.stats() for c in clients]

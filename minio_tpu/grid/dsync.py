@@ -22,9 +22,18 @@ from typing import Callable, Optional, Sequence
 from minio_tpu.grid.client import GridClient
 from minio_tpu.grid.wire import GridError
 from minio_tpu.object.nslock import LockTimeout
+from minio_tpu.utils.env import env_float as _env_float
 
-LOCK_TTL = 30.0
-REFRESH_INTERVAL = 8.0
+
+# Holder-liveness window: a SIGKILLed holder's entries expire on every
+# surviving lock server within LOCK_TTL of its last refresh, so a
+# blocked writer proceeds within that bounded window instead of
+# wedging the namespace forever. Refresh must outpace expiry — the
+# interval is clamped to TTL/3 so a mis-set pair can never let a
+# healthy holder's entries lapse between refreshes.
+LOCK_TTL = _env_float("MTPU_GRID_LOCK_TTL", 30.0)
+REFRESH_INTERVAL = min(_env_float("MTPU_GRID_LOCK_REFRESH", 8.0),
+                       LOCK_TTL / 3.0)
 
 # Shared worker pools and a single refresher servicing every held lock:
 # at production concurrency the old thread-per-locker-per-round +
@@ -103,18 +112,28 @@ class _RefreshDaemon:
                 # while this holder still trusts it).
                 if not getattr(m, "_refresh_inflight", False):
                     m._refresh_inflight = True
-                    _shared_refresh_pool().submit(m._refresh_once)
+                    try:
+                        _shared_refresh_pool().submit(m._refresh_once)
+                    except RuntimeError:
+                        # Interpreter shutting down: the pool refuses
+                        # new futures; the daemon dies with the process.
+                        m._refresh_inflight = False
+                        return
 
 
 class LockServer:
     """Per-node lock table with TTL expiry."""
 
-    def __init__(self, ttl: float = LOCK_TTL):
-        self.ttl = ttl
+    def __init__(self, ttl: Optional[float] = None):
+        self.ttl = ttl if ttl is not None else LOCK_TTL
         self._mu = threading.Lock()
         # resource -> {"writer": uid|None, "wexp": ts,
         #              "readers": {uid: expiry}}
         self._res: dict[str, dict] = {}
+        # TTL expirations of entries whose holder stopped refreshing
+        # (crashed/SIGKILLed/partitioned) — the liveness counter the
+        # lock-leak regression tests assert on.
+        self.expired_total = 0
 
     def _entry(self, resource: str) -> dict:
         e = self._res.get(resource)
@@ -126,7 +145,20 @@ class LockServer:
     def _expire(self, e: dict, now: float) -> None:
         if e["writer"] is not None and e["wexp"] < now:
             e["writer"] = None
-        e["readers"] = {u: x for u, x in e["readers"].items() if x >= now}
+            self.expired_total += 1
+        live = {u: x for u, x in e["readers"].items() if x >= now}
+        self.expired_total += len(e["readers"]) - len(live)
+        e["readers"] = live
+
+    def stats(self) -> dict:
+        with self._mu:
+            now = time.monotonic()
+            writers = sum(1 for e in self._res.values()
+                          if e["writer"] is not None and e["wexp"] >= now)
+            readers = sum(len(e["readers"]) for e in self._res.values())
+            return {"resources": len(self._res), "writers": writers,
+                    "readers": readers, "expired_total": self.expired_total,
+                    "ttl": self.ttl}
 
     def try_lock(self, resource: str, uid: str, write: bool) -> bool:
         now = time.monotonic()
@@ -208,21 +240,26 @@ class RemoteLocker:
     def __init__(self, client: GridClient):
         self.client = client
 
-    def _call(self, op: str, resource: str, uid: str, write: bool) -> bool:
+    def _call(self, op: str, resource: str, uid: str, write: bool):
+        """True/False = the peer ANSWERED (vote); None = unreachable
+        (breaker open, dead node, partition) — the distinction lets
+        DRWMutex fail FAST when a lock quorum cannot possibly form,
+        instead of spinning try-rounds against dead peers until its
+        timeout."""
         try:
             return bool(self.client.call(
                 f"lock.{op}", {"r": resource, "u": uid, "w": write},
                 timeout=5.0))
         except GridError:
-            return False
+            return None
 
-    def try_lock(self, resource, uid, write) -> bool:
+    def try_lock(self, resource, uid, write):
         return self._call("try", resource, uid, write)
 
-    def unlock(self, resource, uid, write) -> bool:
+    def unlock(self, resource, uid, write):
         return self._call("unlock", resource, uid, write)
 
-    def refresh(self, resource, uid, write) -> bool:
+    def refresh(self, resource, uid, write):
         return self._call("refresh", resource, uid, write)
 
 
@@ -237,6 +274,9 @@ class DRWMutex:
         self.uid = str(uuid_mod.uuid4())
         self._write = False
         self._held = False
+        # Set when lock() gave up because too few lock servers even
+        # ANSWERED to form a quorum (fast-fail path, not contention).
+        self.quorum_unreachable = False
         self._stop_refresh = threading.Event()
 
     def _quorum(self, write: bool) -> int:
@@ -246,14 +286,24 @@ class DRWMutex:
         n = len(self.lockers)
         return n // 2 + 1 if write else n - n // 2
 
-    def _fanout(self, op: str, write: bool) -> int:
-        results = [False] * len(self.lockers)
+    def _fanout(self, op: str, write: bool) -> tuple[int, int, bool]:
+        """(granted, reachable, concluded): grants are True votes;
+        reachable counts lockers that ANSWERED (True or False) — None
+        means the locker could not be reached at all. `concluded` is
+        True only when every fan-out task actually RAN to completion
+        inside the window: a task still queued behind a saturated
+        shared pool proves nothing about its locker, so callers must
+        never fast-fail on reachability evidence from an unconcluded
+        round."""
+        results: list = [None] * len(self.lockers)
+        ran = [False] * len(self.lockers)
 
         def run(i, lk):
             try:
                 results[i] = getattr(lk, op)(self.resource, self.uid, write)
             except Exception:  # noqa: BLE001 - dead locker == vote lost
-                results[i] = False
+                results[i] = None
+            ran[i] = True
         pool = _shared_rpc_pool()
         futs = [pool.submit(run, i, lk)
                 for i, lk in enumerate(self.lockers)]
@@ -263,13 +313,23 @@ class DRWMutex:
                 f.result(timeout=max(0.0, deadline - time.monotonic()))
             except Exception:  # noqa: BLE001 - timeout == vote lost
                 pass
-        return sum(results)
+        granted = sum(1 for r in results if r is True)
+        reachable = sum(1 for r in results if r is not None)
+        return granted, reachable, all(ran)
 
     def lock(self, write: bool = True, timeout: float = 60.0) -> bool:
+        # Never spin past the caller's request deadline: the lock
+        # attempt is part of a budgeted request (PR-1 deadlines), and
+        # a lock that cannot be had inside the budget is a fast 503,
+        # not a wedged handler.
+        from minio_tpu.utils import deadline as deadline_mod
+        dl = deadline_mod.current()
+        if dl is not None:
+            timeout = min(timeout, max(0.0, dl.remaining()))
         deadline = time.monotonic() + timeout
         quorum = self._quorum(write)
         while True:
-            got = self._fanout("try_lock", write)
+            got, reachable, concluded = self._fanout("try_lock", write)
             if got >= quorum:
                 self._write = write
                 self._held = True
@@ -278,6 +338,15 @@ class DRWMutex:
             # Failed round: release any partial grants, back off, retry
             # (reference: releaseAll + retry loop, drwmutex.go:218).
             self._fanout("unlock", write)
+            if concluded and reachable < quorum:
+                # A quorum cannot POSSIBLY form — too many lock
+                # servers are dead or partitioned (their breakers make
+                # this round microseconds, not connect timeouts).
+                # Retrying until the timeout cannot help and wedges
+                # every writer for the full window; fail fast and let
+                # the client retry against an honest 503.
+                self.quorum_unreachable = True
+                return False
             if time.monotonic() >= deadline:
                 return False
             time.sleep(random.uniform(0.02, 0.1))
@@ -305,7 +374,8 @@ class DRWMutex:
         if self._stop_refresh.is_set() or not self._held:
             _RefreshDaemon.get().unregister(self)
             return
-        if self._fanout("refresh", self._write) < self._quorum(self._write):
+        granted, _, _ = self._fanout("refresh", self._write)
+        if granted < self._quorum(self._write):
             # Quorum lost (network partition, peer restarts): the
             # holder must stop trusting its lock (reference loss
             # callback cancels the op's context).
@@ -330,7 +400,10 @@ class DistNSLock:
     def write(self, volume: str, path: str, timeout: float = 60.0):
         m = DRWMutex(self.lockers, f"{volume}/{path}")
         if not m.lock(write=True, timeout=timeout):
-            raise LockTimeout(f"dist write lock {volume}/{path}")
+            raise LockTimeout(
+                f"dist write lock {volume}/{path}"
+                + (" (lock quorum unreachable)"
+                   if m.quorum_unreachable else ""))
         try:
             yield
         finally:
@@ -340,7 +413,10 @@ class DistNSLock:
     def read(self, volume: str, path: str, timeout: float = 60.0):
         m = DRWMutex(self.lockers, f"{volume}/{path}")
         if not m.lock(write=False, timeout=timeout):
-            raise LockTimeout(f"dist read lock {volume}/{path}")
+            raise LockTimeout(
+                f"dist read lock {volume}/{path}"
+                + (" (lock quorum unreachable)"
+                   if m.quorum_unreachable else ""))
         try:
             yield
         finally:

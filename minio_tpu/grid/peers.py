@@ -15,7 +15,21 @@ from __future__ import annotations
 import threading
 from typing import Callable
 
+from minio_tpu.utils import tracing
+
 RELOAD_HANDLER = "peer.reload"
+
+# Fan-out outcome counters (module-level: one Prometheus scrape line
+# aggregates every notifier instance in the process). Best-effort
+# failures stay best-effort — but never invisible.
+_stats_mu = threading.Lock()
+NOTIFY_SENT = 0
+NOTIFY_FAILED = 0
+
+
+def notify_stats() -> dict:
+    with _stats_mu:
+        return {"sent": NOTIFY_SENT, "failed": NOTIFY_FAILED}
 
 # Reload kinds a peer understands.
 KIND_IAM = "iam"
@@ -56,10 +70,22 @@ class PeerNotifier:
             t.join(self._timeout)
 
     def _one(self, client, payload) -> None:
+        global NOTIFY_SENT, NOTIFY_FAILED
         try:
             client.call(RELOAD_HANDLER, payload, timeout=self._timeout)
-        except Exception:  # noqa: BLE001 - peer down; TTL is the fallback
-            pass
+            with _stats_mu:
+                NOTIFY_SENT += 1
+        except Exception as e:  # noqa: BLE001 - peer down; TTL fallback —
+            # but the swallowed failure is counted and named: a silent
+            # best-effort path that fails every time is an outage.
+            with _stats_mu:
+                NOTIFY_FAILED += 1
+            tracing.slow_event(
+                "grid", "peer.notify-failed",
+                tags={"peer": f"{getattr(client, 'host', '?')}:"
+                              f"{getattr(client, 'port', '?')}",
+                      "kind": payload.get("kind", ""),
+                      "error": f"{type(e).__name__}: {e}"})
 
 
 def make_reload_handler(iam=None, object_layer=None,

@@ -15,7 +15,7 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional
 
-from minio_tpu.grid import wire
+from minio_tpu.grid import chaos, wire
 
 # exception class -> wire code (extended by storage/remote.py, dsync).
 ERROR_CODES: dict[type, str] = {}
@@ -81,6 +81,15 @@ class GridServer:
             except OSError:
                 pass
         for conn in list(self._conns):
+            # shutdown() before close(): the per-conn reader thread is
+            # blocked in recv, which pins the open socket — a bare
+            # close() would neither wake it nor send the FIN, leaving
+            # peers parked on a half-dead connection with no signal
+            # (their conn-loss hooks — coherence disarm — never fire).
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 conn.close()
             except OSError:
@@ -106,19 +115,27 @@ class GridServer:
         def send(msg: dict) -> None:
             blob = wire.pack_frame(msg)
             with wlock:
+                chaos.net("send")
                 conn.sendall(blob)
 
         try:
             while True:
                 msg = wire.read_frame(conn)
+                # Node-level chaos (tests/cluster.py): a blackholed
+                # node's server side drops the connection; "drop" mode
+                # swallows request frames silently so callers time out
+                # (the asymmetric-partition shape).
+                chaos.net("recv")
                 t = msg.get("t")
+                if t in (wire.T_REQ, wire.T_SREQ) and chaos.drop_inbound():
+                    continue
                 if t == wire.T_PING:
                     send({"t": wire.T_PONG})
                 elif t == wire.T_REQ:
                     self._pool.submit(self._run_unary, send, msg)
                 elif t == wire.T_SREQ:
                     self._pool.submit(self._run_stream, send, msg)
-        except (wire.GridError, OSError, RuntimeError):
+        except (wire.GridError, OSError, RuntimeError, chaos.ChaosInjected):
             # RuntimeError: pool shut down mid-frame during server stop.
             pass
         finally:

@@ -298,6 +298,13 @@ def _worker_stat(server, worker_id: int) -> dict:
         "engine": engine,
         "fileinfo_cache": fileinfo,
     }
+    # Grid peer breaker state (empty on single-node workers today;
+    # carried so a future workers+distributed combination aggregates
+    # per-worker peer health for free, like the engine rows above).
+    from minio_tpu.grid import client as _grid_client
+    gp = _grid_client.peer_stats()
+    if gp:
+        stat["grid"] = gp
     dh = getattr(server, "drive_heal", None)
     if dh is not None:
         # Bulk heals run on worker 0 only, but SO_REUSEPORT balances

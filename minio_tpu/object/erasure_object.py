@@ -323,12 +323,15 @@ class ErasureSet:
             == "minio_tpu.storage.remote"
             for d in self.disks if d is not None)
         if self._remote_set:
-            # Distributed set: a PEER node's writes reach this cache
-            # only via the coalesced best-effort listing broadcast —
-            # too weak a coherence contract for metadata serving. The
-            # cache stays a single-node (and pre-forked-worker, where
-            # the shared generation file is authoritative) win.
-            self.fi_cache.enabled = False
+            # Distributed set: the cache stays ENABLED, gated on the
+            # cross-node generation protocol (grid/coherence). The
+            # distributed boot replaces this deny-all sentinel with
+            # the live PeerCoherence.coherent gate; until then (and on
+            # bare remote sets built without the protocol) lookups
+            # answer misses — correct, just uncached — instead of
+            # hits no invalidation contract covers.
+            self.fi_cache.remote_gate = lambda: False
+            self.metacache.remote_gate = lambda: False
         # Read-kernel counters (admin info): windows served by the
         # fused native GET kernel, by the numpy path, and native
         # verifies that demoted to reconstruction. Incremented from

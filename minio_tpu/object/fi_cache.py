@@ -104,6 +104,14 @@ class FileInfoCache:
         # Cross-process invalidation observer (io/workers.SharedGen or
         # anything with a changed() -> bool); None in single-process.
         self.shared_gen = None
+        # Cross-NODE coherence gate (grid/coherence.PeerCoherence
+        # .coherent, wired at distributed boot). Remote-drive sets set
+        # a deny-all sentinel at construction; the cluster boot
+        # replaces it with the live generation protocol — so the cache
+        # is ON cluster-wide under the protocol, and a bare remote set
+        # without it answers misses, never unprovable hits. None on
+        # local-only sets (no gate, no overhead).
+        self.remote_gate = None
         # Stats (monotonic counters; entries/bytes are gauges).
         self.hits = 0
         self.misses = 0
@@ -161,13 +169,29 @@ class FileInfoCache:
 
     # -- lookup / insert -------------------------------------------------
 
+    def _serving(self) -> bool:
+        """May cached entries be SERVED right now? On a distributed
+        set this requires the coherence gate: with any peer disarmed
+        this node cannot prove it has seen every remote mutation, so
+        lookups miss (a re-read fan-out) rather than risk a stale hit.
+        Inserts are not gated — the token protocol plus the drop in
+        invalidate_bucket make an entry inserted around a resync
+        harmless."""
+        gate = self.remote_gate
+        if gate is None:
+            return True
+        try:
+            return bool(gate())
+        except Exception:  # noqa: BLE001 - a broken gate fails closed
+            return False
+
     def get(self, bucket: str, object_: str, version_id: str,
             need_data: bool) -> Optional[tuple]:
         """(fi, fis) or None. `need_data=True` only matches entries
         whose fis were read with read_data (inline payloads loaded) —
         a metadata-only entry must not feed the data path its empty
         inline sentinels."""
-        if not self.enabled:
+        if not self.enabled or not self._serving():
             return None
         self.maybe_flush()
         key = (bucket, object_, version_id)
@@ -227,7 +251,7 @@ class FileInfoCache:
         then the data map (either class answers a stat); only the stat
         counters move, so the two classes' hit rates stay separately
         observable."""
-        if not self.enabled:
+        if not self.enabled or not self._serving():
             return None
         self.maybe_flush()
         key = (bucket, object_, version_id)

@@ -112,6 +112,11 @@ class WalkStream:
         self.error: Optional[Exception] = None
         self.truncated = False             # hit _MAX_ENTRIES
         self.persisted_from = 0            # segments skipped by a seek
+        # Bypass walks (coherence gate down) are unregistered — no
+        # registry dedupe means concurrent ephemeral walks of one
+        # (bucket, prefix) exist, and letting them persist would
+        # interleave their seg/head writes into a torn base run.
+        self.ephemeral = False
         self.last_touch = time.monotonic()
         self.cond = threading.Condition()
         self._cancel = threading.Event()
@@ -142,7 +147,12 @@ class WalkStream:
                     if len(self.keys) >= _MAX_ENTRIES:
                         self.truncated = True
                         break
-            if not self._cancel.is_set() and not self.shallow:
+            if not self._cancel.is_set() and not self.shallow \
+                    and not self.ephemeral and not self.start_after:
+                # BASE runs persist BEFORE done: a caller that saw the
+                # walk complete may immediately restart-warm-start from
+                # the segments (test-asserted), and the base write has
+                # no wait in it.
                 self._persist(es, mc)
         except Exception as e:  # noqa: BLE001 - reported to waiters
             self.error = e
@@ -150,6 +160,14 @@ class WalkStream:
             with self.cond:
                 self.done = True
                 self.cond.notify_all()
+        # CONTINUATION runs compact AFTER signalling done: compaction
+        # may wait out a bounded gap-retry (_compact_onto) for an
+        # earlier continuation to land, and that wait must never delay
+        # a listing page blocked on this stream's completion.
+        if self.error is None and not self._cancel.is_set() \
+                and not self.shallow and not self.ephemeral \
+                and self.start_after:
+            self._persist(es, mc)
 
     # -- persistence (format v2: segments + prefix index) --------------
 
@@ -188,42 +206,71 @@ class WalkStream:
         except Exception:  # noqa: BLE001 - cache persistence is optional
             pass
 
+    # Gap-retry window: a continuation floored past the base's current
+    # end waits this long for the earlier continuation (whose append
+    # closes the gap) to land before giving up.
+    _COMPACT_WAIT = 5.0
+
     def _compact_onto(self, d, base: str, mc) -> None:
         """Append this continuation stream's entries to the persisted
         base run (segments + index updated in place; the head rewrite
         is the commit point — a crash leaves stray seg files that the
-        head's count check ignores)."""
+        head's count check ignores).
+
+        Continuations complete in COMPLETION order, not key order: a
+        later page's walk can finish before an earlier page's. A walk
+        floored at or below the base's current end appends only its
+        tail past the end (boundary dedup); one floored ABOVE it would
+        persist a run with a silent key-range HOLE — it waits (bounded)
+        for the earlier continuation to close the gap, then appends.
+        Compactions of one MetaCache serialize on compact_mu so two
+        walks never interleave their read-modify-write of the head."""
+        import contextlib
         import msgpack
-        try:
-            head = json.loads(d.read_all(SYS_VOL_, f"{base}/head"))
-        except Exception:  # noqa: BLE001 - no base run to extend
-            return
-        if head.get("v") != _FMT or not head.get("truncated") or \
-                not head.get("seg"):
-            return
-        last = head["seg"][-1][1]
-        if self.start_after < last:
-            return                      # not contiguous with the base
-        # Boundary dedup: a start-floored walk re-emits its floor key.
-        keys, entries = self.keys, self.entries
-        lo = bisect.bisect_right(keys, last)
-        if lo >= len(keys):
-            return
-        seg_index = list(head["seg"])
-        s = len(seg_index)
-        for i in range(lo, len(keys), _SEG):
-            kseg = keys[i:i + _SEG]
-            blob = msgpack.packb(list(zip(kseg, entries[i:i + _SEG])))
-            d.write_all(SYS_VOL_, f"{base}/seg-{s:06d}", blob)
-            seg_index.append([kseg[0], kseg[-1], len(kseg)])
-            s += 1
-        head.update({
-            "count": head["count"] + len(keys) - lo,
-            "truncated": self.truncated,
-            "seg": seg_index})
-        d.write_all(SYS_VOL_, f"{base}/head", json.dumps(head).encode())
-        if mc is not None:
-            mc.compactions += 1
+        lock = mc.compact_mu if mc is not None else contextlib.nullcontext()
+        deadline = time.monotonic() + self._COMPACT_WAIT
+        while not self._cancel.is_set():
+            with lock:
+                try:
+                    head = json.loads(d.read_all(SYS_VOL_, f"{base}/head"))
+                except Exception:  # noqa: BLE001 - no base run to extend
+                    return
+                if head.get("v") != _FMT or not head.get("truncated") or \
+                        not head.get("seg"):
+                    return
+                last = head["seg"][-1][1]
+                if self.start_after <= last:
+                    # Boundary dedup: only the tail past the base's end
+                    # appends (a start-floored walk re-emits its floor
+                    # key; an overlapping walk re-emits the overlap).
+                    keys, entries = self.keys, self.entries
+                    lo = bisect.bisect_right(keys, last)
+                    if lo >= len(keys):
+                        return
+                    seg_index = list(head["seg"])
+                    s = len(seg_index)
+                    for i in range(lo, len(keys), _SEG):
+                        kseg = keys[i:i + _SEG]
+                        blob = msgpack.packb(
+                            list(zip(kseg, entries[i:i + _SEG])))
+                        d.write_all(SYS_VOL_, f"{base}/seg-{s:06d}", blob)
+                        seg_index.append([kseg[0], kseg[-1], len(kseg)])
+                        s += 1
+                    head.update({
+                        "count": head["count"] + len(keys) - lo,
+                        "truncated": self.truncated,
+                        "seg": seg_index})
+                    d.write_all(SYS_VOL_, f"{base}/head",
+                                json.dumps(head).encode())
+                    if mc is not None:
+                        mc.compactions += 1
+                    return
+            if time.monotonic() > deadline or self._cancel.is_set():
+                # Gap never closed — or a bump orphaned this walk
+                # mid-wait (its entries predate a mutation and must
+                # not reach the persisted run); stay truncated.
+                return
+            time.sleep(0.05)
 
     @classmethod
     def load_persisted(cls, es, bucket: str, prefix: str, gen: int,
@@ -314,14 +361,23 @@ class MetaCache:
         self._mu = threading.Lock()
         self._gen: dict[str, int] = {}            # bucket -> generation
         self._walks: dict[tuple, WalkStream] = {}  # key -> walk
+        # Serializes persisted-run compactions (WalkStream._compact_onto
+        # read-modify-writes the segment head from walk threads).
+        self.compact_mu = threading.Lock()
         self.hits = 0
         self.misses = 0
         self.persisted_loads = 0
         self.compactions = 0
         self.walks_started = 0
         # Distributed boot installs a broadcaster(bucket) here; bumps
-        # fan out to peers with leading-edge coalescing.
+        # fan out to peers with leading-edge coalescing. Coalescing
+        # window per instance: the ACKED generation protocol
+        # (grid/coherence) sets it to 0 — an invalidation deferred by
+        # a timer would open a cross-node staleness window the
+        # coherence gate cannot see, so coherence pushes fire
+        # synchronously on every bump.
         self.on_bump: Optional[Callable] = None
+        self.bump_coalesce: float = _BUMP_COALESCE
         self._last_broadcast: dict[str, float] = {}
         self._pending_broadcast: set[str] = set()
         # Local bump listeners (no coalescing, fired on EVERY bump —
@@ -330,6 +386,13 @@ class MetaCache:
         # so caches that must see writes (object/fi_cache) subscribe
         # here instead of wiring each mutation call site.
         self.listeners: list[Callable[[str], None]] = []
+        # Cross-node coherence gate (grid/coherence.PeerCoherence
+        # .coherent on distributed sets; None = local-only, no check).
+        # While the gate is down, walk_for orphans cached streams for
+        # the requested bucket and re-walks — listings stay correct
+        # (drives are the source of truth), just uncached, until the
+        # generation resync re-arms the gate.
+        self.remote_gate: Optional[Callable[[], bool]] = None
 
     def generation(self, bucket: str) -> int:
         with self._mu:
@@ -365,9 +428,9 @@ class MetaCache:
                     w.cancel()
             cb = self.on_bump
             now = time.monotonic()
-            if cb is not None and broadcast:
+            if cb is not None and broadcast and self.bump_coalesce > 0:
                 last = self._last_broadcast.get(bucket, 0.0)
-                if now - last < _BUMP_COALESCE:
+                if now - last < self.bump_coalesce:
                     # Coalesce the burst, but GUARANTEE a trailing
                     # broadcast — dropping it would leave peers stale
                     # after the burst's last write until their next
@@ -376,7 +439,7 @@ class MetaCache:
                         cb = None
                     else:
                         self._pending_broadcast.add(bucket)
-                        defer = _BUMP_COALESCE - (now - last)
+                        defer = self.bump_coalesce - (now - last)
                 else:
                     self._last_broadcast[bucket] = now
         if cb is None or not broadcast:
@@ -426,6 +489,31 @@ class MetaCache:
         covers it, and (b) lets a fresh process's deep continuation
         page load only the persisted segments past it instead of the
         whole run."""
+        gate = self.remote_gate
+        if gate is not None:
+            try:
+                ok = bool(gate())
+            except Exception:  # noqa: BLE001 - a broken gate fails closed
+                ok = False
+            if not ok:
+                # Incoherent (peer disarmed / no protocol): cached and
+                # persisted streams are unprovable — BYPASS the
+                # registry with a fresh unregistered walk. Not a bump:
+                # bumping per lookup would cancel concurrent listings'
+                # in-flight walks (mutual starvation under sustained
+                # listings for as long as any peer is down) and churn
+                # the fileinfo cache through the bump listeners. The
+                # bypass walk serves only this call; the resync that
+                # re-arms the gate bumps whatever actually changed.
+                with self._mu:
+                    self.misses += 1
+                    self.walks_started += 1
+                    gen = self._gen.get(bucket, 0)
+                w = WalkStream(bucket, prefix, gen, start=start,
+                               shallow=shallow)
+                w.ephemeral = True
+                w.start(es, self)
+                return w
         with self._mu:
             gen = self._gen.get(bucket, 0)
             key = (bucket, prefix, start, shallow)

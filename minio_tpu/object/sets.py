@@ -11,7 +11,9 @@ pages.
 
 from __future__ import annotations
 
+import threading
 import uuid as uuid_mod
+from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Sequence
 
 from minio_tpu.object.types import (BucketExists, BucketNotEmpty,
@@ -67,6 +69,12 @@ class ErasureSets:
         self.sets = list(sets)
         self.deployment_id = deployment_id or str(uuid_mod.uuid4())
         self._id_bytes = uuid_mod.UUID(self.deployment_id).bytes
+        # Listing fan-out pool (lazy): per-set walk pages run
+        # CONCURRENTLY — on distributed sets each page is a round of
+        # grid streams, and serializing them multiplies a cluster
+        # listing's latency by the set count.
+        self._list_pool: Optional[ThreadPoolExecutor] = None
+        self._list_pool_mu = threading.Lock()
 
     # -- routing -------------------------------------------------------
 
@@ -160,6 +168,10 @@ class ErasureSets:
             s.invalidate_bucket_meta(bucket)
 
     def close(self) -> None:
+        with self._list_pool_mu:
+            if self._list_pool is not None:
+                self._list_pool.shutdown(wait=False)
+                self._list_pool = None
         for s in self.sets:
             s.close()
 
@@ -242,20 +254,38 @@ class ErasureSets:
 
     # -- listing (merge per-set pages) ---------------------------------
 
+    def _listing_pool(self) -> ThreadPoolExecutor:
+        with self._list_pool_mu:
+            if self._list_pool is None:
+                # Sized for several CONCURRENT listings' fan-outs, not
+                # one: the pool is shared across requests, and a pool
+                # of exactly len(sets) would serialize concurrent
+                # listings behind each other — worse than the old
+                # sequential-per-request shape once a few requests
+                # overlap.
+                self._list_pool = ThreadPoolExecutor(
+                    max_workers=min(32, 4 * len(self.sets)),
+                    thread_name_prefix="sets-list")
+            return self._list_pool
+
     def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
                      delimiter: str = "", max_keys: int = 1000,
                      include_versions: bool = False) -> ListObjectsInfo:
+        def one(s):
+            return s.list_objects(
+                bucket, prefix=prefix, marker=marker, delimiter=delimiter,
+                max_keys=max_keys, include_versions=include_versions)
+
+        if len(self.sets) == 1:
+            return merge_list_pages([one(self.sets[0])], max_keys)
+        futs = [self._listing_pool().submit(one, s) for s in self.sets]
         pages = []
-        found = False
-        for s in self.sets:
+        for f in futs:
             try:
-                pages.append(s.list_objects(
-                    bucket, prefix=prefix, marker=marker, delimiter=delimiter,
-                    max_keys=max_keys, include_versions=include_versions))
-                found = True
+                pages.append(f.result())
             except BucketNotFound:
                 continue
-        if not found:
+        if not pages:
             raise BucketNotFound(bucket)
         return merge_list_pages(pages, max_keys)
 

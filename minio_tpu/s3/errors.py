@@ -94,6 +94,12 @@ def from_exception(e: Exception) -> S3Error:
     for cls, code in mp_map.items():
         if isinstance(e, cls):
             return S3Error(code, str(e))
+    from minio_tpu.object.nslock import LockTimeout
+    if isinstance(e, LockTimeout):
+        # Lock starvation — including a dsync lock quorum that is
+        # unreachable (nodes down/partitioned) — answers an HONEST
+        # 503 + Retry-After, not a 500 after the full lock timeout.
+        return S3Error("SlowDown", str(e))
     b = getattr(e, "bucket", "")
     k = getattr(e, "object", "")
     mapping = {

@@ -724,6 +724,53 @@ class Metrics:
             metric("minio_tpu_get_kernel_windows_total",
                    "GET windows decoded, by path",
                    "counter", [({"path": p}, v) for p, v in gk.items()])
+        # -- distributed plane: grid peer breakers, notify fan-out,
+        #    cross-node coherence -----------------------------------------
+        from minio_tpu.grid import client as _grid_client
+        from minio_tpu.grid import peers as _grid_peers
+        gstats = _grid_client.peer_stats()
+        _STATE_NUM = {"closed": 0, "half-open": 1, "open": 2}
+        metric("minio_tpu_grid_peer_state",
+               "Per-peer grid circuit breaker state "
+               "(0 closed, 1 half-open, 2 open)", "gauge",
+               [({"peer": g["peer"]}, _STATE_NUM.get(g["state"], 2))
+                for g in gstats])
+        metric("minio_tpu_grid_peer_reconnects_total",
+               "Grid connections re-established per peer", "counter",
+               [({"peer": g["peer"]}, g["reconnects"]) for g in gstats])
+        metric("minio_tpu_grid_peer_rpc_errors_total",
+               "Grid transport failures per peer (timeouts, resets, "
+               "refused connects; remote handler errors excluded)",
+               "counter",
+               [({"peer": g["peer"]}, g["rpc_errors"]) for g in gstats])
+        nst = _grid_peers.notify_stats()
+        metric("minio_tpu_peer_notify_sent_total",
+               "Peer reload notifications acknowledged", "counter",
+               [({}, nst["sent"])])
+        metric("minio_tpu_peer_notify_failed_total",
+               "Peer reload notifications that failed (best-effort "
+               "path; the receiver's TTL/resync is the fallback)",
+               "counter", [({}, nst["failed"])])
+        coh = getattr(server, "coherence", None) if server is not None \
+            else None
+        if coh is not None:
+            cst = coh.stats()
+            metric("minio_tpu_cluster_peers_armed",
+                   "Peers whose generation state is synced (caches "
+                   "serve hits only with every peer armed)", "gauge",
+                   [({}, cst["armed"])])
+            metric("minio_tpu_cluster_gen_resyncs_total",
+                   "Generation resync rounds completed against peers",
+                   "counter", [({}, cst["resyncs"])])
+            metric("minio_tpu_cluster_invalidations_applied_total",
+                   "Cross-node cache invalidations applied locally "
+                   "(pushed + recovered by resync)", "counter",
+                   [({}, cst["inv_applied"])])
+            metric("minio_tpu_cluster_invalidations_failed_total",
+                   "Invalidation pushes a peer failed to ack "
+                   "(escalated: logged, connection reset, covered by "
+                   "the peer's next resync)", "counter",
+                   [({}, cst["inv_failed"])])
         if peer_states:
             metric("minio_tpu_worker_in_flight",
                    "In-flight requests per pre-forked worker", "gauge",
@@ -865,6 +912,17 @@ def node_info(server) -> dict:
     from minio_tpu.storage import meta_scan as _ms
     info["metacache"] = {"sets": metacache, "scan": dict(_ms.counters)}
     info["get_kernel"] = get_kernel
+    # Distributed plane: per-peer breaker states, notify fan-out
+    # outcomes, and the coherence protocol's arm/generation state.
+    from minio_tpu.grid import client as _grid_client
+    from minio_tpu.grid import peers as _grid_peers
+    gstats = _grid_client.peer_stats()
+    if gstats:
+        info["grid"] = {"peers": gstats,
+                        "notify": _grid_peers.notify_stats()}
+    coh = getattr(server, "coherence", None)
+    if coh is not None:
+        info["coherence"] = coh.stats()
     cluster = getattr(server, "cluster_stats", None)
     if cluster is not None:
         try:

@@ -439,9 +439,9 @@ def main(argv=None) -> int:
     except Exception:  # noqa: BLE001 - config is optional
         pass
     if distributed:
-        # Peer control plane: mutations of shared state (IAM, bucket
-        # metadata, config) fan out an immediate cache invalidation to
-        # every peer; the per-cache TTL covers unreachable peers
+        # Peer control plane: mutations of shared state (IAM, config,
+        # decom) fan out an immediate cache invalidation to every
+        # peer; the per-cache TTL covers unreachable peers
         # (reference: cmd/notification.go + cmd/peer-rest-client.go:304).
         from minio_tpu.grid.peers import (PeerNotifier, RELOAD_HANDLER,
                                           make_reload_handler)
@@ -452,19 +452,50 @@ def main(argv=None) -> int:
             apply_config=lambda: cfg_mod.apply_config(
                 srv, cfg_mod.load_config(layer))))
         srv.peer_notify = peer_notifier.broadcast
+        srv.peer_notifier = peer_notifier
         creds.iam.on_change = lambda: peer_notifier.broadcast("iam")
-        layer.on_bucket_meta_change = \
-            lambda bucket: peer_notifier.broadcast("bucket-meta",
-                                                   bucket=bucket)
         layer.on_decom_change = lambda: peer_notifier.broadcast("decom")
-        # Listing walk-stream invalidation: a write on this node drops
-        # peers' metacache streams for the bucket (leading-edge
-        # coalesced inside MetaCache.bump, trailing-guaranteed).
-        for p in pools:
-            for s in p.sets:
-                s.metacache.on_bump = (
-                    lambda bucket: peer_notifier.broadcast("listing",
-                                                           bucket=bucket))
+        # Namespace + bucket-meta invalidation rides the GENERATION
+        # protocol (grid/coherence): acked-or-escalated pushes, and a
+        # reconnecting peer must resync generations before its caches
+        # re-arm — the contract that lets fi_cache and the listing
+        # caches stay ON cluster-wide.
+        from minio_tpu.grid.coherence import (CLASS_BUCKET_META,
+                                              CLASS_LISTING, PeerCoherence,
+                                              make_set_invalidator)
+        all_sets_d = [s for p in pools for s in p.sets]
+        # Self-declared coherence identity: must be UNIQUE per node and
+        # stable across restarts (peers key applied-generation records
+        # by it; restart detection rides the instance id). The bind
+        # address is neither when every node runs the default
+        # 0.0.0.0:9000 — fall back to the hostname, which is what
+        # distinguishes nodes in a same-port deployment.
+        ident_host = my_host if my_host not in ("0.0.0.0", "::", "") \
+            else socket_mod.gethostname()
+        coherence = PeerCoherence(
+            node_id=f"{ident_host}:{my_port}",
+            peers={f"{h}:{p}": client_for(h, p + GRID_PORT_OFFSET)
+                   for h, p in remote_nodes},
+            on_invalidate=make_set_invalidator(all_sets_d, layer=layer))
+        coherence.register_into(grid_srv)
+        layer.on_bucket_meta_change = \
+            lambda bucket: coherence.broadcast(bucket, CLASS_BUCKET_META)
+        # A write on this node orphans peers' walk streams + fileinfo
+        # entries for the bucket (leading-edge coalesced inside
+        # MetaCache.bump, trailing-guaranteed).
+        for s in all_sets_d:
+            s.metacache.on_bump = (
+                lambda bucket: coherence.broadcast(bucket, CLASS_LISTING))
+            # Synchronous acked pushes: a timer-deferred invalidation
+            # would be a cross-node staleness window no gate covers.
+            s.metacache.bump_coalesce = 0.0
+            # EVERY set gates on coherence in distributed mode — a set
+            # whose drives are all local here is remote from the peers'
+            # side, so peers mutate it too.
+            s.fi_cache.remote_gate = coherence.coherent
+            s.metacache.remote_gate = coherence.coherent
+        coherence.start()
+        srv.coherence = coherence
         # Cluster-wide profiling fan-out (reference: profiling rides
         # NotificationSys too).
         from minio_tpu.s3.profiling import (PROFILE_HANDLER,
@@ -544,6 +575,8 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         scanner.stop()
         drive_heal.stop()
+        if getattr(srv, "coherence", None) is not None:
+            srv.coherence.stop()
         if ftp is not None:
             # Gateways stop BEFORE the S3 server closes the object
             # layer (their in-flight transfers use it).

@@ -36,6 +36,12 @@ from minio_tpu.storage.meta import (FileInfo, FileNotFoundErr, MetaError,
 # of waiting behind one multi-MiB sendall (the write lock is per frame).
 CHUNK = 1 << 20
 
+# walk_scan wire entry kinds: [path, kind, payload...] per entry.
+_WS_SUMMARY = 0        # [path, 0, vlist]            trimmed summary
+_WS_SUMMARY_BLOB = 1   # [path, 1, vlist, blob]      summary + journal
+_WS_BLOB = 2           # [path, 2, blob]             scanner fallback
+_WS_MARK = 3           # [path, 3]                   shallow prefix mark
+
 _CODE_TO_EXC = {
     "FileNotFound": FileNotFoundErr,
     "VersionNotFound": VersionNotFoundErr,
@@ -233,6 +239,41 @@ class RemoteStorage:
     def list_dir(self, volume: str, dir_path: str, count: int = -1) -> list[str]:
         return self._call("list_dir", volume, dir_path, count)
 
+    def walk_scan(self, volume: str, base_dir: str = "",
+                  forward_from: str = "", shallow: bool = False):
+        """The trimmed listing walk over the grid: the remote node runs
+        its local batched-native walk_scan (storage/local.py) and ships
+        only the SUMMARY tuples — at 10M objects the difference versus
+        walk_dir's full xl.meta journals is the whole metadata plane's
+        PR-8 win, now available to distributed sets. Yields the same
+        (path, vlist, blob) triples the local generator does, including
+        the PREFIX_MARK sentinel for shallow delimiter pages."""
+        from minio_tpu.storage.meta_scan import PREFIX_MARK
+        c = client_for(self.host, self.port)
+        try:
+            for batch in c.stream("st.walk_scan",
+                                  {"d": self.root,
+                                   "a": [volume, base_dir, forward_from,
+                                         bool(shallow)]}):
+                for ent in batch:
+                    path, kind = ent[0], ent[1]
+                    if kind == _WS_MARK:
+                        yield path, PREFIX_MARK, None
+                    elif kind == _WS_BLOB:
+                        yield path, None, ent[2]
+                    else:
+                        # Canonical tuple-of-tuples form — identical to
+                        # what the local generator yields, so resolver
+                        # agreement sets and metacache entries never
+                        # see a list/tuple split across drive kinds.
+                        vlist = tuple(tuple(v) for v in ent[2])
+                        blob = ent[3] if kind == _WS_SUMMARY_BLOB else None
+                        yield path, vlist, blob
+        except RemoteCallError as e:
+            _raise_mapped(e)
+        except GridError as e:
+            raise StorageError(f"remote drive {self.endpoint}: {e}") from None
+
     def walk_dir(self, volume: str, base_dir: str = "",
                  recursive: bool = True,
                  forward_from: str = "") -> Iterator[tuple[str, bytes]]:
@@ -296,6 +337,13 @@ class StorageRPCService:
                 pass
 
     def _disk(self, payload: dict) -> LocalStorage:
+        # Cluster-harness chaos: a "hung remote drive" sleeps here —
+        # every storage RPC funnels through this lookup (the in-process
+        # twin is tests/chaos.HungDisk; this reaches spawned nodes).
+        from minio_tpu.grid import chaos
+        delay = chaos.drive_delay()
+        if delay > 0:
+            time.sleep(delay)
         d = self.disks.get(payload.get("d", ""))
         if d is None:
             raise StorageError(f"no such drive: {payload.get('d')!r}")
@@ -317,6 +365,7 @@ class StorageRPCService:
         srv.register("st.create_commit", self._create_commit)
         srv.register_stream("st.read_file_stream", self._read_file_stream)
         srv.register_stream("st.walk_dir", self._walk_dir)
+        srv.register_stream("st.walk_scan", self._walk_scan)
 
     def _make_unary(self, name: str):
         def handler(payload):
@@ -439,6 +488,42 @@ class StorageRPCService:
             batch.append([path, blob])
             size += len(blob) + len(path)
             if len(batch) >= 128 or size >= CHUNK:
+                yield batch
+                batch, size = [], 0
+        if batch:
+            yield batch
+
+    def _walk_scan(self, payload):
+        """Trimmed listing walk: stream the local walk_scan's summary
+        entries in batched frames — summaries are tens of bytes per
+        version, so one frame carries hundreds of keys where _walk_dir
+        carried a handful of full journals."""
+        from minio_tpu.storage.meta_scan import PREFIX_MARK
+        d = self._disk(payload)
+        vol, base_dir, forward_from, shallow = payload["a"]
+        ws = getattr(d, "walk_scan", None)
+        if ws is None:
+            raise StorageError("drive does not support walk_scan")
+        batch: list = []
+        size = 0
+        for path, vlist, blob in ws(vol, base_dir=base_dir,
+                                    forward_from=forward_from,
+                                    shallow=bool(shallow)):
+            if vlist is PREFIX_MARK:
+                ent = [path, _WS_MARK]
+                size += len(path) + 8
+            elif vlist is None:
+                ent = [path, _WS_BLOB, blob]
+                size += len(path) + len(blob or b"")
+            elif blob is not None:
+                ent = [path, _WS_SUMMARY_BLOB,
+                       [list(v) for v in vlist], blob]
+                size += len(path) + len(blob) + 64 * len(vlist)
+            else:
+                ent = [path, _WS_SUMMARY, [list(v) for v in vlist]]
+                size += len(path) + 64 * len(vlist)
+            batch.append(ent)
+            if len(batch) >= 512 or size >= CHUNK:
                 yield batch
                 batch, size = [], 0
         if batch:

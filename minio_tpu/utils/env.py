@@ -1,0 +1,28 @@
+"""Shared env-knob parsing: positive number with a default.
+
+Every subsystem grew its own `_env_int`/`_env_float` copy of this
+logic; new code imports these instead so the parse rules (empty/unset
+-> default, unparsable -> default, <= 0 -> default) cannot drift
+per-module. Knobs where 0 is meaningful (disable semantics) parse
+themselves — these helpers are for strictly-positive tunables.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def env_num(key: str, default, cast=float):
+    try:
+        v = cast(os.environ.get(key, "") or default)
+        return v if v > 0 else default
+    except ValueError:
+        return default
+
+
+def env_float(key: str, default: float) -> float:
+    return env_num(key, default, float)
+
+
+def env_int(key: str, default: int) -> int:
+    return env_num(key, default, int)

@@ -128,6 +128,19 @@ def slow_ops() -> list[dict]:
         return list(_slow_ops)
 
 
+def slow_event(type_: str, name: str, ms: float = 0.0,
+               tags: Optional[dict] = None) -> None:
+    """Record one event on the slow-op channel UNCONDITIONALLY (no
+    MTPU_SLOW_OP_MS threshold): for rare operational failures — a peer
+    that would not ack an invalidation, a swallowed best-effort
+    broadcast — that must reach the ring, the counters, and stderr
+    even on a box with slow-op sampling disarmed. The rate limiter
+    still bounds stderr volume."""
+    _record_slow({"type": type_, "name": name, "ms": round(ms, 3),
+                  "time": time.time(), "event": True,
+                  "tags": dict(tags or {})})
+
+
 def _record_slow(rec: dict) -> None:
     global slow_total, _slow_log_sec, _slow_log_n
     sec = int(time.time())

@@ -1,0 +1,100 @@
+#!/usr/bin/env python3
+"""Boot an N-node-in-one-container minio-tpu cluster and drive chaos
+interactively.
+
+    python scripts/cluster_up.py --nodes 4 --drives 2 /tmp/mtpu-cluster
+
+Spawns N real server processes (real grid mesh, real dsync quorums)
+over directory drives under the given root, prints the S3 endpoints,
+then reads chaos commands from stdin until EOF/quit:
+
+    kill N | restart N | partition N | drop N | rejoin N
+    delay N SECONDS | hang N SECONDS | status | quit
+
+The same primitives the chaos tests use (tests/cluster.py) — this is
+the operator-facing wrapper for poking a live topology.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tests.cluster import Cluster  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(prog="cluster_up")
+    ap.add_argument("root", help="directory for drives/logs/chaos files")
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--drives", type=int, default=2,
+                    help="drives per node")
+    ap.add_argument("--parity", type=int, default=None)
+    ap.add_argument("--scanner-interval", type=float, default=60.0)
+    args = ap.parse_args()
+
+    os.makedirs(args.root, exist_ok=True)
+    cluster = Cluster(args.root, nodes=args.nodes,
+                      drives_per_node=args.drives, parity=args.parity,
+                      scanner_interval=args.scanner_interval)
+    print(f"booting {args.nodes} nodes x {args.drives} drives "
+          f"under {args.root} ...", flush=True)
+    try:
+        cluster.start()
+        for i in range(cluster.n):
+            print(f"  node {i}: http://{cluster.address(i)}  "
+                  f"(grid :{cluster.ports[i] + 1000}, "
+                  f"log {cluster.log_path(i)})")
+        print("cluster up. commands: kill/restart/partition/drop/rejoin N,"
+              " delay N S, hang N S, status, quit", flush=True)
+        for line in sys.stdin:
+            parts = line.split()
+            if not parts:
+                continue
+            cmd, rest = parts[0], parts[1:]
+            try:
+                if cmd in ("quit", "exit", "q"):
+                    break
+                elif cmd == "status":
+                    for i in range(cluster.n):
+                        chaos = "none"
+                        if os.path.exists(cluster.chaos_path(i)):
+                            with open(cluster.chaos_path(i)) as fh:
+                                chaos = fh.read().strip() or "none"
+                        print(f"  node {i}: "
+                              f"{'up' if cluster.alive(i) else 'DOWN'} "
+                              f"chaos={chaos}")
+                elif cmd == "kill":
+                    cluster.kill(int(rest[0]))
+                elif cmd == "restart":
+                    cluster.restart(int(rest[0]))
+                elif cmd == "partition":
+                    cluster.partition(int(rest[0]))
+                elif cmd == "drop":
+                    cluster.drop(int(rest[0]))
+                elif cmd == "rejoin":
+                    cluster.rejoin(int(rest[0]))
+                elif cmd == "delay":
+                    cluster.delay(int(rest[0]), float(rest[1]))
+                elif cmd == "hang":
+                    cluster.hang_drives(int(rest[0]), float(rest[1]))
+                else:
+                    print(f"unknown command: {cmd}")
+                    continue
+                print("ok", flush=True)
+            except (IndexError, ValueError) as e:
+                print(f"bad args: {e}", flush=True)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        print("stopping cluster", flush=True)
+        cluster.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

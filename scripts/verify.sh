@@ -35,6 +35,18 @@ if [ "${MTPU_CRASH_SWEEP:-}" = "1" ]; then
         -q -p no:cacheprovider || exit 1
 fi
 
+# Fast cluster subset FIRST: the multi-node-in-one-container harness
+# (tests/cluster.py) booting real server processes with real grid
+# websockets and dsync quorums — kill/partition/walk_scan/coherence
+# invariants. These also run inside tier-1 below (they are not marked
+# slow); running them up front fails the distributed plane loudly in
+# seconds instead of minutes into the full suite. The 8-node matrix
+# and SIGKILL-mid-PUT lock-expiry e2e are @slow (run them with
+# `pytest tests/test_cluster.py -m slow`).
+echo "== cluster smoke (fast subset) =="
+env JAX_PLATFORMS=cpu python -m pytest tests/test_cluster.py \
+    -q -m 'not slow' -p no:cacheprovider || exit 1
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \

@@ -1,0 +1,251 @@
+"""N-node-in-one-container cluster harness.
+
+Spawns N REAL `minio_tpu.server` processes on loopback — real grid
+mesh, real dsync lock quorums, real storage RPC — over simulated
+(directory) drives, sized so 4-8 node clusters fit tier-1 boxes. The
+node-level extension of the drive-level chaos harness (tests/chaos.py):
+
+    kill(i)            SIGKILL the node process (crash, not shutdown)
+    restart(i)         respawn it on the same endpoints/drives
+    partition(i)       blackhole the node's grid plane (every grid
+                       connect/send/accept fails) via its chaos file
+    drop(i)            silently swallow inbound grid requests (the
+                       asymmetric black hole — callers time out)
+    delay(i, s)        add `s` seconds to every grid frame (jitter)
+    hang_drives(i, s)  every storage RPC served by the node sleeps `s`
+                       (a hung REMOTE drive)
+    rejoin(i)          clear the node's chaos file
+
+Chaos rides MTPU_GRID_CHAOS (grid/chaos.py): each node polls its own
+JSON file, so a LIVE spawned process is reconfigured from the test
+without signals or restarts. scripts/cluster_up.py drives the same
+class interactively.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Grid port = S3 port + this (minio_tpu/server.py GRID_PORT_OFFSET).
+GRID_OFFSET = 1000
+
+
+def _bindable(port: int) -> bool:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", port))
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()
+
+
+def free_ports(n: int, lo: int = 9600, hi: int = 28000) -> list[int]:
+    """`n` consecutive S3 ports whose grid twins (port+1000) are also
+    free. Random base per attempt so concurrent/serial test clusters
+    do not collide on TIME_WAIT leftovers."""
+    for _ in range(200):
+        base = random.randrange(lo, hi)
+        ports = [base + i for i in range(n)]
+        if all(_bindable(p) and _bindable(p + GRID_OFFSET) for p in ports):
+            return ports
+    raise RuntimeError("no free port range for cluster")
+
+
+class Cluster:
+    """N server processes sharing one erasure layout on loopback."""
+
+    def __init__(self, root, nodes: int = 4, drives_per_node: int = 2,
+                 ports: Optional[list[int]] = None, parity: Optional[int]
+                 = None, set_size: Optional[int] = None,
+                 scanner_interval: float = 0.0, boot_timeout: float = 60.0,
+                 env: Optional[dict] = None, extra: tuple = ()):
+        self.root = str(root)
+        self.n = nodes
+        self.drives_per_node = drives_per_node
+        self.ports = ports or free_ports(nodes)
+        self.procs: dict[int, Optional[subprocess.Popen]] = {}
+        self._gen = {i: 0 for i in range(nodes)}   # log file generation
+        self.extra = tuple(extra)
+        if parity is not None:
+            self.extra += ("--parity", str(parity))
+        if set_size is not None:
+            self.extra += ("--set-size", str(set_size))
+        self.extra += ("--scanner-interval", str(scanner_interval),
+                       "--boot-timeout", str(boot_timeout))
+        self.env = dict(env or {})
+        self.endpoints: list[str] = []
+        for i in range(nodes):
+            for d in range(drives_per_node):
+                path = os.path.join(self.root, f"n{i}", f"d{d}")
+                os.makedirs(path, exist_ok=True)
+                self.endpoints.append(
+                    f"http://127.0.0.1:{self.ports[i]}{path}")
+
+    # -- lifecycle -----------------------------------------------------
+
+    def chaos_path(self, i: int) -> str:
+        return os.path.join(self.root, f"chaos-n{i}.json")
+
+    def log_path(self, i: int) -> str:
+        return os.path.join(self.root, f"node{i}.log.{self._gen[i]}")
+
+    def address(self, i: int) -> str:
+        return f"127.0.0.1:{self.ports[i]}"
+
+    def spawn(self, i: int) -> None:
+        self._gen[i] += 1
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu",
+                   PYTHONPATH=REPO_ROOT,
+                   MTPU_GRID_CHAOS=self.chaos_path(i),
+                   # Fast re-arm + fast breaker recovery at test scale;
+                   # callers override via env=.
+                   MTPU_GRID_SYNC_S="0.5",
+                   MTPU_GRID_COOLDOWN="0.25",
+                   **self.env)
+        cmd = [sys.executable, "-m", "minio_tpu.server",
+               "--address", self.address(i), "--ec-backend", "host",
+               *self.extra, *self.endpoints]
+        log = open(self.log_path(i), "wb")
+        self.procs[i] = subprocess.Popen(cmd, stdout=log,
+                                         stderr=subprocess.STDOUT, env=env,
+                                         cwd=REPO_ROOT)
+
+    def start(self, wait: bool = True) -> "Cluster":
+        for i in range(self.n):
+            self.spawn(i)
+        if wait:
+            self.wait_ready()
+        return self
+
+    def wait_ready(self, idx: Optional[int] = None,
+                   timeout: float = 120.0) -> None:
+        nodes = [idx] if idx is not None else list(range(self.n))
+        deadline = time.time() + timeout
+        for i in nodes:
+            path = self.log_path(i)
+            while True:
+                blob = b""
+                if os.path.exists(path):
+                    with open(path, "rb") as fh:
+                        blob = fh.read()
+                if b"serving S3" in blob:
+                    break
+                p = self.procs.get(i)
+                if p is not None and p.poll() is not None:
+                    raise RuntimeError(
+                        f"node {i} exited rc={p.returncode}:\n"
+                        f"{blob.decode(errors='replace')[-2000:]}")
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"node {i} not ready:\n"
+                        f"{blob.decode(errors='replace')[-2000:]}")
+                time.sleep(0.25)
+
+    def alive(self, i: int) -> bool:
+        p = self.procs.get(i)
+        return p is not None and p.poll() is None
+
+    def kill(self, i: int) -> None:
+        """SIGKILL — a crash, not a drain: held dsync locks leak until
+        their TTL, staged writes stay torn, no clean-shutdown stamp."""
+        p = self.procs.get(i)
+        if p is None:
+            return
+        try:
+            p.send_signal(signal.SIGKILL)
+            p.wait(timeout=10)
+        except OSError:
+            pass
+        self.procs[i] = None
+
+    def restart(self, i: int, wait: bool = True) -> None:
+        if self.alive(i):
+            self.kill(i)
+        self.rejoin(i)
+        self.spawn(i)
+        if wait:
+            self.wait_ready(i)
+
+    # -- chaos ---------------------------------------------------------
+
+    def _write_chaos(self, i: int, cfg: dict) -> None:
+        tmp = self.chaos_path(i) + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(cfg, fh)
+        os.replace(tmp, self.chaos_path(i))
+
+    def partition(self, i: int) -> None:
+        self._write_chaos(i, {"mode": "blackhole"})
+
+    def drop(self, i: int) -> None:
+        self._write_chaos(i, {"mode": "drop"})
+
+    def delay(self, i: int, seconds: float) -> None:
+        self._write_chaos(i, {"mode": "delay", "seconds": seconds})
+
+    def hang_drives(self, i: int, seconds: float) -> None:
+        self._write_chaos(i, {"drive_delay": seconds})
+
+    def rejoin(self, i: int) -> None:
+        try:
+            os.unlink(self.chaos_path(i))
+        except OSError:
+            pass
+
+    # -- clients -------------------------------------------------------
+
+    def client(self, i: int, **kw):
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from s3client import S3Client
+        return S3Client(self.address(i), **kw)
+
+    def drive_dir(self, i: int, d: int) -> str:
+        return os.path.join(self.root, f"n{i}", f"d{d}")
+
+    # -- teardown ------------------------------------------------------
+
+    def stop(self) -> None:
+        for i in list(self.procs):
+            p = self.procs.get(i)
+            if p is not None:
+                try:
+                    p.send_signal(signal.SIGKILL)
+                except OSError:
+                    pass
+        for i in list(self.procs):
+            p = self.procs.get(i)
+            if p is not None:
+                try:
+                    p.wait(timeout=10)
+                except Exception:  # noqa: BLE001 - teardown best effort
+                    pass
+        self.procs.clear()
+
+    def __enter__(self) -> "Cluster":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def logs(self, i: int) -> str:
+        out = []
+        for g in range(1, self._gen[i] + 1):
+            path = os.path.join(self.root, f"node{i}.log.{g}")
+            if os.path.exists(path):
+                with open(path, "rb") as fh:
+                    out.append(fh.read().decode(errors="replace"))
+        return "\n".join(out)

@@ -404,17 +404,34 @@ def test_grid_client_survives_peer_restart(tmp_path):
     srv.start()
     port = srv.port
     c = GridClient("127.0.0.1", port, connect_timeout=1.0,
-                   call_timeout=5.0)
+                   call_timeout=5.0, cooldown=1.0)
     assert c.call("grid.ping") == "pong"
     srv.stop()
     with pytest.raises(GridError):
         c.call("grid.ping")
-    # Peer comes back on the same port: the next call reconnects
-    # (send-phase retry absorbs the stale-socket race).
+    # The failed call's send retries opened the per-peer breaker:
+    # while it is open (cooldown pinned to 1 s so this call cannot
+    # race into a half-open probe) further calls fail fast with no
+    # connect attempt. The tight-window fail-fast bound lives in
+    # tests/test_cluster.py::test_grid_breaker_opens_and_fails_fast.
+    t0 = time.monotonic()
+    with pytest.raises(GridError) as ei:
+        c.call("grid.ping")
+    assert time.monotonic() - t0 < 0.5
+    assert "circuit open" in str(ei.value)
+    # Peer comes back on the same port: the half-open probe reconnects
+    # within the (jittered, bounded) cooldown window.
     srv2 = GridServer(port, host="127.0.0.1")
     srv2.start()
     try:
-        assert c.call("grid.ping") == "pong"
+        deadline = time.monotonic() + 10
+        while True:
+            try:
+                assert c.call("grid.ping") == "pong"
+                break
+            except GridError:
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
     finally:
         srv2.stop()
         c.close()

@@ -1,0 +1,804 @@
+"""Fleet-scale robustness: grid chaos, per-peer breakers, cross-node
+cache coherence, remote walk_scan listings, dsync lock liveness, and
+the multi-node cluster chaos matrix (tests/cluster.py harness).
+
+Fast tests run in-process (grid pairs, two-"node" coherence stacks) or
+on small 3-node clusters; the 8-node matrix is @slow."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from minio_tpu.grid import GridClient, GridError, GridServer
+from minio_tpu.grid import chaos as chaos_mod
+from minio_tpu.grid.coherence import CLASS_LISTING, PeerCoherence
+from minio_tpu.storage.local import LocalStorage
+from minio_tpu.storage.meta import FileNotFoundErr
+from minio_tpu.storage.remote import RemoteStorage, StorageRPCService
+from tests.cluster import Cluster
+
+
+# ---------------------------------------------------------------------------
+# grid chaos injection (the harness's partition/delay/hang primitives)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def chaos_file(tmp_path):
+    """Arm MTPU_GRID_CHAOS for this process, yield the file path, and
+    fully disarm afterwards (the module gate is process-global)."""
+    path = tmp_path / "chaos.json"
+    old = os.environ.get(chaos_mod.ENV)
+    os.environ[chaos_mod.ENV] = str(path)
+    chaos_mod._reset_for_tests()
+    try:
+        yield path
+    finally:
+        if old is None:
+            os.environ.pop(chaos_mod.ENV, None)
+        else:
+            os.environ[chaos_mod.ENV] = old
+        chaos_mod._reset_for_tests()
+
+
+def _wait_chaos():
+    time.sleep(chaos_mod._POLL_S + 0.02)
+
+
+def test_grid_chaos_modes(chaos_file):
+    srv = GridServer(0, host="127.0.0.1")
+    srv.register("echo", lambda p: p)
+    srv.start()
+    c = GridClient("127.0.0.1", srv.port, send_retries=0, trip_after=1000)
+    try:
+        assert c.call("echo", 1) == 1
+        # Blackhole: connects/sends/accepts fail -> fast GridError.
+        chaos_file.write_text('{"mode": "blackhole"}')
+        _wait_chaos()
+        with pytest.raises(GridError):
+            c.call("echo", 2, timeout=1.0)
+        # Drop: request frames vanish silently -> caller times out.
+        chaos_file.write_text('{"mode": "drop"}')
+        _wait_chaos()
+        t0 = time.monotonic()
+        with pytest.raises(GridError):
+            c.call("echo", 3, timeout=0.5)
+        assert time.monotonic() - t0 >= 0.4   # timed out, not refused
+        # Delay: frames pay the configured jitter.
+        chaos_file.write_text('{"mode": "delay", "seconds": 0.15}')
+        _wait_chaos()
+        t0 = time.monotonic()
+        assert c.call("echo", 4, timeout=5.0) == 4
+        assert time.monotonic() - t0 >= 0.15
+        # Cleared: back to healthy.
+        chaos_file.write_text("{}")
+        _wait_chaos()
+        assert c.call("echo", 5) == 5
+    finally:
+        c.close()
+        srv.stop()
+
+
+def test_chaos_drive_delay_hangs_remote_rpc(chaos_file, tmp_path):
+    local = LocalStorage(str(tmp_path / "drv"))
+    srv = GridServer(0, host="127.0.0.1")
+    StorageRPCService({local.root: local}).register_into(srv)
+    srv.start()
+    rem = RemoteStorage("127.0.0.1", srv.port, local.root)
+    try:
+        rem.make_vol("v")
+        chaos_file.write_text('{"drive_delay": 0.3}')
+        _wait_chaos()
+        t0 = time.monotonic()
+        rem.write_all("v", "k", b"x")
+        assert time.monotonic() - t0 >= 0.3
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# per-peer circuit breaker
+# ---------------------------------------------------------------------------
+
+def test_grid_breaker_opens_and_fails_fast():
+    """A dead peer costs one fast failure per call once the breaker
+    opens, instead of a connect attempt per call; a returning peer
+    closes it via the half-open probe."""
+    # Nothing listens here; connects fail (refused) immediately.
+    probe = GridServer(0, host="127.0.0.1")
+    probe.start()
+    port = probe.port
+    probe.stop()
+    time.sleep(0.05)
+    c = GridClient("127.0.0.1", port, send_retries=2,
+                   trip_after=3, cooldown=0.2, cooldown_max=1.0)
+    with pytest.raises(GridError):
+        c.call("echo", 1, timeout=1.0)      # 3 attempts = 3 faults
+    assert c.breaker_state() == "open"
+    t0 = time.monotonic()
+    with pytest.raises(GridError) as ei:
+        c.call("echo", 2, timeout=1.0)
+    assert time.monotonic() - t0 < 0.05     # no connect attempt at all
+    assert "circuit open" in str(ei.value)
+    st = c.stats()
+    assert st["state"] == "open" and st["rpc_errors"] >= 3
+    assert st["breaker_opens"] == 1
+    # Peer returns: after the cooldown one probe call reconnects.
+    srv = GridServer(port, host="127.0.0.1")
+    srv.register("echo", lambda p: p)
+    srv.start()
+    try:
+        deadline = time.monotonic() + 10
+        while True:
+            try:
+                assert c.call("echo", 3, timeout=2.0) == 3
+                break
+            except GridError:
+                assert time.monotonic() < deadline
+                time.sleep(0.1)
+        assert c.breaker_state() == "closed"
+        assert c.stats()["reconnects"] >= 1
+    finally:
+        c.close()
+        srv.stop()
+
+
+def test_remote_handler_errors_never_trip_breaker():
+    srv = GridServer(0, host="127.0.0.1")
+
+    def boom(p):
+        raise FileNotFoundErr("nope")
+    srv.register("boom", boom)
+    srv.start()
+    c = GridClient("127.0.0.1", srv.port, trip_after=2)
+    try:
+        from minio_tpu.grid import RemoteCallError
+        for _ in range(6):
+            with pytest.raises(RemoteCallError):
+                c.call("boom")
+        assert c.breaker_state() == "closed"
+    finally:
+        c.close()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# peer-notify observability (satellite: no more invisible swallows)
+# ---------------------------------------------------------------------------
+
+def test_peer_notifier_counts_and_logs_failures():
+    from minio_tpu.grid import peers as peers_mod
+    from minio_tpu.grid.peers import PeerNotifier, RELOAD_HANDLER, \
+        make_reload_handler
+    from minio_tpu.utils import tracing
+
+    srv = GridServer(0, host="127.0.0.1")
+    srv.register(RELOAD_HANDLER, make_reload_handler())
+    srv.start()
+    try:
+        before = peers_mod.notify_stats()
+        live = GridClient("127.0.0.1", srv.port)
+        dead = GridClient("127.0.0.1", 1, send_retries=0)
+        n = PeerNotifier([live, dead], timeout=1.0)
+        n.broadcast("iam")
+        after = peers_mod.notify_stats()
+        assert after["sent"] == before["sent"] + 1
+        assert after["failed"] == before["failed"] + 1
+        recent = [r for r in tracing.slow_ops()
+                  if r.get("name") == "peer.notify-failed"]
+        assert recent and recent[-1]["tags"]["peer"].endswith(":1")
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# coherence protocol: generation-validated cross-node invalidation
+# ---------------------------------------------------------------------------
+
+def _coherence_pair():
+    """Two nodes' coherence stacks wired over real grid sockets."""
+    srv_a = GridServer(0, host="127.0.0.1")
+    srv_b = GridServer(0, host="127.0.0.1")
+    srv_a.start()
+    srv_b.start()
+    c_ab = GridClient("127.0.0.1", srv_b.port, send_retries=0)
+    c_ba = GridClient("127.0.0.1", srv_a.port, send_retries=0)
+    inv_a, inv_b = [], []
+    coh_a = PeerCoherence("A", {"B": c_ab},
+                          on_invalidate=lambda b, c: inv_a.append((b, c)))
+    coh_b = PeerCoherence("B", {"A": c_ba},
+                          on_invalidate=lambda b, c: inv_b.append((b, c)))
+    coh_a.register_into(srv_a)
+    coh_b.register_into(srv_b)
+    return (srv_a, srv_b, c_ab, c_ba, coh_a, coh_b, inv_a, inv_b)
+
+
+def test_coherence_push_resync_and_rearm():
+    srv_a, srv_b, c_ab, c_ba, coh_a, coh_b, inv_a, inv_b = \
+        _coherence_pair()
+    try:
+        # Disarmed until the first resync proves generation state.
+        assert not coh_a.coherent() and not coh_b.coherent()
+        assert coh_a.resync("B") and coh_b.resync("A")
+        assert coh_a.coherent() and coh_b.coherent()
+
+        # Push: a mutation on A reaches B acked, B applies it.
+        coh_a.broadcast("bkt", CLASS_LISTING)
+        assert ("bkt", CLASS_LISTING) in inv_b
+        assert coh_a.stats()["inv_sent"] == 1
+        assert coh_a.stats()["inv_failed"] == 0
+
+        # Missed-push recovery: A mutates while B cannot be reached;
+        # B's resync finds the advanced generation and re-invalidates.
+        real_call = c_ab.call
+        c_ab.call = lambda *a, **kw: (_ for _ in ()).throw(
+            GridError("partitioned"))
+        coh_a.broadcast("bkt", CLASS_LISTING)     # escalates
+        assert coh_a.stats()["inv_failed"] == 1
+        assert coh_a.stats()["escalations"] == 1
+        n_before = len(inv_b)
+        coh_b._disarm("A")
+        assert not coh_b.coherent()
+        assert coh_b.resync("A")                  # pull recovers the gap
+        assert len(inv_b) == n_before + 1
+        assert coh_b.coherent()
+        c_ab.call = real_call
+
+        # No change -> resync invalidates nothing.
+        n_before = len(inv_b)
+        assert coh_b.resync("A")
+        assert len(inv_b) == n_before
+    finally:
+        c_ab.close()
+        c_ba.close()
+        srv_a.stop()
+        srv_b.stop()
+
+
+def test_coherence_conn_loss_disarms():
+    srv_a, srv_b, c_ab, c_ba, coh_a, coh_b, inv_a, inv_b = \
+        _coherence_pair()
+    try:
+        assert coh_b.resync("A")
+        assert coh_b.coherent()
+        # A live connection to the peer dying disarms immediately.
+        assert c_ba.ping()
+        srv_a.stop()
+        deadline = time.monotonic() + 5
+        while coh_b.coherent() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not coh_b.coherent()
+    finally:
+        c_ab.close()
+        c_ba.close()
+        srv_b.stop()
+
+
+def test_fi_cache_remote_gate_blocks_serving():
+    from minio_tpu.object.fi_cache import FileInfoCache
+
+    class FI:
+        erasure = type("E", (), {"data_blocks": 0})()
+        inline_data = b""
+
+    cache = FileInfoCache(enabled=True)
+    tok = cache.token("b")
+    cache.put("b", "o", "", FI(), [], read_data=True, token=tok)
+    assert cache.get("b", "o", "", need_data=False) is not None
+    gate_up = [False]
+    cache.remote_gate = lambda: gate_up[0]
+    assert cache.get("b", "o", "", need_data=False) is None
+    assert cache.get_stat("b", "o", "") is None
+    gate_up[0] = True
+    assert cache.get("b", "o", "", need_data=False) is not None
+
+
+def test_metacache_remote_gate_bypasses_cached_walks(tmp_path):
+    from minio_tpu.object.erasure_object import ErasureSet
+    disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    es = ErasureSet(disks)
+    try:
+        es.make_bucket("b")
+        es.put_object("b", "k1", b"x" * 1024)
+        mc = es.metacache
+        assert [o.name for o in es.list_objects("b").objects] == ["k1"]
+        hits0 = mc.stats()["hits"]
+        es.list_objects("b")
+        assert mc.stats()["hits"] == hits0 + 1      # cached walk reused
+        gate_up = [False]
+        mc.remote_gate = lambda: gate_up[0]
+        started0 = mc.stats()["walks_started"]
+        es.list_objects("b")                        # incoherent: re-walk
+        assert mc.stats()["walks_started"] == started0 + 1
+        gate_up[0] = True
+        es.list_objects("b")
+        es.list_objects("b")                        # coherent: cached again
+        assert mc.stats()["hits"] > hits0 + 1
+    finally:
+        es.close()
+
+
+# ---------------------------------------------------------------------------
+# two-node in-process stack: remote sets with COHERENT caches ON
+# ---------------------------------------------------------------------------
+
+def _two_node_stack(tmp_path):
+    """Two 'nodes' sharing one 4-drive erasure layout: each node sees
+    its own 2 drives locally and the sibling's 2 over the grid, each
+    runs its own metacache/fi_cache wired into a PeerCoherence pair —
+    the in-process twin of a 2-node cluster's cache plane."""
+    from minio_tpu.object.erasure_object import ErasureSet
+
+    drives = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    srv_a = GridServer(0, host="127.0.0.1")   # node A serves d0, d1
+    srv_b = GridServer(0, host="127.0.0.1")   # node B serves d2, d3
+    StorageRPCService({d.root: d for d in drives[:2]}).register_into(srv_a)
+    StorageRPCService({d.root: d for d in drives[2:]}).register_into(srv_b)
+    srv_a.start()
+    srv_b.start()
+
+    es_a = ErasureSet([drives[0], drives[1],
+                       RemoteStorage("127.0.0.1", srv_b.port,
+                                     drives[2].root),
+                       RemoteStorage("127.0.0.1", srv_b.port,
+                                     drives[3].root)])
+    es_b = ErasureSet([RemoteStorage("127.0.0.1", srv_a.port,
+                                     drives[0].root),
+                       RemoteStorage("127.0.0.1", srv_a.port,
+                                     drives[1].root),
+                       drives[2], drives[3]])
+    # Remote sets start with the deny-all gate (no protocol): the old
+    # `enabled = False` branch is gone, replaced by the gate.
+    assert es_a.fi_cache.enabled and es_b.fi_cache.enabled
+    assert es_a.fi_cache.remote_gate() is False
+
+    c_ab = GridClient("127.0.0.1", srv_b.port, send_retries=0)
+    c_ba = GridClient("127.0.0.1", srv_a.port, send_retries=0)
+
+    from minio_tpu.grid.coherence import make_set_invalidator
+    coh_a = PeerCoherence("A", {"B": c_ab},
+                          on_invalidate=make_set_invalidator([es_a]))
+    coh_b = PeerCoherence("B", {"A": c_ba},
+                          on_invalidate=make_set_invalidator([es_b]))
+    coh_a.register_into(srv_a)
+    coh_b.register_into(srv_b)
+    for es, coh in ((es_a, coh_a), (es_b, coh_b)):
+        es.metacache.on_bump = \
+            lambda bucket, coh=coh: coh.broadcast(bucket, CLASS_LISTING)
+        es.metacache.bump_coalesce = 0.0     # synchronous acked pushes
+        es.fi_cache.remote_gate = coh.coherent
+        es.metacache.remote_gate = coh.coherent
+    assert coh_a.resync("B") and coh_b.resync("A")
+    return {"drives": drives, "servers": (srv_a, srv_b),
+            "clients": (c_ab, c_ba), "sets": (es_a, es_b),
+            "coh": (coh_a, coh_b)}
+
+
+def _teardown_stack(stack):
+    for es in stack["sets"]:
+        es.close()
+    for c in stack["clients"]:
+        c.close()
+    for s in stack["servers"]:
+        s.stop()
+
+
+def test_cross_node_overwrite_invalidates_sibling_fi_cache(tmp_path):
+    """THE remote-set coherence claim: fi_cache is ON on both nodes'
+    remote sets, repeat GETs hit, and an overwrite through node A
+    invalidates node B's cached entry before A's PUT returns."""
+    stack = _two_node_stack(tmp_path)
+    es_a, es_b = stack["sets"]
+    try:
+        es_a.make_bucket("bkt")
+        v1 = os.urandom(256 << 10)
+        es_a.put_object("bkt", "obj", v1)
+
+        _, got = es_b.get_object("bkt", "obj")
+        assert got == v1
+        hits0 = es_b.fi_cache.stats()["hits"]
+        _, got = es_b.get_object("bkt", "obj")
+        assert got == v1
+        assert es_b.fi_cache.stats()["hits"] > hits0, \
+            "repeat GET on a coherent remote set must be a cache hit"
+
+        # Cross-node overwrite: A's PUT broadcasts the acked
+        # invalidation inside the PUT, so by return B holds nothing.
+        v2 = os.urandom(256 << 10)
+        es_a.put_object("bkt", "obj", v2)
+        assert es_b.fi_cache.get("bkt", "obj", "", need_data=False) is None
+        _, got = es_b.get_object("bkt", "obj")
+        assert got == v2
+
+        # Listings too: B's walk streams were orphaned by the same
+        # bump; a new key through A is visible on B immediately.
+        assert [o.name for o in es_b.list_objects("bkt").objects] == ["obj"]
+        es_a.put_object("bkt", "obj2", b"x" * 2048)
+        names = [o.name for o in es_b.list_objects("bkt").objects]
+        assert names == ["obj", "obj2"]
+    finally:
+        _teardown_stack(stack)
+
+
+def test_partitioned_then_rejoined_node_serves_zero_stale(tmp_path):
+    """The staleness probe: B caches an entry, the coherence plane
+    partitions, A overwrites (push escalates), and B must answer
+    misses — never the stale hit — until its rejoin resync re-arms."""
+    stack = _two_node_stack(tmp_path)
+    es_a, es_b = stack["sets"]
+    c_ab, c_ba = stack["clients"]
+    coh_a, coh_b = stack["coh"]
+    try:
+        es_a.make_bucket("bkt")
+        v1 = os.urandom(128 << 10)
+        es_a.put_object("bkt", "obj", v1)
+        _, got = es_b.get_object("bkt", "obj")
+        assert got == v1
+        assert es_b.fi_cache.get("bkt", "obj", "", need_data=False) \
+            is not None
+
+        # Partition the coherence plane both ways (the data-plane
+        # drive clients stay up: we are probing CACHE staleness, so B
+        # must be able to read the truth yet must not serve the cache).
+        def dead(*a, **kw):
+            raise GridError("partitioned")
+        real_ab, real_ba = c_ab.call, c_ba.call
+        c_ab.call = dead
+        c_ba.call = dead
+        assert not coh_b.resync("A")          # B notices: disarmed
+        assert not coh_b.coherent()
+
+        v2 = os.urandom(128 << 10)
+        es_a.put_object("bkt", "obj", v2)     # push to B escalates
+        assert coh_a.stats()["inv_failed"] >= 1
+
+        # B's cached (now stale) entry exists physically but the gate
+        # refuses to serve it; the read comes from the drives = v2.
+        assert es_b.fi_cache.get("bkt", "obj", "", need_data=False) is None
+        _, got = es_b.get_object("bkt", "obj")
+        assert got == v2
+
+        # Rejoin: resync sees A's advanced generation, invalidates,
+        # re-arms — and the caches work again (fresh entries, hits).
+        c_ab.call, c_ba.call = real_ab, real_ba
+        assert coh_b.resync("A")
+        assert coh_b.coherent()
+        assert es_b.fi_cache.get("bkt", "obj", "", need_data=False) is None
+        _, got = es_b.get_object("bkt", "obj")
+        assert got == v2
+        hits0 = es_b.fi_cache.stats()["hits"]
+        _, got = es_b.get_object("bkt", "obj")
+        assert got == v2 and es_b.fi_cache.stats()["hits"] > hits0
+    finally:
+        _teardown_stack(stack)
+
+
+# ---------------------------------------------------------------------------
+# remote walk_scan: trimmed summaries over the grid
+# ---------------------------------------------------------------------------
+
+def _fixture_set(tmp_path, n=4):
+    from minio_tpu.object.erasure_object import ErasureSet
+    drives = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(n)]
+    es = ErasureSet(drives)
+    es.make_bucket("wb")
+    keys = ["a/x", "a/y/deep", "b", "c/1", "c/2", "zz"]
+    for i, k in enumerate(keys):
+        es.put_object("wb", k, bytes([i]) * (1024 + i))
+    es.put_object("wb", "a/x", b"overwritten" * 100)   # newer version
+    return drives, es, sorted(keys)
+
+
+def test_remote_walk_scan_identical_to_local(tmp_path):
+    drives, es, keys = _fixture_set(tmp_path)
+    srv = GridServer(0, host="127.0.0.1")
+    StorageRPCService({d.root: d for d in drives}).register_into(srv)
+    srv.start()
+    try:
+        rem = RemoteStorage("127.0.0.1", srv.port, drives[0].root)
+        local_walk = list(drives[0].walk_scan("wb"))
+        remote_walk = list(rem.walk_scan("wb"))
+        assert [(p, v, b) for p, v, b in local_walk] == \
+            [(p, v, b) for p, v, b in remote_walk]
+        # Shallow (delimiter) walks round-trip the PREFIX_MARK sentinel
+        # by IDENTITY (the resolver tests `is PREFIX_MARK`).
+        from minio_tpu.storage.meta_scan import PREFIX_MARK
+        local_sh = list(drives[0].walk_scan("wb", shallow=True))
+        remote_sh = list(rem.walk_scan("wb", shallow=True))
+        assert local_sh == remote_sh
+        assert any(v is PREFIX_MARK for _, v, _ in remote_sh)
+    finally:
+        es.close()
+        srv.stop()
+
+
+def test_distributed_listing_byte_identical(tmp_path, monkeypatch):
+    """A remote-drive set's listing — riding walk_scan trimmed
+    summaries over the grid — is identical to (a) the same namespace
+    listed over local drives and (b) the full-journal walk_dir path."""
+    from minio_tpu.object.erasure_object import ErasureSet
+    drives, es, keys = _fixture_set(tmp_path)
+    srv = GridServer(0, host="127.0.0.1")
+    StorageRPCService({d.root: d for d in drives}).register_into(srv)
+    srv.start()
+    es_r = ErasureSet([RemoteStorage("127.0.0.1", srv.port, d.root)
+                       for d in drives])
+
+    def snap(listing):
+        return [(o.name, o.etag, o.size, o.version_id, o.delete_marker)
+                for o in listing.objects], sorted(listing.prefixes)
+
+    shapes = ({}, {"prefix": "a/"}, {"delimiter": "/"},
+              {"prefix": "c/", "delimiter": "/"},
+              {"include_versions": True}, {"max_keys": 3})
+    es_j = ErasureSet([RemoteStorage("127.0.0.1", srv.port, d.root)
+                       for d in drives])
+    try:
+        trimmed = {}
+        for i, kwargs in enumerate(shapes):
+            local = snap(es.list_objects("wb", **kwargs))
+            trimmed[i] = snap(es_r.list_objects("wb", **kwargs))
+            assert trimmed[i] == local, f"listing differs for {kwargs}"
+        # And against the legacy full-journal stream: hide walk_scan so
+        # remote drives fall back to walk_dir's raw xl.meta journals.
+        monkeypatch.delattr(RemoteStorage, "walk_scan")
+        for i, kwargs in enumerate(shapes):
+            journal = snap(es_j.list_objects("wb", **kwargs))
+            assert trimmed[i] == journal, \
+                f"trimmed vs full-journal differs for {kwargs}"
+    finally:
+        es.close()
+        es_r.close()
+        es_j.close()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# dsync: lock-holder liveness
+# ---------------------------------------------------------------------------
+
+def test_leaked_write_lock_unblocks_within_ttl():
+    """The namespace-wedge regression: a holder that dies without
+    unlocking (SIGKILL shape: refresh stops, entries linger) no longer
+    wedges writers — they proceed within the TTL window."""
+    from minio_tpu.grid.dsync import DRWMutex, LocalLocker, LockServer
+
+    servers = [LockServer(ttl=0.4) for _ in range(3)]
+    lks = [LocalLocker(s) for s in servers]
+    holder = DRWMutex(lks, "bkt/obj")
+    assert holder.lock(write=True, timeout=2)
+    holder._stop_refresh.set()           # the crash: no refresh, no unlock
+
+    blocked = DRWMutex(lks, "bkt/obj")
+    t0 = time.monotonic()
+    assert blocked.lock(write=True, timeout=5)
+    waited = time.monotonic() - t0
+    blocked.unlock()
+    assert waited < 2.0, f"writer waited {waited:.2f}s, TTL is 0.4s"
+    assert sum(s.stats()["expired_total"] for s in servers) >= 1
+
+
+def test_lock_ttl_env_knobs(monkeypatch):
+    import importlib
+
+    from minio_tpu.grid import dsync as dsync_mod
+    monkeypatch.setenv("MTPU_GRID_LOCK_TTL", "9.0")
+    monkeypatch.setenv("MTPU_GRID_LOCK_REFRESH", "100.0")
+    mod = importlib.reload(dsync_mod)
+    try:
+        assert mod.LOCK_TTL == 9.0
+        assert mod.REFRESH_INTERVAL == 3.0     # clamped to TTL/3
+        assert mod.LockServer().ttl == 9.0
+    finally:
+        monkeypatch.delenv("MTPU_GRID_LOCK_TTL")
+        monkeypatch.delenv("MTPU_GRID_LOCK_REFRESH")
+        importlib.reload(mod)
+
+
+# ---------------------------------------------------------------------------
+# multi-process cluster chaos matrix (tests/cluster.py harness)
+# ---------------------------------------------------------------------------
+
+def _put_retry(cli, path, body, deadline_s=45):
+    deadline = time.time() + deadline_s
+    while True:
+        try:
+            st, _, b = cli.request("PUT", path, body=body)
+        except Exception as e:  # noqa: BLE001 - conn reset mid-failover
+            st, b = 0, str(e).encode()
+        if st == 200:
+            return
+        assert time.time() < deadline, f"PUT {path}: {st} {b[:300]}"
+        time.sleep(1)
+
+
+def test_cluster_kill_in_and_out_of_write_quorum(tmp_path):
+    """3 nodes x 2 drives (EC 3+3, write quorum 4): one node down is
+    IN write quorum (PUTs succeed), two nodes down is OUT (honest 503
+    SlowDownWrite, and fast — the peer breaker fails the dead nodes'
+    drives in microseconds, not a connect timeout per shard)."""
+    with Cluster(tmp_path, nodes=3, drives_per_node=2) as cluster:
+        c0 = cluster.client(0)
+        assert c0.request("PUT", "/qbkt")[0] == 200
+        v1 = os.urandom(1 << 20)
+        _put_retry(c0, "/qbkt/obj1", v1)
+
+        cluster.kill(2)
+        v2 = os.urandom(1 << 20)
+        _put_retry(c0, "/qbkt/obj2", v2)         # in quorum: succeeds
+        st, _, got = cluster.client(1).request("GET", "/qbkt/obj2")
+        assert st == 200 and got == v2
+
+        cluster.kill(1)                          # 2 of 6 drives left
+        deadline = time.time() + 45
+        while True:
+            st, _, b = c0.request("PUT", "/qbkt/obj3", body=b"x" * 4096)
+            if st == 503:
+                break
+            assert time.time() < deadline, f"want 503, got {st}"
+            time.sleep(1)
+        assert b"reduce your request rate" in b or b"SlowDown" in b, b
+        # Fail FAST: breakers are open by now.
+        t0 = time.time()
+        st, _, _ = c0.request("PUT", "/qbkt/obj3", body=b"x" * 4096)
+        assert st == 503
+        assert time.time() - t0 < 10.0
+        # Reads of quorum-readable data still work (obj1 has 2 shards
+        # on node0 + reconstruct is impossible at 2/6 — honest 503 too).
+        st, _, _ = c0.request("GET", "/qbkt/obj1")
+        assert st in (200, 503)
+
+
+def test_cluster_partition_rejoin_no_stale_reads(tmp_path):
+    """Partition a node's grid plane, overwrite through the healthy
+    side, rejoin: the rejoined node must never answer the old bytes,
+    and must see keys written during the partition."""
+    with Cluster(tmp_path, nodes=3, drives_per_node=2) as cluster:
+        c0 = cluster.client(0)
+        c2 = cluster.client(2)
+        assert c0.request("PUT", "/pbkt")[0] == 200
+        v1 = os.urandom(512 << 10)
+        _put_retry(c0, "/pbkt/obj", v1)
+        # Warm node2's caches (repeat GET = fi_cache hit path).
+        for _ in range(2):
+            st, _, got = c2.request("GET", "/pbkt/obj")
+            assert st == 200 and got == v1
+        st, _, _ = c2.request("GET", "/pbkt")
+        assert st == 200
+
+        cluster.partition(2)
+        time.sleep(1.0)          # > chaos poll + sync interval (0.5 s)
+        v2 = os.urandom(512 << 10)
+        _put_retry(c0, "/pbkt/obj", v2)          # 4/6 drives: quorum
+        _put_retry(c0, "/pbkt/during", b"y" * 4096)
+
+        # The partitioned node must not serve the stale cache: its
+        # coherence gate is down, so either an honest error (no read
+        # quorum from its 2 local drives) or — never — v1.
+        st, _, got = c2.request("GET", "/pbkt/obj")
+        assert not (st == 200 and got == v1), "stale read served"
+
+        cluster.rejoin(2)
+        deadline = time.time() + 45
+        while True:
+            st, _, got = c2.request("GET", "/pbkt/obj")
+            if st == 200 and got == v2:
+                break
+            assert not (st == 200 and got == v1), "stale read after rejoin"
+            assert time.time() < deadline, f"rejoin GET: {st}"
+            time.sleep(1)
+        # Listing on the rejoined node sees the partition-era write.
+        deadline = time.time() + 30
+        while True:
+            st, _, body = c2.request("GET", "/pbkt")
+            if st == 200 and b"during" in body:
+                break
+            assert time.time() < deadline, f"listing stale: {st} {body[:200]}"
+            time.sleep(1)
+
+
+@pytest.mark.slow
+def test_cluster_dsync_lock_expires_after_holder_sigkill(tmp_path):
+    """A SIGKILLed node's in-flight PUT leaks its dsync write lock on
+    the surviving lock servers; a writer of the same key proceeds
+    within the TTL window instead of wedging."""
+    with Cluster(tmp_path, nodes=3, drives_per_node=2,
+                 env={"MTPU_GRID_LOCK_TTL": "4"}) as cluster:
+        c0 = cluster.client(0)
+        assert c0.request("PUT", "/lbkt")[0] == 200
+        _put_retry(c0, "/lbkt/obj", b"seed" * 1024)
+
+        # Node2's PUT hangs mid-write (peers' drives answer slowly),
+        # holding the distributed write lock; SIGKILL leaks it.
+        cluster.hang_drives(0, 6.0)
+        cluster.hang_drives(1, 6.0)
+        time.sleep(0.2)
+
+        def doomed():
+            try:
+                cluster.client(2, timeout=30).request(
+                    "PUT", "/lbkt/obj", body=os.urandom(1 << 20))
+            except Exception:  # noqa: BLE001 - node dies mid-request
+                pass
+        t = threading.Thread(target=doomed, daemon=True)
+        t.start()
+        time.sleep(1.5)                  # lock acquired, writes hanging
+        cluster.kill(2)
+        cluster.rejoin(0)
+        cluster.rejoin(1)
+
+        t0 = time.time()
+        _put_retry(c0, "/lbkt/obj", b"after" * 1024, deadline_s=40)
+        waited = time.time() - t0
+        assert waited < 30, f"writer waited {waited:.1f}s past the leak"
+        st, _, got = cluster.client(1).request("GET", "/lbkt/obj")
+        assert st == 200 and got == b"after" * 1024
+
+
+@pytest.mark.slow
+def test_cluster_8_node_chaos_matrix(tmp_path):
+    """The acceptance matrix at 8 nodes x 8 drives (EC 4+4): single
+    node killed -> writes succeed; listing via a sibling is complete;
+    partition-then-rejoin serves no stale bytes; 4 nodes dead -> out
+    of write quorum -> honest fast 503s."""
+    with Cluster(tmp_path, nodes=8, drives_per_node=1) as cluster:
+        c0 = cluster.client(0)
+        assert c0.request("PUT", "/mbkt")[0] == 200
+        keys = {}
+        for i in range(6):
+            keys[f"k{i}"] = os.urandom(128 << 10)
+            _put_retry(c0, f"/mbkt/k{i}", keys[f"k{i}"])
+
+        # Cross-node reads + complete listing through a sibling.
+        c3 = cluster.client(3)
+        st, _, got = c3.request("GET", "/mbkt/k0")
+        assert st == 200 and got == keys["k0"]
+        st, _, body = c3.request("GET", "/mbkt")
+        assert st == 200
+        for k in keys:
+            assert k.encode() in body
+
+        # Kill one node: still in write quorum (7 >= 5).
+        cluster.kill(7)
+        v = os.urandom(128 << 10)
+        _put_retry(c0, "/mbkt/k0", v)
+        keys["k0"] = v
+        st, _, got = c3.request("GET", "/mbkt/k0")
+        assert st == 200 and got == v
+
+        # Partition node 6, overwrite through node 0, rejoin: no stale.
+        c6 = cluster.client(6)
+        for _ in range(2):
+            st, _, got = c6.request("GET", "/mbkt/k1")
+            assert st == 200 and got == keys["k1"]
+        cluster.partition(6)
+        time.sleep(1.0)
+        v = os.urandom(128 << 10)
+        _put_retry(c0, "/mbkt/k1", v)
+        st, _, got = c6.request("GET", "/mbkt/k1")
+        assert not (st == 200 and got == keys["k1"]), "stale read"
+        keys["k1"] = v
+        cluster.rejoin(6)
+        deadline = time.time() + 45
+        while True:
+            st, _, got = c6.request("GET", "/mbkt/k1")
+            if st == 200 and got == v:
+                break
+            assert not (st == 200 and got != v), "stale read after rejoin"
+            assert time.time() < deadline
+            time.sleep(1)
+
+        # Out of write quorum: 4 alive < 5 -> honest, fast 503s.
+        for i in (4, 5, 6):
+            cluster.kill(i)
+        deadline = time.time() + 45
+        while True:
+            st, _, b = c0.request("PUT", "/mbkt/kx", body=b"x" * 4096)
+            if st == 503:
+                break
+            assert time.time() < deadline, f"want 503, got {st}"
+            time.sleep(1)
+        t0 = time.time()
+        st, _, _ = c0.request("PUT", "/mbkt/kx", body=b"x" * 4096)
+        assert st == 503 and time.time() - t0 < 10.0

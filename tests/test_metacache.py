@@ -293,10 +293,26 @@ def test_truncated_walk_compacts_in_place(tmp_path, monkeypatch):
                 break
             marker = page.next_marker
         assert names == [f"o{i:05d}" for i in range(250)]
+        # Wait for the persisted run to be COMPLETE, not merely for the
+        # first compaction: continuation walks compact in COMPLETION
+        # order, and one floored past the base's current end waits out
+        # a bounded gap-retry until the earlier continuation bridges it
+        # (WalkStream._compact_onto) — so full convergence is async.
+        import json as _json
         import time as _t
-        deadline = _t.monotonic() + 10
-        while es.metacache.compactions < 1 and _t.monotonic() < deadline:
+        base = metacache.WalkStream._dir("cp", "")
+        deadline = _t.monotonic() + 20
+        head = {}
+        while _t.monotonic() < deadline:
+            try:
+                head = _json.loads(
+                    disks[0].read_all(".mtpu.sys", f"{base}/head"))
+                if head.get("count") == 250 and not head.get("truncated"):
+                    break
+            except Exception:  # noqa: BLE001 - base not persisted yet
+                pass
             _t.sleep(0.05)
+        assert head.get("count") == 250 and not head.get("truncated"), head
         assert es.metacache.compactions >= 1
     finally:
         es.close()
