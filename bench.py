@@ -151,6 +151,8 @@ def main() -> None:
             _get_scaling()
         if _want("meta_listing"):
             _meta_listing()
+        if _want("small_put"):
+            _small_put()
         if _want("distributed"):
             _distributed()
         return
@@ -260,6 +262,10 @@ def main() -> None:
     # ---- 10. Metadata plane: LIST/HEAD at high cardinality ------------
     if _want("meta_listing"):
         _meta_listing()
+
+    # ---- 10b. KV-scale small-object write plane -----------------------
+    if _want("small_put"):
+        _small_put()
 
     # ---- 11. Distributed: N-node cluster vs single node ---------------
     if _want("distributed"):
@@ -428,6 +434,112 @@ def _put_concurrent() -> None:
         else round(served / max(tpu, 1e-9), 3),
         "http_workers": _os.cpu_count(),
         "concurrency": threads,
+    }))
+
+
+def _small_put() -> None:
+    """KV-scale small-object write plane (ROADMAP item 4): 4 KiB
+    objects at high concurrency through the real object layer
+    (12 local drives, EC 8+4, inline journal commits), ops/s +
+    p50/p99. Two like-for-like columns inside ONE run on one host:
+
+      value / p50 / p99   group-commit lanes ON (the shipped default):
+                          concurrent commits coalesce per drive into
+                          WAL-backed batches (storage/group_commit)
+      solo_ops_s          MTPU_GROUP_COMMIT=off on the SAME fixture —
+                          the per-request commit fan-out, which is the
+                          pre-PR write path byte-for-byte
+
+      served_ops_s        the same storm through the pre-forked HTTP
+                          front end (probe subprocess; explicit null
+                          where the fleet cannot boot)
+
+    Best-of-2 measured passes per column (aggregate ops/s on a shared
+    box is scheduler-noise-prone; the floor of the noise is the honest
+    capability number), fresh keys every pass (the KV-ingest shape).
+    """
+    import shutil
+    import tempfile
+    from concurrent.futures import ThreadPoolExecutor
+
+    from minio_tpu.object.erasure_object import ErasureSet
+    from minio_tpu.storage.local import LocalStorage
+
+    body = np.random.default_rng(7).integers(
+        0, 256, size=4096, dtype=np.uint8).tobytes()
+    threads, per = (16, 25) if _SMALL else (32, 50)
+
+    def run(group_on: bool):
+        saved = _os.environ.get("MTPU_GROUP_COMMIT")
+        _os.environ["MTPU_GROUP_COMMIT"] = "on" if group_on else "off"
+        base = "/dev/shm" if _os.access("/dev/shm", _os.W_OK) else None
+        root = tempfile.mkdtemp(prefix="bench-smallput-", dir=base)
+        try:
+            disks = [LocalStorage(f"{root}/d{i}") for i in range(12)]
+            for d in disks:
+                d.make_vol("bench")
+            es = ErasureSet(disks, parity=M)
+            ex = ThreadPoolExecutor(max_workers=threads)
+            lat: list = []
+
+            def put(tag, t, collect):
+                mine = []
+                for i in range(per):
+                    t0 = time.perf_counter()
+                    es.put_object("bench", f"{tag}-{t}-{i}", body)
+                    mine.append(time.perf_counter() - t0)
+                if collect:
+                    lat.extend(mine)
+
+            list(ex.map(lambda t: put("w", t, False), range(threads)))
+            best, best_lat = 0.0, []
+            for rep in range(2):
+                lat = []
+                t0 = time.perf_counter()
+                list(ex.map(lambda t: put(f"m{rep}", t, True),
+                            range(threads)))
+                ops = threads * per / (time.perf_counter() - t0)
+                if ops > best:
+                    best, best_lat = ops, sorted(lat)
+            gc = es.group_commit.stats() \
+                if getattr(es, "group_commit", None) else None
+            ex.shutdown(wait=False)
+            es.close()
+            p50 = best_lat[len(best_lat) // 2] * 1e3
+            p99 = best_lat[min(len(best_lat) - 1,
+                               len(best_lat) * 99 // 100)] * 1e3
+            return best, round(p50, 2), round(p99, 2), gc
+        finally:
+            if saved is None:
+                _os.environ.pop("MTPU_GROUP_COMMIT", None)
+            else:
+                _os.environ["MTPU_GROUP_COMMIT"] = saved
+            shutil.rmtree(root, ignore_errors=True)
+
+    solo_ops, solo_p50, solo_p99, _ = run(group_on=False)
+    ops, p50, p99, gc = run(group_on=True)
+    served = None
+    if (_os.cpu_count() or 1) >= 2:
+        served = _served_probe_value("SERVED_SMALL_PUT_OPS")
+    summary = None
+    if gc is not None:
+        summary = {k: gc[k] for k in
+                   ("batches", "members", "solo_bypass", "fill_mean",
+                    "fsyncs_saved", "merged_members", "noop_skips",
+                    "deadline_culls", "solo_demotions")}
+        summary["fill_mean"] = round(summary["fill_mean"], 2)
+    print(json.dumps({
+        "metric": "small_put_ops_s",
+        "value": round(ops, 1),
+        "unit": "ops/s",
+        "p50_ms": p50, "p99_ms": p99,
+        "solo_ops_s": round(solo_ops, 1),
+        "solo_p50_ms": solo_p50, "solo_p99_ms": solo_p99,
+        "vs_solo": round(ops / max(solo_ops, 1e-9), 3),
+        "served_ops_s": served,
+        "object_bytes": len(body),
+        "concurrency": threads,
+        "group_commit": summary,
     }))
 
 
@@ -1302,6 +1414,32 @@ def _serve_probe() -> None:
             wall = dt if wall is None else min(wall, dt)
         print("SERVED_GIBPS="
               f"{threads * per_thread * len(body) / wall / (1 << 30):.4f}")
+
+        # Small-object storm through the front end: 4 KiB signed PUTs
+        # on the same keep-alive clients — the served column of the
+        # small_put section (group-commit lanes engaged inside each
+        # worker under concurrency).
+        small = np.random.default_rng(8).integers(
+            0, 256, size=4096, dtype=np.uint8).tobytes()
+
+        def small_worker(tag, t):
+            cli = clients[t]
+            for i in range(per_small):
+                st, _, _ = cli.request("PUT", f"/bench/sp-{tag}-{t}-{i}",
+                                       body=small)
+                assert st == 200, st
+
+        per_small = 12 if _SMALL else 40
+        list(ex.map(lambda t: small_worker("w", t), range(threads)))
+        wall = None
+        for _rep in range(2):
+            t0 = time.perf_counter()
+            list(ex.map(lambda t: small_worker(f"m{_rep}", t),
+                        range(threads)))
+            dt = time.perf_counter() - t0
+            wall = dt if wall is None else min(wall, dt)
+        print("SERVED_SMALL_PUT_OPS="
+              f"{threads * per_small / wall:.2f}")
 
         # One reusable receive buffer per client thread: the GET probe
         # reads bodies via recv_into (S3Client.get_into), so the

@@ -288,6 +288,7 @@ def _worker_stat(server, worker_id: int) -> dict:
         fic = getattr(s, "fi_cache", None)
         if fic is not None:
             fileinfo.append(fic.stats())
+    from minio_tpu.storage import group_commit as _gc_mod
     stat = {
         "worker": worker_id,
         "pid": os.getpid(),
@@ -297,6 +298,9 @@ def _worker_stat(server, worker_id: int) -> dict:
         "bufpool": global_pool().stats(),
         "engine": engine,
         "fileinfo_cache": fileinfo,
+        # Per-worker group-commit lane occupancy: each worker runs its
+        # own lanes, so the fleet view is a merge (group_commit.merge_stats).
+        "group_commit": _gc_mod.aggregate_stats(),
     }
     # Grid peer breaker state (empty on single-node workers today;
     # carried so a future workers+distributed combination aggregates

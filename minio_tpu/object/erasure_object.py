@@ -332,6 +332,24 @@ class ErasureSet:
             # hits no invalidation contract covers.
             self.fi_cache.remote_gate = lambda: False
             self.metacache.remote_gate = lambda: False
+        # Group-commit lanes (storage/group_commit): concurrent
+        # small-object journal commits coalesce per drive into one
+        # WAL-backed batch — the metadata twin of the stripe batcher.
+        # Local sets only: every drive must implement the batched
+        # commit protocol (remote drives and fault doubles that would
+        # lose their injection seam fall back to the solo fan-out).
+        from minio_tpu.storage import group_commit as gc_mod
+        self.group_commit = None
+        if gc_mod.enabled() and not self._remote_set and \
+                all(_group_commit_capable(d) for d in self.disks):
+            self.group_commit = gc_mod.GroupCommit(
+                self.disks, self.io,
+                name=f"set:{id(self) & 0xffff:x}")
+            # One coalesced invalidation per batch per bucket, through
+            # the same metacache-bump funnel per-request mutations use
+            # (fi_cache listeners + worker shared-gen observers ride
+            # along), fired BEFORE any member acks.
+            self.group_commit.bump = self.metacache.bump
         # Read-kernel counters (admin info): windows served by the
         # fused native GET kernel, by the numpy path, and native
         # verifies that demoted to reconstruction. Incremented from
@@ -352,6 +370,10 @@ class ErasureSet:
             self._mrf_closed = True
             if self._mrf is not None:
                 self._mrf.stop()
+        if self.group_commit is not None:
+            # Final WAL checkpoint rides along: graceful stops leave no
+            # group-commit WALs for the next boot to replay.
+            self.group_commit.close()
         self.pool.shutdown(wait=False)
         self.io.close()
 
@@ -1063,6 +1085,25 @@ class ErasureSet:
 
     def _put_object_buffered(self, bucket: str, object_: str, data: bytes,
                              opts: PutOptions) -> ObjectInfo:
+        if self.group_commit is not None and len(data) <= BLOCK_SIZE:
+            # Track the WHOLE buffered-put body, not just the commit:
+            # the lanes' early-close rule compares pending members to
+            # in-flight requests, so a request still encoding must
+            # already count — its members are coming, and closing a
+            # batch without them costs a whole extra commit round.
+            # Bodies over one erasure block stay untracked: their
+            # encode can run tens of ms, and a lane waiting on one as
+            # an expected member would stall every small PUT behind
+            # the window cap (they still join batches opportunistically
+            # when small traffic is in flight).
+            with self.group_commit.tracking():
+                return self._put_object_buffered_inner(bucket, object_,
+                                                       data, opts)
+        return self._put_object_buffered_inner(bucket, object_, data, opts)
+
+    def _put_object_buffered_inner(self, bucket: str, object_: str,
+                                   data: bytes,
+                                   opts: PutOptions) -> ObjectInfo:
         self._check_bucket(bucket)
         n = len(self.disks)
         m = self.default_parity
@@ -1128,11 +1169,51 @@ class ErasureSet:
                               list(framed[shard_idx]))
                 d.rename_data(SYS_VOL, staging, fi, bucket, object_)
 
+        def stage_one(disk_idx: int):
+            d = self.disks[disk_idx]
+            shard_idx = distribution[disk_idx] - 1
+            d.create_file(SYS_VOL, f"{staging}/{data_dir}/part.1",
+                          list(framed[shard_idx]))
+
+        gc = self.group_commit
+        used_group = False
         try:
             with self.ns.write(bucket, object_):
-                _, errors = self._fanout(
-                    _leased_fns([lambda i=i: write_one(i)
-                                 for i in range(n)], frames_lease))
+                if gc is not None and gc.worth_batching():
+                    # Coalesced commit: journal writes ride the
+                    # per-drive group lanes — one WAL-backed batch per
+                    # drive per window instead of one durable commit
+                    # per drive per request. Non-inline shards stage
+                    # first (the solo engine fan-out), then the
+                    # rename_data commits coalesce the same way.
+                    used_group = True
+                    from minio_tpu.storage.group_commit import GroupOp
+                    if inline:
+                        errors = gc.commit_fanout(
+                            [GroupOp.write_meta(
+                                bucket, object_,
+                                make_fi(distribution[i] - 1))
+                             for i in range(n)])
+                    else:
+                        _, serrors = self._fanout(
+                            _leased_fns([lambda i=i: stage_one(i)
+                                         for i in range(n)],
+                                        frames_lease))
+                        gerrors = gc.commit_fanout(
+                            [GroupOp.rename(
+                                SYS_VOL, staging,
+                                make_fi(distribution[i] - 1),
+                                bucket, object_)
+                             if serrors[i] is None else None
+                             for i in range(n)])
+                        errors = [se if se is not None else ge
+                                  for se, ge in zip(serrors, gerrors)]
+                else:
+                    if gc is not None:
+                        gc.note_solo()
+                    _, errors = self._fanout(
+                        _leased_fns([lambda i=i: write_one(i)
+                                     for i in range(n)], frames_lease))
         finally:
             # The producer's reference, released even when the lock
             # times out; per-drive references (_leased_fns) are
@@ -1160,7 +1241,11 @@ class ErasureSet:
             # drives that missed the write (reference MRF hook,
             # cmd/erasure-object.go:1556-1594).
             self.mrf.enqueue(bucket, object_, version_id)
-        self.metacache.bump(bucket)
+        if not used_group:
+            # Group commits already fired ONE coalesced bump per batch
+            # (before any member ack); a second per-request bump here
+            # would undo the coalescing the lane exists for.
+            self.metacache.bump(bucket)
         return ObjectInfo(bucket=bucket, name=object_, mod_time=mod_time,
                           size=len(data), etag=etag,
                           content_type=opts.content_type,
@@ -2521,12 +2606,33 @@ class ErasureSet:
             marker_vid = "" if opts.null_marker else new_uuid()
             fi = FileInfo(volume=bucket, name=object_, version_id=marker_vid,
                           deleted=True, mod_time=now_ns())
-            _, errors = self._fanout(
-                [lambda d=d: d.write_metadata(bucket, object_, fi)
-                 for d in self.disks])
+            gc = self.group_commit
+            used_group = False
+            if gc is not None:
+                # Delete markers are journal-only commits — the same
+                # shape as inline PUTs, so a concurrent delete storm
+                # coalesces through the same per-drive lanes.
+                with gc.tracking():
+                    if gc.worth_batching():
+                        used_group = True
+                        from minio_tpu.storage.group_commit import GroupOp
+                        errors = gc.commit_fanout(
+                            [GroupOp.write_meta(bucket, object_, fi)
+                             for _ in self.disks])
+                    else:
+                        gc.note_solo()
+                        _, errors = self._fanout(
+                            [lambda d=d: d.write_metadata(
+                                bucket, object_, fi)
+                             for d in self.disks])
+            else:
+                _, errors = self._fanout(
+                    [lambda d=d: d.write_metadata(bucket, object_, fi)
+                     for d in self.disks])
             if sum(e is None for e in errors) < write_quorum:
                 raise WriteQuorumError(bucket, object_)
-            self.metacache.bump(bucket)
+            if not used_group:
+                self.metacache.bump(bucket)
             return DeletedObject(object_name=object_, delete_marker=True,
                                  delete_marker_version_id=marker_vid or "null")
 
@@ -2908,6 +3014,29 @@ def _unwrap_disk(d):
             return d
         d = inner
     return d
+
+
+def _group_commit_capable(d) -> bool:
+    """True when `d` implements the batched commit protocol in a way
+    the group lanes may use. The health wrapper forwards; LocalStorage
+    and CrashDisk define commit_group on their type; anything else
+    (remote drives, NaughtyDisk — whose targeted fault injection a
+    forwarded commit_group would silently bypass) keeps the solo
+    fan-out. OfflineDisk slots pass: every op on them fails the same
+    way solo ops do."""
+    for _ in range(8):
+        if d is None:
+            return False
+        cls = type(d)
+        if cls.__name__ == "OfflineDisk":
+            return True
+        if "commit_group" in cls.__dict__:
+            return True
+        if cls.__name__ == "DiskHealthWrapper":
+            d = d.wrapped
+            continue
+        return False
+    return False
 
 
 def _leased_fns(fns, lease):

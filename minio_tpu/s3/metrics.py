@@ -580,6 +580,64 @@ class Metrics:
                     "Kernel-lane service time of decode-route "
                     "(get/reconstruct) device dispatches",
                     [({}, bst["decode_lane_hist"])])
+        # -- group-commit write plane (storage/group_commit) ------------
+        # Occupancy diagnosis for the small-object commit lanes: batch
+        # size distribution + mean fill say whether concurrent PUTs
+        # actually coalesce; fsyncs_saved is the durability-cost
+        # amortization; culls/demotions are the isolation escape
+        # hatches firing.
+        from minio_tpu.storage import group_commit as _gc_mod
+        gst = _gc_mod.aggregate_stats()
+        peers_gc = [p.get("group_commit") for p in (peer_states or [])
+                    if isinstance(p.get("group_commit"), dict)]
+        if peers_gc:
+            # Pre-forked mode: each worker runs its own lanes and a
+            # scrape lands on an arbitrary one — merge the fleet.
+            gst = _gc_mod.merge_stats(peers_gc)
+        metric("minio_tpu_group_commit_batches_total",
+               "Coalesced per-drive commit batches dispatched",
+               "counter", [({}, gst["batches"])])
+        metric("minio_tpu_group_commit_members_total",
+               "Commit members carried by those batches", "counter",
+               [({}, gst["members"])])
+        metric("minio_tpu_group_commit_solo_total",
+               "Group-eligible commits that took the solo fan-out "
+               "(no coalescing company)", "counter",
+               [({}, gst["solo_bypass"])])
+        metric("minio_tpu_group_commit_batch_size_dispatches_total",
+               "Batches per power-of-two member-count bucket",
+               "counter",
+               [({"size": str(b)}, v) for b, v in
+                sorted(gst["size_buckets"].items())])
+        metric("minio_tpu_group_commit_fill_mean",
+               "Mean members per batch since boot", "gauge",
+               [({}, round(gst["fill_mean"], 3))])
+        metric("minio_tpu_group_commit_merged_members_total",
+               "Same-object members merged into one journal rewrite",
+               "counter", [({}, gst["merged_members"])])
+        metric("minio_tpu_group_commit_noop_skips_total",
+               "Byte-identical version re-adds short-circuited "
+               "without a journal rewrite", "counter",
+               [({}, gst["noop_skips"])])
+        metric("minio_tpu_group_commit_fsyncs_saved_total",
+               "Per-journal fdatasyncs replaced by batch WAL syncs",
+               "counter", [({}, gst["fsyncs_saved"])])
+        metric("minio_tpu_group_commit_deadline_culls_total",
+               "Members culled for exhausted deadlines before their "
+               "batch dispatched (batch-mates unaffected)", "counter",
+               [({}, gst["deadline_culls"])])
+        metric("minio_tpu_group_commit_solo_demotions_total",
+               "Members demoted to the solo commit path after a batch "
+               "fault", "counter", [({}, gst["solo_demotions"])])
+        metric("minio_tpu_group_commit_checkpoints_total",
+               "Background WAL checkpoints (one os.sync each)",
+               "counter", [({}, gst["checkpoints"])])
+        metric("minio_tpu_group_commit_wals_retired_total",
+               "WAL frames retired by checkpoints", "counter",
+               [({}, gst["wals_retired"])])
+        hist_metric("minio_tpu_group_commit_wait_seconds",
+                    "Coalescing wait per commit member (enqueue to "
+                    "batch dispatch)", [({}, gst["wait_hist"])])
         # Report the lane without CREATING it: kernel_lane() lazily
         # spawns a worker thread, and a scrape on a host-codec-only
         # process should not pay a permanent thread to export zeros.
@@ -907,6 +965,12 @@ def node_info(server) -> dict:
             metacache.append({"set": si, **mc.stats()})
         for key in get_kernel:
             get_kernel[key] += getattr(s, "get_kernel", {}).get(key, 0)
+    # Group-commit write plane: per-set lane occupancy + the process's
+    # WAL checkpoint counters (storage/group_commit).
+    from minio_tpu.storage import group_commit as _gc_mod
+    gst = _gc_mod.aggregate_stats()
+    gst.pop("wait_hist", None)
+    info["group_commit"] = gst
     info["io_engine"] = engine
     info["fileinfo_cache"] = fileinfo
     from minio_tpu.storage import meta_scan as _ms

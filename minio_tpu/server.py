@@ -295,6 +295,12 @@ def main(argv=None) -> int:
                         for vol, path in rep["heal"]:
                             pending_heals.append((len(pools), vol, path))
                     else:
+                        # Clean restart: still replay any group-commit
+                        # WALs a SIGKILLed worker left behind (cheap
+                        # no-op when gcommit/ is empty).
+                        from minio_tpu.storage.group_commit import \
+                            replay_wals
+                        replay_wals(d)
                         sweep_stale_tmp(d)
                 except Exception:  # noqa: BLE001 - janitor never blocks boot
                     pass

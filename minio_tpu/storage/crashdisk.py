@@ -38,13 +38,16 @@ import shutil
 import threading
 from typing import Optional
 
-from minio_tpu.storage.local import SYS_VOL, TMP_DIR, StorageError
+from minio_tpu.storage.local import SYS_VOL, TMP_DIR, PowerFault
 
 TEAR_MODES = ("drop", "tear", "lose_entry")
 
 
-class PowerCut(StorageError):
-    """The node lost power: this and every later call cannot happen."""
+class PowerCut(PowerFault):
+    """The node lost power: this and every later call cannot happen.
+
+    Subclasses local.PowerFault so commit_group propagates it
+    WHOLESALE instead of recording it as one member's error."""
 
 
 class CrashClock:
@@ -118,6 +121,24 @@ class CrashDisk:
         # rename-commit — the un-fsynced directory entry lose_entry
         # rolls back when the power dies.
         self._last_commit: Optional[tuple] = None
+        # Group-commit renames whose CONTENT was never fdatasync'd
+        # (commit_group writes destinations tmp+rename with the WAL as
+        # the durability point): (dest, new_blob, prior). At a power
+        # cut, drop/tear leave the rename durable with TORN content
+        # (the page cache died); lose_entry loses the rename's dir
+        # entry instead (dest reverts to prior). Entries retire when a
+        # checkpoint's os.sync completes.
+        self._unsynced: list = []
+        # WAL files whose gcommit/ dir entry was never synced: lost
+        # under lose_entry (the documented MTPU_FS_OSYNC exception —
+        # FS_OSYNC dir-syncs gcommit/ and clears this).
+        self._unsynced_wals: list = []
+        # The background checkpoint coordinator must never touch this
+        # drive's WAL: the power-cut double owns durability timing —
+        # checkpoints happen only through the hook-ticked
+        # gc_checkpoint() above.
+        if hasattr(disk, "_gc_auto"):
+            disk._gc_auto = False
         clock.register(self)
 
     @property
@@ -140,10 +161,34 @@ class CrashDisk:
 
     def _on_power_cut(self) -> None:
         """Called once when the clock fires (any disk, any thread)."""
+        with self._mu:
+            unsynced, self._unsynced = self._unsynced, []
+            uwals, self._unsynced_wals = self._unsynced_wals, []
+            last, self._last_commit = self._last_commit, None
+        # Group-commit destinations with un-fsynced content: the power
+        # cut tears them (drop/tear — the rename's entry is journaled,
+        # the cached pages are not) or voids the rename outright
+        # (lose_entry). replay_wals repairs the former from the WAL.
+        for dest, blob, prior in unsynced:
+            try:
+                if self.mode == "lose_entry":
+                    if prior is None:
+                        os.remove(dest)
+                    else:
+                        with open(dest, "wb") as f:
+                            f.write(prior)
+                else:
+                    with open(dest, "wb") as f:
+                        f.write(blob[:len(blob) // 2])
+            except OSError:
+                pass
         if self.mode != "lose_entry":
             return
-        with self._mu:
-            last, self._last_commit = self._last_commit, None
+        for wal in uwals:
+            try:
+                os.remove(wal)
+            except OSError:
+                pass
         if last is None:
             return
         dest, prior = last
@@ -312,6 +357,22 @@ class CrashDisk:
                 metafmt.VersionNotFoundErr):
             pass
 
+    # -- group commit (storage/group_commit lanes) -----------------------
+
+    def commit_group(self, ops, _info=None):
+        """The batched commit with a crash point at EVERY durable
+        sub-step boundary: each rename_data member's data-dir move,
+        the multi-object WAL write, each destination journal rename,
+        and the checkpoint's sync — the composite sub-steps the
+        group-commit crash matrix sweeps."""
+        self._check_alive()
+        return self._disk.commit_group(ops, _info=_info,
+                                       _hook=_GCHook(self))
+
+    def gc_checkpoint(self):
+        self._check_alive()
+        return self._disk.gc_checkpoint(_hook=_GCHook(self))
+
     def __getattr__(self, name: str):
         attr = getattr(self._disk, name)
         if not callable(attr):
@@ -330,3 +391,86 @@ class CrashDisk:
             self._check_alive()
             return attr(*args, **kwargs)
         return passthrough
+
+
+class _GCHook:
+    """commit_group's crash-injection seam, bound to one CrashDisk.
+
+    LocalStorage.commit_group calls these at every durable sub-step
+    boundary; each tick can fire the shared clock, fabricate the
+    partial on-disk state a real cut would leave at that instant, and
+    raise PowerCut. note_* calls record completed-but-not-yet-durable
+    effects so a LATER cut (any op, any disk) tears them retroactively
+    in _on_power_cut — the page cache dies with the node, not with the
+    op that filled it."""
+
+    __slots__ = ("cd",)
+
+    def __init__(self, cd: CrashDisk):
+        self.cd = cd
+
+    def step_move(self, op) -> None:
+        cd = self.cd
+        if cd._clock.tick():
+            if cd.mode == "tear" and op.fi.data_dir:
+                d = cd._disk
+                try:
+                    src = os.path.join(
+                        d._obj_dir(op.src_volume, op.src_path),
+                        op.fi.data_dir)
+                    dst_dir = d._obj_dir(op.volume, op.path)
+                    os.makedirs(dst_dir, exist_ok=True)
+                    os.replace(src, os.path.join(dst_dir, op.fi.data_dir))
+                except OSError:
+                    pass
+            raise PowerCut(f"{cd.endpoint}: power cut moving data dir "
+                           "(group commit)")
+
+    def step_wal(self, path: str, frame: bytes) -> None:
+        cd = self.cd
+        if cd._clock.tick():
+            if cd.mode == "tear":
+                # Torn multi-object WAL frame: a prefix of the append
+                # landed. The frame crc makes it self-evident at
+                # replay; it protects nobody.
+                try:
+                    os.makedirs(os.path.dirname(path), exist_ok=True)
+                    with open(path, "ab") as f:
+                        f.write(frame[:max(0, len(frame) - 1) // 2])
+                except OSError:
+                    pass
+            raise PowerCut(f"{cd.endpoint}: power cut writing group WAL")
+
+    def note_wal(self, path: str, synced_dir: bool) -> None:
+        cd = self.cd
+        if cd.mode == "lose_entry" and not synced_dir:
+            with cd._mu:
+                cd._unsynced_wals.append(path)
+
+    def meta_prior(self, volume: str, path: str):
+        return self.cd._meta_prior(volume, path)
+
+    def step_rename(self, dest: str, blob: bytes) -> None:
+        cd = self.cd
+        if cd._clock.tick():
+            # Power dies BEFORE this rename: this destination keeps its
+            # old journal; earlier renames of the same batch are torn
+            # by _on_power_cut (their content was never synced).
+            raise PowerCut(f"{cd.endpoint}: power cut in batched "
+                           "rename sequence")
+
+    def note_rename(self, dest: str, blob: bytes, prior) -> None:
+        cd = self.cd
+        with cd._mu:
+            cd._unsynced.append((dest, bytes(blob),
+                                 None if prior is None else bytes(prior)))
+
+    def step_sync(self) -> None:
+        cd = self.cd
+        if cd._clock.tick():
+            # Cut during the checkpoint: the sync never happened —
+            # unsynced destinations tear, live WALs survive for replay.
+            raise PowerCut(f"{cd.endpoint}: power cut in WAL checkpoint")
+        with cd._mu:
+            cd._unsynced.clear()
+            cd._unsynced_wals.clear()

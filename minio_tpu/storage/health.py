@@ -41,7 +41,10 @@ _DOMAIN_ERRORS = (FileNotFoundErr, VersionNotFoundErr, MetaError,
                   NotADirectoryError, ValueError, KeyError)
 
 # Bulk transfer ops get a longer deadline than metadata ops.
-_BULK_OPS = {"create_file", "read_file", "rename_data"}
+# commit_group is bulk: one call commits a whole coalesced batch (many
+# members' journals + one WAL fsync) and must not be clipped by the
+# single-op metadata timeout.
+_BULK_OPS = {"create_file", "read_file", "rename_data", "commit_group"}
 # Ops returning lazy iterators: each next() must go through the
 # deadline/breaker machinery, not just the (instant) generator creation.
 _GENERATOR_OPS = {"walk_dir", "walk_scan"}

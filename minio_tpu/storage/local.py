@@ -83,6 +83,13 @@ class FaultyDisk(StorageError):
     pass
 
 
+class PowerFault(StorageError):
+    """Base of injected power-cut faults (storage/crashdisk.PowerCut):
+    a dead node's fault must propagate WHOLESALE out of commit_group —
+    recording it as one member's error would let batch-mates proceed
+    on a node that no longer exists."""
+
+
 @dataclass
 class DiskInfo:
     total: int = 0
@@ -99,6 +106,37 @@ class DiskInfo:
 class VolInfo:
     name: str
     created: int = 0
+
+
+def _read_raw(path: str) -> bytes:
+    """Whole-file read through raw os.open — the io.open stack costs
+    several times the syscall for the small files the group-commit hot
+    loop reads (version journals)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        size = os.fstat(fd).st_size
+        buf = os.read(fd, size)
+        while len(buf) < size:
+            chunk = os.read(fd, size - len(buf))
+            if not chunk:
+                break
+            buf += chunk
+        return buf
+    finally:
+        os.close(fd)
+
+
+def _write_raw(path: str, blob: bytes) -> None:
+    """Whole-file write through raw os.open (no fsync — callers that
+    need durability sync explicitly)."""
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+    try:
+        off = 0
+        view = memoryview(blob)
+        while off < len(blob):
+            off += os.write(fd, view[off:])
+    finally:
+        os.close(fd)
 
 
 def _is_valid_volname(vol: str) -> bool:
@@ -139,6 +177,15 @@ class LocalStorage:
         self._disk_id: Optional[str] = None
         self._lock = threading.Lock()          # guards _path_locks
         self._path_locks: dict[str, threading.Lock] = {}
+        # Group-commit WAL (commit_group): one append-mode file per
+        # process, held open across batches; frames accumulate until a
+        # checkpoint's sync truncates it (storage/group_commit).
+        self._gc_mu = threading.Lock()
+        self._gc_wal_fd: Optional[int] = None
+        self._gc_wal_path = ""
+        self._gc_dirty = 0                 # frames since last checkpoint
+        import itertools
+        self._gc_seq = itertools.count()   # tmp-name counter (hot loop)
         os.makedirs(os.path.join(self.root, SYS_VOL, TMP_DIR), exist_ok=True)
 
     def _path_lock(self, volume: str, path: str) -> threading.Lock:
@@ -559,6 +606,12 @@ class LocalStorage:
                 xl = self._read_meta(volume, path)
             except FileNotFoundErr:
                 xl = XLMeta()
+            if xl.version_unchanged(fi):
+                # Byte-identical re-add (hot-key overwrite-with-same-
+                # content storms: MRF retries, heal rewrites of
+                # agreeing copies): the journal would not change, so
+                # skip the rewrite + fsync entirely.
+                return
             old_ddir = xl.add_version(fi)
             self._atomic_write(self._meta_path(volume, path), xl.dump())
             self._reclaim_data_dir(volume, path, old_ddir)
@@ -568,6 +621,8 @@ class LocalStorage:
             xl = self._read_meta(volume, path)
             if xl._find(fi.storage_version_id()) is None:
                 raise VersionNotFoundErr(fi.version_id)
+            if xl.version_unchanged(fi):
+                return
             old_ddir = xl.add_version(fi)
             self._atomic_write(self._meta_path(volume, path), xl.dump())
             self._reclaim_data_dir(volume, path, old_ddir)
@@ -637,6 +692,315 @@ class LocalStorage:
             self._reclaim_data_dir(dst_volume, dst_path, old_ddir)
         # Clean the now-empty staging dir.
         shutil.rmtree(self._obj_dir(src_volume, src_path), ignore_errors=True)
+
+    # ------------------------------------------------------------------
+    # the GROUP commit protocol (storage/group_commit.py lanes)
+    # ------------------------------------------------------------------
+
+    def commit_group(self, ops: list, _info: Optional[dict] = None,
+                     _hook=None) -> list:
+        """Batched commit point for a group of write_metadata /
+        rename_data ops (storage/group_commit.GroupOp). Returns a
+        per-member result list: None = committed, Exception = that
+        member failed — batch-mates are unaffected (isolation is per
+        member for merge faults, per OBJECT for journal-write faults).
+
+        Protocol (the group twin of _atomic_write — see the module
+        docstring of storage/group_commit for the durability story):
+          1. per rename_data member: staged data dir moves in;
+          2. per DISTINCT object: one journal read-modify-write, every
+             member merged in arrival order (same-object overwrite
+             storms collapse to one rewrite; byte-identical re-adds
+             skip entirely);
+          3. ONE write-ahead record (gcommit/<wal>) holding every
+             merged journal, fdatasync'd once — the batch's durability
+             point, amortized across all members;
+          4. per changed object: plain tmp + rename (no per-file
+             fdatasync: a destination torn by a power cut is repaired
+             from the WAL by replay_wals at mount);
+          5. one _fsync_dir pass over distinct parents (MTPU_FS_OSYNC);
+          6. old-data-dir reclaim + staging cleanup.
+        WAL files retire at the next checkpoint (one os.sync every
+        MTPU_GROUP_COMMIT_CKPT batches); replay is idempotent.
+
+        `_info` (optional dict) receives batch accounting: objects,
+        merged (same-object extra members), noops, fsyncs_saved.
+        `_hook` is the crash-injection seam (storage/crashdisk): called
+        at every durable sub-step boundary.
+        """
+        from minio_tpu.storage import group_commit as gc_mod
+        results: list = [None] * len(ops)
+        info = _info if _info is not None else {}
+        info.setdefault("objects", 0)
+        info.setdefault("merged", 0)
+        info.setdefault("noops", 0)
+        info.setdefault("fsyncs_saved", 0)
+        groups: dict[tuple, list[int]] = {}
+        order: list[tuple] = []
+        for i, op in enumerate(ops):
+            key = (op.volume, op.path)
+            if key not in groups:
+                groups[key] = []
+                order.append(key)
+            groups[key].append(i)
+        # All path locks, sorted: a fixed global order can never
+        # deadlock against another multi-lock holder, and solo ops
+        # take single locks (trivially compatible).
+        lks = [self._path_lock(v, p) for (v, p) in sorted(groups)]
+        for lk in lks:
+            lk.acquire()
+        staging_cleanup: list[tuple[str, str]] = []
+        try:
+            # (vol, path, meta_path, blob, member_idxs,
+            #  replaced_ddirs, was_fresh)
+            staged: list = []
+            reclaims: list = []    # applied only once the journal LANDS
+            for key in order:
+                vol, path = key
+                idxs = groups[key]
+                dst_dir = self._obj_dir(vol, path)
+                meta_path = dst_dir + os.sep + META_FILE
+                # Raw os.open read: the io.open machinery costs ~4x the
+                # syscall on this path, and at KV batch sizes the
+                # per-member constant IS the commit cost.
+                fresh = False
+                try:
+                    xl = XLMeta.load(_read_raw(meta_path))
+                except (FileNotFoundError, NotADirectoryError):
+                    xl = XLMeta()
+                    fresh = True
+                except (OSError, MetaError, ValueError) as e:
+                    for i in idxs:
+                        results[i] = e
+                    continue
+                if fresh:
+                    # The object dir is needed for data-dir moves and
+                    # the journal rename alike; one mkdir now beats an
+                    # ENOENT retry dance per sub-step later.
+                    try:
+                        os.mkdir(dst_dir)
+                    except FileExistsError:
+                        pass
+                    except FileNotFoundError:
+                        os.makedirs(dst_dir, exist_ok=True)
+                changed = False
+                ok_idxs: list[int] = []
+                obj_reclaims: list[str] = []
+                for i in idxs:
+                    op = ops[i]
+                    # Snapshot so one member's fault cannot poison the
+                    # merged journal its same-object mates commit.
+                    snap = (list(xl.versions), dict(xl.inline))
+                    try:
+                        if op.kind == "rd":
+                            if fresh:
+                                op.fi.fresh = True
+                            old = xl.add_version(op.fi)
+                            if op.fi.data_dir:
+                                if _hook is not None:
+                                    _hook.step_move(op)
+                                src_data = os.path.join(
+                                    self._obj_dir(op.src_volume,
+                                                  op.src_path),
+                                    op.fi.data_dir)
+                                dd = os.path.join(dst_dir,
+                                                  op.fi.data_dir)
+                                if os.path.isdir(dd):
+                                    shutil.rmtree(dd)
+                                os.replace(src_data, dd)
+                            if old:
+                                obj_reclaims.append(old)
+                            staging_cleanup.append((op.src_volume,
+                                                    op.src_path))
+                            changed = True
+                        else:
+                            if xl.version_unchanged(op.fi):
+                                info["noops"] += 1
+                            else:
+                                old = xl.add_version(op.fi)
+                                if old:
+                                    obj_reclaims.append(old)
+                                changed = True
+                        ok_idxs.append(i)
+                    except PowerFault:
+                        raise
+                    except Exception as e:  # noqa: BLE001 - per member
+                        xl.versions, xl.inline = snap
+                        results[i] = e
+                if ok_idxs:
+                    info["objects"] += 1
+                    info["merged"] += len(ok_idxs) - 1
+                    if changed:
+                        staged.append((vol, path, meta_path, xl.dump(),
+                                       ok_idxs, obj_reclaims, fresh))
+            if staged:
+                recs = [(v, p, b) for v, p, _m, b, _, _, _ in staged]
+                try:
+                    self._gc_append_wal(recs, _hook)
+                except PowerFault:
+                    raise
+                except Exception as e:  # noqa: BLE001 - batch durability
+                    for _v, _p, _m, _b, idxs2, _r, _f in staged:
+                        for i in idxs2:
+                            if results[i] is None:
+                                results[i] = e
+                    staged = []
+                # One WAL fdatasync covers what would have been one
+                # fdatasync per changed journal on the solo path (plus
+                # one dir fsync per commit under FS_OSYNC).
+                info["fsyncs_saved"] += max(0, len(staged) - 1)
+                tmp_dir = os.path.join(self.root, SYS_VOL, TMP_DIR)
+                dirs: set[str] = set()
+                for vol, path, meta_path, blob, idxs2, obj_reclaims, \
+                        was_fresh in staged:
+                    try:
+                        prior = None
+                        if _hook is not None:
+                            _hook.step_rename(meta_path, blob)
+                            prior = _hook.meta_prior(vol, path)
+                        if was_fresh:
+                            # FRESH object: no old journal a torn write
+                            # could destroy, so the journal lands
+                            # DIRECTLY (one filesystem-journal
+                            # transaction instead of create+rename —
+                            # the KV-ingest case is all fresh keys). A
+                            # reader racing the µs-scale write sees an
+                            # unparsable journal for a key that is not
+                            # yet acked — the same "not there yet" it
+                            # would have seen a µs earlier; a power cut
+                            # leaves a torn dest replay_wals repairs.
+                            _write_raw(meta_path, blob)
+                        else:
+                            # Overwrite: tmp + rename, so the OLD
+                            # journal stays intact (and visible) until
+                            # the atomic replace.
+                            tmp = os.path.join(
+                                tmp_dir, f"gc{os.getpid()}-"
+                                f"{next(self._gc_seq)}")
+                            _write_raw(tmp, blob)
+                            os.replace(tmp, meta_path)
+                        dirs.add(meta_path.rsplit(os.sep, 1)[0])
+                        if _hook is not None:
+                            _hook.note_rename(meta_path, blob, prior)
+                        # Old data dirs reclaim only once the NEW
+                        # journal actually landed — a failed rename
+                        # leaves the old journal, whose versions still
+                        # reference them.
+                        reclaims.extend((vol, path, dd)
+                                        for dd in obj_reclaims)
+                    except PowerFault:
+                        raise
+                    except Exception as e:  # noqa: BLE001 - per object
+                        for i in idxs2:
+                            if results[i] is None:
+                                results[i] = e
+                if FS_OSYNC:
+                    for d in sorted(dirs):
+                        self._fsync_dir(d)
+            for vol, path, ddir in reclaims:
+                self._reclaim_data_dir(vol, path, ddir)
+        finally:
+            for lk in lks:
+                lk.release()
+        for sv, sp in staging_cleanup:
+            shutil.rmtree(self._obj_dir(sv, sp), ignore_errors=True)
+        return results
+
+    # When False (set by crash doubles that own durability timing) the
+    # background checkpoint coordinator never touches this drive's
+    # WAL — checkpoints happen only through an explicit, hook-ticked
+    # gc_checkpoint().
+    _gc_auto = True
+
+    def _gc_append_wal(self, recs: list, _hook=None) -> None:
+        """Append one batch frame to this drive's group-commit WAL and
+        fdatasync it — the batch's durability point. The file is
+        created once and held open; checkpoints truncate it in place
+        (no per-batch create/unlink, see storage/group_commit)."""
+        from minio_tpu.storage import group_commit as gc_mod
+        frame = gc_mod.encode_frame(recs)
+        with self._gc_mu:
+            created = False
+            if self._gc_wal_fd is None:
+                path = gc_mod.wal_file_path(self.root)
+                flags = os.O_WRONLY | os.O_CREAT | os.O_APPEND
+                try:
+                    fd = os.open(path, flags, 0o644)
+                except FileNotFoundError:
+                    os.makedirs(os.path.dirname(path), exist_ok=True)
+                    fd = os.open(path, flags, 0o644)
+                self._gc_wal_fd = fd
+                self._gc_wal_path = path
+                created = True
+            if _hook is not None:
+                _hook.step_wal(self._gc_wal_path, frame)
+            fd = self._gc_wal_fd
+            off = 0
+            view = memoryview(frame)
+            while off < len(frame):
+                off += os.write(fd, view[off:])
+            os.fdatasync(fd)
+            if created:
+                if FS_OSYNC:
+                    self._fsync_dir(os.path.dirname(self._gc_wal_path))
+                if _hook is not None:
+                    _hook.note_wal(self._gc_wal_path,
+                                   synced_dir=FS_OSYNC)
+            self._gc_dirty += 1
+        if self._gc_auto and _hook is None:
+            from minio_tpu.storage.group_commit import \
+                schedule_checkpoint
+            schedule_checkpoint(self)
+
+    def gc_pending(self) -> int:
+        """Frames appended since the last checkpoint."""
+        with self._gc_mu:
+            return self._gc_dirty
+
+    def gc_truncate_wal(self, expect: Optional[int] = None) -> int:
+        """Drop the WAL's frames (caller has ALREADY made the renamed
+        destinations durable via sync); returns the frame count.
+        `expect` guards the sync-to-truncate window: a frame appended
+        AFTER the caller's sync was not covered by it, so a changed
+        count skips the truncate (those frames retire next
+        checkpoint) instead of erasing a live durability point."""
+        with self._gc_mu:
+            n = self._gc_dirty
+            if n == 0 or (expect is not None and n != expect):
+                return 0
+            if self._gc_wal_fd is not None:
+                try:
+                    os.ftruncate(self._gc_wal_fd, 0)
+                except OSError:
+                    pass
+            self._gc_dirty = 0
+        return n
+
+    def gc_checkpoint(self, _hook=None) -> int:
+        """Forced checkpoint: make every renamed group-commit
+        destination durable (one os.sync) and truncate the WAL frames
+        it was protecting. Returns the number of frames retired.
+        Called at set close (graceful stops leave no frames for the
+        next boot to replay) and by the crash harness through its
+        injection hook."""
+        pre = self.gc_pending()
+        if not pre:
+            return 0
+        if _hook is not None:
+            _hook.step_sync()
+        os.sync()
+        return self.gc_truncate_wal(expect=pre)
+
+    def gc_close(self) -> None:
+        """Close the WAL fd (after a final checkpoint; the empty file
+        itself may remain — replay of an empty WAL is a no-op)."""
+        with self._gc_mu:
+            fd, self._gc_wal_fd = self._gc_wal_fd, None
+        if fd is not None:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
 
     def rename_file(self, src_volume: str, src_path: str,
                     dst_volume: str, dst_path: str) -> None:
@@ -1130,9 +1494,18 @@ def recovery_sweep(disk, min_age: Optional[float] = None) -> dict:
     Returns {"removed": int, "dangling": int, "heal": [(bucket, path)]}
     — the caller enqueues the heal list onto the owning set's MRF.
     Only safe before the drive starts serving.
+
+    Group-commit WALs replay FIRST (storage/group_commit.replay_wals):
+    a batched commit's journal claims must be reinstated before the
+    dangling-data-dir scan looks, or the scan would reap data dirs the
+    replayed journals reference.
     """
+    from minio_tpu.storage.group_commit import replay_wals
+    gc = replay_wals(disk)
     out = {"removed": sweep_stale_tmp(disk, min_age),
-           "dangling": 0, "heal": []}
+           "dangling": 0, "heal": [],
+           "wal_replayed": gc["replayed"],
+           "wal_repaired": gc["repaired"]}
     root = getattr(disk, "root", None)
     if root is None:
         return out

@@ -240,6 +240,21 @@ class XLMeta:
             return old_ddir
         return ""
 
+    def version_unchanged(self, fi: FileInfo) -> bool:
+        """True when add_version(fi) would be a byte-identical no-op:
+        the resident entry for this version id equals fi's version map
+        AND its inline bytes. Overwrite-with-same-content storms (MRF
+        retries, replication resync, heal rewrites of agreeing copies
+        — anything that preserves mod_time) then skip the full journal
+        rewrite + fsync entirely."""
+        vid = fi.storage_version_id()
+        old = self._find(vid)
+        if old is None or old != fi.to_version_map():
+            return False
+        want = bytes(fi.inline_data) if fi.inline_data is not None \
+            else None
+        return self.inline.get(vid) == want
+
     def delete_version(self, version_id: str) -> str:
         """Remove a version; returns its data_dir ("" if none/inline)."""
         vid = version_id or NULL_VERSION_ID

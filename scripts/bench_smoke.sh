@@ -18,7 +18,7 @@ fi
 
 echo "== bench smoke (baseline: $latest) =="
 out=$(JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} \
-      MTPU_BENCH_ONLY=put_latency,put_concurrent,get_latency,get_concurrent,meta_listing,distributed \
+      MTPU_BENCH_ONLY=put_latency,put_concurrent,get_latency,get_concurrent,meta_listing,small_put,distributed \
       MTPU_BENCH_SMALL=1 \
       python bench.py)
 echo "$out"
@@ -53,6 +53,10 @@ import sys
 # p50 (fileinfo cache + verify kernel — native host or batched device
 # per calibration). The bench emits an explicit null on hosts where the
 # fixture cannot build, and the gate skips cleanly there.
+# The small_put gate ("higher") watches the KV-scale write plane: the
+# group-commit lanes' aggregate small-object ops/s through the object
+# layer. The bench always measures it on local drives; the served
+# column (nullable on 1-core hosts) is informational, not gated.
 # The distributed listing gate ("lower") watches the cluster listing
 # page: every measured page pays a real cross-node walk over the
 # remote walk_scan trimmed-summary stream through REAL spawned server
@@ -68,6 +72,7 @@ GATES = [
     ("get_object_p50_ec4_1mib_ms", "value", "lower"),
     ("meta_listing_list_cold_p50_ms", "value", "lower"),
     ("meta_listing_head_p50_ms", "cold_p50_ms", "lower"),
+    ("small_put_ops_s", "value", "higher"),
     ("distributed_list_page_p50_ms", "value", "lower"),
 ]
 
