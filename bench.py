@@ -153,6 +153,10 @@ def main() -> None:
             _meta_listing()
         if _want("small_put"):
             _small_put()
+        if _want("transform_put"):
+            _transform_put()
+        if _want("transform_get"):
+            _transform_get()
         if _want("distributed"):
             _distributed()
         return
@@ -266,6 +270,12 @@ def main() -> None:
     # ---- 10b. KV-scale small-object write plane -----------------------
     if _want("small_put"):
         _small_put()
+
+    # ---- 10c. Fused transform plane: plaintext vs SSE vs compressed ---
+    if _want("transform_put"):
+        _transform_put()
+    if _want("transform_get"):
+        _transform_get()
 
     # ---- 11. Distributed: N-node cluster vs single node ---------------
     if _want("distributed"):
@@ -541,6 +551,251 @@ def _small_put() -> None:
         "concurrency": threads,
         "group_commit": summary,
     }))
+
+
+def _transform_fixture():
+    """(root, es, kms, body): 12-drive EC 8+4 set on /dev/shm plus a
+    bench KMS, shared by the transform_put/transform_get sections."""
+    import base64
+    import tempfile
+
+    from minio_tpu.crypto.kms import KMS
+    from minio_tpu.object.erasure_object import ErasureSet
+    from minio_tpu.storage.local import LocalStorage
+
+    base = "/dev/shm" if _os.access("/dev/shm", _os.W_OK) else None
+    root = tempfile.mkdtemp(prefix="bench-transform-", dir=base)
+    disks = [LocalStorage(f"{root}/d{i}") for i in range(12)]
+    for d in disks:
+        d.make_vol("bench")
+    es = ErasureSet(disks, parity=M)
+    kms = KMS({"bench": b"\x07" * 32}, "bench")
+    # Compressible-but-not-trivial body (numbered text lines), 4 MiB.
+    line = b"".join(b"%09d transform bench line\n" % i
+                    for i in range(5000))
+    size = (1 << 20) if _SMALL else (4 << 20)
+    body = (line * (size // len(line) + 1))[:size]
+    del base64
+    return root, es, kms, body
+
+
+def _transform_modes(kms, body):
+    """Mode name -> (PutOptions factory, per-object spec factory).
+    Factories build FRESH options per object (SSE seals a fresh data
+    key per object, exactly like the S3 handler)."""
+    from minio_tpu.crypto import sse as sse_mod
+    from minio_tpu.object import transform as tf
+    from minio_tpu.object.types import PutOptions
+
+    def plain(bucket, key):
+        return PutOptions(transform=tf.TransformSpec())
+
+    def sse(bucket, key):
+        data_key, nonce, imeta = sse_mod.encrypt_metadata(
+            bucket, key, len(body), kms, None)
+        opts = PutOptions(transform=tf.TransformSpec(
+            enc_key=data_key, enc_nonce=nonce))
+        opts.internal_metadata.update(imeta)
+        return opts
+
+    def comp(bucket, key):
+        return PutOptions(transform=tf.TransformSpec(compress=True))
+
+    return {"plain": plain, "sse": sse, "comp": comp}
+
+
+def _transform_put() -> None:
+    """Fused single-pass PUT data plane (ROADMAP item 3): aggregate
+    PUT throughput for plaintext vs SSE (DARE AES-256-GCM) vs
+    compressed bodies, like-for-like in ONE run on one fixture — the
+    fused pass (one native digest/compress/encrypt/frame call per PUT)
+    against the layered per-stage pipeline (MTPU_TRANSFORM_FUSED=off)
+    on the same fixture. The acceptance signal is the sse/plain and
+    comp/plain aggregate ratios (chartered ~<= 1.1x on a host whose
+    wall is the data path) plus the path-split counters proving ZERO
+    legacy-path requests with fusion on. Explicit-null skip when the
+    native kernel library is unavailable."""
+    import shutil
+    from concurrent.futures import ThreadPoolExecutor
+
+    from minio_tpu.object import transform as tf
+
+    if not tf.fused_put_enabled():
+        for mode in ("plain", "sse", "comp"):
+            print(json.dumps({
+                "metric": f"transform_put_{mode}_gibps", "value": None,
+                "skipped": "native transform kernel unavailable"}))
+        return
+    root, es, kms, body = _transform_fixture()
+    threads, per = (4, 3) if _SMALL else (8, 6)
+    try:
+        modes = _transform_modes(kms, body)
+
+        def run_mode(mode, fused_on):
+            saved = _os.environ.get("MTPU_TRANSFORM_FUSED")
+            _os.environ["MTPU_TRANSFORM_FUSED"] = \
+                "on" if fused_on else "off"
+            try:
+                ex = ThreadPoolExecutor(max_workers=threads)
+                lat: list = []
+
+                def put(tag, t):
+                    for i in range(per):
+                        opts = modes[mode](
+                            "bench", f"{mode}-{tag}-{t}-{i}")
+                        t0 = time.perf_counter()
+                        es.put_object("bench",
+                                      f"{mode}-{tag}-{t}-{i}", body,
+                                      opts)
+                        lat.append(time.perf_counter() - t0)
+
+                list(ex.map(lambda t: put("w", t), range(threads)))
+                best, best_lat = 0.0, []
+                for rep in range(2):
+                    lat = []
+                    t0 = time.perf_counter()
+                    list(ex.map(lambda t: put(f"m{rep}", t),
+                                range(threads)))
+                    gibps = threads * per * len(body) \
+                        / (time.perf_counter() - t0) / (1 << 30)
+                    if gibps > best:
+                        best, best_lat = gibps, sorted(lat)
+                ex.shutdown(wait=False)
+                p50 = best_lat[len(best_lat) // 2] * 1e3
+                return best, round(p50, 2)
+            finally:
+                if saved is None:
+                    _os.environ.pop("MTPU_TRANSFORM_FUSED", None)
+                else:
+                    _os.environ["MTPU_TRANSFORM_FUSED"] = saved
+
+        tf.reset_stats()
+        fused = {m: run_mode(m, True) for m in ("plain", "sse", "comp")}
+        split = tf.stats()["put_requests"]
+        legacy = {m: run_mode(m, False)
+                  for m in ("plain", "sse", "comp")}
+        plain_gibps = fused["plain"][0]
+        for mode in ("plain", "sse", "comp"):
+            g, p50 = fused[mode]
+            lg, lp50 = legacy[mode]
+            line = {
+                "metric": f"transform_put_{mode}_gibps",
+                "value": round(g, 3),
+                "unit": "GiB/s",
+                "p50_ms": p50,
+                "legacy_gibps": round(lg, 3),
+                "legacy_p50_ms": lp50,
+                "vs_legacy": round(g / max(lg, 1e-9), 3),
+                "object_bytes": len(body),
+                "concurrency": threads,
+            }
+            if mode != "plain":
+                line["vs_plain"] = round(g / max(plain_gibps, 1e-9), 3)
+            if mode == "plain":
+                line["path_split"] = dict(split)
+            print(json.dumps(line))
+    finally:
+        es.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _transform_get() -> None:
+    """GET direction of the fused transform plane: aggregate
+    whole-object GET throughput for plaintext vs SSE vs compressed
+    objects (windowed verify -> decrypt -> decompress out of the
+    pooled GET readahead), like-for-like in one run, fused vs the
+    layered pipeline on the same stored objects."""
+    import shutil
+    from concurrent.futures import ThreadPoolExecutor
+
+    from minio_tpu.object import transform as tf
+    from minio_tpu.object.types import GetOptions
+
+    if not tf.fused_put_enabled():
+        for mode in ("plain", "sse", "comp"):
+            print(json.dumps({
+                "metric": f"transform_get_{mode}_gibps", "value": None,
+                "skipped": "native transform kernel unavailable"}))
+        return
+    root, es, kms, body = _transform_fixture()
+    threads, per = (4, 3) if _SMALL else (8, 6)
+    n_objs = threads
+    try:
+        modes = _transform_modes(kms, body)
+        for mode, mk in modes.items():
+            for i in range(n_objs):
+                es.put_object("bench", f"g-{mode}-{i}", body,
+                              mk("bench", f"g-{mode}-{i}"))
+
+        def read_one(mode, i):
+            info = es.get_object_info("bench", f"g-{mode}-{i}")
+            imeta = info.internal_metadata
+            if imeta.get("x-internal-sse-alg"):
+                _, chunks, _, _ = tf.get_encrypted(
+                    es, kms, "bench", f"g-{mode}-{i}",
+                    info.version_id, None, {}, info)
+            elif imeta.get("x-internal-comp"):
+                _, chunks, _, _ = tf.get_compressed(
+                    es, "bench", f"g-{mode}-{i}", info.version_id,
+                    None, info)
+            else:
+                _, chunks = es.get_object_stream(
+                    "bench", f"g-{mode}-{i}", GetOptions())
+            total = 0
+            for c in chunks:
+                total += len(c)
+            if total != len(body):
+                raise RuntimeError(
+                    f"short read: {total} != {len(body)}")
+
+        def run_mode(mode, fused_on):
+            saved = _os.environ.get("MTPU_TRANSFORM_FUSED")
+            _os.environ["MTPU_TRANSFORM_FUSED"] = \
+                "on" if fused_on else "off"
+            try:
+                ex = ThreadPoolExecutor(max_workers=threads)
+
+                def reader(t):
+                    for i in range(per):
+                        read_one(mode, (t + i) % n_objs)
+
+                list(ex.map(reader, range(threads)))   # warm
+                best = 0.0
+                for _rep in range(2):
+                    t0 = time.perf_counter()
+                    list(ex.map(reader, range(threads)))
+                    gibps = threads * per * len(body) \
+                        / (time.perf_counter() - t0) / (1 << 30)
+                    best = max(best, gibps)
+                ex.shutdown(wait=False)
+                return best
+            finally:
+                if saved is None:
+                    _os.environ.pop("MTPU_TRANSFORM_FUSED", None)
+                else:
+                    _os.environ["MTPU_TRANSFORM_FUSED"] = saved
+
+        fused = {m: run_mode(m, True) for m in ("plain", "sse", "comp")}
+        legacy = {m: run_mode(m, False)
+                  for m in ("plain", "sse", "comp")}
+        for mode in ("plain", "sse", "comp"):
+            line = {
+                "metric": f"transform_get_{mode}_gibps",
+                "value": round(fused[mode], 3),
+                "unit": "GiB/s",
+                "legacy_gibps": round(legacy[mode], 3),
+                "vs_legacy": round(
+                    fused[mode] / max(legacy[mode], 1e-9), 3),
+                "object_bytes": len(body),
+                "concurrency": threads,
+            }
+            if mode != "plain":
+                line["vs_plain"] = round(
+                    fused[mode] / max(fused["plain"], 1e-9), 3)
+            print(json.dumps(line))
+    finally:
+        es.close()
+        shutil.rmtree(root, ignore_errors=True)
 
 
 def _bench_set(root, n_objects, body):

@@ -41,6 +41,64 @@ class CompressionError(Exception):
     pass
 
 
+def _native_lib():
+    """Native kernel library when it carries the zlib block entry
+    points (built against the same zlib the Python module wraps, so
+    output is byte-identical; None -> Python fallback). The fused-plane
+    kill-switch (MTPU_TRANSFORM_FUSED=off) disables this too, so "off"
+    exercises the layered pipeline end to end."""
+    from minio_tpu import native
+    return native.feature("mtpu_deflate_blocks")
+
+
+def deflate_blocks(data) -> "tuple[bytes, list[int]] | None":
+    """All blocks deflated in ONE GIL-free native call: (stored bytes,
+    cumulative ends), or None when the native path is unavailable or
+    errored (caller falls back to the per-block Python loop)."""
+    lib = _native_lib()
+    if lib is None or not len(data):
+        return None
+    import ctypes
+    nblocks = (len(data) + BLOCK - 1) // BLOCK
+    # compressBound-style headroom per block so an incompressible body
+    # still deflates (the caller compares totals and stores raw).
+    cap = len(data) + nblocks * 1104 + 64
+    out = (ctypes.c_uint8 * cap)()
+    ends = (ctypes.c_int64 * nblocks)()
+    from minio_tpu import native
+    got = lib.mtpu_deflate_blocks(native._u8(data), len(data), BLOCK, 6,
+                                  out, cap, ends)
+    if got < 0:
+        return None
+    return bytes(memoryview(out)[:got]), list(ends)
+
+
+def inflate_blocks(stored, ends: list[int], first_block: int,
+                   nblocks: int, stored_base: int) -> "bytes | None":
+    """Inflate stored blocks [first_block, first_block+nblocks) out of
+    a stored window in ONE native call; None -> Python fallback,
+    CompressionError on corrupt blocks/windows."""
+    lib = _native_lib()
+    if lib is None or nblocks <= 0:
+        return None if nblocks > 0 else b""
+    import ctypes
+
+    import numpy as _np
+    cap = nblocks * BLOCK
+    out = (ctypes.c_uint8 * cap)()
+    ends_arr = (ctypes.c_int64 * len(ends))(*ends)
+    src = _np.frombuffer(stored, dtype=_np.uint8)
+    got = lib.mtpu_inflate_blocks(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(src),
+        ends_arr, first_block, nblocks, stored_base, out, cap)
+    if got == -2:
+        return None
+    if got < 0:
+        raise CompressionError(
+            f"block range {first_block}+{nblocks} fails decompression")
+    return bytes(memoryview(out)[:got])
+
+
 def eligible(key: str, content_type: str) -> bool:
     k = key.lower()
     if any(k.endswith(ext) for ext in DEFAULT_EXTENSIONS):
@@ -52,21 +110,32 @@ def eligible(key: str, content_type: str) -> bool:
 def compress(data: bytes) -> tuple[bytes, dict] | None:
     """Compress into the block scheme; None when not worth storing
     (incompressible)."""
-    blocks = []
-    ends = []
-    total = 0
-    for off in range(0, len(data), BLOCK):
-        blob = zlib.compress(data[off:off + BLOCK], 6)
-        blocks.append(blob)
-        total += len(blob)
-        ends.append(total)
+    native_out = deflate_blocks(data)
+    if native_out is not None:
+        stored, ends = native_out
+        total = len(stored)
+    else:
+        blocks = []
+        ends = []
+        total = 0
+        for off in range(0, len(data), BLOCK):
+            blob = zlib.compress(data[off:off + BLOCK], 6)
+            blocks.append(blob)
+            total += len(blob)
+            ends.append(total)
+        stored = b"".join(blocks)
     if total >= len(data):
         return None
+    return stored, index_meta(len(data), ends)
+
+
+def index_meta(plain_size: int, ends: list[int]) -> dict:
+    """The internal-metadata entries recording the block scheme (shared
+    by the layered compressor above and the fused native transform)."""
     index = base64.b64encode(
         struct.pack(f">{len(ends)}I", *ends)).decode()
-    meta = {META_SCHEME: SCHEME, META_SIZE: str(len(data)),
+    return {META_SCHEME: SCHEME, META_SIZE: str(plain_size),
             META_INDEX: index}
-    return b"".join(blocks), meta
 
 
 def _index(meta: dict) -> list[int]:
@@ -96,17 +165,24 @@ def decompress_range(stored: bytes, meta: dict, offset: int,
     ends = _index(meta)
     first = offset // BLOCK
     last = (offset + length - 1) // BLOCK
-    out = bytearray()
-    for b in range(first, last + 1):
-        lo = (ends[b - 1] if b else 0) - stored_base
-        hi = ends[b] - stored_base
-        if lo < 0 or hi > len(stored):
-            raise CompressionError("stored window does not cover range")
-        try:
-            out += zlib.decompress(stored[lo:hi])
-        except zlib.error:
-            raise CompressionError(
-                f"block {b} fails decompression") from None
+    native_out = inflate_blocks(stored, ends, first, last - first + 1,
+                                stored_base)
+    if native_out is not None:
+        out = native_out
+    else:
+        acc = bytearray()
+        for b in range(first, last + 1):
+            lo = (ends[b - 1] if b else 0) - stored_base
+            hi = ends[b] - stored_base
+            if lo < 0 or hi > len(stored):
+                raise CompressionError(
+                    "stored window does not cover range")
+            try:
+                acc += zlib.decompress(stored[lo:hi])
+            except zlib.error:
+                raise CompressionError(
+                    f"block {b} fails decompression") from None
+        out = bytes(acc)
     skip = offset - first * BLOCK
     return bytes(out[skip:skip + length])
 

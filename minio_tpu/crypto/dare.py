@@ -19,19 +19,72 @@ from __future__ import annotations
 import struct
 from typing import Iterator
 
-try:
-    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-except ImportError:  # optional dep, gated at use (crypto/kms.py)
-    AESGCM = None
-
-from minio_tpu.crypto.kms import require_aesgcm
+from minio_tpu.crypto.kms import aesgcm, require_aesgcm
 
 PACKAGE_SIZE = 64 * 1024
 TAG_SIZE = 16
 
+# Native bulk window: how many plaintext bytes one GIL-free
+# mtpu_dare_seal/open call covers (16 packages = 1 MiB).
+_BULK_PACKAGES = 16
+
 
 class DareError(Exception):
     pass
+
+
+def _native_lib():
+    """The native kernel library when it carries the DARE entry points
+    (None -> per-package Python AEAD fallback, byte-identical). The
+    fused-plane kill-switch (MTPU_TRANSFORM_FUSED=off) disables the
+    bulk path too, so "off" exercises the layered pipeline end to
+    end."""
+    from minio_tpu import native
+    return native.feature("mtpu_dare_seal")
+
+
+def seal_bulk(key: bytes, base_nonce: bytes, first_seq: int,
+              plain: bytes):
+    """Seal whole packages of `plain` in ONE native call; None when the
+    native library is unavailable (caller falls back per package)."""
+    lib = _native_lib()
+    if lib is None:
+        return None
+    import ctypes
+
+    from minio_tpu import native
+    pkgs = (len(plain) + PACKAGE_SIZE - 1) // PACKAGE_SIZE
+    out = (ctypes.c_uint8 * (len(plain) + pkgs * TAG_SIZE))()
+    n = lib.mtpu_dare_seal(native._u8(key), native._u8(base_nonce),
+                           first_seq, native._u8(plain), len(plain), out)
+    return bytes(out)[:n]
+
+
+def open_bulk(key: bytes, base_nonce: bytes, first_seq: int,
+              cipher):
+    """Open whole sealed packages in ONE native call: plaintext bytes,
+    DareError on authentication failure, None when the native library
+    is unavailable. `cipher` may be any contiguous buffer (pooled GET
+    windows pass memoryviews; the native call reads them in place —
+    no staging copy)."""
+    lib = _native_lib()
+    if lib is None:
+        return None
+    import ctypes
+
+    import numpy as _np
+
+    from minio_tpu import native
+    src = _np.frombuffer(cipher, dtype=_np.uint8)
+    out = (ctypes.c_uint8 * max(1, len(src)))()
+    n = lib.mtpu_dare_open(
+        native._u8(key), native._u8(base_nonce), first_seq,
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(src),
+        out)
+    if n < 0:
+        raise DareError(
+            f"package {first_seq + (-n - 1)} fails authentication")
+    return bytes(memoryview(out)[:n])
 
 
 def _nonce(base: bytes, seq: int) -> bytes:
@@ -74,7 +127,8 @@ class EncryptingPayload:
     def __init__(self, inner, key: bytes, base_nonce: bytes):
         require_aesgcm()
         self._inner = inner
-        self._aead = AESGCM(key)
+        self._key = bytes(key)
+        self._aead = None if _native_lib() is not None else aesgcm(key)
         self._base = base_nonce
         self.size = encrypt_stream_size(inner.size)
         self._seq = 0
@@ -83,6 +137,25 @@ class EncryptingPayload:
 
     def read(self, n: int) -> bytes:
         while not self._buf and self._plain_left > 0:
+            if self._aead is None:
+                # Native bulk: up to _BULK_PACKAGES packages sealed in
+                # one GIL-free call instead of one AEAD hop per 64 KiB.
+                want = min(_BULK_PACKAGES * PACKAGE_SIZE, self._plain_left)
+                chunk = _read_exact(self._inner, want)
+                self._plain_left -= len(chunk)
+                sealed = seal_bulk(self._key, self._base, self._seq, chunk)
+                if sealed is None:       # library vanished mid-stream
+                    self._aead = aesgcm(self._key)
+                    sealed = b"".join(
+                        self._aead.encrypt(
+                            _nonce(self._base, self._seq + i),
+                            bytes(memoryview(chunk)[o:o + PACKAGE_SIZE]),
+                            _aad(self._seq + i))
+                        for i, o in enumerate(
+                            range(0, len(chunk), PACKAGE_SIZE)))
+                self._seq += (len(chunk) + PACKAGE_SIZE - 1) // PACKAGE_SIZE
+                self._buf = memoryview(sealed)
+                continue
             chunk = _read_exact(self._inner, min(PACKAGE_SIZE,
                                                  self._plain_left))
             self._plain_left -= len(chunk)
@@ -114,16 +187,80 @@ def decrypt_packages(chunks: Iterator, key: bytes, base_nonce: bytes,
                      first_seq: int, skip: int, length: int):
     """Decrypt a ciphertext byte stream of whole packages starting at
     package `first_seq`; yield plaintext, dropping `skip` leading bytes
-    and stopping after `length` bytes (range-GET trimming)."""
+    and stopping after `length` bytes (range-GET trimming). Whole
+    pooled windows open through ONE native call when the kernel
+    library is present (byte-identical to the per-package AEAD loop)."""
     require_aesgcm()
-    aead = AESGCM(key)
     try:
-        yield from _decrypt_inner(chunks, aead, base_nonce, first_seq,
-                                  skip, length)
+        if _native_lib() is not None:
+            yield from _decrypt_inner_native(chunks, bytes(key),
+                                             base_nonce, first_seq, skip,
+                                             length)
+        else:
+            yield from _decrypt_inner(chunks, aesgcm(key), base_nonce,
+                                      first_seq, skip, length)
     finally:
         close = getattr(chunks, "close", None)
         if close is not None:
             close()
+
+
+def _trim(plain, skip, produced, length):
+    """(emit, skip', produced') applying the range head-drop and tail
+    cap shared by both decryptors."""
+    if skip:
+        drop = min(skip, len(plain))
+        plain = plain[drop:]
+        skip -= drop
+    take = min(len(plain), length - produced)
+    return plain[:take], skip, produced + take
+
+
+def _decrypt_inner_native(chunks, key, base_nonce, first_seq, skip,
+                          length):
+    seq = first_seq
+    carry = b""
+    produced = 0
+    full_pkg = PACKAGE_SIZE + TAG_SIZE
+    for chunk in chunks:
+        if produced >= length:
+            break
+        # Open every whole package the current window carries straight
+        # out of the (possibly pooled) chunk. The sub-package carry
+        # from the previous window completes into its own small open —
+        # never by copying the whole new chunk onto it — so a 32 MiB
+        # GET readahead window decrypts with zero staging memcpy.
+        view = memoryview(chunk)
+        if carry:
+            head_take = min(full_pkg - len(carry), len(view))
+            carry = carry + bytes(view[:head_take])
+            view = view[head_take:]
+            if len(carry) < full_pkg:
+                continue
+            plain = open_bulk(key, base_nonce, seq, carry)
+            carry = b""
+            seq += 1
+            out, skip, produced = _trim(plain, skip, produced, length)
+            if out:
+                yield out
+            if produced >= length:
+                break
+        usable = len(view) - (len(view) % full_pkg)
+        if usable:
+            plain = open_bulk(key, base_nonce, seq, view[:usable])
+            seq += usable // full_pkg
+            out, skip, produced = _trim(plain, skip, produced, length)
+            if out:
+                yield out
+        carry = bytes(view[usable:])
+    if carry and produced < length:
+        # Tail: one final short sealed package.
+        plain = open_bulk(key, base_nonce, seq, carry)
+        out, skip, produced = _trim(plain, skip, produced, length)
+        if out:
+            yield out
+    if produced < length:
+        raise DareError("ciphertext stream ended early")
 
 
 def _decrypt_inner(chunks, aead, base_nonce, first_seq, skip, length):
@@ -150,14 +287,8 @@ def _decrypt_inner(chunks, aead, base_nonce, first_seq, skip, length):
             raise DareError(
                 f"package {seq} fails authentication") from None
         seq += 1
-        if skip:
-            drop = min(skip, len(plain))
-            plain = plain[drop:]
-            skip -= drop
-        if not plain:
-            continue
-        take = min(len(plain), length - produced)
-        produced += take
-        yield plain[:take]
+        plain, skip, produced = _trim(plain, skip, produced, length)
+        if plain:
+            yield plain
     if produced < length:
         raise DareError("ciphertext stream ended early")

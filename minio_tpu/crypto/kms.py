@@ -23,13 +23,90 @@ class KMSError(Exception):
     pass
 
 
+class _NativeAESGCM:
+    """AESGCM-compatible AES-256-GCM over the native kernel
+    (native/native.cc mtpu_gcm_seal/mtpu_gcm_open): same deterministic
+    output as the `cryptography` wheel — GCM has exactly one valid
+    ciphertext per (key, nonce, aad, plaintext) — validated against the
+    NIST SP 800-38D vectors in tests/test_transform_fused.py. Restores
+    the whole SSE/KMS surface in containers without the wheel, and the
+    bulk DARE paths ride the same kernels GIL-free."""
+
+    __slots__ = ("_key",)
+
+    def __init__(self, key: bytes):
+        if len(key) != 32:
+            raise KMSError("native AES-GCM supports 256-bit keys only")
+        self._key = bytes(key)
+
+    def encrypt(self, nonce: bytes, data: bytes, aad) -> bytes:
+        import ctypes
+
+        from minio_tpu import native
+        lib = native.load()
+        if len(nonce) != 12:
+            raise KMSError("native AES-GCM requires a 96-bit nonce")
+        aad = aad or b""
+        out = (ctypes.c_uint8 * (len(data) + 16))()
+        lib.mtpu_gcm_seal(native._u8(self._key), native._u8(nonce),
+                          native._u8(aad), len(aad), native._u8(data),
+                          len(data), out)
+        return bytes(out)
+
+    def decrypt(self, nonce: bytes, data: bytes, aad) -> bytes:
+        import ctypes
+
+        from minio_tpu import native
+        lib = native.load()
+        if len(nonce) != 12:
+            raise KMSError("native AES-GCM requires a 96-bit nonce")
+        aad = aad or b""
+        if len(data) < 16:
+            raise ValueError("ciphertext shorter than the GCM tag")
+        out = (ctypes.c_uint8 * (len(data) - 16))()
+        got = lib.mtpu_gcm_open(native._u8(self._key), native._u8(nonce),
+                                native._u8(aad), len(aad),
+                                native._u8(data), len(data), out)
+        if got < 0:
+            raise ValueError("GCM tag verification failed")
+        return bytes(out)
+
+
+def _native_gcm_available() -> bool:
+    try:
+        from minio_tpu import native
+        lib = native.load()
+        return lib is not None and hasattr(lib, "mtpu_gcm_seal")
+    except Exception:  # noqa: BLE001 - loader failure = unavailable
+        return False
+
+
+def aesgcm_impl():
+    """The AEAD class backing KMS/SSE/DARE: the `cryptography` wheel
+    when installed, else the native kernel, else None (SSE features
+    report unavailable at use)."""
+    if AESGCM is not None:
+        return AESGCM
+    if _native_gcm_available():
+        return _NativeAESGCM
+    return None
+
+
+def aesgcm(key: bytes):
+    """An AEAD instance for `key` (raises KMSError when no backend)."""
+    require_aesgcm()
+    return aesgcm_impl()(key)
+
+
 def require_aesgcm() -> None:
-    """Fail loudly AT USE TIME when the optional `cryptography` wheel
-    is absent: a deployment that never touches KMS/SSE must not pay an
-    import-time crash for a feature it does not use."""
-    if AESGCM is None:
+    """Fail loudly AT USE TIME when no AES-GCM backend exists (neither
+    the optional `cryptography` wheel nor the native kernel library): a
+    deployment that never touches KMS/SSE must not pay an import-time
+    crash for a feature it does not use."""
+    if aesgcm_impl() is None:
         raise KMSError(
-            "the 'cryptography' package is not installed; "
+            "no AES-GCM backend (the 'cryptography' package is not "
+            "installed and the native kernel library is unavailable); "
             "KMS/SSE features are unavailable")
 
 
@@ -82,7 +159,7 @@ class KMS:
         master = self._keys[kid]
         nonce = os.urandom(12)
         aad = json.dumps(context, sort_keys=True).encode()
-        ct = AESGCM(master).encrypt(nonce, key, aad)
+        ct = aesgcm(master).encrypt(nonce, key, aad)
         blob = {"v": 1, "kid": kid,
                 "n": base64.b64encode(nonce).decode(),
                 "c": base64.b64encode(ct).decode()}
@@ -107,7 +184,7 @@ class KMS:
             raise KMSError("malformed sealed key") from None
         aad = json.dumps(context, sort_keys=True).encode()
         try:
-            return AESGCM(master).decrypt(nonce, ct, aad)
+            return aesgcm(master).decrypt(nonce, ct, aad)
         except Exception:
             raise KMSError("sealed key does not unseal "
                            "(wrong master key or context)") from None
@@ -210,6 +287,6 @@ class KeyStore:
         canary = os.urandom(16)
         require_aesgcm()
         nonce = os.urandom(12)
-        ct = AESGCM(self.kms._keys[name]).encrypt(nonce, canary, b"")
-        ok = AESGCM(self.kms._keys[name]).decrypt(nonce, ct, b"") == canary
+        ct = aesgcm(self.kms._keys[name]).encrypt(nonce, canary, b"")
+        ok = aesgcm(self.kms._keys[name]).decrypt(nonce, ct, b"") == canary
         return {"name": name, "encrypt_ok": ok, "decrypt_ok": ok}

@@ -15,12 +15,7 @@ import json
 import os
 from typing import Optional
 
-try:
-    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-except ImportError:  # optional dep, gated at use (crypto/kms.py)
-    AESGCM = None
-
-from minio_tpu.crypto.kms import KMS, KMSError, require_aesgcm
+from minio_tpu.crypto.kms import KMS, KMSError, aesgcm, require_aesgcm
 
 ALG_SSE_S3 = "SSE-S3"
 ALG_SSE_C = "SSE-C"
@@ -99,7 +94,7 @@ def seal_with_customer_key(data_key: bytes, customer_key: bytes,
     require_aesgcm()
     nonce = os.urandom(12)
     aad = json.dumps(context, sort_keys=True).encode()
-    ct = AESGCM(customer_key).encrypt(nonce, data_key, aad)
+    ct = aesgcm(customer_key).encrypt(nonce, data_key, aad)
     return json.dumps({"v": 1, "n": base64.b64encode(nonce).decode(),
                        "c": base64.b64encode(ct).decode()},
                       sort_keys=True)
@@ -116,7 +111,7 @@ def unseal_with_customer_key(sealed: str, customer_key: bytes,
         raise SSEError("InvalidArgument", "malformed sealed key") from None
     aad = json.dumps(context, sort_keys=True).encode()
     try:
-        return AESGCM(customer_key).decrypt(nonce, ct, aad)
+        return aesgcm(customer_key).decrypt(nonce, ct, aad)
     except Exception:
         raise SSEError("AccessDenied",
                        "SSE-C key does not decrypt this object") from None

@@ -26,17 +26,23 @@ def _build() -> bool:
     # one tmp file (a corrupt .so with a fresh mtime would permanently
     # disable the native path).
     tmp = f"{_SO}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-o", tmp, _SRC]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _SO)
-        return True
-    except Exception:
+    base = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-o", tmp,
+            _SRC]
+    # zlib backs the fused transform's block compression; a container
+    # without the headers still gets every other kernel (the deflate/
+    # inflate entry points then answer -2 and Python keeps its own
+    # zlib path).
+    for cmd in (base + ["-lz"], base + ["-DMTPU_NO_ZLIB"]):
         try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        return False
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, _SO)
+            return True
+        except Exception:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    return False
 
 
 def load():
@@ -112,6 +118,62 @@ def _declare(lib) -> None:
     lib.mtpu_meta_scan.argtypes = [u8p, i64p, ctypes.c_int64,
                                    ctypes.c_int64, i64p]
     lib.mtpu_meta_scan.restype = ctypes.c_int64
+    # Fused data plane: streaming digests, AES-256-GCM / DARE, block
+    # deflate/inflate, and the single-pass transform+frame kernels.
+    sz = ctypes.c_size_t
+    i64 = ctypes.c_int64
+    lib.mtpu_digest_init.argtypes = [i64, u8p]
+    lib.mtpu_digest_init.restype = None
+    lib.mtpu_digest_update.argtypes = [i64, u8p, u8p, sz]
+    lib.mtpu_digest_update.restype = None
+    lib.mtpu_digest_final.argtypes = [i64, u8p, u8p]
+    lib.mtpu_digest_final.restype = None
+    lib.mtpu_crc32.argtypes = [ctypes.c_uint32, u8p, sz]
+    lib.mtpu_crc32.restype = ctypes.c_uint32
+    lib.mtpu_gcm_seal.argtypes = [u8p, u8p, u8p, sz, u8p, sz, u8p]
+    lib.mtpu_gcm_seal.restype = None
+    lib.mtpu_gcm_open.argtypes = [u8p, u8p, u8p, sz, u8p, sz, u8p]
+    lib.mtpu_gcm_open.restype = i64
+    lib.mtpu_dare_seal.argtypes = [u8p, u8p, ctypes.c_uint64, u8p, sz, u8p]
+    lib.mtpu_dare_seal.restype = i64
+    lib.mtpu_dare_open.argtypes = [u8p, u8p, ctypes.c_uint64, u8p, sz, u8p]
+    lib.mtpu_dare_open.restype = i64
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.mtpu_deflate_blocks.argtypes = [u8p, sz, sz, i64, u8p, sz, i64p]
+    lib.mtpu_deflate_blocks.restype = i64
+    lib.mtpu_inflate_blocks.argtypes = [u8p, sz, i64p, i64, i64, i64,
+                                        u8p, sz]
+    lib.mtpu_inflate_blocks.restype = i64
+    lib.mtpu_transform_frame.argtypes = [
+        u8p, sz, i64, u8p, u8p, u8p, u8p, sz, u8p, sz, i64p, i64, sz,
+        u8p, u8p, sz, sz, sz, sz, u8p, sz, i64p]
+    lib.mtpu_transform_frame.restype = i64
+    lib.mtpu_untransform.argtypes = [u8p, sz, i64, u8p, u8p, i64, i64p,
+                                     i64, i64, i64, u8p, sz, u8p, sz]
+    lib.mtpu_untransform.restype = i64
+    lib.mtpu_put_frame_md5.argtypes = [u8p, u8p, u8p, u8p, sz, sz, sz,
+                                       sz, sz, u8p]
+    lib.mtpu_put_frame_md5.restype = None
+
+
+def feature(symbol: str, gated: bool = True):
+    """The library handle when it carries `symbol`, else None — the
+    ONE gate every fused-transform-plane call site shares. With
+    `gated` (the default) the MTPU_TRANSFORM_FUSED=off kill-switch
+    also answers None, so "off" reverts the whole plane (fused
+    orchestration AND the dare/compress native bulk paths) to the
+    layered pipeline; pass gated=False for primitives that must keep
+    working regardless (the AES-GCM backend — without it a wheel-less
+    container loses SSE entirely, which is availability, not an
+    optimization the switch governs)."""
+    if gated and os.environ.get("MTPU_TRANSFORM_FUSED", "") \
+            .strip().lower() in ("off", "0", "false", "no"):
+        return None
+    try:
+        lib = load()
+    except Exception:  # noqa: BLE001 - loader failure = unavailable
+        return None
+    return lib if lib is not None and hasattr(lib, symbol) else None
 
 
 def _u8(arr) -> "ctypes.POINTER(ctypes.c_uint8)":

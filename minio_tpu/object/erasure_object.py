@@ -89,6 +89,60 @@ def new_staging() -> str:
     return f"{STAGING_PREFIX}/p{_os.getpid()}-{new_uuid()}"
 
 
+class _Md5Stream:
+    """Streaming etag md5 for the windowed PUT loop: a native digest
+    context updated GIL-free — and folded INTO the pooled frame call
+    (mtpu_put_frame_md5) when the window takes that path — with
+    hashlib as the fallback."""
+
+    __slots__ = ("_lib", "_ctx", "_h", "_folded")
+
+    def __init__(self):
+        self._h = None
+        self._ctx = None
+        self._folded = False
+        try:
+            from minio_tpu import native
+            lib = native.load()
+            if lib is not None and hasattr(lib, "mtpu_digest_init"):
+                import ctypes
+                self._lib = lib
+                self._ctx = (ctypes.c_uint8 * 128)()
+                lib.mtpu_digest_init(0, self._ctx)
+                return
+        except Exception:  # noqa: BLE001 - loader failure -> hashlib
+            pass
+        self._lib = None
+        self._h = hashlib.md5()
+
+    @property
+    def native_ctx(self):
+        return self._ctx
+
+    def mark_folded(self) -> None:
+        self._folded = True
+
+    def take_folded(self) -> bool:
+        folded, self._folded = self._folded, False
+        return folded
+
+    def update(self, data) -> None:
+        if self._ctx is not None:
+            from minio_tpu import native
+            self._lib.mtpu_digest_update(0, self._ctx, native._u8(data),
+                                         len(data))
+        else:
+            self._h.update(data)
+
+    def hexdigest(self) -> str:
+        if self._ctx is not None:
+            import ctypes
+            out = (ctypes.c_uint8 * 16)()
+            self._lib.mtpu_digest_final(0, self._ctx, out)
+            return bytes(out).hex()
+        return self._h.hexdigest()
+
+
 @functools.lru_cache(maxsize=1)
 def _on_tpu() -> bool:
     try:
@@ -152,6 +206,23 @@ def _batcher_for(k: int, m: int):
                          functools.partial(_host_rows, k, m),
                          min_device_blocks=MIN_DEVICE_BLOCKS,
                          pool=global_pool(), name=f"{k}+{m}")
+
+
+@functools.lru_cache(maxsize=64)
+def _transform_batcher_for(k: int, m: int):
+    """The fused transform plane's frame-stage batcher: same mesh
+    framer / host-row rivalry as the PUT batcher, but a SEPARATE
+    route ("transform") with its own calibration entry and
+    MTPU_BATCH_FORCE pin — the transform pipeline's stored windows
+    (post-compress/encrypt) coalesce and route on their own
+    measurement, since their arrival pattern and sizes differ from raw
+    PUT windows."""
+    from minio_tpu.ops.batcher import StripeBatcher
+    return StripeBatcher(_mesh_framer_for(k, m),
+                         functools.partial(_host_rows, k, m),
+                         min_device_blocks=MIN_DEVICE_BLOCKS,
+                         pool=global_pool(), name=f"tf:{k}+{m}",
+                         route="transform")
 
 
 # -- the decode mirror: GET verify + reconstruct batchers -------------------
@@ -922,14 +993,20 @@ class ErasureSet:
                          for b in range(stacked.shape[0])])
 
     def _frame_pooled(self, data: bytes, k: int, m: int, full: int,
-                      shard_size: int):
+                      shard_size: int, md5=None):
         """Fused HOST encode+frame into a pooled aligned buffer: GF
         parity + HighwayHash + `digest || block` interleave in ONE
         GIL-free native call (native/native.cc mtpu_put_frame), output
         leased from the buffer pool instead of fresh per-put arrays.
         Returns (chunks, lease) covering the FULL blocks — chunks[i] a
         single memoryview into the lease — or None when the native
-        library, the shape, or the algorithm rules it out."""
+        library, the shape, or the algorithm rules it out.
+
+        md5: optional _Md5Stream — when it carries a native context the
+        WHOLE window (ragged tail included) md5-extends inside the same
+        native call (mtpu_put_frame_md5) and the stream is marked
+        folded, so the streaming PUT hot loop never touches the GIL for
+        its per-window etag update."""
         if bitrot.DEFAULT_ALGORITHM != bitrot.HIGHWAYHASH256S \
                 or k * shard_size != BLOCK_SIZE:
             return None
@@ -949,21 +1026,32 @@ class ErasureSet:
         pm = np.ascontiguousarray(_parity_matrix(k, m)) if m \
             else np.zeros((0, k), dtype=np.uint8)
         out = (ctypes.c_uint8 * (n * span)).from_buffer(lease.raw)
+        md5_ctx = md5.native_ctx if md5 is not None else None
         try:
             with tracing.span("kernel", "mtpu_put_frame",
                               {"blocks": full, "k": k, "m": m}) \
                     if tracing.ACTIVE else tracing.NOOP:
-                lib.mtpu_put_frame(
-                    native._u8(MAGIC_KEY), native._u8(pm),
-                    src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-                    full, k, m, shard_size, out)
+                if md5_ctx is not None:
+                    lib.mtpu_put_frame_md5(
+                        md5_ctx, native._u8(MAGIC_KEY), native._u8(pm),
+                        src.ctypes.data_as(
+                            ctypes.POINTER(ctypes.c_uint8)),
+                        full, k, m, shard_size, len(data), out)
+                    md5.mark_folded()
+                else:
+                    lib.mtpu_put_frame(
+                        native._u8(MAGIC_KEY), native._u8(pm),
+                        src.ctypes.data_as(
+                            ctypes.POINTER(ctypes.c_uint8)),
+                        full, k, m, shard_size, out)
         except BaseException:
             lease.release()
             raise
         mv = lease.view(n * span)
         return [[mv[i * span:(i + 1) * span]] for i in range(n)], lease
 
-    def _frame_windows(self, data: bytes, k: int, m: int):
+    def _frame_windows(self, data: bytes, k: int, m: int,
+                       route: str = "put", md5=None):
         """Encode + bitrot-frame the object: (chunks, lease) where
         chunks is per-drive lists of framed byte chunks (shard index
         order) ready to write as shard files, and lease is a bufpool
@@ -1001,21 +1089,23 @@ class ErasureSet:
         # measured the host path no matter what the batcher was forced
         # to, which is exactly the invisible degradation the knob
         # exists to rule out.
+        batcher_for = _batcher_for if route == "put" \
+            else _transform_batcher_for
         use_device = (full >= 1 and m > 0
-                      and (_on_tpu() or batch_force_mode() == "device")
+                      and (_on_tpu() or batch_force_mode(route) == "device")
                       and hasattr(self.backend, "apply_matrix_device")
                       and BLOCK_SIZE % k == 0 and shard_size % 1024 == 0
                       # Once the batcher's calibration resolves to
                       # host, skip its queue entirely: the pooled
                       # native path below IS the fast host path.
-                      and _batcher_for(k, m).wants_device())
+                      and batcher_for(k, m).wants_device())
         chunks: list[list] = [[] for _ in range(n)]
         lease = None
         if use_device:
             buf = np.frombuffer(data, dtype=np.uint8,
                                 count=full * BLOCK_SIZE)
             stacked = buf.reshape(full, k, shard_size)
-            rows = _batcher_for(k, m).frame(stacked)
+            rows = batcher_for(k, m).frame(stacked)
             # rows[i] = per-block (digest, block) piece tuples. The
             # `hash || block` on-disk frame is assembled by the writer
             # from the pieces (reference cmd/bitrot-streaming.go:44-75
@@ -1025,7 +1115,8 @@ class ErasureSet:
                 for pieces in rows[i][:full]:
                     chunks[i].extend(pieces)
         elif full:
-            pooled = self._frame_pooled(data, k, m, full, shard_size)
+            pooled = self._frame_pooled(data, k, m, full, shard_size,
+                                        md5=md5)
             if pooled is not None:
                 chunks, lease = pooled
             else:
@@ -1068,6 +1159,244 @@ class ErasureSet:
             lease.release()
 
     # ------------------------------------------------------------------
+    # Fused single-pass transform plane (object/transform.TransformSpec)
+    # ------------------------------------------------------------------
+
+    def _transform_frame_windows(self, data, k: int, m: int, spec):
+        """Execute a TransformSpec over `data` (the LOGICAL body) next
+        to the framer: ONE GIL-free native call computes the etag md5 +
+        declared checksums, deflates into the block scheme, seals into
+        DARE packages, and frames the stored stream's full erasure
+        blocks (native/native.cc mtpu_transform_frame) — the
+        composition of the layered pipeline's separate walks. Returns
+        (framed_chunks, lease, stored_len, etag_hex); spec is filled
+        with digests/metadata and its pre-commit verify hook has run.
+
+        Where the transform-route batcher calibrates to the device,
+        the native call skips its frame stage and the stored windows
+        ride the mesh framer through _frame_windows(route="transform").
+        Ineligible shapes (no native library, non-HighwayHash bitrot,
+        k not dividing the block) fall back to the staged Python
+        pipeline — byte-identical stored stream, counted as
+        path=legacy."""
+        import ctypes
+
+        from minio_tpu import native
+        from minio_tpu.crypto import compress as comp_mod
+        from minio_tpu.crypto import sse as sse_mod
+        from minio_tpu.object import transform as transform_mod
+
+        plen = len(data)
+        spec.plain_size = plen
+        # native.feature honors the MTPU_TRANSFORM_FUSED kill-switch:
+        # direct object-layer callers (bench legacy legs, tests) must
+        # take the staged pipeline under "off" exactly like the S3
+        # handler path does.
+        lib = native.feature("mtpu_transform_frame")
+        e = self._erasure(k, m)
+        n = k + m
+        shard_size = e.shard_size()
+        if lib is None \
+                or bitrot.DEFAULT_ALGORITHM != bitrot.HIGHWAYHASH256S \
+                or plen == 0:
+            return self._transform_staged(data, k, m, spec)
+        use_device = (m > 0
+                      and (_on_tpu()
+                           or batch_force_mode("transform") == "device")
+                      and hasattr(self.backend, "apply_matrix_device")
+                      and BLOCK_SIZE % k == 0 and shard_size % 1024 == 0
+                      and _transform_batcher_for(k, m).wants_device())
+        frame_native = not use_device and k * shard_size == BLOCK_SIZE
+        PKG, TAG = 64 * 1024, 16
+        npkg = (plen + PKG - 1) // PKG if spec.encrypt else 0
+        ncomp = (plen + comp_mod.BLOCK - 1) // comp_mod.BLOCK \
+            if spec.compress else 0
+        stored_cap = plen + npkg * TAG + ncomp * 1104 + 64
+        scratch_cap = plen + ncomp * 1104 + 64 \
+            if (spec.compress and spec.encrypt) else 0
+        max_full = stored_cap // BLOCK_SIZE + 1
+        frames_cap = n * max_full * (32 + shard_size) if frame_native \
+            else 0
+        lease = global_pool().lease(stored_cap + scratch_cap + frames_cap)
+        from minio_tpu.utils.highwayhash import MAGIC_KEY
+        flags = 1
+        for algo, bit in (("sha256", 2), ("sha1", 4), ("crc32", 8)):
+            if algo in spec.algos:
+                flags |= bit
+        if spec.compress:
+            flags |= 16
+        if spec.encrypt:
+            flags |= 32
+        if frame_native:
+            flags |= 64
+        digests = (ctypes.c_uint8 * 72)()
+        comp_ends = (ctypes.c_int64 * max(1, ncomp))()
+        info = (ctypes.c_int64 * 8)()
+        src = np.frombuffer(data, dtype=np.uint8, count=plen)
+        pm = np.ascontiguousarray(_parity_matrix(k, m)) if m \
+            else np.zeros((0, k), dtype=np.uint8)
+        stored_arr = (ctypes.c_uint8 * stored_cap).from_buffer(lease.raw)
+        scratch_arr = (ctypes.c_uint8 * max(1, scratch_cap)).from_buffer(
+            lease.raw, stored_cap) if scratch_cap else None
+        framed_arr = (ctypes.c_uint8 * frames_cap).from_buffer(
+            lease.raw, stored_cap + scratch_cap) if frames_cap else None
+        try:
+            with tracing.span("kernel", "mtpu_transform_frame",
+                              {"bytes": plen, "k": k, "m": m,
+                               "flags": flags}) \
+                    if tracing.ACTIVE else tracing.NOOP:
+                ret = lib.mtpu_transform_frame(
+                    src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                    plen, flags, native._u8(spec.enc_key or b"\0" * 32),
+                    native._u8(spec.enc_nonce or b"\0" * 12), digests,
+                    stored_arr, stored_cap, scratch_arr or stored_arr,
+                    scratch_cap, comp_ends, max(1, ncomp),
+                    comp_mod.BLOCK, native._u8(MAGIC_KEY),
+                    pm.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                    k, m, shard_size, BLOCK_SIZE,
+                    framed_arr or stored_arr, frames_cap, info)
+            if ret == -2:
+                # Built without zlib (-DMTPU_NO_ZLIB): the compress
+                # stage cannot run natively — the staged pipeline's
+                # Python zlib path owns this shape.
+                lease.release()
+                lease = None
+                return self._transform_staged(data, k, m, spec)
+            if ret < 0:
+                raise CodecError(f"mtpu_transform_frame failed: {ret}")
+            stored_len, full = int(info[0]), int(info[1])
+            spec.stored_size = stored_len
+            spec.comp_used = bool(info[2])
+            spec.digests = {"md5": bytes(digests[0:16])}
+            if "sha256" in spec.algos:
+                spec.digests["sha256"] = bytes(digests[16:48])
+            if "sha1" in spec.algos:
+                spec.digests["sha1"] = bytes(digests[48:68])
+            if "crc32" in spec.algos:
+                spec.digests["crc32"] = bytes(digests[68:72])
+            spec.etag = spec.digests["md5"].hex()
+            if spec.comp_used:
+                spec.comp_ends = list(comp_ends[: int(info[7])])
+                spec.meta.update(comp_mod.index_meta(plen, spec.comp_ends))
+                if spec.encrypt:
+                    # The DARE stream's plaintext is the COMPRESSED
+                    # stream: patch the sse size the handler stamped
+                    # with the pre-compression value.
+                    spec.meta[sse_mod.META_SIZE] = str(spec.comp_ends[-1])
+            spec.run_verify()
+            # Frame stage: views of the native output + the ragged
+            # stored tail through the split path, or the whole stored
+            # stream through the transform-route batcher.
+            stored_mv = lease.view(stored_len)
+            if frame_native:
+                hsize = 32
+                frame = hsize + shard_size
+                span = full * frame
+                base = stored_cap + scratch_cap
+                mv = lease.view(base + n * span)
+                chunks = [[mv[base + i * span: base + (i + 1) * span]]
+                          for i in range(n)]
+                tail = stored_len - full * BLOCK_SIZE
+                if tail:
+                    framed_tail = self._frame_tail(
+                        e, bytes(stored_mv[full * BLOCK_SIZE:stored_len]),
+                        k, m, shard_size)
+                    for i in range(n):
+                        chunks[i].append(framed_tail[i])
+                if stored_len == 0:
+                    chunks = [[b""] for _ in range(n)]
+                transform_mod.note_put("fused", plen, list(info[3:7]))
+                return chunks, lease, stored_len, spec.etag
+            # Device (or non-dividing-k) frame route: the stored bytes
+            # re-enter the shared windowed framer under the transform
+            # route label.
+            chunks, flease = self._frame_windows(
+                bytes(stored_mv[:stored_len]) if stored_len else b"",
+                k, m, route="transform")
+            transform_mod.note_put("fused", plen, list(info[3:7]))
+            lease.release()
+            lease = None
+            return chunks, flease, stored_len, spec.etag
+        except BaseException:
+            if lease is not None:
+                lease.release()
+            raise
+
+    def _frame_tail(self, e, tail: bytes, k: int, m: int,
+                    shard_size: int):
+        """Frame the sub-block ragged tail exactly like _frame_windows'
+        tail path (split + parity + bitrot frame)."""
+        tail_shards = e.split(tail)
+        parity = np.asarray(e.backend.apply_matrix(
+            _parity_matrix(k, m), tail_shards)) if m else \
+            np.zeros((0, tail_shards.shape[1]), dtype=np.uint8)
+        return bitrot.frame_shards_batch(
+            np.concatenate([tail_shards, parity], axis=0)
+            if m else tail_shards, shard_size)
+
+    def _transform_staged(self, data, k: int, m: int, spec):
+        """Staged (layered) execution of a TransformSpec for shapes the
+        single native call cannot take: same stored bytes, same
+        metadata, counted as path=legacy in the transform plane's
+        split counters."""
+        import hashlib as _hl
+        import zlib as _zl
+
+        from minio_tpu.crypto import compress as comp_mod
+        from minio_tpu.crypto import dare as dare_mod
+        from minio_tpu.crypto import sse as sse_mod
+        from minio_tpu.object import transform as transform_mod
+
+        data = bytes(data)
+        plen = len(data)
+        spec.plain_size = plen
+        spec.digests = {}
+        if "sha256" in spec.algos:
+            spec.digests["sha256"] = _hl.sha256(data).digest()
+        if "sha1" in spec.algos:
+            spec.digests["sha1"] = _hl.sha1(data).digest()
+        if "crc32" in spec.algos:
+            import struct as _st
+            spec.digests["crc32"] = _st.pack(
+                ">I", _zl.crc32(data) & 0xFFFFFFFF)
+        body = data
+        if spec.compress and plen:
+            result = comp_mod.compress(data)
+            if result is not None:
+                body, meta = result
+                spec.comp_used = True
+                spec.meta.update(meta)
+        if spec.encrypt:
+            sealed = dare_mod.seal_bulk(spec.enc_key, spec.enc_nonce, 0,
+                                        body)
+            if sealed is None:
+                from minio_tpu.utils.streams import Payload as _P
+                enc = dare_mod.EncryptingPayload(
+                    _P.wrap(body), spec.enc_key, spec.enc_nonce)
+                parts = []
+                while True:
+                    c = enc.read(1 << 20)
+                    if not c:
+                        break
+                    parts.append(c)
+                sealed = b"".join(parts)
+            stored = sealed
+            if spec.comp_used:
+                spec.meta[sse_mod.META_SIZE] = str(len(body))
+        else:
+            stored = body
+        spec.stored_size = len(stored)
+        spec.digests["md5"] = _hl.md5(
+            data if (spec.comp_used or not spec.encrypt)
+            else stored).digest()
+        spec.etag = spec.digests["md5"].hex()
+        spec.run_verify()
+        chunks, lease = self._frame_windows(stored, k, m,
+                                            route="transform")
+        transform_mod.note_put("legacy", plen)
+        return chunks, lease, len(stored), spec.etag
+
+    # ------------------------------------------------------------------
     # PutObject
     # ------------------------------------------------------------------
 
@@ -1079,6 +1408,13 @@ class ErasureSet:
         opts = opts or PutOptions()
         payload = Payload.wrap(data)
         if payload.size > STREAM_THRESHOLD:
+            if opts.transform is not None:
+                # The fused spec is a buffered-plane contract; silently
+                # ignoring it here would commit plaintext under
+                # encrypted metadata.
+                raise ValueError(
+                    "TransformSpec requires a buffered-size body "
+                    f"(<= {STREAM_THRESHOLD} bytes)")
             return self._put_object_streaming(bucket, object_, payload, opts)
         return self._put_object_buffered(bucket, object_,
                                          payload.read_all(), opts)
@@ -1117,12 +1453,24 @@ class ErasureSet:
         # commit fan-out below serializes against other ops on this key.
         e = self._erasure(k, m)
         shard_size = e.shard_size()
-        framed, frames_lease = self._frame_windows(data, k, m)
-
-        etag = opts.etag or hashlib.md5(data).hexdigest()
+        if opts.transform is not None:
+            # Fused single-pass plane: digest + compress + DARE + frame
+            # in one native call (spec verify hook runs pre-commit
+            # inside); `size` below is the STORED length — exactly what
+            # a pre-transformed payload's len() was on the layered
+            # path. The spec's metadata (compression index, corrected
+            # sse size) lands in internal metadata with the rest.
+            framed, frames_lease, size, etag = \
+                self._transform_frame_windows(data, k, m, opts.transform)
+            opts.internal_metadata.update(opts.transform.meta)
+            etag = opts.etag or etag
+        else:
+            framed, frames_lease = self._frame_windows(data, k, m)
+            size = len(data)
+            etag = opts.etag or hashlib.md5(data).hexdigest()
         version_id = opts.version_id or (new_uuid() if opts.versioned else "")
         mod_time = opts.mod_time or now_ns()
-        shard_file_len = e.shard_file_size(len(data))
+        shard_file_len = e.shard_file_size(size)
         inline = shard_file_len <= SMALL_FILE_THRESHOLD and not opts.versioned \
             or shard_file_len <= SMALL_FILE_THRESHOLD // 8
         if inline and frames_lease is not None:
@@ -1147,9 +1495,9 @@ class ErasureSet:
             return FileInfo(
                 volume=bucket, name=object_, version_id=version_id,
                 deleted=False, data_dir=data_dir, mod_time=mod_time,
-                size=len(data), metadata=metadata,
-                parts=[ObjectPartInfo(number=1, size=len(data),
-                                      actual_size=len(data), etag=etag)],
+                size=size, metadata=metadata,
+                parts=[ObjectPartInfo(number=1, size=size,
+                                      actual_size=size, etag=etag)],
                 erasure=ErasureInfo(
                     data_blocks=k, parity_blocks=m, block_size=BLOCK_SIZE,
                     index=shard_idx + 1, distribution=tuple(distribution)),
@@ -1247,11 +1595,11 @@ class ErasureSet:
             # would undo the coalescing the lane exists for.
             self.metacache.bump(bucket)
         return ObjectInfo(bucket=bucket, name=object_, mod_time=mod_time,
-                          size=len(data), etag=etag,
+                          size=size, etag=etag,
                           content_type=opts.content_type,
                           version_id=version_id,
                           user_metadata=dict(opts.user_metadata),
-                          actual_size=len(data))
+                          actual_size=size)
 
     def restore_version(self, bucket: str, object_: str, src_fi,
                         data: Optional[bytes],
@@ -1480,7 +1828,12 @@ class ErasureSet:
                    for i in range(n)]
         for t in threads:
             t.start()
-        md5 = hashlib.md5()
+        # Streaming etag: a native md5 context that the pooled frame
+        # call extends INSIDE the same GIL-free native pass as the
+        # encode+frame (mtpu_put_frame_md5); windows that take the
+        # device or fallback route update it explicitly (still native,
+        # still no GIL held over the buffer walk).
+        md5 = _Md5Stream()
         write_quorum = k + (1 if k == m else 0)
         stream_error: Optional[Exception] = None
         try:
@@ -1490,10 +1843,12 @@ class ErasureSet:
                 window = payload.read_exact(window_bytes)
                 if not window:
                     break
-                md5.update(window)
                 window_lease = None
                 try:
-                    framed, window_lease = self._frame_windows(window, k, m)
+                    framed, window_lease = self._frame_windows(
+                        window, k, m, md5=md5)
+                    if not md5.take_folded():
+                        md5.update(window)
                     if n - sum(dead) < write_quorum:
                         raise WriteQuorumError(
                             "", "",
@@ -2376,9 +2731,12 @@ class ErasureSet:
         size = fi.size
         # Content transforms (SSE, compression) store the logical size
         # internally; the API surface reports it, the storage size
-        # stays in fi.
-        logical = internal.get("x-internal-sse-size") \
-            or internal.get("x-internal-comp-size")
+        # stays in fi. Compression's size wins when BOTH transforms are
+        # present (compress-then-encrypt): the sse size is then the
+        # DARE stream's plaintext = the COMPRESSED length, not the
+        # object's logical bytes.
+        logical = internal.get("x-internal-comp-size") \
+            or internal.get("x-internal-sse-size")
         if logical is not None:
             try:
                 size = int(logical)

@@ -119,6 +119,11 @@ class PutOptions:
     # Pre-computed etag override (content transforms hash the LOGICAL
     # bytes; the store would otherwise hash what it stores).
     etag: str = ""
+    # Fused single-pass data plane plan (object/transform.TransformSpec,
+    # duck-typed to avoid an import cycle): when set, the erasure layer
+    # runs digest/compress/DARE/frame as ONE native pass over the body
+    # instead of the caller pre-transforming the payload.
+    transform: Optional[object] = None
 
 
 @dataclasses.dataclass

@@ -52,6 +52,11 @@ PR "device-resident read path"): a batcher carries a `route` —
   * "reconstruct"  — batched GF decode-matrix application for degraded
                      reads / heal rebuilds (rs_device.make_mesh_matrix;
                      members are [B, k, shard] survivor stripes).
+  * "transform"    — the fused single-pass data plane's frame stage
+                     (object/transform.py): stored windows that already
+                     ran digest/compress/DARE through the native
+                     transform kernel coalesce here, calibrated and
+                     forceable independently of raw PUT windows.
 Routes calibrate INDEPENDENTLY (one batcher instance per route and
 config): a host whose device link wins on encode but loses on decode —
 or vice versa — routes each direction on its own measurement, and
@@ -180,7 +185,7 @@ class _Pending:
 _REGISTRY: "weakref.WeakSet[StripeBatcher]" = weakref.WeakSet()
 
 
-ROUTES = ("put", "get", "reconstruct")
+ROUTES = ("put", "get", "reconstruct", "transform")
 
 
 def _route_zero() -> dict:

@@ -120,6 +120,19 @@ class ChecksumingReader:
         return base64.b64encode(self._hashers[algo].digest()).decode()
 
 
+class DigestValues:
+    """ChecksumingReader-compatible digest source for values the fused
+    native transform pass already computed (object/transform.py): the
+    declared/trailer verification then costs ZERO extra walks of the
+    body — the single fused pass produced every digest."""
+
+    def __init__(self, raw_by_algo: dict):
+        self._raw = dict(raw_by_algo)
+
+    def b64(self, algo: str) -> str:
+        return base64.b64encode(self._raw[algo]).decode()
+
+
 def verify_and_meta(reader: ChecksumingReader, expected: dict) -> dict:
     """Compare computed digests with the declared ones; returns the
     internal-metadata entries to store. `expected[algo]` may be None

@@ -580,6 +580,33 @@ class Metrics:
                     "Kernel-lane service time of decode-route "
                     "(get/reconstruct) device dispatches",
                     [({}, bst["decode_lane_hist"])])
+        # -- fused transform plane (object/transform) -------------------
+        # Path split is the conformance signal: with fusion on, the
+        # legacy counters must stay ZERO for buffered traffic — any
+        # legacy tick means a request silently fell back to the
+        # layered per-stage walks the fused pass exists to remove.
+        from minio_tpu.object import transform as _tf_mod
+        tst = _tf_mod.stats()
+        metric("minio_tpu_transform_requests_total",
+               "Transform-plane requests by direction and path "
+               "(fused = single native pass, legacy = layered "
+               "per-stage walks)", "counter",
+               [({"dir": "put", "path": p}, v)
+                for p, v in sorted(tst["put_requests"].items())] +
+               [({"dir": "get", "path": p}, v)
+                for p, v in sorted(tst["get_requests"].items())])
+        metric("minio_tpu_transform_bytes_total",
+               "Logical bytes through the transform plane", "counter",
+               [({"dir": d}, v) for d, v in sorted(tst["bytes"].items())])
+        metric("minio_tpu_transform_fused_enabled",
+               "1 when the fused single-pass plane is active "
+               "(native kernel present, MTPU_TRANSFORM_FUSED not off)",
+               "gauge", [({}, 1 if tst["fused_enabled"] else 0)])
+        hist_metric("minio_tpu_transform_stage_service_seconds",
+                    "Per-stage service time inside the fused native "
+                    "pass (digest|compress|encrypt|frame)",
+                    [({"stage": s}, h)
+                     for s, h in sorted(tst["stage_hists"].items())])
         # -- group-commit write plane (storage/group_commit) ------------
         # Occupancy diagnosis for the small-object commit lanes: batch
         # size distribution + mean fill say whether concurrent PUTs
@@ -971,6 +998,11 @@ def node_info(server) -> dict:
     gst = _gc_mod.aggregate_stats()
     gst.pop("wait_hist", None)
     info["group_commit"] = gst
+    # Fused transform plane: path split + bytes (object/transform).
+    from minio_tpu.object import transform as _tf_mod
+    tst = _tf_mod.stats()
+    tst.pop("stage_hists", None)
+    info["transform"] = tst
     info["io_engine"] = engine
     info["fileinfo_cache"] = fileinfo
     from minio_tpu.storage import meta_scan as _ms

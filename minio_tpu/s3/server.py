@@ -2130,12 +2130,31 @@ def _make_handler(server: S3Server):
             opts.internal_metadata.update(
                 self._object_lock_put_meta(bucket, h))
             self._check_quota(bucket, payload.size)
-            payload, checksum_hdrs = self._apply_checksums(payload, h,
-                                                           opts)
-            plain_size = payload.size
-            payload, sse_headers = self._apply_sse(bucket, key, payload,
-                                                   h, opts)
-            payload = self._apply_compression(key, payload, opts)
+            fused = self._fused_put_prepare(bucket, key, payload, h, opts)
+            if fused is not None:
+                # Fused single-pass plane: the raw LOGICAL body goes to
+                # the object layer with a TransformSpec — etag md5,
+                # declared checksums, compression, and DARE all run as
+                # ONE native pass next to the framer
+                # (object/transform.py). Checksum verification runs
+                # pre-commit via the spec's verify hook.
+                payload, sse_headers, checksum_hdrs, plain_size = fused
+            else:
+                from minio_tpu.object import transform as _tf
+                from minio_tpu.object.erasure_object import \
+                    STREAM_THRESHOLD as _ST
+                if payload.size <= _ST:
+                    _tf.note_put("legacy", payload.size)
+                payload, checksum_hdrs = self._apply_checksums(payload, h,
+                                                               opts)
+                plain_size = payload.size
+                # Compression BEFORE encryption: the block scheme sees
+                # plaintext (ciphertext is incompressible), so
+                # compressed+encrypted objects store DARE(compressed)
+                # — the same layering the fused pass produces.
+                payload = self._apply_compression(key, payload, opts)
+                payload, sse_headers = self._apply_sse(bucket, key,
+                                                       payload, h, opts)
             # Replicate only after the SSE decision: encrypted objects
             # do not replicate in v1 (their keys bind to this cluster),
             # and an incoming REPLICA must not ping-pong back in
@@ -2251,6 +2270,76 @@ def _make_handler(server: S3Server):
                 if ent is not None:
                     ent[1] += nbytes
 
+        def _fused_put_prepare(self, bucket, key, payload, h, opts):
+            """Plan the fused single-pass data plane for a buffered
+            PUT: returns (logical bytes, sse response headers, checksum
+            response headers, plain size) with opts.transform set — or
+            None when the fused plane cannot take this request (kill
+            switch, no native kernel, streaming-size body) and the
+            layered pipeline should run instead."""
+            from minio_tpu.crypto import sse as sse_mod
+            from minio_tpu.object import transform as _tf
+            from minio_tpu.object.erasure_object import STREAM_THRESHOLD
+            from minio_tpu.s3 import checksum as ck
+            if not _tf.fused_put_enabled() \
+                    or payload.size > STREAM_THRESHOLD:
+                return None
+            try:
+                declared = dict(ck.declared_algos(h))
+                t_algos = ck.trailer_algos(h)
+                algos = ck.single_algo(declared, t_algos)
+            except ck.ChecksumError as e:
+                raise S3Error(e.code, str(e)) from None
+            # SSE decision (same gates as transform.sse_payload, minus
+            # the payload wrap — the erasure layer seals in-pass).
+            try:
+                customer = sse_mod.parse_sse_c(h)
+                enc_key = enc_nonce = b""
+                sse_headers = {}
+                if customer is not None or sse_mod.wants_sse_s3(
+                        h, server.object_layer.get_bucket_meta(bucket)
+                        .get("config:encryption")):
+                    enc_key, enc_nonce, imeta = sse_mod.encrypt_metadata(
+                        bucket, key, payload.size, server.kms, customer)
+                    opts.internal_metadata.update(imeta)
+                    sse_headers = ({sse_mod.H_C_ALG: "AES256",
+                                    sse_mod.H_C_MD5: customer[1]}
+                                   if customer is not None
+                                   else {sse_mod.H_SSE: "AES256"})
+            except sse_mod.SSEError as e:
+                raise S3Error(e.code, str(e)) from None
+            from minio_tpu.crypto import compress as comp
+            compress = bool(server.compression and payload.size
+                            and comp.eligible(key, opts.content_type))
+            raw = getattr(payload, "_reader", None)   # trailer source
+            # Reading the body drives the SigV4/chunk-signature checks
+            # and the trailer parse — the single ingest walk the
+            # layered path also pays; every digest after this point
+            # comes out of the ONE fused native pass.
+            data = payload.read_all()
+            checksum_hdrs: dict = {}
+
+            def verify(sp):
+                expected = dict(declared)
+                trailers = getattr(raw, "trailers", {}) or {}
+                for a in t_algos:
+                    expected.setdefault(a,
+                                        trailers.get(ck.H_PREFIX + a))
+                if not expected:
+                    return
+                try:
+                    meta = ck.verify_and_meta(
+                        ck.DigestValues(sp.digests), expected)
+                except ck.ChecksumError as e:
+                    raise S3Error(e.code, str(e)) from None
+                opts.internal_metadata.update(meta)
+                checksum_hdrs.update(ck.response_headers(meta))
+
+            opts.transform = _tf.TransformSpec(
+                algos=tuple(algos), compress=compress, enc_key=enc_key,
+                enc_nonce=enc_nonce, verify=verify)
+            return data, sse_headers, checksum_hdrs, len(data)
+
         def _apply_checksums(self, payload, h, opts):
             """Wrap the LOGICAL payload in checksum computation when
             the request declares x-amz-checksum-* values (headers, or
@@ -2307,12 +2396,13 @@ def _make_handler(server: S3Server):
 
         def _apply_compression(self, key, payload, opts):
             """Compress eligible buffered-size plaintext objects
-            (reference: cmd/object-api-utils.go compression gate — never
-            combined with SSE, never for incompressible payloads)."""
+            (reference: cmd/object-api-utils.go compression gate —
+            never for incompressible payloads). Runs BEFORE the SSE
+            wrap, so encrypted eligible objects store DARE over the
+            compressed block stream — the fused pass's layering."""
             from minio_tpu.crypto import compress as comp
             from minio_tpu.object.erasure_object import STREAM_THRESHOLD
             if not server.compression \
-                    or opts.internal_metadata.get("x-internal-sse-alg") \
                     or payload.size == 0 \
                     or payload.size > STREAM_THRESHOLD \
                     or not comp.eligible(key, opts.content_type):
@@ -2367,7 +2457,12 @@ def _make_handler(server: S3Server):
             SSE-C) and resolves ranges against the logical size."""
             sinfo = server.object_layer.get_object_info(
                 sbucket, skey, GetOptions(version_id=src_vid))
-            if sinfo.internal_metadata.get("x-internal-comp"):
+            # SSE first: a compressed+encrypted source must decrypt
+            # before inflating (get_encrypted handles the combined
+            # layering; the comp branch alone would inflate ciphertext).
+            if sinfo.internal_metadata.get("x-internal-comp") \
+                    and not sinfo.internal_metadata.get(
+                        "x-internal-sse-alg"):
                 sinfo, chunks, _, _ = self._get_compressed(
                     sbucket, skey, src_vid or sinfo.version_id, spec,
                     sinfo)
@@ -2505,7 +2600,8 @@ def _make_handler(server: S3Server):
                         raise      # genuinely out of range
                 imeta = info.internal_metadata
                 if imeta.get("x-internal-sse-alg"):
-                    chunks.close()
+                    if chunks is not None:
+                        chunks.close()
                     self._sse_check_head(h, info)
                     info, chunks, start, length = self._get_encrypted(
                         bucket, key, vid or info.version_id, spec, h,

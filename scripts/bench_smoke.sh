@@ -18,7 +18,7 @@ fi
 
 echo "== bench smoke (baseline: $latest) =="
 out=$(JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} \
-      MTPU_BENCH_ONLY=put_latency,put_concurrent,get_latency,get_concurrent,meta_listing,small_put,distributed \
+      MTPU_BENCH_ONLY=put_latency,put_concurrent,get_latency,get_concurrent,meta_listing,small_put,transform_put,distributed \
       MTPU_BENCH_SMALL=1 \
       python bench.py)
 echo "$out"
@@ -57,6 +57,13 @@ import sys
 # group-commit lanes' aggregate small-object ops/s through the object
 # layer. The bench always measures it on local drives; the served
 # column (nullable on 1-core hosts) is informational, not gated.
+# The transform_put gates ("higher") watch the fused single-pass data
+# plane: the SSE and compressed PUT aggregates relative to the
+# plaintext aggregate measured in the SAME run (vs_plain — both sides
+# share the run's scheduler weather, so the ratio is the stable
+# signal; ROADMAP item 3 charters ~>= 0.9, i.e. within ~1.1x of
+# plaintext). Skips via explicit null where the native transform
+# kernel is unavailable.
 # The distributed listing gate ("lower") watches the cluster listing
 # page: every measured page pays a real cross-node walk over the
 # remote walk_scan trimmed-summary stream through REAL spawned server
@@ -73,6 +80,8 @@ GATES = [
     ("meta_listing_list_cold_p50_ms", "value", "lower"),
     ("meta_listing_head_p50_ms", "cold_p50_ms", "lower"),
     ("small_put_ops_s", "value", "higher"),
+    ("transform_put_sse_gibps", "vs_plain", "higher"),
+    ("transform_put_comp_gibps", "vs_plain", "higher"),
     ("distributed_list_page_p50_ms", "value", "lower"),
 ]
 

@@ -8,9 +8,12 @@ import os
 
 import pytest
 
-pytest.importorskip(
-    "cryptography",
-    reason="SSE/KMS needs the optional 'cryptography' wheel")
+from minio_tpu.crypto.kms import aesgcm_impl
+
+if aesgcm_impl() is None:
+    pytest.skip("SSE/KMS needs an AES-GCM backend (the optional "
+                "'cryptography' wheel or the native kernel library)",
+                allow_module_level=True)
 
 from minio_tpu.crypto import (EncryptingPayload, KMS, KMSError,
                               encrypt_stream_size, decrypt_packages,
