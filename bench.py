@@ -159,6 +159,8 @@ def main() -> None:
             _transform_get()
         if _want("distributed"):
             _distributed()
+        if _want("connections"):
+            _connections()
         return
 
     import jax
@@ -280,6 +282,10 @@ def main() -> None:
     # ---- 11. Distributed: N-node cluster vs single node ---------------
     if _want("distributed"):
         _distributed()
+
+    # ---- 12. Connection plane: idle fd cost + GET fan-in ramp ---------
+    if _want("connections"):
+        _connections()
 
 
 def _put_latency() -> None:
@@ -1727,6 +1733,262 @@ def _serve_probe() -> None:
         except subprocess.TimeoutExpired:
             srv.kill()
         shutil.rmtree(root, ignore_errors=True)
+
+
+def _connections() -> None:
+    """Connection-plane bench (ROADMAP item 6): what an IDLE keep-alive
+    connection costs, and whether the served GET aggregate survives
+    client fan-in.
+
+      idle rss        N idle keep-alive connections held against a
+                      2-worker fleet; the fleet's RSS delta over its
+                      quiescent baseline, per connection. Under the
+                      epoll loop an idle connection is a parked fd with
+                      a hibernated recv buffer; under the thread path
+                      (MTPU_HTTP_EVENTLOOP=off, measured back-to-back
+                      as the pre-PR column) it pins a thread stack.
+      get ramp        served GET aggregate (1 MiB object) as the
+                      client connection count ramps — the measurement
+                      r10 could not make with one hot socket
+                      (tests/s3client.py ramp_get: one persistent raw
+                      socket per client thread).
+
+    Emits explicit-null lines on fd-limited hosts (RLIMIT_NOFILE too
+    small for the connection target) so the smoke gate skips cleanly.
+
+    Environment:
+      MTPU_BENCH_IDLE_CONNS   idle-connection target (default 10000,
+                              2000 under MTPU_BENCH_SMALL)
+    """
+    try:
+        _connections_inner()
+    except Exception as e:  # noqa: BLE001 - boot/socket failure
+        for m in ("connections_idle_rss_per_conn_kib",
+                  "connections_get_ramp_gibps"):
+            print(json.dumps({"metric": m, "value": None,
+                              "skip": f"{type(e).__name__}: {e}"}))
+
+
+def _conn_tree_rss_kib(pid: int) -> int:
+    """VmRSS sum (KiB) of `pid` and every descendant (the pre-forked
+    fleet: parent + workers)."""
+    def descend(p: int) -> list:
+        out = [p]
+        try:
+            with open(f"/proc/{p}/task/{p}/children") as f:
+                kids = f.read().split()
+        except OSError:
+            kids = []
+        for k in kids:
+            out += descend(int(k))
+        return out
+
+    total = 0
+    for p in descend(pid):
+        try:
+            with open(f"/proc/{p}/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS:"):
+                        total += int(line.split()[1])
+                        break
+        except OSError:
+            pass
+    return total
+
+
+def _connections_inner() -> None:
+    import shutil
+    import signal
+    import socket
+    import subprocess
+    import sys as _sys
+    import tempfile
+    from concurrent.futures import ThreadPoolExecutor
+
+    repo = _os.path.dirname(_os.path.abspath(__file__))
+    if repo not in _sys.path:
+        _sys.path.insert(0, repo)
+    from tests.s3client import S3Client, ramp_get
+
+    n_idle = int(_os.environ.get("MTPU_BENCH_IDLE_CONNS", 0) or
+                 (2000 if _SMALL else 10000))
+    ramp = (1, 4, 16) if _SMALL else (1, 4, 16, 64, 256)
+    ramp_secs = 1.5 if _SMALL else 3.0
+
+    # fd budget: this process holds every idle client socket; the
+    # server process holds the matching accepted fds (its own limit is
+    # inherited from ours). Raise soft to hard, then gate.
+    import resource
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    want_fds = n_idle + 1024
+    if soft < want_fds and hard >= want_fds:
+        resource.setrlimit(resource.RLIMIT_NOFILE, (want_fds, hard))
+        soft = want_fds
+    if soft < want_fds:
+        for m in ("connections_idle_rss_per_conn_kib",
+                  "connections_get_ramp_gibps"):
+            print(json.dumps({
+                "metric": m, "value": None,
+                "skip": f"RLIMIT_NOFILE {soft} < {want_fds} "
+                        f"needed for {n_idle} idle connections"}))
+        return
+
+    def boot(root: str, eventloop: bool):
+        port = 19350 + (_os.getpid() % 200) + (0 if eventloop else 1)
+        env = dict(_os.environ)
+        env.update(JAX_PLATFORMS="cpu", MTPU_HTTP_WORKERS="2",
+                   # The idle probe must outlive its own setup window:
+                   # a reaped connection would under-count RSS.
+                   MTPU_HTTP_KEEPALIVE_S="600")
+        if eventloop:
+            env.pop("MTPU_HTTP_EVENTLOOP", None)
+        else:
+            env["MTPU_HTTP_EVENTLOOP"] = "off"
+        proc = subprocess.Popen(
+            [_sys.executable, "-m", "minio_tpu.server",
+             "--address", f"127.0.0.1:{port}", "--scanner-interval", "0",
+             f"{root}/d{{1...4}}"],
+            env=env, cwd=repo,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        addr = f"127.0.0.1:{port}"
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError("fleet died during boot")
+            try:
+                if S3Client(addr).request(
+                        "GET", "/minio/health/live", sign=False)[0] == 200:
+                    return proc, addr
+            except OSError:
+                time.sleep(0.4)
+        proc.kill()
+        raise RuntimeError("fleet failed to boot in 90s")
+
+    def shutdown(proc) -> None:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=25)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+    def idle_probe(proc, addr) -> dict:
+        """Open n_idle keep-alive connections (one served request each,
+        then parked idle) and charge the fleet's RSS delta to them."""
+        host, _, port = addr.rpartition(":")
+        req = (f"GET /minio/health/live HTTP/1.1\r\nHost: {addr}\r\n"
+               "\r\n").encode()
+        socks: list = [None] * n_idle
+        failures = [0]
+
+        def opener(lo: int, hi: int) -> None:
+            for i in range(lo, hi):
+                try:
+                    s = socket.create_connection((host, int(port)),
+                                                 timeout=30)
+                    s.sendall(req)
+                    buf = b""
+                    while b"\r\n\r\n" not in buf:
+                        got = s.recv(4096)
+                        if not got:
+                            raise ConnectionError("EOF in idle prime")
+                        buf += got
+                    head, rest = buf.split(b"\r\n\r\n", 1)
+                    clen = 0
+                    for line in head.split(b"\r\n")[1:]:
+                        if line[:15].lower() == b"content-length:":
+                            clen = int(line[15:])
+                    while len(rest) < clen:
+                        rest += s.recv(4096)
+                    socks[i] = s
+                except OSError:
+                    failures[0] += 1
+        time.sleep(2)
+        rss0 = _conn_tree_rss_kib(proc.pid)
+        step = max(1, n_idle // 32)
+        with ThreadPoolExecutor(max_workers=32) as ex:
+            list(ex.map(lambda lo: opener(lo, min(lo + step, n_idle)),
+                        range(0, n_idle, step)))
+        held = sum(1 for s in socks if s is not None)
+        time.sleep(3)              # let buffers hibernate / settle
+        rss1 = _conn_tree_rss_kib(proc.pid)
+        for s in socks:
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        return {"conns_held": held, "failures": failures[0],
+                "rss_base_mib": round(rss0 / 1024, 1),
+                "rss_idle_mib": round(rss1 / 1024, 1),
+                "kib_per_conn": round((rss1 - rss0) / max(held, 1), 2)}
+
+    def ramp_probe(addr) -> list:
+        cli = S3Client(addr)
+        assert cli.request("PUT", "/connb")[0] == 200
+        body = np.random.default_rng(5).integers(
+            0, 256, size=1 << 20, dtype=np.uint8).tobytes()
+        assert cli.request("PUT", "/connb/ramp", body=body)[0] == 200
+        out = []
+        for conns in ramp:
+            r = ramp_get(addr, "/connb/ramp", len(body), conns,
+                         duration_s=ramp_secs)
+            out.append(r)
+        return out
+
+    results: dict = {}
+    for front in ("eventloop", "threads"):
+        root = tempfile.mkdtemp(prefix=f"bench-conn-{front}-")
+        try:
+            proc, addr = boot(root, eventloop=(front == "eventloop"))
+            try:
+                idle = idle_probe(proc, addr)
+                ramps = ramp_probe(addr)
+            finally:
+                shutdown(proc)
+            results[front] = {"idle": idle, "ramp": ramps}
+        except Exception as e:  # noqa: BLE001 - the thread path may
+            # genuinely fail to hold the target (10k OS threads); an
+            # explicit error column is the honest pre-PR record.
+            results[front] = {"error": f"{type(e).__name__}: {e}"}
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+
+    loop = results.get("eventloop", {})
+    pre = results.get("threads", {})
+    if "idle" not in loop:
+        for m in ("connections_idle_rss_per_conn_kib",
+                  "connections_get_ramp_gibps"):
+            print(json.dumps({"metric": m, "value": None,
+                              "skip": loop.get("error", "probe failed")}))
+        return
+
+    idle = loop["idle"]
+    print(json.dumps({
+        "metric": "connections_idle_rss_per_conn_kib",
+        "value": idle["kib_per_conn"],
+        "unit": "KiB/conn",
+        "conns": idle["conns_held"],
+        "open_failures": idle["failures"],
+        "rss_base_mib": idle["rss_base_mib"],
+        "rss_idle_mib": idle["rss_idle_mib"],
+        "pre_pr_threadpath": pre.get("idle")
+        or {"error": pre.get("error", "probe failed")},
+        "workers": 2,
+    }))
+    ramps = loop["ramp"]
+    tail = ramps[-1]
+    print(json.dumps({
+        "metric": "connections_get_ramp_gibps",
+        "value": tail["agg_gibps"],
+        "unit": "GiB/s",
+        "connections": tail["connections"],
+        "ramp": ramps,
+        "vs_c1": round(tail["agg_gibps"]
+                       / max(ramps[0]["agg_gibps"], 1e-9), 3),
+        "pre_pr_threadpath": pre.get("ramp")
+        or {"error": pre.get("error", "probe failed")},
+        "workers": 2,
+    }))
 
 
 def _distributed() -> None:

@@ -302,6 +302,18 @@ def _worker_stat(server, worker_id: int) -> dict:
         # own lanes, so the fleet view is a merge (group_commit.merge_stats).
         "group_commit": _gc_mod.aggregate_stats(),
     }
+    # Event-loop connection plane (s3/eventloop.py): each worker runs
+    # its own epoll loop; any worker's metrics/admin scrape merges the
+    # fleet's parked/active/shed/loop-lag view from these.
+    loop_st = None
+    es = getattr(server, "eventloop_stats", None)
+    if es is not None:
+        try:
+            loop_st = es()
+        except Exception:  # noqa: BLE001 - snapshot best effort
+            loop_st = None
+    if loop_st is not None:
+        stat["connections"] = loop_st
     # Grid peer breaker state (empty on single-node workers today;
     # carried so a future workers+distributed combination aggregates
     # per-worker peer health for free, like the engine rows above).

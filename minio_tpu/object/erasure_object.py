@@ -2059,6 +2059,59 @@ class ErasureSet:
         next(g)
         return info, g
 
+    def get_object_file(self, bucket: str, object_: str,
+                        opts: Optional[GetOptions] = None,
+                        info: Optional[ObjectInfo] = None):
+        """Sendfile source probe for the serve plane (s3/eventloop
+        connection plane): (info, fd, offset, length) when this
+        object's STORED bytes equal its plaintext and live contiguously
+        in one local file — today the FS-warm-tier copy of a
+        transitioned version. Erasure-resident objects are never
+        eligible: every shard file interleaves bitrot digests with the
+        blocks (`digest || block` framing), so no raw-byte file exists
+        for them. Whole-object, unencrypted, uncompressed reads only;
+        None when ineligible. The caller owns the returned fd.
+
+        Pass `info` (an ObjectInfo already resolved for this exact
+        version, e.g. from an open get_object_stream whose read lock
+        is still held) to skip the quorum fileinfo fan-out — the probe
+        then needs only the tier file open+fstat."""
+        from minio_tpu.object import tier as tier_mod
+        opts = opts or GetOptions()
+        if opts.range_spec is not None or opts.offset:
+            return None
+        if info is None:
+            with self.ns.read(bucket, object_):
+                info, _fi, _fis, _offset, _length = self._prepare_get(
+                    bucket, object_, opts)
+        imeta = info.internal_metadata or {}
+        if imeta.get("x-internal-sse-alg") \
+                or imeta.get("x-internal-comp"):
+            return None
+        length = info.size
+        name = imeta.get(tier_mod.META_TIER)
+        if not name or self.tiers is None or length == 0:
+            return None
+        try:
+            backend = self.tiers.get(name)
+        except Exception:  # noqa: BLE001 - tier config drift
+            return None
+        local_path = getattr(backend, "local_path", None)
+        if local_path is None:
+            return None
+        path = local_path(imeta.get(tier_mod.META_TIER_KEY, ""))
+        try:
+            fd = os.open(path, os.O_RDONLY)
+        except OSError:
+            return None
+        if os.fstat(fd).st_size != length:
+            # Stored size must equal the plaintext length for a raw
+            # file copy (no transform); anything else is not ours
+            # to stream.
+            os.close(fd)
+            return None
+        return info, fd, 0, length
+
     def _window_descs(self, fi: FileInfo, offset: int,
                       length: int) -> list[tuple]:
         """(part_number, part_size, rel, step) windows covering

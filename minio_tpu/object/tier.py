@@ -62,6 +62,14 @@ class FSWarmBackend:
         except FileNotFoundError:
             raise TierError(f"tier object {key!r} missing") from None
 
+    def local_path(self, key: str) -> str:
+        """Filesystem path of the stored tier copy — the sendfile
+        source probe (erasure-resident data is bitrot-framed per
+        shard; the FS tier file is the one place an object's stored
+        bytes live contiguously). Remote backends have no such path
+        (duck-typed absence)."""
+        return os.path.join(self.path, key)
+
     def remove(self, key: str) -> None:
         try:
             os.remove(os.path.join(self.path, key))

@@ -1,0 +1,706 @@
+"""Event-loop connection plane: epoll front end for 10k+ connections.
+
+The thread-per-connection front end (ThreadingHTTPServer) spends a
+thread stack on every OPEN connection — at SDK connection-pool fan-in
+the connection count, not the per-request cost, becomes the wall. This
+module replaces the accept path inside each pre-forked worker with one
+epoll event loop:
+
+  * idle connections PARK in a single epoll set costing a file
+    descriptor and a small Python object — their pooled recv buffer is
+    hibernated (returned to io/bufpool) whenever it is empty, so 10k
+    idle keep-alive connections hold zero recv buffers;
+  * readable sockets drain non-blocking into their per-connection
+    ConnReader (s3/hotloop.py) until the native framer
+    (`mtpu_http_head`) frames a COMPLETE request head — only then is
+    the request dispatched to a bounded executor running the existing
+    handler stack (partial heads never occupy a thread: slowloris
+    clients are reaped by the idle deadline while parked);
+  * keep-alive turnaround RE-PARKS the fd instead of pinning a thread;
+    pipelined requests already buffered are served back-to-back on the
+    same dispatch;
+  * a response's FINAL gathered write is EAGAIN-aware: when the socket
+    buffer fills, the remainder is handed to the loop's EPOLLOUT
+    machinery and the executor thread returns to the pool
+    (`offload_final`), the loop finishing the drain and re-parking;
+  * connection-level backpressure runs BEFORE request-level shedding:
+    past MTPU_MAX_CONNS the loop answers accepts with an immediate
+    503 + Retry-After and closes, so an fd storm can never starve the
+    admission gates of descriptors.
+
+With the native framer disabled (MTPU_HTTP_NATIVE=off) the loop still
+parks idle connections; a readable socket dispatches the stock
+blocking parser (head framing then happens in the executor under the
+keep-alive timeout).
+
+Environment:
+  MTPU_HTTP_EVENTLOOP  "off"/"0"/"false" reverts wholesale to the
+                       thread-per-connection path (kill-switch)
+  MTPU_LOOP_WORKERS    executor threads per worker process
+                       (default max(8, 4 x cores))
+  MTPU_MAX_CONNS       per-worker open-connection cap (default: soft
+                       RLIMIT_NOFILE minus 512 headroom, min 64)
+  MTPU_HTTP_KEEPALIVE_S  idle deadline for parked connections (shared
+                       with the thread path; <= 0 disables reaping)
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import queue
+import select
+import socket
+import sys
+import threading
+import time
+
+from minio_tpu.s3 import hotloop
+from minio_tpu.utils.env import env_int
+from minio_tpu.utils.latency import Histogram
+
+_LISTEN_BACKLOG = 1024
+_REAP_INTERVAL = 1.0
+# Pipelined requests served per dispatch before the connection yields
+# the executor thread back (fairness under a hot pipelining client).
+_PIPELINE_BURST = 32
+
+# Connection-level backpressure: the canned response for accepts past
+# MTPU_MAX_CONNS — shed BEFORE any byte is read, so request-level
+# admission (s3/admission.py) never sees the overflow.
+_SHED_RESPONSE = (b"HTTP/1.1 503 Service Unavailable\r\n"
+                  b"Retry-After: 1\r\nContent-Length: 0\r\n"
+                  b"Connection: close\r\n\r\n")
+
+# _Conn states.
+_PARKED = 0        # in the epoll set, waiting for bytes
+_DISPATCHED = 1    # an executor thread owns the socket
+_WRITING = 2       # loop owns a response tail (EPOLLOUT drain)
+
+
+def loop_enabled(env=os.environ) -> bool:
+    """MTPU_HTTP_EVENTLOOP kill-switch + platform gate (epoll is
+    Linux; other platforms keep the thread path)."""
+    if env.get("MTPU_HTTP_EVENTLOOP", "").lower() in ("off", "0", "false"):
+        return False
+    return hasattr(select, "epoll")
+
+
+def default_max_conns() -> int:
+    """Per-worker connection cap: the soft fd limit minus headroom for
+    drives, pool internals, and the control plane."""
+    try:
+        import resource
+        soft, _ = resource.getrlimit(resource.RLIMIT_NOFILE)
+    except Exception:  # noqa: BLE001 - exotic platform
+        soft = 1024
+    if soft <= 0 or soft >= (1 << 30):      # RLIM_INFINITY
+        soft = 1 << 20
+    return max(64, soft - 512)
+
+
+class _Executor:
+    """Bounded lazy pool of DAEMON worker threads (ThreadPoolExecutor
+    threads are non-daemon and would block interpreter exit — the
+    thread front end uses daemon handler threads, and drain-on-stop is
+    owned by S3Server's in-flight counter, not by thread joins)."""
+
+    def __init__(self, max_workers: int):
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self._max = max(1, max_workers)
+        self._mu = threading.Lock()
+        self.threads = 0
+        self._idle = 0
+        self._pending = 0
+
+    def submit(self, fn) -> None:
+        # Spawn whenever queued-but-unclaimed tasks outnumber threads
+        # actually blocked in q.get(): a burst of submits from the loop
+        # thread must not serialize behind one idle thread that hasn't
+        # woken yet (an admin/health dispatch queued behind a slow data
+        # request would starve).
+        with self._mu:
+            self._pending += 1
+            spawn = self._pending > self._idle and self.threads < self._max
+            if spawn:
+                self.threads += 1
+        if spawn:
+            try:
+                threading.Thread(target=self._run, daemon=True,
+                                 name="loop-exec").start()
+            except Exception:
+                # Thread exhaustion: roll the count back so a later
+                # submit retries the spawn. With at least one live
+                # thread the queued task still drains; with none the
+                # dispatch fails loudly (caller closes that conn only).
+                with self._mu:
+                    self.threads -= 1
+                    if self.threads == 0:
+                        self._pending -= 1
+                        raise
+        self._q.put(fn)
+
+    def _run(self) -> None:
+        while True:
+            with self._mu:
+                self._idle += 1
+            fn = self._q.get()
+            with self._mu:
+                self._idle -= 1
+                self._pending -= 1
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 - a task must not kill a worker
+                pass
+
+    def depth(self) -> int:
+        return self._q.qsize()
+
+
+class _Conn:
+    __slots__ = ("sock", "fd", "handler", "reader", "state", "registered",
+                 "last_activity", "pending", "close_after_write")
+
+    def __init__(self, sock, fd, handler, reader):
+        self.sock = sock
+        self.fd = fd
+        self.handler = handler
+        self.reader = reader               # ConnReader or None (native off)
+        self.state = _PARKED
+        self.registered = False
+        self.last_activity = time.monotonic()
+        self.pending = None                # loop-owned response tail
+        self.close_after_write = False
+
+
+class EventLoopServer:
+    """epoll accept/dispatch front end, API-compatible with the subset
+    of ThreadingHTTPServer that S3Server drives (server_address,
+    serve_forever/shutdown/server_close)."""
+
+    daemon_threads = True        # attribute parity with the thread path
+
+    def __init__(self, server_address, HandlerClass, reuse_port: bool = False,
+                 keepalive_s: float | None = 75.0,
+                 max_conns: int | None = None, workers: int | None = None):
+        self.handler_cls = HandlerClass
+        self.keepalive_s = keepalive_s
+        self.max_conns = max_conns if max_conns is not None else \
+            env_int("MTPU_MAX_CONNS", default_max_conns())
+        self._native_lib = getattr(HandlerClass, "loop_native_lib", None)
+        self.socket = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if reuse_port:
+            self.socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        self.socket.bind(server_address)
+        self.server_address = self.socket.getsockname()
+        self.socket.listen(_LISTEN_BACKLOG)
+        self.socket.setblocking(False)
+        self._epoll = select.epoll()
+        self._wr, self._ww = os.pipe()
+        os.set_blocking(self._wr, False)
+        n_workers = workers if workers is not None else env_int(
+            "MTPU_LOOP_WORKERS", max(8, 4 * (os.cpu_count() or 1)))
+        self._executor = _Executor(n_workers)
+        self._mu = threading.Lock()
+        self._conns: dict[int, _Conn] = {}
+        self._inbox: collections.deque = collections.deque()
+        self._running = False
+        self._stopping = False
+        self._closed = False
+        self._done = threading.Event()
+        # Connection-plane counters (loop thread is the only writer for
+        # most; reads are snapshots for metrics/admin).
+        self.loop_lag = Histogram()
+        self.accepted_total = 0
+        self.shed_total = 0
+        self.reparks_total = 0
+        self.reaped_idle_total = 0
+        self.dispatch_total = 0
+
+    # -- loop ------------------------------------------------------------
+
+    def serve_forever(self, poll_interval: float = 0.5) -> None:
+        self._running = True
+        ep = self._epoll
+        lfd = self.socket.fileno()
+        ep.register(lfd, select.EPOLLIN)
+        ep.register(self._wr, select.EPOLLIN)
+        last_reap = time.monotonic()
+        try:
+            while not self._stopping:
+                try:
+                    events = ep.poll(poll_interval)
+                except InterruptedError:
+                    continue
+                if self._stopping:
+                    break
+                t0 = time.monotonic()
+                had_events = bool(events) or bool(self._inbox)
+                for fd, ev in events:
+                    try:
+                        if fd == lfd:
+                            self._accept_burst()
+                        elif fd == self._wr:
+                            self._drain_wakeup()
+                        else:
+                            self._on_event(fd, ev)
+                    except Exception:  # noqa: BLE001 - one conn only
+                        self._oops(fd)
+                self._process_inbox()
+                now = time.monotonic()
+                if had_events:
+                    # Loop lag: how long this tick's ready events waited
+                    # on the loop thread — the dispatch latency the
+                    # single-threaded plane adds on top of the kernel.
+                    self.loop_lag.observe(now - t0)
+                if self.keepalive_s is not None \
+                        and now - last_reap >= _REAP_INTERVAL:
+                    last_reap = now
+                    self._reap_idle(now)
+        finally:
+            self._running = False
+            self._teardown()
+            self._done.set()
+
+    def _oops(self, fd: int) -> None:
+        """Last-ditch per-connection failure containment: the loop must
+        survive any single socket's misbehavior."""
+        with self._mu:
+            conn = self._conns.get(fd)
+        if conn is not None:
+            self._destroy(conn)
+
+    def _drain_wakeup(self) -> None:
+        try:
+            while os.read(self._wr, 4096):
+                pass
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError:
+            pass
+
+    def _post(self, item) -> bool:
+        """Hand a connection back to the loop thread; False when the
+        loop is gone (caller must clean up inline)."""
+        with self._mu:
+            if self._stopping or not self._running:
+                return False
+            self._inbox.append(item)
+        try:
+            os.write(self._ww, b"x")
+        except OSError:
+            return False
+        return True
+
+    def _process_inbox(self) -> None:
+        while True:
+            try:
+                op, conn = self._inbox.popleft()
+            except IndexError:
+                return
+            try:
+                if op == "park":
+                    self._park(conn)
+                elif op == "write":
+                    self._begin_write(conn)
+                elif op == "close":
+                    self._destroy(conn)
+            except Exception:  # noqa: BLE001 - one conn only, loop survives
+                self._oops(conn.fd)
+
+    # -- accept / backpressure -------------------------------------------
+
+    def _accept_burst(self) -> None:
+        # Bounded per tick: an accept storm must not starve parked
+        # connections' events (level-triggered epoll re-arms the rest).
+        for _ in range(256):
+            try:
+                s, addr = self.socket.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            with self._mu:
+                n_conns = len(self._conns)
+            if self._stopping:
+                s.close()
+                return
+            if n_conns >= self.max_conns:
+                self._shed(s)
+                continue
+            self.accepted_total += 1
+            s.setblocking(False)
+            try:
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            conn = self._new_conn(s, addr)
+            if conn is None:
+                s.close()
+                continue
+            with self._mu:
+                self._conns[conn.fd] = conn
+            self._register(conn, select.EPOLLIN)
+
+    def _shed(self, s: socket.socket) -> None:
+        """Connection-level backpressure: immediate 503 + close, no
+        handler, no buffer, no thread."""
+        self.shed_total += 1
+        try:
+            s.setblocking(False)
+            s.send(_SHED_RESPONSE)
+        except OSError:
+            pass
+        finally:
+            s.close()
+
+    def _new_conn(self, s, addr):
+        h = self.handler_cls.__new__(self.handler_cls)
+        h.request = s
+        h.client_address = addr
+        h.server = self
+        h.close_connection = True
+        try:
+            h.setup()
+        except Exception:  # noqa: BLE001 - per-conn alloc failure
+            return None
+        conn = _Conn(s, s.fileno(), h, getattr(h, "_conn", None))
+        h._loop_conn = conn
+        return conn
+
+    # -- epoll bookkeeping ----------------------------------------------
+
+    def _register(self, conn: _Conn, mask) -> None:
+        if conn.registered:
+            self._epoll.modify(conn.fd, mask)
+        else:
+            self._epoll.register(conn.fd, mask)
+            conn.registered = True
+
+    def _unregister(self, conn: _Conn) -> None:
+        if conn.registered:
+            conn.registered = False
+            try:
+                self._epoll.unregister(conn.fd)
+            except (OSError, ValueError):
+                # ValueError: epoll already closed (teardown ordering).
+                pass
+
+    # -- read side -------------------------------------------------------
+
+    def _on_event(self, fd: int, ev) -> None:
+        with self._mu:
+            conn = self._conns.get(fd)
+        if conn is None:
+            try:
+                self._epoll.unregister(fd)
+            except OSError:
+                pass
+            return
+        if conn.state == _WRITING:
+            if ev & (select.EPOLLHUP | select.EPOLLERR):
+                self._destroy(conn)
+            else:
+                self._drain_pending(conn)
+            return
+        if conn.state != _PARKED:
+            return
+        if ev & select.EPOLLERR:
+            self._destroy(conn)
+            return
+        self._read_ready(conn, ev)
+
+    def _read_ready(self, conn: _Conn, ev) -> None:
+        reader = conn.reader
+        if reader is None:
+            # Native framer off: no loop-side buffer exists. EPOLLHUP
+            # with no pending bytes is a plain disconnect; otherwise
+            # dispatch the stock blocking parser (bytes wait in the
+            # kernel buffer until the executor reads them).
+            if ev & select.EPOLLHUP and not ev & select.EPOLLIN:
+                self._destroy(conn)
+                return
+            self._dispatch(conn, "stock", None)
+            return
+        n = reader.fill_nb()
+        if n == 0:
+            if reader.buffered:
+                # EOF mid-head: stock error path decides (thread-path
+                # parity with parse_head's _Fallback on EOF-mid-head).
+                self._dispatch(conn, "fallback", None)
+            else:
+                self._destroy(conn)        # clean close between requests
+            return
+        if n is None:
+            if ev & select.EPOLLHUP:
+                self._destroy(conn)
+            return
+        conn.last_activity = time.monotonic()
+        self._advance(conn)
+
+    def _advance(self, conn: _Conn) -> None:
+        """Frame-or-park: dispatch when a complete head (or a
+        fallback-worthy prefix) is buffered; otherwise stay parked —
+        a partial head never holds an executor thread."""
+        status, head = conn.reader.try_parse_head(self._native_lib)
+        if status == "head":
+            self._dispatch(conn, "head", head)
+        elif status == "fallback":
+            self._dispatch(conn, "fallback", None)
+        # "more": remain parked; the idle deadline covers slow heads.
+
+    def _dispatch(self, conn: _Conn, mode: str, head) -> None:
+        conn.state = _DISPATCHED
+        self._unregister(conn)
+        self.dispatch_total += 1
+        self._executor.submit(lambda: self._serve(conn, mode, head))
+
+    # -- executor side ---------------------------------------------------
+
+    def _serve(self, conn: _Conn, mode: str, head) -> None:
+        """One dispatch: serve the framed request (and any pipelined
+        successors already buffered), then hand the connection back to
+        the loop — re-park, tail-write, or close."""
+        h = conn.handler
+        sock = conn.sock
+        broken = False
+        try:
+            sock.setblocking(True)
+            for _ in range(_PIPELINE_BURST):
+                if mode == "head":
+                    sock.settimeout(None)        # thread-path parity:
+                    h._dispatch_head(head)       # body reads block
+                else:
+                    # "fallback" (native framer declined the buffered
+                    # bytes) and "stock" (native off): the handler's own
+                    # thread-path entry point — it re-runs the framing
+                    # decision on the SAME bytes, counts the fallback,
+                    # and applies the stock keep-alive timeout shape.
+                    sock.settimeout(self.keepalive_s)
+                    h.handle_one_request()
+                    sock.settimeout(None)
+                if h.close_connection or conn.pending is not None:
+                    break
+                mode, head = self._next_buffered(conn, h)
+                if mode is None:
+                    break
+        except Exception:  # noqa: BLE001 - dead client / handler failure
+            broken = True
+        # Hand back to the loop thread.
+        if conn.pending is not None and not broken:
+            conn.close_after_write = h.close_connection
+            if not self._post(("write", conn)):
+                self._destroy(conn)
+            return
+        if broken or h.close_connection:
+            if not self._post(("close", conn)):
+                self._destroy(conn)
+            return
+        conn.last_activity = time.monotonic()
+        if conn.reader is not None and not conn.reader.buffered:
+            # Idle keep-alive: park with ZERO pooled bytes held.
+            conn.reader.hibernate()
+        try:
+            sock.setblocking(False)
+        except OSError:
+            self._destroy(conn)
+            return
+        if not self._post(("park", conn)):
+            self._destroy(conn)
+
+    def _next_buffered(self, conn: _Conn, h):
+        """Pipelining probe after a served request: another complete
+        head already buffered? ("head"/"fallback"/"stock", head) to
+        keep serving on this thread, (None, None) to re-park."""
+        reader = conn.reader
+        if reader is not None:
+            if not reader.buffered:
+                return None, None
+            if self._native_lib is None:
+                return "fallback", None
+            status, head = reader.try_parse_head(self._native_lib)
+            if status == "head":
+                return "head", head
+            if status == "fallback":
+                return "fallback", None
+            return None, None              # partial next head: park
+        # Stock rfile: peek without blocking (non-blocking raw read
+        # returns None into the BufferedReader, which then reports
+        # only what it already buffered).
+        try:
+            conn.sock.setblocking(False)
+            try:
+                buffered = h.rfile.peek(1) if hasattr(h.rfile, "peek") \
+                    else b""
+            finally:
+                conn.sock.setblocking(True)
+        except (OSError, ValueError):
+            return None, None
+        return ("stock", None) if buffered else (None, None)
+
+    # -- loop-owned response tails --------------------------------------
+
+    def offload_final(self, conn: _Conn, bufs) -> bool:
+        """A response's FINAL gathered write, EAGAIN-aware (executor
+        context): send what the socket takes now; COPY the remainder
+        (pooled views die when their generator closes) and leave it on
+        the connection for the loop's EPOLLOUT drain. Always handles
+        the buffers; raises like send_gathered on a dead peer."""
+        sock = conn.sock
+        sock.setblocking(False)
+        try:
+            _, rest = hotloop.send_nb(sock, bufs)
+        finally:
+            try:
+                sock.setblocking(True)
+            except OSError:
+                pass
+        if rest:
+            conn.pending = [memoryview(bytes(b)) for b in rest]
+        return True
+
+    def _begin_write(self, conn: _Conn) -> None:
+        conn.state = _WRITING
+        # The executor restored blocking mode for the handler; from
+        # here the LOOP owns the socket and every send must EAGAIN,
+        # not block the loop thread.
+        try:
+            conn.sock.setblocking(False)
+        except OSError:
+            self._destroy(conn)
+            return
+        self._drain_pending(conn)
+
+    def _drain_pending(self, conn: _Conn) -> None:
+        try:
+            _, rest = hotloop.send_nb(conn.sock, conn.pending or [])
+        except OSError:
+            self._destroy(conn)
+            return
+        if rest:
+            conn.pending = rest
+            conn.last_activity = time.monotonic()
+            self._register(conn, select.EPOLLOUT)
+            return
+        conn.pending = None
+        if conn.close_after_write:
+            self._destroy(conn)
+            return
+        # Tail drained: back to a parked keep-alive connection.
+        conn.state = _PARKED
+        conn.last_activity = time.monotonic()
+        self.reparks_total += 1
+        if conn.reader is not None and not conn.reader.buffered:
+            conn.reader.hibernate()
+        self._register(conn, select.EPOLLIN)
+        if conn.reader is not None and conn.reader.buffered:
+            self._advance(conn)
+
+    def _park(self, conn: _Conn) -> None:
+        if self._stopping:
+            self._destroy(conn)
+            return
+        conn.state = _PARKED
+        self.reparks_total += 1
+        self._register(conn, select.EPOLLIN)
+
+    # -- reaping / teardown ----------------------------------------------
+
+    def _reap_idle(self, now: float) -> None:
+        ks = self.keepalive_s
+        with self._mu:
+            conns = list(self._conns.values())
+        for conn in conns:
+            if conn.state != _DISPATCHED \
+                    and now - conn.last_activity > ks:
+                # Parked idle keep-alive AND parked-with-partial-head
+                # (slowloris) AND stalled tail writes all age out on
+                # the same deadline.
+                self.reaped_idle_total += 1
+                self._destroy(conn)
+
+    def _destroy(self, conn: _Conn) -> None:
+        """Close one connection: epoll, handler teardown (recv-buffer
+        lease, conn gauge), socket. Loop thread or — after the loop has
+        stopped — the owning executor thread."""
+        with self._mu:
+            live = self._conns.pop(conn.fd, None) is not None
+        if not live:
+            return
+        # Only the loop thread ever registers, so a conn reaching here
+        # from an executor (post-stop cleanup) is never registered.
+        self._unregister(conn)
+        try:
+            conn.handler.finish()
+        except Exception:  # noqa: BLE001 - dead socket teardown
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    def _teardown(self) -> None:
+        self._process_inbox()
+        with self._mu:
+            conns = list(self._conns.values())
+        for conn in conns:
+            if conn.state != _DISPATCHED:
+                # In-flight requests keep their sockets; their executor
+                # threads clean up on completion (_post sees stopping).
+                self._destroy(conn)
+        self.server_close()
+        try:
+            self._epoll.close()
+        except OSError:
+            pass
+        for fd in (self._wr, self._ww):
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+
+    def shutdown(self) -> None:
+        with self._mu:
+            self._stopping = True
+        if not self._running:
+            return
+        try:
+            os.write(self._ww, b"x")
+        except OSError:
+            pass
+        if not self._done.wait(timeout=10):
+            print("eventloop: loop thread failed to stop in 10s",
+                  file=sys.stderr)
+
+    def server_close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self.socket.close()
+        except OSError:
+            pass
+
+    # -- observability ---------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._mu:
+            conns = list(self._conns.values())
+        parked = sum(1 for c in conns if c.state == _PARKED)
+        writing = sum(1 for c in conns if c.state == _WRITING)
+        return {
+            "enabled": True,
+            "parked": parked,
+            "active": len(conns) - parked,
+            "writing": writing,
+            "max_conns": self.max_conns,
+            "accepted_total": self.accepted_total,
+            "shed_total": self.shed_total,
+            "reparks_total": self.reparks_total,
+            "reaped_idle_total": self.reaped_idle_total,
+            "dispatch_total": self.dispatch_total,
+            "executor_threads": self._executor.threads,
+            "executor_queue": self._executor.depth(),
+            "loop_lag": self.loop_lag.state(),
+        }

@@ -95,18 +95,24 @@ class ConnReader:
     """Pooled-buffer connection reader, persistent across keep-alive
     requests. File-like for every body/fallback consumer (BufferedReader
     semantics: read(n) blocks for n bytes or EOF), while the native head
-    parser works on the underlying buffer directly between requests."""
+    parser works on the underlying buffer directly between requests.
+
+    The recv buffer is LAZY and hibernatable: no pool lease is held
+    until the first byte arrives, and `hibernate()` returns the lease
+    whenever the buffer is empty (the event loop parks idle keep-alive
+    connections with ZERO pooled bytes held — 10k idle connections cost
+    file descriptors and small Python objects, not 10k recv buffers).
+    The next fill re-leases transparently (steady-state pool hit)."""
 
     def __init__(self, sock: socket.socket, pool=None):
         from minio_tpu.io.bufpool import global_pool
         self._sock = sock
-        self._lease = (pool or global_pool()).lease(RECV_BUF)
-        self._raw = self._lease.raw
-        self._cap = len(self._raw)
-        self._mv = memoryview(self._raw)
-        # ctypes view for the native framer (dropped before the lease
-        # returns — an exported buffer must never reach the free list).
-        self._arr = (ctypes.c_uint8 * self._cap).from_buffer(self._raw)
+        self._pool = pool or global_pool()
+        self._lease = None
+        self._raw = None
+        self._cap = RECV_BUF
+        self._mv = None
+        self._arr = None
         self._out = (ctypes.c_int32 * (6 + 4 * MAX_HEADERS))()
         self._start = 0
         self._end = 0
@@ -117,6 +123,39 @@ class ConnReader:
 
     # -- buffer plumbing -------------------------------------------------
 
+    def _ensure(self) -> None:
+        """Lease the recv buffer (first use, or re-arm after
+        hibernate())."""
+        if self._raw is not None:
+            return
+        if self._closed:
+            # A re-lease after close() would never be released again.
+            raise ValueError("read on closed ConnReader")
+        self._lease = self._pool.lease(RECV_BUF)
+        self._raw = self._lease.raw
+        self._cap = len(self._raw)
+        self._mv = memoryview(self._raw)
+        # ctypes view for the native framer (dropped before the lease
+        # returns — an exported buffer must never reach the free list).
+        self._arr = (ctypes.c_uint8 * self._cap).from_buffer(self._raw)
+        self._start = self._end = 0
+
+    def hibernate(self) -> bool:
+        """Release the pooled recv buffer if nothing is buffered.
+        Returns True when the reader now holds no lease (already
+        hibernated counts); False when buffered bytes pin it."""
+        if self._raw is None:
+            return True
+        if self._end - self._start:
+            return False
+        self._arr = None
+        self._mv.release()
+        self._mv = None
+        self._raw = None
+        lease, self._lease = self._lease, None
+        lease.release()
+        return True
+
     def _compact(self) -> None:
         if self._start:
             n = self._end - self._start
@@ -126,11 +165,29 @@ class ConnReader:
     def _fill(self) -> int:
         """recv into the buffer tail; returns bytes added (0 = EOF or
         buffer full)."""
+        self._ensure()
         if self._end == self._cap:
             self._compact()
             if self._end == self._cap:
                 return 0
         n = self._sock.recv_into(self._mv[self._end:], self._cap - self._end)
+        self._end += n
+        return n
+
+    def fill_nb(self):
+        """Non-blocking fill for the event loop (socket must be in
+        non-blocking mode): bytes added (> 0), 0 at EOF, or None when
+        the read would block (spurious wakeup) or the buffer is full."""
+        self._ensure()
+        if self._end == self._cap:
+            self._compact()
+            if self._end == self._cap:
+                return None
+        try:
+            n = self._sock.recv_into(self._mv[self._end:],
+                                     self._cap - self._end)
+        except (BlockingIOError, InterruptedError):
+            return None
         self._end += n
         return n
 
@@ -144,7 +201,8 @@ class ConnReader:
         if n is None or n < 0:
             # Read-to-EOF: nothing on the serve path does this (bodies
             # are Content-Length or chunk framed), but be correct.
-            parts = [bytes(self._mv[self._start:self._end])]
+            parts = [bytes(self._mv[self._start:self._end])
+                     if self._mv is not None else b""]
             self._start = self._end = 0
             while True:
                 chunk = self._sock.recv(65536)
@@ -195,6 +253,7 @@ class ConnReader:
         return done
 
     def readline(self, limit: int = 65537) -> bytes:
+        self._ensure()
         while True:
             nl = self._raw.find(b"\n", self._start, self._end)
             if nl >= 0:
@@ -217,11 +276,16 @@ class ConnReader:
         if self._closed:
             return
         self._closed = True
+        if self._raw is None:          # hibernated / never leased
+            return
         # Exported views go first: a ctypes array or memoryview still
         # attached would alias a recycled pool buffer.
         self._arr = None
         self._mv.release()
-        self._lease.release()
+        self._mv = None
+        self._raw = None
+        lease, self._lease = self._lease, None
+        lease.release()
 
     # -- native head parse ----------------------------------------------
 
@@ -234,6 +298,7 @@ class ConnReader:
         parser should take this request (bytes left buffered)."""
         while True:
             if self.buffered:
+                self._ensure()
                 self._compact()
                 n = native_lib.mtpu_http_head(self._arr, self._end,
                                               self._out, MAX_HEADERS)
@@ -280,6 +345,29 @@ class ConnReader:
         return d, method, target, version, out[4] == 11
 
 
+    def try_parse_head(self, native_lib):
+        """Frame one request head from ALREADY-buffered bytes only —
+        the event loop's non-blocking probe (never touches the socket).
+
+        Returns ("head", head_tuple) on a complete head (consumed),
+        ("more", None) when more bytes are needed, or ("fallback",
+        None) when the Python parser must take this request (malformed
+        / oversized head; bytes stay buffered)."""
+        if not self.buffered:
+            return ("more", None)
+        self._ensure()
+        self._compact()
+        n = native_lib.mtpu_http_head(self._arr, self._end,
+                                      self._out, MAX_HEADERS)
+        if n > 0:
+            return ("head", self._build_head(int(n)))
+        if n != _INCOMPLETE:
+            return ("fallback", None)
+        if self._end == self._cap:
+            return ("fallback", None)      # head larger than the buffer
+        return ("more", None)
+
+
 class _Fallback(Exception):
     """Native framer declined this request; run the Python parser."""
 
@@ -317,3 +405,33 @@ def send_gathered(sock: socket.socket, bufs) -> int:
         e.mtpu_sent = done
         raise
     return total
+
+
+def send_nb(sock: socket.socket, bufs) -> tuple[int, list]:
+    """EAGAIN-aware gathered send on a NON-blocking socket: sendmsg
+    until done or the kernel buffer fills. Returns (bytes_sent,
+    remaining_views) — remaining empty when everything went out. Raises
+    (with .mtpu_sent progress) on a dead peer, like send_gathered."""
+    bufs = [b if isinstance(b, memoryview) else memoryview(b)
+            for b in bufs if len(b)]
+    done = 0
+    try:
+        while bufs:
+            try:
+                sent = sock.sendmsg(bufs)
+            except (BlockingIOError, InterruptedError):
+                return done, bufs
+            done += sent
+            skip = sent
+            rest = []
+            for b in bufs:
+                if skip >= len(b):
+                    skip -= len(b)
+                    continue
+                rest.append(b[skip:] if skip else b)
+                skip = 0
+            bufs = rest
+    except Exception as e:           # noqa: BLE001 - annotate progress
+        e.mtpu_sent = done
+        raise
+    return done, []

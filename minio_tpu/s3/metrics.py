@@ -38,6 +38,9 @@ class Metrics:
         self._conn_active = 0
         self._keepalive_reuses = 0
         self._parse_fallbacks = 0
+        # Response-path split (event-loop connection plane): sendfile
+        # short-circuit / pooled gathered sendmsg / legacy wfile.
+        self._response_path = {"sendfile": 0, "pooled": 0, "legacy": 0}
         self._start = time.time()
 
     def record(self, api: str, status: int, seconds: float,
@@ -74,11 +77,19 @@ class Metrics:
         with self._mu:
             self._parse_fallbacks += 1
 
+    def response_path(self, kind: str) -> None:
+        """One response served via `kind` (sendfile|pooled|legacy) —
+        stamped exactly once per response at its final write."""
+        with self._mu:
+            self._response_path[kind] = \
+                self._response_path.get(kind, 0) + 1
+
     def http_conn_stats(self) -> dict:
         with self._mu:
             return {"connections_active": self._conn_active,
                     "keepalive_reuses": self._keepalive_reuses,
-                    "parse_fallbacks": self._parse_fallbacks}
+                    "parse_fallbacks": self._parse_fallbacks,
+                    "response_path": dict(self._response_path)}
 
     def last_minute(self) -> dict:
         """Per-API last-minute summaries {api: {count,p50,p99,max}} —
@@ -103,6 +114,7 @@ class Metrics:
                 "conn_active": self._conn_active,
                 "keepalive_reuses": self._keepalive_reuses,
                 "parse_fallbacks": self._parse_fallbacks,
+                "response_path": dict(self._response_path),
             }
         out["latency_hist"] = {a: h.state() for a, h in hists.items()}
         out["last_minute"] = {a: lm.window() for a, lm in minutes.items()}
@@ -152,6 +164,7 @@ class Metrics:
             conn_active = self._conn_active
             keepalive_reuses = self._keepalive_reuses
             parse_fallbacks = self._parse_fallbacks
+            resp_path = dict(self._response_path)
             hists = {a: h.state() for a, h in self._latency_hist.items()}
             minutes = {a: lm.window()
                        for a, lm in self._last_minute.items()}
@@ -162,6 +175,7 @@ class Metrics:
             reqs, lat_sum, lat_count = {}, {}, {}
             rx = tx = 0
             conn_active = keepalive_reuses = parse_fallbacks = 0
+            resp_path = {}
             slow_total = 0
             hist_states: dict[str, list] = {}
             minute_states: dict[str, list] = {}
@@ -181,6 +195,8 @@ class Metrics:
                 conn_active += st.get("conn_active", 0)
                 keepalive_reuses += st.get("keepalive_reuses", 0)
                 parse_fallbacks += st.get("parse_fallbacks", 0)
+                for k, v in st.get("response_path", {}).items():
+                    resp_path[k] = resp_path.get(k, 0) + v
                 slow_total += st.get("slow_ops_total", 0)
             hists = {a: Histogram.merge(sts)
                      for a, sts in hist_states.items()}
@@ -210,6 +226,56 @@ class Metrics:
         metric("minio_tpu_http_parse_fallbacks_total",
                "Requests the native head framer declined to the Python "
                "parser", "counter", [({}, parse_fallbacks)])
+        metric("minio_tpu_http_response_path_total",
+               "Responses by final-write mechanism (sendfile "
+               "short-circuit / pooled gathered sendmsg / legacy "
+               "buffered writes)", "counter",
+               [({"path": k}, v) for k, v in sorted(resp_path.items())])
+        # Event-loop connection plane (s3/eventloop.py): parked vs
+        # active fds, fresh accepts vs keep-alive re-parks, shed and
+        # reaped connections, and the loop-lag histogram. Fleet-merged
+        # from every worker's control snapshot when available.
+        loop_stats = None
+        if peer_states:
+            peer_loops = [p.get("connections") for p in peer_states
+                          if isinstance(p.get("connections"), dict)]
+            if peer_loops:
+                loop_stats = merge_loop_stats(peer_loops)
+        if loop_stats is None and server is not None:
+            es = getattr(server, "eventloop_stats", None)
+            loop_stats = es() if es is not None else None
+        ls = loop_stats or {}
+        metric("minio_tpu_http_eventloop_enabled",
+               "1 when the epoll event-loop front end serves this "
+               "fleet, 0 under thread-per-connection", "gauge",
+               [({}, 1 if ls.get("enabled") else 0)])
+        metric("minio_tpu_http_parked_connections",
+               "Keep-alive connections parked in the epoll set "
+               "(no thread, hibernated recv buffer)", "gauge",
+               [({}, ls.get("parked", 0))])
+        metric("minio_tpu_http_dispatched_connections",
+               "Connections currently owned by an executor thread or "
+               "a loop-owned response-tail drain", "gauge",
+               [({}, ls.get("active", 0))])
+        metric("minio_tpu_http_conns_accepted_total",
+               "Fresh connections accepted by the event loop",
+               "counter", [({}, ls.get("accepted_total", 0))])
+        metric("minio_tpu_http_conns_shed_total",
+               "Connections shed at accept (connection-level "
+               "backpressure past MTPU_MAX_CONNS)", "counter",
+               [({}, ls.get("shed_total", 0))])
+        metric("minio_tpu_http_conn_reparks_total",
+               "Keep-alive turnarounds re-parked into the epoll set "
+               "instead of pinning a thread", "counter",
+               [({}, ls.get("reparks_total", 0))])
+        metric("minio_tpu_http_idle_reaped_total",
+               "Connections reaped by the idle deadline (includes "
+               "slowloris partial heads)", "counter",
+               [({}, ls.get("reaped_idle_total", 0))])
+        if ls.get("loop_lag"):
+            hist_metric("minio_tpu_http_loop_lag_seconds",
+                        "Event-loop tick service lag (ready events to "
+                        "handled)", [({}, ls["loop_lag"])])
         hist_metric("minio_tpu_api_request_duration_seconds",
                     "Bucketed request latency per API",
                     [({"api": a}, st) for a, st in sorted(hists.items())])
@@ -908,6 +974,45 @@ def probe_disks(object_layer) -> list:
     return out
 
 
+def _lag_summary(state: dict) -> dict:
+    """Approximate p50/p99 in milliseconds from a bucketed histogram
+    state (latency.percentile: upper bound of the quantile's bucket)."""
+    from minio_tpu.utils.latency import percentile
+    counts = state.get("counts", [])
+    total = state.get("count", 0)
+    return {
+        "count": total,
+        "mean_ms": round(1000.0 * state.get("sum", 0.0) / total, 3)
+        if total else 0.0,
+        "p50_ms": round(percentile(counts, total, 0.5) * 1000.0, 3),
+        "p99_ms": round(percentile(counts, total, 0.99) * 1000.0, 3),
+    }
+
+
+def merge_loop_stats(stats_list) -> dict:
+    """Fleet merge of per-worker EventLoopServer.stats() snapshots:
+    counters and gauges sum, max_conns sums (fleet capacity), the
+    loop-lag histograms merge."""
+    out = {"enabled": False, "parked": 0, "active": 0, "writing": 0,
+           "max_conns": 0, "accepted_total": 0, "shed_total": 0,
+           "reparks_total": 0, "reaped_idle_total": 0,
+           "dispatch_total": 0, "executor_threads": 0,
+           "executor_queue": 0}
+    lags = []
+    for st in stats_list:
+        if not isinstance(st, dict):
+            continue
+        out["enabled"] = out["enabled"] or bool(st.get("enabled"))
+        for k in list(out):
+            if k != "enabled":
+                out[k] += st.get(k, 0)
+        if st.get("loop_lag"):
+            lags.append(st["loop_lag"])
+    if lags:
+        out["loop_lag"] = Histogram.merge(lags)
+    return out
+
+
 def node_info(server) -> dict:
     """One node's admin-info summary (drives, usage, heal state) —
     served locally by the admin handler and remotely over the grid's
@@ -969,6 +1074,16 @@ def node_info(server) -> dict:
         # keep-alive reuse, native-parse fallbacks. Fleet-merged below
         # when the pre-forked control plane is up.
         info["http"] = m.http_conn_stats()
+    # Event-loop connection plane (s3/eventloop.py): parked/active fd
+    # gauges, accept/shed/re-park counters, loop-lag summary. Replaced
+    # by the fleet merge below in worker mode.
+    es = getattr(server, "eventloop_stats", None)
+    loop_st = es() if es is not None else None
+    if loop_st is not None:
+        lag = loop_st.pop("loop_lag", None)
+        if lag:
+            loop_st["loop_lag_ms"] = _lag_summary(lag)
+        info["connections"] = loop_st
     info["slow_ops"] = {"total": _tracing.slow_total,
                         "threshold_ms": _tracing.slow_ms(),
                         "recent": _tracing.slow_ops()[-20:]}
@@ -1030,7 +1145,9 @@ def node_info(server) -> dict:
                  if k in p}
                 for p in peers]
             http_tot = {"connections_active": 0, "keepalive_reuses": 0,
-                        "parse_fallbacks": 0}
+                        "parse_fallbacks": 0,
+                        "response_path": {"sendfile": 0, "pooled": 0,
+                                          "legacy": 0}}
             merged = False
             for p in peers:
                 st = p.get("metrics")
@@ -1042,8 +1159,19 @@ def node_info(server) -> dict:
                         st.get("keepalive_reuses", 0)
                     http_tot["parse_fallbacks"] += \
                         st.get("parse_fallbacks", 0)
+                    for k, v in st.get("response_path", {}).items():
+                        http_tot["response_path"][k] = \
+                            http_tot["response_path"].get(k, 0) + v
             if merged:
                 info["http"] = http_tot
+            peer_loops = [p.get("connections") for p in peers
+                          if isinstance(p.get("connections"), dict)]
+            if peer_loops:
+                fleet = merge_loop_stats(peer_loops)
+                lag = fleet.pop("loop_lag", None)
+                if lag:
+                    fleet["loop_lag_ms"] = _lag_summary(lag)
+                info["connections"] = fleet
         except Exception:  # noqa: BLE001 - control plane down; own view
             info["workers"] = [{"worker": getattr(server, "worker_id", 0),
                                 "pid": os.getpid(),

@@ -128,9 +128,21 @@ class S3Server:
         if reuse_port is None:
             reuse_port = os.environ.get("MTPU_REUSE_PORT", "") \
                 in ("1", "on", "true")
-        server_cls = _ReusePortHTTPServer if reuse_port \
-            else ThreadingHTTPServer
-        self.httpd = server_cls((host or "127.0.0.1", int(port)), handler)
+        # Event-loop connection plane (s3/eventloop.py): epoll accept/
+        # dispatch, idle connections parked fd-cheap, bounded executor.
+        # MTPU_HTTP_EVENTLOOP=off reverts wholesale to thread-per-
+        # connection (and non-Linux platforms take it automatically).
+        from minio_tpu.s3 import eventloop as eventloop_mod
+        if eventloop_mod.loop_enabled():
+            self.httpd = eventloop_mod.EventLoopServer(
+                (host or "127.0.0.1", int(port)), handler,
+                reuse_port=reuse_port,
+                keepalive_s=handler.loop_keepalive_s)
+        else:
+            server_cls = _ReusePortHTTPServer if reuse_port \
+                else ThreadingHTTPServer
+            self.httpd = server_cls((host or "127.0.0.1", int(port)),
+                                    handler)
         self.httpd.daemon_threads = True
         # Pre-forked worker identity (io/workers.py attaches these;
         # single-process mode is worker 0 of 1). cluster_stats, when
@@ -216,6 +228,12 @@ class S3Server:
         h, p = self.httpd.server_address[:2]
         return f"{h}:{p}"
 
+    def eventloop_stats(self):
+        """Connection-plane snapshot of the epoll front end, or None
+        under the thread-per-connection path (metrics/admin surface)."""
+        stats = getattr(self.httpd, "stats", None)
+        return stats() if stats is not None else None
+
     def start(self) -> None:
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True)
@@ -251,12 +269,10 @@ class S3Server:
             close()
 
 
-def _make_handler(server: S3Server):
-    # Native serve hot loop (s3/hotloop.py): request heads framed
-    # GIL-free out of a pooled per-connection recv buffer, kept hot
-    # across keep-alive requests. MTPU_HTTP_NATIVE=off (or a missing
-    # native lib) keeps the stock BaseHTTPRequestHandler parse path.
-    native_lib = hotloop.lib() if hotloop.native_enabled() else None
+def _keepalive_seconds():
+    """MTPU_HTTP_KEEPALIVE_S: idle keep-alive deadline, shared by the
+    thread path (settimeout around the head parse) and the event
+    loop's parked-connection reaper. None = no idle timeout."""
     try:
         keepalive_s = float(
             os.environ.get("MTPU_HTTP_KEEPALIVE_S", "") or 75.0)
@@ -265,11 +281,26 @@ def _make_handler(server: S3Server):
     if keepalive_s <= 0:
         # <= 0 means "no idle timeout" — settimeout(0) would flip the
         # socket non-blocking and drop every slow-arriving head.
-        keepalive_s = None
+        return None
+    return keepalive_s
+
+
+def _make_handler(server: S3Server):
+    # Native serve hot loop (s3/hotloop.py): request heads framed
+    # GIL-free out of a pooled per-connection recv buffer, kept hot
+    # across keep-alive requests. MTPU_HTTP_NATIVE=off (or a missing
+    # native lib) keeps the stock BaseHTTPRequestHandler parse path.
+    native_lib = hotloop.lib() if hotloop.native_enabled() else None
+    keepalive_s = _keepalive_seconds()
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
         server_version = "MinIO-TPU"
+        # Event-loop dispatcher hooks (s3/eventloop.py): the loop frames
+        # heads with the same native lib and enforces the same idle
+        # deadline the thread path applies via settimeout.
+        loop_native_lib = native_lib
+        loop_keepalive_s = keepalive_s
 
         # -- plumbing ---------------------------------------------------
 
@@ -284,6 +315,9 @@ def _make_handler(server: S3Server):
             self._body_reader = None
             self._defer_head = False
             self._deferred_head = None
+            # Set by the event-loop dispatcher (s3/eventloop.py _Conn);
+            # None under the thread-per-connection front end.
+            self._loop_conn = None
             if native_lib is not None:
                 # The pooled ConnReader replaces the per-connection
                 # BufferedReader for EVERY parser (the Python fallback
@@ -341,6 +375,14 @@ def _make_handler(server: S3Server):
             if head is None:                  # clean close between requests
                 self.close_connection = True
                 return
+            self._dispatch_head(head)
+
+        def _dispatch_head(self, head):
+            """Serve ONE natively-framed request head: shared by the
+            thread path above and the event-loop dispatcher
+            (s3/eventloop.py), which frames heads on the loop and hands
+            them here on an executor thread."""
+            self._h_lower = None
             d, method, target, version, http11 = head
             self.command = method
             self.path = target
@@ -402,13 +444,26 @@ def _make_handler(server: S3Server):
             self._defer_head = False
             return head or b""
 
-        def _send_bufs(self, bufs) -> None:
+        def _send_bufs(self, bufs, final: bool = False) -> None:
             """Gathered zero-copy write: one sendmsg for head + body
             views (pooled GET windows go to the wire as memoryviews,
             no Python-level joins). Falls back to wfile on platforms
-            without sendmsg."""
+            without sendmsg.
+
+            `final` marks a response's LAST write: under the event loop
+            a full socket buffer then hands the remainder to the loop's
+            EPOLLOUT drain (the executor thread goes back to the pool
+            instead of blocking on a slow reader); it also stamps the
+            per-response path-split counter exactly once."""
+            lc = self._loop_conn
+            if final and lc is not None:
+                self.server.offload_final(lc, bufs)
+                server.metrics.response_path("pooled")
+                return
             try:
                 hotloop.send_gathered(self.connection, bufs)
+                if final:
+                    server.metrics.response_path("pooled")
             except (AttributeError, NotImplementedError):
                 sent = 0
                 try:
@@ -416,9 +471,41 @@ def _make_handler(server: S3Server):
                         if len(b):
                             self.wfile.write(b)
                             sent += len(b)
+                    if final:
+                        server.metrics.response_path("legacy")
                 except Exception as e:  # noqa: BLE001 - annotate progress
                     e.mtpu_sent = sent
                     raise
+
+        def _sendfile_body(self, head: bytes, fd: int, offset: int,
+                           length: int) -> None:
+            """Whole-object zero-copy GET body: the header block goes
+            out via the gathered write, then the body moves file->socket
+            entirely in-kernel (os.sendfile) — no userspace byte, no
+            pooled window. Blocking-socket context only (the event
+            loop's executor and the thread path both hold the socket
+            blocking while a handler runs); the caller's finally owns
+            the fd."""
+            sent = 0
+            try:
+                self._send_bufs([head])
+                sfd = self.connection.fileno()
+                while sent < length:
+                    n = os.sendfile(sfd, fd, offset + sent,
+                                    min(length - sent, 1 << 24))
+                    if n == 0:          # truncated source: cut short
+                        break
+                    sent += n
+                self._sent_bytes = getattr(self, "_sent_bytes", 0) + sent
+            except OSError:
+                # Headers (a 200) may already be on the wire: all we
+                # can do is cut the connection so the client sees a
+                # truncated transfer, never a silently short body.
+                sent = -1
+            if sent == length:
+                server.metrics.response_path("sendfile")
+            else:
+                self.close_connection = True
 
         def _headers_lower(self) -> dict[str, str]:
             h = self.headers
@@ -618,11 +705,11 @@ def _make_handler(server: S3Server):
             self.end_headers()
             head = self._take_head()
             if body and self.command != "HEAD":
-                self._send_bufs([head, body])
+                self._send_bufs([head, body], final=True)
                 self._sent_bytes = getattr(self, "_sent_bytes", 0) \
                     + len(body)
             else:
-                self._send_bufs([head])
+                self._send_bufs([head], final=True)
 
         # Shed-path body drain cap: reading the remnant is cheap
         # network receive (the resource being protected is CPU/disk,
@@ -2562,6 +2649,7 @@ def _make_handler(server: S3Server):
             rng = h.get("range", "")
             spec = _range_spec(rng)
             chunks = None
+            send_fd = None
             if any(c in h for c in ("if-match", "if-none-match",
                                     "if-modified-since",
                                     "if-unmodified-since")):
@@ -2613,6 +2701,32 @@ def _make_handler(server: S3Server):
                         bucket, key, vid or info.version_id, spec, info)
                 else:
                     start, length = info.range_start, info.range_length
+                    # Whole-object plaintext sendfile short-circuit:
+                    # a tier-resident (FS-warm) version's stored bytes
+                    # live contiguously in one local file, so the body
+                    # can go socket-ward entirely in-kernel. Erasure-
+                    # resident objects never qualify (shard files are
+                    # bitrot-framed). The probe is gated on the tier
+                    # marker so the hot erasure GET path pays nothing.
+                    if spec is None and length \
+                            and imeta.get("x-internal-tier-name"):
+                        gof = getattr(server.object_layer,
+                                      "get_object_file", None)
+                        sf = None
+                        if gof is not None:
+                            # The stream's read lock is still held and
+                            # `info` is resolved for this exact version:
+                            # the probe skips a second quorum fan-out.
+                            try:
+                                sf = gof(bucket, key, GetOptions(
+                                    version_id=vid or info.version_id),
+                                    info=info)
+                            except Exception:  # noqa: BLE001 - fall back
+                                sf = None
+                        if sf is not None:
+                            chunks.close()
+                            chunks = None
+                            info, send_fd, start, length = sf
             if spec and info.size == 0 and spec[0] is None:
                 spec = None  # suffix range on empty object: plain 200 (AWS)
             headers = {
@@ -2650,7 +2764,10 @@ def _make_handler(server: S3Server):
                 self.end_headers()
                 head = self._take_head()
                 if method == "HEAD":
-                    return self._send_bufs([head])
+                    return self._send_bufs([head], final=True)
+                if send_fd is not None:
+                    return self._sendfile_body(head, send_fd, start,
+                                               length)
                 sent = 0
                 try:
                     # Gathered zero-copy streaming: the header block
@@ -2658,17 +2775,22 @@ def _make_handler(server: S3Server):
                     # a pooled-buffer memoryview straight from the
                     # engine's readahead (released when the generator
                     # advances) — no Python-level joins or re-buffering.
+                    # The LAST window is the response's final write:
+                    # under the event loop an EAGAIN remainder there is
+                    # handed to the loop instead of blocking the
+                    # executor on a slow reader.
                     for chunk in chunks:
+                        last = sent + len(chunk) >= length
                         if head is not None:
-                            self._send_bufs([head, chunk])
+                            self._send_bufs([head, chunk], final=last)
                             head = None
                         else:
-                            self._send_bufs([chunk])
+                            self._send_bufs([chunk], final=last)
                         sent += len(chunk)
                         self._sent_bytes = getattr(
                             self, "_sent_bytes", 0) + len(chunk)
                     if head is not None:      # zero-length body
-                        self._send_bufs([head])
+                        self._send_bufs([head], final=True)
                         head = None
                 except Exception as exc:  # noqa: BLE001 - headers may be sent
                     if head is not None and \
@@ -2691,6 +2813,8 @@ def _make_handler(server: S3Server):
             finally:
                 if chunks is not None:
                     chunks.close()
+                if send_fd is not None:
+                    os.close(send_fd)
 
         def _post_object(self, bucket, body, ctype):
             """Browser-form POST-policy upload (reference:

@@ -18,7 +18,7 @@ fi
 
 echo "== bench smoke (baseline: $latest) =="
 out=$(JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} \
-      MTPU_BENCH_ONLY=put_latency,put_concurrent,get_latency,get_concurrent,meta_listing,small_put,transform_put,distributed \
+      MTPU_BENCH_ONLY=put_latency,put_concurrent,get_latency,get_concurrent,meta_listing,small_put,transform_put,distributed,connections \
       MTPU_BENCH_SMALL=1 \
       python bench.py)
 echo "$out"
@@ -64,6 +64,13 @@ import sys
 # signal; ROADMAP item 3 charters ~>= 0.9, i.e. within ~1.1x of
 # plaintext). Skips via explicit null where the native transform
 # kernel is unavailable.
+# The connections gates watch the event-loop connection plane
+# (ROADMAP item 6): idle keep-alive RSS per connection ("lower" — the
+# parked-fd memory model must not regress back toward thread stacks)
+# and the served GET aggregate at the top of the client connection
+# ramp ("higher" — fan-in must not degrade the aggregate). Both emit
+# explicit nulls on fd-limited hosts (RLIMIT_NOFILE below the
+# connection target) and the gates skip cleanly there.
 # The distributed listing gate ("lower") watches the cluster listing
 # page: every measured page pays a real cross-node walk over the
 # remote walk_scan trimmed-summary stream through REAL spawned server
@@ -83,6 +90,8 @@ GATES = [
     ("transform_put_sse_gibps", "vs_plain", "higher"),
     ("transform_put_comp_gibps", "vs_plain", "higher"),
     ("distributed_list_page_p50_ms", "value", "lower"),
+    ("connections_idle_rss_per_conn_kib", "value", "lower"),
+    ("connections_get_ramp_gibps", "value", "higher"),
 ]
 
 

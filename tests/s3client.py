@@ -9,6 +9,8 @@ import hashlib
 import hmac
 import http.client
 import socket
+import threading
+import time
 import urllib.parse
 
 from minio_tpu.s3 import sigv4
@@ -271,3 +273,78 @@ class S3Client:
         qs = urllib.parse.urlencode(
             [(k, v) for k, vs in query.items() for v in vs])
         return sigv4.uri_encode(path, encode_slash=False) + "?" + qs
+
+
+def ramp_get(address: str, path: str, body_len: int, connections: int,
+             duration_s: float = 2.0, access_key: str = "minioadmin",
+             secret_key: str = "minioadmin",
+             region: str = "us-east-1") -> dict:
+    """Multi-connection GET fan-in driver: `connections` client threads,
+    each with its OWN persistent raw socket (S3Client.get_into — signed
+    head out, recv_into straight into a reusable buffer), all released
+    together and looping the same object for `duration_s`. This is the
+    measurement r10 could not make: the served GET aggregate against a
+    growing client connection count, instead of one hot socket whose
+    single client thread was the bottleneck. Returns {connections, ops,
+    bytes, secs, agg_gibps, errors}; the aggregate counts only
+    responses that completed inside the window."""
+    results: list = [None] * connections
+    deadline_box = [0.0]
+    # The barrier action runs in exactly one thread at the release
+    # moment, so every worker reads a deadline anchored to the instant
+    # the whole ramp went hot — not to when the driver started priming.
+    barrier = threading.Barrier(
+        connections + 1, action=lambda: deadline_box.__setitem__(
+            0, time.monotonic() + duration_s))
+
+    def worker(t: int) -> None:
+        cli = S3Client(address, access_key=access_key,
+                       secret_key=secret_key, region=region)
+        buf = bytearray(body_len)
+        ops = got = errs = 0
+        primed = False
+        try:
+            # Prime the connection OUTSIDE the measured window (TCP +
+            # first-request warmup is setup, not serving).
+            st, n = cli.get_into(path, buf)
+            assert st == 200 and n == body_len, (st, n)
+            primed = True
+            barrier.wait()
+            deadline = deadline_box[0]
+            while time.monotonic() < deadline:
+                try:
+                    st, n = cli.get_into(path, buf)
+                except OSError:
+                    errs += 1
+                    continue
+                if st == 200 and n == body_len:
+                    ops += 1
+                    got += n
+                else:
+                    errs += 1
+        except Exception:  # noqa: BLE001 - surface via the error count
+            errs += 1
+            if not primed:
+                try:
+                    barrier.wait(timeout=60)
+                except threading.BrokenBarrierError:
+                    pass
+        finally:
+            results[t] = (ops, got, errs)
+            cli.close()
+
+    threads = [threading.Thread(target=worker, args=(t,), daemon=True)
+               for t in range(connections)]
+    for th in threads:
+        th.start()
+    barrier.wait()
+    t0 = time.monotonic()
+    for th in threads:
+        th.join(timeout=duration_s + 120)
+    secs = max(time.monotonic() - t0, 1e-9)
+    ops = sum(r[0] for r in results if r)
+    nbytes = sum(r[1] for r in results if r)
+    errors = sum(r[2] for r in results if r)
+    return {"connections": connections, "ops": ops, "bytes": nbytes,
+            "secs": round(secs, 3), "errors": errors,
+            "agg_gibps": round(nbytes / secs / (1 << 30), 4)}
