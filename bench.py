@@ -161,6 +161,8 @@ def main() -> None:
             _distributed()
         if _want("connections"):
             _connections()
+        if _want("rebalance"):
+            _rebalance()
         return
 
     import jax
@@ -286,6 +288,10 @@ def main() -> None:
     # ---- 12. Connection plane: idle fd cost + GET fan-in ramp ---------
     if _want("connections"):
         _connections()
+
+    # ---- 13. Elastic fleet: foreground SLO under an online drain ------
+    if _want("rebalance"):
+        _rebalance()
 
 
 def _put_latency() -> None:
@@ -2166,6 +2172,180 @@ def _distributed_inner() -> None:
         "vs_single_node": round(multi["list_p50_ms"]
                                 / max(single["list_p50_ms"], 1e-9), 3),
     }))
+
+
+def _rebalance() -> None:
+    """Elastic fleet (ROADMAP item 3): foreground PUT/GET latency
+    while a pool drains CONCURRENTLY vs the same ops on a quiescent
+    layer, measured in one run — vs_quiescent (during p50 / quiescent
+    p50) is the stable cross-host signal. Then the safety sweep: after
+    the drain, every object (seeded + written mid-drain) must read
+    back byte-identical and list exactly once (rebalance_identity
+    1.0). A second, pressure-wired drain records that the migration
+    governor actually yields under foreground saturation. Emits
+    explicit nulls when the fixture cannot build (gate skips)."""
+    try:
+        _rebalance_inner()
+    except (OSError, MemoryError) as e:
+        print(json.dumps({"metric": "rebalance_fg_p50_during_ms",
+                          "value": None, "unit": "ms",
+                          "skipped": f"fixture failed: {e}"}))
+        print(json.dumps({"metric": "rebalance_identity",
+                          "value": None, "unit": "fraction",
+                          "skipped": f"fixture failed: {e}"}))
+
+
+def _rebalance_inner() -> None:
+    import shutil
+    import tempfile
+    import threading
+
+    from minio_tpu.object.erasure_object import ErasureSet
+    from minio_tpu.object.pools import ServerPools
+    from minio_tpu.object.sets import ErasureSets
+    from minio_tpu.storage.local import LocalStorage
+
+    dep = "00000000-0000-0000-0000-00000000be4c"
+    rng = np.random.default_rng(7)
+    base = rng.integers(0, 256, size=64 << 10, dtype=np.uint8).tobytes()
+
+    def body_for(tag: str) -> bytes:
+        return base[:-16] + tag.encode().ljust(16, b".")[:16]
+
+    n_seed = 280 if _SMALL else 900
+    fg_puts = 30 if _SMALL else 90
+    fg_gets = 60 if _SMALL else 180
+
+    def mklayer(root):
+        pools = []
+        for p in ("p0", "p1"):
+            disks = [LocalStorage(f"{root}/{p}/d{i}") for i in range(4)]
+            pools.append(ErasureSets([ErasureSet(disks)],
+                                     deployment_id=dep))
+        lay = ServerPools(pools)
+        lay.make_bucket("bench")
+        return lay
+
+    def pctl(times: list, q: float) -> float:
+        s = sorted(times)
+        return round(s[min(len(s) - 1, int(len(s) * q))] * 1e3, 2)
+
+    root = tempfile.mkdtemp(prefix="bench-rebal-")
+    try:
+        lay = mklayer(root)
+        everything = {}
+        for i in range(n_seed):
+            k = f"s-{i:04d}"
+            b = body_for(k)
+            lay.pools[0].put_object("bench", k, b)
+            everything[k] = b
+        seeded = sorted(everything)
+
+        def fg_round(tag: str) -> dict:
+            put_t, get_t = [], []
+            for i in range(fg_puts):
+                k = f"fg-{tag}-{i:03d}"
+                b = body_for(k)
+                t0 = time.perf_counter()
+                lay.put_object("bench", k, b)
+                put_t.append(time.perf_counter() - t0)
+                everything[k] = b
+            for i in range(fg_gets):
+                k = seeded[(i * 37) % len(seeded)]
+                t0 = time.perf_counter()
+                _, got = lay.get_object("bench", k)
+                get_t.append(time.perf_counter() - t0)
+                if got != everything[k]:
+                    raise AssertionError(f"wrong bytes mid-drain: {k}")
+            return {"put_p50_ms": pctl(put_t, 0.50),
+                    "put_p99_ms": pctl(put_t, 0.99),
+                    "get_p50_ms": pctl(get_t, 0.50),
+                    "get_p99_ms": pctl(get_t, 0.99)}
+
+        # Warmup: first reads pay one-time lazy init (caches, list
+        # pool) that would land in the quiescent p99 as a fake outlier.
+        for i in range(8):
+            lay.get_object("bench", seeded[i])
+        lay.put_object("bench", "warm", base)
+        everything["warm"] = base
+        quiet = fg_round("q")
+        t0 = time.perf_counter()
+        d = lay.start_decommission(0, checkpoint_every=64)
+        during = fg_round("d")
+        overlap = not d.wait(timeout=0)   # drain outlived the round?
+        if not d.wait(300):
+            raise AssertionError("drain never completed")
+        drain_secs = time.perf_counter() - t0
+        st = lay.decommission_status()
+        if st["status"] != "complete" or st["failed"]:
+            raise AssertionError(f"drain failed: {st}")
+
+        # Byte-identity sweep + single-visibility over EVERYTHING.
+        mismatches = 0
+        for k, b in everything.items():
+            _, got = lay.get_object("bench", k)
+            if got != b:
+                mismatches += 1
+        names = []
+        marker = ""
+        while True:
+            page = lay.list_objects("bench", marker=marker,
+                                    max_keys=1000, include_versions=True)
+            names.extend(o.name for o in page.objects)
+            if not page.is_truncated:
+                break
+            marker = page.next_marker
+        if len(names) != len(set(names)) or \
+                set(names) != set(everything):
+            mismatches += 1
+        lay.close()
+
+        # Governor-yield probe: a fresh drain wired to a saturation
+        # signal must pause (yields > 0) while the foreground is busy.
+        _os.environ["MTPU_REBALANCE_YIELD_MS"] = "2"
+        try:
+            lay2 = mklayer(f"{root}/sat")
+            for i in range(40):
+                lay2.pools[0].put_object("bench", f"y-{i:03d}",
+                                         body_for(f"y-{i:03d}"))
+            busy = threading.Event()
+            busy.set()
+            lay2.migration_pressure = busy.is_set
+            d2 = lay2.start_decommission(0)
+            deadline = time.time() + 10
+            while d2.state["yields"] < 1 and time.time() < deadline:
+                lay2.put_object("bench", "hot", base)   # saturating fg
+            yields = int(d2.state.get("yields", 0))
+            busy.clear()
+            if not d2.wait(120):
+                raise AssertionError("pressure-wired drain never finished")
+            lay2.close()
+        finally:
+            _os.environ.pop("MTPU_REBALANCE_YIELD_MS", None)
+
+        total = len(everything)
+        print(json.dumps({
+            "metric": "rebalance_fg_p50_during_ms",
+            "value": during["put_p50_ms"],
+            "unit": "ms",
+            "vs_quiescent": round(during["put_p50_ms"]
+                                  / max(quiet["put_p50_ms"], 1e-6), 3),
+            "quiescent": quiet, "during": during,
+            "drain_overlapped_measurement": overlap,
+            "drain_secs": round(drain_secs, 3),
+            "migrated": st.get("migrated", 0),
+            "bytes_moved": st.get("bytes_moved", 0),
+            "seeded_objects": n_seed, "object_bytes": len(base),
+        }))
+        print(json.dumps({
+            "metric": "rebalance_identity",
+            "value": round((total - mismatches) / total, 4),
+            "unit": "fraction",
+            "objects": total, "mismatches": mismatches,
+            "yields_under_saturation": yields,
+        }))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
 
 
 if __name__ == "__main__":

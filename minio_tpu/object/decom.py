@@ -28,9 +28,10 @@ from __future__ import annotations
 import json
 import threading
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 from minio_tpu.storage.local import SYS_VOL
+from minio_tpu.utils.env import env_float, env_int
 
 DECOM_PATH = "config/decom.json"
 CHECKPOINT_EVERY = 16          # objects between checkpoint persists
@@ -38,6 +39,65 @@ CHECKPOINT_EVERY = 16          # objects between checkpoint persists
 
 class DecomError(Exception):
     pass
+
+
+class LeaseHeld(DecomError):
+    """Another node holds the migration coordinator lease: the drain
+    (or rebalance) is already being driven from there."""
+
+
+class MigrationGovernor:
+    """Admission integration for migration traffic: the drain/rebalance
+    walk is a BACKGROUND class that yields to foreground SLOs.
+
+    gate() blocks while the front end is visibly queueing (the same
+    pressure signal drive_heal's bulk heal sheds on — see
+    drive_heal.admission_pressure wired via layer.migration_pressure),
+    counting each pause into state["yields"]. Knobs:
+
+      MTPU_REBALANCE_WORKERS   concurrent migrate workers per pool walk
+                               (default 1: strictly ordered)
+      MTPU_REBALANCE_YIELD_MS  pressure poll interval while yielded,
+                               and the fixed pacing delay per key when
+                               > 0 and no pressure (default 50 / 0)
+    """
+
+    def __init__(self, layer, state: dict, stop: threading.Event):
+        self.pressure: Optional[Callable[[], bool]] = \
+            getattr(layer, "migration_pressure", None)
+        self.poll_s = max(1.0, env_float("MTPU_REBALANCE_YIELD_MS",
+                                         50.0)) / 1000.0
+        self.pace_s = env_float("MTPU_REBALANCE_PACE_MS", 0.0) / 1000.0
+        self.workers = max(1, env_int("MTPU_REBALANCE_WORKERS", 1))
+        self.state = state
+        self._stop = stop
+        self._mu = threading.Lock()
+
+    def count(self, key: str, by: int = 1) -> None:
+        """Thread-safe state counter bump (workers > 1 share state)."""
+        self.add(self.state, key, by)
+
+    def add(self, rec: dict, key: str, by: int = 1) -> None:
+        """Same, for a caller-chosen record (rebalance keeps per-pool
+        records inside its state doc)."""
+        with self._mu:
+            rec[key] = rec.get(key, 0) + by
+
+    def gate(self) -> bool:
+        """Pause while foreground clients queue; False = stop fired
+        (the caller checkpoints and returns)."""
+        p = self.pressure
+        yielded = False
+        while p is not None and p():
+            if self._stop.is_set():
+                return False
+            if not yielded:
+                yielded = True
+                self.count("yields")
+            time.sleep(self.poll_s)
+        if self.pace_s > 0:
+            time.sleep(self.pace_s)
+        return not self._stop.is_set()
 
 
 def pool_signature(pool) -> str:
@@ -155,10 +215,27 @@ def _save_state(pools_layer, state: dict) -> None:
     _write_doc(pools_layer, doc, state["pool"], scrub=True)
 
 
+def coordinator_lease(layer, name: str):
+    """dsync write lease electing THE single fleet-wide coordinator
+    for a migration (`decom` / `rebalance`). Returns None when the
+    layer has no lockers (single-node deployments need no election).
+
+    The lease auto-refreshes while held; a SIGKILLed coordinator stops
+    refreshing and the LockServer TTL (MTPU_GRID_LOCK_TTL) expires its
+    entry, after which any surviving node's elastic janitor wins the
+    lock and resumes the walk from the persisted checkpoint."""
+    lockers = getattr(layer, "lockers", None)
+    if not lockers:
+        return None
+    from minio_tpu.grid.dsync import DRWMutex
+    return DRWMutex(lockers, f"{SYS_VOL}/elastic/{name}-coordinator")
+
+
 def migrate_key(layer, src_idx: int, bucket: str, key: str,
-                pick_dst) -> None:
+                pick_dst) -> int:
     """Move one key's whole version stack out of pool `src_idx` — the
     transfer primitive shared by decommission and rebalance.
+    Returns the number of data bytes restored into the destination.
 
     Shape: snapshot → restore (no locks held across sets — in
     distributed mode src and dst share the cluster-wide per-key
@@ -190,10 +267,11 @@ def migrate_key(layer, src_idx: int, bucket: str, key: str,
         dst_idx = pick_dst()
     dst_set = layer.pools[dst_idx].set_for(key)
     for _attempt in range(5):
+        moved = 0
         try:
             versions = src_set.list_versions_all(bucket, key)
         except ObjectNotFound:
-            return                  # deleted mid-walk: nothing to do
+            return 0                # deleted mid-walk: nothing to do
         from minio_tpu.object.tier import META_TIER
         for fi in sorted(versions, key=lambda f: -f.mod_time):
             data = None
@@ -214,6 +292,18 @@ def migrate_key(layer, src_idx: int, bucket: str, key: str,
             # key lock so the decision and the write are atomic.
             dst_set.restore_version(bucket, key, fi, data,
                                     skip_if_newer_null=True)
+            if data is not None:
+                moved += len(data)
+        # Cross-node coherence: peers may hold a cached GET/HEAD
+        # (fi_cache) or listing page resolved against the SOURCE copy.
+        # Bump the bucket generation — broadcast-acked in distributed
+        # mode — BEFORE any source copy is destroyed, so no node keeps
+        # serving the migrated-away copy from cache after the cleanup
+        # below lands (a re-fill in the gap resolves destination-first
+        # and is already correct).
+        mc = getattr(src_set, "metacache", None)
+        if mc is not None:
+            mc.bump(bucket)
         with src_set.ns.write(bucket, key):
             try:
                 cur = src_set.list_versions_all(bucket, key)
@@ -241,7 +331,7 @@ def migrate_key(layer, src_idx: int, bucket: str, key: str,
                             versioned=False))
                 except (ObjectNotFound, VersionNotFound):
                     pass
-            return
+            return moved
     raise DecomError(f"{bucket}/{key}: version stack kept changing")
 
 
@@ -269,9 +359,16 @@ class Decommission:
             "started_ns": time.time_ns(),
             "bucket": "", "marker": "",        # resume checkpoint
             "migrated": 0, "failed": 0,
+            "bytes_moved": 0, "yields": 0,
         }
+        # Resumed checkpoints written by older servers lack the newer
+        # counters; the governor and metrics read them unconditionally.
+        self.state.setdefault("bytes_moved", 0)
+        self.state.setdefault("yields", 0)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._gov = MigrationGovernor(pools_layer, self.state, self._stop)
+        self._lease = None
         # The decom document, loaded once: checkpoints must not pay a
         # cluster-wide read + scrub every few objects on the hot path.
         self._doc: Optional[dict] = None
@@ -296,13 +393,41 @@ class Decommission:
         cluster (the doc is only mutated by the single active drain)."""
         if self._doc is None:
             self._doc = load_doc(self.layer)
+        self.state["checkpoint_ns"] = time.time_ns()
         self._doc["records"][self.state["pool_sig"]] = self.state
         self._doc["rev"] = self._doc.get("rev", 0) + 1
         _write_doc(self.layer, self._doc, self.pool_idx, scrub=scrub)
 
+    def _acquire_lease(self) -> None:
+        """Exactly ONE node drives a drain at a time: losing quorum on
+        the lease mid-walk pauses this driver (checkpoint persists,
+        status stays 'draining') so whichever node re-wins the lease
+        resumes without two walkers racing the same keys."""
+        lease = coordinator_lease(self.layer, "decom")
+        if lease is not None:
+            lease.on_lost = self._stop.set
+            if not lease.lock(write=True, timeout=5.0):
+                raise LeaseHeld(
+                    "decommission coordinator lease held by another node")
+        self._lease = lease
+
+    def _release_lease(self) -> None:
+        lease, self._lease = self._lease, None
+        if lease is not None:
+            try:
+                lease.unlock()
+            except Exception:  # noqa: BLE001 - lease may be lost already
+                pass
+
     def start(self) -> None:
+        self._acquire_lease()
+        self.state.pop("paused", None)
         self.layer.decommissioning.add(self.pool_idx)
-        self._persist(scrub=True)
+        try:
+            self._persist(scrub=True)
+        except DecomError:
+            self._release_lease()
+            raise
         self._notify_peers()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"decom-pool{self.pool_idx}")
@@ -316,7 +441,11 @@ class Decommission:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=30)
+        self._release_lease()
         if self.state.get("status") == "draining":
+            # Mark the pause EXPLICIT: the elastic janitor auto-resumes
+            # crashed walks (which never set this), not operator stops.
+            self.state["paused"] = True
             try:
                 self._persist()
             except DecomError:
@@ -340,49 +469,87 @@ class Decommission:
                 self._persist()
             except DecomError:
                 pass
+        finally:
+            self._release_lease()
+
+    def _do_key(self, bucket: str, key: str) -> None:
+        """Gate on foreground pressure, then migrate one key and
+        account it. Shared by the serial and parallel page paths."""
+        gov = self._gov
+        if not gov.gate():
+            return
+        try:
+            moved = self._migrate_key(None, bucket, key)
+            gov.count("migrated")
+            gov.count("bytes_moved", int(moved or 0))
+        except Exception as e:  # noqa: BLE001 - keep going
+            gov.count("failed")
+            self.state["last_error"] = f"{bucket}/{key}: {e}"
 
     def _drain(self) -> None:
+        from concurrent.futures import ThreadPoolExecutor
         src = self.layer.pools[self.pool_idx]
+        gov = self._gov
         since_ckpt = 0
-        buckets = sorted(b.name for b in src.list_buckets())
-        # Resume: skip buckets already fully drained.
-        start_bucket = self.state.get("bucket", "")
-        for bucket in buckets:
-            if bucket < start_bucket:
-                continue
-            marker = self.state.get("marker", "") \
-                if bucket == start_bucket else ""
-            while not self._stop.is_set():
-                page = src.list_objects(bucket, marker=marker,
-                                        max_keys=256,
-                                        include_versions=True)
-                keys = sorted({o.name for o in page.objects})
-                for key in keys:
+        pool = ThreadPoolExecutor(
+            max_workers=gov.workers,
+            thread_name_prefix=f"decom{self.pool_idx}-mig") \
+            if gov.workers > 1 else None
+        try:
+            buckets = sorted(b.name for b in src.list_buckets())
+            # Resume: skip buckets already fully drained.
+            start_bucket = self.state.get("bucket", "")
+            for bucket in buckets:
+                if bucket < start_bucket:
+                    continue
+                marker = self.state.get("marker", "") \
+                    if bucket == start_bucket else ""
+                while not self._stop.is_set():
+                    page = src.list_objects(bucket, marker=marker,
+                                            max_keys=256,
+                                            include_versions=True)
+                    keys = sorted({o.name for o in page.objects})
+                    if pool is not None:
+                        # Page-barrier parallel migration: the marker
+                        # only ever advances past a FULLY completed
+                        # page, so a crash re-walks at most one page
+                        # (migrate_key is idempotent over re-walks).
+                        list(pool.map(
+                            lambda k: self._do_key(bucket, k), keys))
+                        if keys and not self._stop.is_set():
+                            self.state["bucket"] = bucket
+                            self.state["marker"] = keys[-1]
+                            since_ckpt += len(keys)
+                    else:
+                        for key in keys:
+                            if self._stop.is_set():
+                                return
+                            self._do_key(bucket, key)
+                            # Track progress after every key (a clean
+                            # stop() persists it exactly); hit the
+                            # drives only every checkpoint_every keys.
+                            self.state["bucket"] = bucket
+                            self.state["marker"] = key
+                            since_ckpt += 1
+                            if since_ckpt >= self.checkpoint_every:
+                                since_ckpt = 0
+                                self._persist()
                     if self._stop.is_set():
                         return
-                    try:
-                        self._migrate_key(src, bucket, key)
-                        self.state["migrated"] += 1
-                    except Exception as e:  # noqa: BLE001 - keep going
-                        self.state["failed"] += 1
-                        self.state["last_error"] = f"{bucket}/{key}: {e}"
-                    # Track progress after every key (a clean stop()
-                    # persists it exactly); hit the drives only every
-                    # checkpoint_every keys.
-                    self.state["bucket"] = bucket
-                    self.state["marker"] = key
-                    since_ckpt += 1
                     if since_ckpt >= self.checkpoint_every:
                         since_ckpt = 0
                         self._persist()
-                if not page.is_truncated:
-                    break
-                marker = page.next_marker
-            if self._stop.is_set():
-                return
-            self.state["bucket"] = bucket
-            self.state["marker"] = ""
-            self._persist()
+                    if not page.is_truncated:
+                        break
+                    marker = page.next_marker
+                if self._stop.is_set():
+                    return
+                self.state["bucket"] = bucket
+                self.state["marker"] = ""
+                self._persist()
+        finally:
+            if pool is not None:
+                pool.shutdown(wait=True)
         if self.state["failed"]:
             self.state["status"] = "failed"
         else:
@@ -391,8 +558,9 @@ class Decommission:
         self._persist()
         self._notify_peers()
 
-    def _migrate_key(self, src_pool, bucket: str, key: str) -> None:
-        migrate_key(self.layer, self.pool_idx, bucket, key, self._dst_idx)
+    def _migrate_key(self, src_pool, bucket: str, key: str) -> int:
+        return migrate_key(self.layer, self.pool_idx, bucket, key,
+                           self._dst_idx)
 
     def _dst_idx(self) -> int:
         """Surviving pool with the most free space (the reference picks

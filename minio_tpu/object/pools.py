@@ -47,6 +47,13 @@ class ServerPools:
         # Peer fan-out hook fired on drain status transitions so other
         # nodes re-sync their exclusion sets (grid.peers).
         self.on_decom_change = None
+        # Distributed wiring (server.py): dsync lockers electing the
+        # single migration coordinator (empty = single-node, no
+        # election), and the foreground admission-pressure probe that
+        # migration walks yield to (decom.MigrationGovernor).
+        self.lockers: list = []
+        self.migration_pressure = None
+        self._janitor = None           # (thread, stop_event) when running
 
     # -- placement -----------------------------------------------------
 
@@ -303,16 +310,28 @@ class ServerPools:
                 rec.update(bucket="", marker="", failed=0)
             rec["status"] = "draining"
             rec["pool"] = idx
-            self._decom = decom.Decommission(self, idx, state=rec)
-            self._decom.start()
+            d = decom.Decommission(self, idx, state=rec)
+            try:
+                d.start()
+            except decom.LeaseHeld:
+                # Another node already drives this drain; our markers
+                # are synced, which is all this node needs.
+                return None
+            self._decom = d
             return self._decom
         return None
 
     def decommission_status(self):
+        """Drain progress — served from ANY node: a live local driver
+        answers directly, everyone else reads the coordinator's
+        persisted (rev-voted, cluster-readable) checkpoint."""
         from minio_tpu.object import decom
-        if self._decom is not None:
-            return dict(self._decom.state)
+        d = self._decom
+        if d is not None and not d.wait(timeout=0):
+            return dict(d.state)
         state = decom.load_state(self)
+        if state is None and d is not None:
+            return dict(d.state)
         return dict(state) if state else None
 
     def cancel_decommission(self):
@@ -363,25 +382,38 @@ class ServerPools:
                 len(state.get("pools", {})) != len(self.pools):
             state = None
         with self._rebalance_lock():
-            self._rebalance = rebalance.Rebalance(self, state=state)
-            self._rebalance.start()
+            rb = rebalance.Rebalance(self, state=state)
+            try:
+                rb.start()
+            except rebalance.LeaseHeld:
+                # Another node already drives this rebalance.
+                return None
+            self._rebalance = rb
             return self._rebalance
 
-    def rebalance_status(self):
+    def _rebalance_state_copy(self, rb):
         import json as _json
+        # Deep copy: the worker mutates nested per-pool dicts
+        # concurrently, and a shallow copy could change size under
+        # the admin handler's JSON serializer.
+        for _ in range(3):
+            try:
+                return _json.loads(_json.dumps(rb.state))
+            except RuntimeError:
+                continue
+        return {"status": rb.state.get("status", "rebalancing")}
+
+    def rebalance_status(self):
+        """Rebalance progress — served from ANY node (same shape as
+        decommission_status: live driver first, else the persisted
+        rev-voted checkpoint any node can read)."""
         from minio_tpu.object import rebalance
         rb = getattr(self, "_rebalance", None)
-        if rb is not None:
-            # Deep copy: the worker mutates nested per-pool dicts
-            # concurrently, and a shallow copy could change size under
-            # the admin handler's JSON serializer.
-            for _ in range(3):
-                try:
-                    return _json.loads(_json.dumps(rb.state))
-                except RuntimeError:
-                    continue
-            return {"status": rb.state.get("status", "rebalancing")}
+        if rb is not None and not rb.wait(timeout=0):
+            return self._rebalance_state_copy(rb)
         state = rebalance.load_state(self)
+        if state is None and rb is not None:
+            return self._rebalance_state_copy(rb)
         return dict(state) if state else None
 
     def stop_rebalance(self):
@@ -389,6 +421,64 @@ class ServerPools:
         rb = getattr(self, "_rebalance", None)
         if rb is not None:
             rb.stop()
+
+    # -- elastic janitor ----------------------------------------------
+
+    def elastic_janitor_tick(self) -> list[str]:
+        """One orphan-recovery pass: if the persisted decom/rebalance
+        checkpoint says a walk is mid-flight but no LOCAL driver is
+        alive, try to win the coordinator lease and resume it. On the
+        node that lost its coordinator this is how the fleet heals — a
+        SIGKILLed coordinator's lease expires after MTPU_GRID_LOCK_TTL
+        and the next tick on any surviving node picks the walk up from
+        the checkpoint. Explicit operator stops set state["paused"]
+        and are never auto-resumed. Returns the walks resumed here."""
+        from minio_tpu.object import decom, rebalance
+        resumed = []
+        d = self._decom
+        if d is None or d.wait(timeout=0):
+            st = decom.load_state(self)
+            if st and st.get("status") == "draining" \
+                    and not st.get("paused") \
+                    and self.resume_decommission() is not None:
+                resumed.append("decom")
+        rb = getattr(self, "_rebalance", None)
+        if rb is None or rb.wait(timeout=0):
+            st = rebalance.load_state(self)
+            if st and st.get("status") == "rebalancing" \
+                    and not st.get("paused") \
+                    and self.resume_rebalance() is not None:
+                resumed.append("rebalance")
+        return resumed
+
+    def start_elastic_janitor(self, interval: Optional[float] = None):
+        """Run the janitor on EVERY node (distributed boots): ticks
+        every MTPU_ELASTIC_JANITOR_S seconds (default 10); the lease
+        keeps at most one node actually driving."""
+        import threading
+        from minio_tpu.utils.env import env_float
+        if self._janitor is not None:
+            return
+        if interval is None:
+            interval = env_float("MTPU_ELASTIC_JANITOR_S", 10.0)
+        stop = threading.Event()
+
+        def run():
+            while not stop.wait(interval):
+                try:
+                    self.elastic_janitor_tick()
+                except Exception:  # noqa: BLE001 - next tick retries
+                    pass
+
+        t = threading.Thread(target=run, daemon=True,
+                             name="elastic-janitor")
+        self._janitor = (t, stop)
+        t.start()
+
+    def stop_elastic_janitor(self) -> None:
+        if self._janitor is not None:
+            self._janitor[1].set()
+            self._janitor = None
 
     # -- multipart -----------------------------------------------------
 
@@ -444,9 +534,13 @@ class ServerPools:
                      include_versions: bool = False) -> ListObjectsInfo:
         pages = []
         found = False
-        for p in self.pools:
+        # Pool SEARCH order (draining pools last): during a migration
+        # the same key/version may exist in both source and destination
+        # for a moment, and merge_list_pages keeps the FIRST copy seen
+        # — the destination's, matching what reads resolve.
+        for i in self._pool_order():
             try:
-                pages.append(p.list_objects(
+                pages.append(self.pools[i].list_objects(
                     bucket, prefix=prefix, marker=marker, delimiter=delimiter,
                     max_keys=max_keys, include_versions=include_versions))
                 found = True
@@ -454,7 +548,8 @@ class ServerPools:
                 continue
         if not found:
             raise BucketNotFound(bucket)
-        return merge_list_pages(pages, max_keys)
+        return merge_list_pages(pages, max_keys,
+                                versioned=include_versions)
 
     # -- healing -------------------------------------------------------
 

@@ -35,8 +35,12 @@ import threading
 import time
 from typing import Optional
 
-from minio_tpu.object.decom import migrate_key
+from minio_tpu.object.decom import (LeaseHeld, MigrationGovernor,
+                                    coordinator_lease, migrate_key)
 from minio_tpu.storage.local import SYS_VOL
+
+__all__ = ["Rebalance", "RebalanceError", "LeaseHeld", "load_state",
+           "bucket_used_bytes", "pool_usage"]
 
 REBAL_PATH = "config/rebalance.json"
 CHECKPOINT_EVERY = 16
@@ -120,12 +124,15 @@ class Rebalance:
         self.threshold = threshold
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._lease = None
         # Planning walks every pool's namespace for usage accounting —
         # that happens in the background worker, NOT here: the admin
         # start handler must return immediately on large clusters.
         self.state = state or {"status": "planning",
                                "started_ns": time.time_ns(),
-                               "pools": {}, "rev": 0}
+                               "pools": {}, "rev": 0, "yields": 0}
+        self.state.setdefault("yields", 0)
+        self._gov = MigrationGovernor(pools_layer, self.state, self._stop)
 
     # -- planning -------------------------------------------------------
 
@@ -157,6 +164,7 @@ class Rebalance:
 
     def _persist(self) -> None:
         self.state["rev"] = self.state.get("rev", 0) + 1
+        self.state["checkpoint_ns"] = time.time_ns()
         blob = json.dumps(self.state, sort_keys=True).encode()
         disks = [d for s in self.layer.pools[0].sets for d in s.disks]
         ok = 0
@@ -171,8 +179,34 @@ class Rebalance:
 
     # -- control --------------------------------------------------------
 
+    def _acquire_lease(self) -> None:
+        """One coordinator fleet-wide: see decom.coordinator_lease.
+        Quorum loss mid-run pauses this driver (checkpoint persists,
+        status stays 'rebalancing') so the next lease winner resumes."""
+        lease = coordinator_lease(self.layer, "rebalance")
+        if lease is not None:
+            lease.on_lost = self._stop.set
+            if not lease.lock(write=True, timeout=5.0):
+                raise LeaseHeld(
+                    "rebalance coordinator lease held by another node")
+        self._lease = lease
+
+    def _release_lease(self) -> None:
+        lease, self._lease = self._lease, None
+        if lease is not None:
+            try:
+                lease.unlock()
+            except Exception:  # noqa: BLE001 - lease may be lost already
+                pass
+
     def start(self) -> None:
-        self._persist()
+        self._acquire_lease()
+        self.state.pop("paused", None)
+        try:
+            self._persist()
+        except RebalanceError:
+            self._release_lease()
+            raise
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="rebalance")
         self._thread.start()
@@ -183,7 +217,11 @@ class Rebalance:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=30)
+        self._release_lease()
         if self.state.get("status") in ("planning", "rebalancing"):
+            # Explicit pause (vs crash): the elastic janitor only
+            # auto-resumes walks that never set this flag.
+            self.state["paused"] = True
             try:
                 self._persist()
             except RebalanceError:
@@ -238,54 +276,99 @@ class Rebalance:
                 self._persist()
             except RebalanceError:
                 pass
+        finally:
+            self._release_lease()
+
+    def _do_key(self, src: int, rec: dict, bucket: str, key: str,
+                size: int, exclude: set[int]) -> None:
+        """Gate on foreground pressure, migrate one key, account it
+        (governor counters are thread-safe for workers > 1)."""
+        gov = self._gov
+        if not gov.gate():
+            return
+        try:
+            migrate_key(self.layer, src, bucket, key,
+                        lambda: self._pick_dst(exclude))
+            gov.add(rec, "migrated")
+            gov.add(rec, "bytes_moved", size)
+        except Exception as e:  # noqa: BLE001 - keep going
+            gov.add(rec, "failed")
+            rec["last_error"] = f"{bucket}/{key}: {e}"
 
     def _drain_pool(self, src: int, exclude: set[int]) -> None:
+        from concurrent.futures import ThreadPoolExecutor
         rec = self.state["pools"][str(src)]
         pool = self.layer.pools[src]
+        gov = self._gov
         since_ckpt = 0
-        buckets = sorted(b.name for b in pool.list_buckets())
-        start_bucket = rec.get("bucket", "")
-        for bucket in buckets:
-            if bucket < start_bucket:
-                continue
-            marker = rec.get("marker", "") if bucket == start_bucket else ""
-            while not self._stop.is_set():
-                page = pool.list_objects(bucket, marker=marker,
-                                         max_keys=256,
-                                         include_versions=True)
-                sizes: dict[str, int] = {}
-                for o in page.objects:
-                    sizes[o.name] = sizes.get(o.name, 0) + o.size
-                for key in sorted(sizes):
-                    if self._stop.is_set():
-                        return
-                    try:
-                        migrate_key(self.layer, src, bucket, key,
-                                    lambda: self._pick_dst(exclude))
-                        rec["migrated"] += 1
-                        rec["bytes_moved"] += sizes[key]
-                    except Exception as e:  # noqa: BLE001 - keep going
-                        rec["failed"] += 1
-                        rec["last_error"] = f"{bucket}/{key}: {e}"
-                    rec["bucket"] = bucket
-                    rec["marker"] = key
-                    since_ckpt += 1
-                    if since_ckpt >= self.checkpoint_every:
-                        since_ckpt = 0
-                        self._persist()
-                    if rec["bytes_moved"] >= rec["bytes_target"]:
-                        # Pool reached the average: done shedding.
-                        rec["done"] = True
-                        self._persist()
-                        return
-                if not page.is_truncated:
-                    break
-                marker = page.next_marker
-            if self._stop.is_set():
-                return
-            rec["bucket"] = bucket
-            rec["marker"] = ""
-            self._persist()
+        workers = ThreadPoolExecutor(
+            max_workers=gov.workers,
+            thread_name_prefix=f"rebal{src}-mig") \
+            if gov.workers > 1 else None
+        try:
+            buckets = sorted(b.name for b in pool.list_buckets())
+            start_bucket = rec.get("bucket", "")
+            for bucket in buckets:
+                if bucket < start_bucket:
+                    continue
+                marker = rec.get("marker", "") \
+                    if bucket == start_bucket else ""
+                while not self._stop.is_set():
+                    page = pool.list_objects(bucket, marker=marker,
+                                             max_keys=256,
+                                             include_versions=True)
+                    sizes: dict[str, int] = {}
+                    for o in page.objects:
+                        sizes[o.name] = sizes.get(o.name, 0) + o.size
+                    keys = sorted(sizes)
+                    if workers is not None:
+                        # Page-barrier parallel migration (see
+                        # Decommission._drain): the marker advances
+                        # only past a FULLY completed page and the
+                        # byte-target check runs at the barrier.
+                        list(workers.map(
+                            lambda k: self._do_key(src, rec, bucket, k,
+                                                   sizes[k], exclude),
+                            keys))
+                        if keys and not self._stop.is_set():
+                            rec["bucket"] = bucket
+                            rec["marker"] = keys[-1]
+                            since_ckpt += len(keys)
+                        if since_ckpt >= self.checkpoint_every:
+                            since_ckpt = 0
+                            self._persist()
+                        if rec["bytes_moved"] >= rec["bytes_target"]:
+                            rec["done"] = True
+                            self._persist()
+                            return
+                    else:
+                        for key in keys:
+                            if self._stop.is_set():
+                                return
+                            self._do_key(src, rec, bucket, key,
+                                         sizes[key], exclude)
+                            rec["bucket"] = bucket
+                            rec["marker"] = key
+                            since_ckpt += 1
+                            if since_ckpt >= self.checkpoint_every:
+                                since_ckpt = 0
+                                self._persist()
+                            if rec["bytes_moved"] >= rec["bytes_target"]:
+                                # Pool reached the average: done.
+                                rec["done"] = True
+                                self._persist()
+                                return
+                    if not page.is_truncated:
+                        break
+                    marker = page.next_marker
+                if self._stop.is_set():
+                    return
+                rec["bucket"] = bucket
+                rec["marker"] = ""
+                self._persist()
+        finally:
+            if workers is not None:
+                workers.shutdown(wait=True)
         # Walked everything (targets were estimates): done either way.
         rec["done"] = True
         self._persist()

@@ -22,16 +22,32 @@ from minio_tpu.utils.siphash import sip_hash_mod
 
 
 def merge_list_pages(pages: Sequence[ListObjectsInfo],
-                     max_keys: int) -> ListObjectsInfo:
+                     max_keys: int,
+                     versioned: bool = False) -> ListObjectsInfo:
     """Merge per-set/per-pool listing pages into one page.
 
     Each input page is sorted and complete up to its own max_keys, so
     the first max_keys of the merged key order are fully represented.
+
+    Entries dedup by key + VersionID (`versioned` pages keep every
+    distinct version; plain pages keep one entry per key): while a
+    pool migration is in flight (object/decom.migrate_key) the SAME
+    version exists in both the source and the destination pool, and
+    pages are merged in pool SEARCH order (destination pools first
+    during a drain) — without the dedup a listing taken inside the
+    migration window would show the key twice, and "never
+    doubly-visible" is the invariant the chaos matrix asserts.
     """
     items: list[tuple[str, str, object]] = []
     seen_prefixes: set[str] = set()
+    seen_versions: set[tuple] = set()
     for page in pages:
         for o in page.objects:
+            vkey = (o.name, getattr(o, "version_id", "")) if versioned \
+                else (o.name,)
+            if vkey in seen_versions:
+                continue
+            seen_versions.add(vkey)
             items.append((o.name, "o", o))
         for p in page.prefixes:
             if p not in seen_prefixes:
@@ -277,7 +293,8 @@ class ErasureSets:
                 max_keys=max_keys, include_versions=include_versions)
 
         if len(self.sets) == 1:
-            return merge_list_pages([one(self.sets[0])], max_keys)
+            return merge_list_pages([one(self.sets[0])], max_keys,
+                                    versioned=include_versions)
         futs = [self._listing_pool().submit(one, s) for s in self.sets]
         pages = []
         for f in futs:
@@ -287,7 +304,8 @@ class ErasureSets:
                 continue
         if not pages:
             raise BucketNotFound(bucket)
-        return merge_list_pages(pages, max_keys)
+        return merge_list_pages(pages, max_keys,
+                                versioned=include_versions)
 
     # -- healing -------------------------------------------------------
 

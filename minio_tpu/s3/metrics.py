@@ -566,6 +566,66 @@ class Metrics:
                     metric("minio_tpu_decommission_failed_total",
                            "Objects the drain failed to migrate",
                            "counter", [({}, st.get("failed", 0))])
+                    metric("minio_tpu_decom_bytes_moved_total",
+                           "Data bytes restored into surviving pools "
+                           "by the active/last drain", "counter",
+                           [({}, st.get("bytes_moved", 0))])
+                    metric("minio_tpu_decom_yields_total",
+                           "Drain pauses taken to yield to queueing "
+                           "foreground requests", "counter",
+                           [({}, st.get("yields", 0))])
+                    if st.get("checkpoint_ns"):
+                        age = max(0.0, time.time() -
+                                  st["checkpoint_ns"] / 1e9)
+                        metric("minio_tpu_decom_checkpoint_age_seconds",
+                               "Seconds since the drain checkpoint "
+                               "last persisted (resume staleness "
+                               "bound)", "gauge", [({}, age)])
+            rb_status = getattr(server.object_layer,
+                                "rebalance_status", None) \
+                if getattr(server, "object_layer", None) is not None \
+                else None
+            if rb_status is not None:
+                st = rb_status()
+                if st:
+                    recs = sorted((st.get("pools") or {}).items())
+                    metric("minio_tpu_rebalance_active",
+                           "1 while a rebalance walk is in progress",
+                           "gauge",
+                           [({}, 1 if st.get("status") in
+                             ("planning", "rebalancing") else 0)])
+                    metric("minio_tpu_rebalance_migrated_total",
+                           "Objects each participating pool shed in "
+                           "the active/last rebalance", "counter",
+                           [({"pool": p}, r.get("migrated", 0))
+                            for p, r in recs])
+                    metric("minio_tpu_rebalance_bytes_moved_total",
+                           "Bytes each participating pool shed",
+                           "counter",
+                           [({"pool": p}, r.get("bytes_moved", 0))
+                            for p, r in recs])
+                    metric("minio_tpu_rebalance_failed_total",
+                           "Objects the rebalance failed to migrate",
+                           "counter",
+                           [({"pool": p}, r.get("failed", 0))
+                            for p, r in recs])
+                    metric("minio_tpu_rebalance_pool_fill_fraction",
+                           "Used/capacity per pool as of rebalance "
+                           "planning", "gauge",
+                           [({"pool": p},
+                             r.get("used", 0) / (r.get("capacity") or 1))
+                            for p, r in recs])
+                    metric("minio_tpu_rebalance_yields_total",
+                           "Rebalance pauses taken to yield to "
+                           "queueing foreground requests", "counter",
+                           [({}, st.get("yields", 0))])
+                    if st.get("checkpoint_ns"):
+                        age = max(0.0, time.time() -
+                                  st["checkpoint_ns"] / 1e9)
+                        metric(
+                            "minio_tpu_rebalance_checkpoint_age_seconds",
+                            "Seconds since the rebalance checkpoint "
+                            "last persisted", "gauge", [({}, age)])
 
         # -- I/O engine observability (io/bufpool + io/engine) ----------
         # Saturation diagnosis: pool hit rate says whether hot paths
@@ -1055,6 +1115,19 @@ def node_info(server) -> dict:
             info["drive_heal"] = server.drive_heal.status()
         except Exception:  # noqa: BLE001 - status best effort
             pass
+    # Elastic-fleet migrations (object/decom.py + object/rebalance.py):
+    # the any-node status docs — a live local driver's counters when
+    # this node coordinates, else the persisted rev-voted checkpoint.
+    for sec, attr in (("decommission", "decommission_status"),
+                      ("rebalance", "rebalance_status")):
+        fn = getattr(server.object_layer, attr, None)
+        if fn is not None:
+            try:
+                st = fn()
+                if st:
+                    info[sec] = st
+            except Exception:  # noqa: BLE001 - status best effort
+                pass
     adm = getattr(server, "admission", None)
     if adm is not None:
         # Shed/queue/deadline counters per request class: the operator-

@@ -3488,7 +3488,37 @@ def _make_handler(server: S3Server):
                     raise S3Error("MalformedXML") from None
                 raise S3Error("MethodNotAllowed")
 
-            # Pool decommission (reference: cmd/admin-handlers-pools.go).
+            # Pool decommission / rebalance admin verbs — served from
+            # ANY node (reference: cmd/admin-handlers-pools.go).
+            # Starts work everywhere because the checkpoint doc lives
+            # on cluster-readable drives and the dsync coordinator
+            # lease keeps a single driver; status fans IN a live
+            # coordinator's counters (fresher than the checkpoint);
+            # stop fans OUT so it reaches whichever node drives the
+            # walk (grid elastic.status/elastic.stop, wired at boot).
+            def _elastic_live_peer(kind):
+                for _n, cli in getattr(server, "profile_peers",
+                                       None) or []:
+                    try:
+                        r = cli.call("elastic.status", None, timeout=3.0)
+                    except Exception:  # noqa: BLE001 - peer down
+                        continue
+                    if isinstance(r, dict) and r.get(f"{kind}_live") \
+                            and r.get(kind):
+                        # At most one live driver exists (the lease),
+                        # so the first live answer is THE coordinator.
+                        return r[kind]
+                return None
+
+            def _elastic_stop_peers(kind):
+                for _n, cli in getattr(server, "profile_peers",
+                                       None) or []:
+                    try:
+                        cli.call("elastic.stop", {"kind": kind},
+                                 timeout=5.0)
+                    except Exception:  # noqa: BLE001 - peer down
+                        continue
+
             if op == "decommission" and method == "POST":
                 ol = server.object_layer
                 if not hasattr(ol, "start_decommission"):
@@ -3500,35 +3530,49 @@ def _make_handler(server: S3Server):
                     raise S3Error("InvalidArgument", str(e)) from None
                 return ok()
             if op == "decommission-status" and method == "GET":
-                fn = getattr(server.object_layer, "decommission_status",
-                             None)
-                return ok(fn() if fn else None)
+                ol = server.object_layer
+                fn = getattr(ol, "decommission_status", None)
+                st = fn() if fn else None
+                d = getattr(ol, "_decom", None)
+                if d is None or d.wait(timeout=0):
+                    peer = _elastic_live_peer("decommission")
+                    if peer is not None:
+                        st = peer
+                return ok(st)
             if op == "decommission-cancel" and method == "POST":
                 fn = getattr(server.object_layer, "cancel_decommission",
                              None)
                 if fn:
                     fn()
+                _elastic_stop_peers("decommission")
                 return ok()
 
-            # Pool rebalance (reference:
-            # cmd/admin-handlers-pools.go RebalanceStart/Status/Stop).
             if op == "rebalance-start" and method == "POST":
                 ol = server.object_layer
                 if not hasattr(ol, "start_rebalance"):
                     raise S3Error("NotImplemented", "single-pool layout")
-                from minio_tpu.object.rebalance import RebalanceError
+                from minio_tpu.object.rebalance import (LeaseHeld,
+                                                        RebalanceError)
                 try:
                     ol.start_rebalance()
-                except RebalanceError as e:
+                except (LeaseHeld, RebalanceError) as e:
                     raise S3Error("InvalidArgument", str(e)) from None
                 return ok()
             if op == "rebalance-status" and method == "GET":
-                fn = getattr(server.object_layer, "rebalance_status", None)
-                return ok(fn() if fn else None)
+                ol = server.object_layer
+                fn = getattr(ol, "rebalance_status", None)
+                st = fn() if fn else None
+                rb = getattr(ol, "_rebalance", None)
+                if rb is None or rb.wait(timeout=0):
+                    peer = _elastic_live_peer("rebalance")
+                    if peer is not None:
+                        st = peer
+                return ok(st)
             if op == "rebalance-stop" and method == "POST":
                 fn = getattr(server.object_layer, "stop_rebalance", None)
                 if fn:
                     fn()
+                _elastic_stop_peers("rebalance")
                 return ok()
 
             # KMS key management (reference: cmd/kms-handlers.go

@@ -339,8 +339,16 @@ def main(argv=None) -> int:
                 pass
 
     layer = ServerPools(pools)
+    if distributed:
+        # Coordinator election for fleet-wide migrations: rebalance and
+        # decommission take a dsync write lease (decom.coordinator_lease)
+        # over the same lockers as the namespace locks, so exactly one
+        # node drives a walk and a SIGKILLed coordinator's lease expires
+        # after MTPU_GRID_LOCK_TTL for any peer to take over.
+        layer.lockers = lockers
     # Resume an interrupted pool decommission from its checkpoint
-    # (reference: pools.Init resuming persisted decom state).
+    # (reference: pools.Init resuming persisted decom state; with the
+    # lease above, at most one booting node actually wins the resume).
     if len(pools) > 1:
         try:
             if layer.resume_decommission() is not None:
@@ -406,6 +414,10 @@ def main(argv=None) -> int:
     # heal sheds while admission control reports client queueing.
     srv.drive_heal = drive_heal
     drive_heal.pressure = lambda: admission_pressure(srv.admission)
+    # Migration walks (rebalance/decommission) are a background class
+    # too: they pause while foreground requests queue, same signal as
+    # the bulk heal above (object/decom.MigrationGovernor).
+    layer.migration_pressure = lambda: admission_pressure(srv.admission)
     # Warm tiers: registry on pool 0's drives, resolved by every set's
     # read/transition paths (reference: globalTierConfigMgr).
     from minio_tpu.object.tier import TierRegistry
@@ -514,6 +526,35 @@ def main(argv=None) -> int:
         srv.profile_peers = [
             (f"{h}:{p}", client_for(h, p + GRID_PORT_OFFSET))
             for h, p in remote_nodes]
+        # Any-node elastic admin verbs: status fans IN (the coordinator
+        # holds counters fresher than the persisted checkpoint), stop
+        # fans OUT (it must reach whichever node drives the walk).
+        def _elastic_status(payload):
+            rb = getattr(layer, "_rebalance", None)
+            dc = layer._decom
+            return {
+                "rebalance": layer.rebalance_status(),
+                "rebalance_live": bool(rb is not None
+                                       and not rb.wait(timeout=0)),
+                "decommission": layer.decommission_status(),
+                "decommission_live": bool(dc is not None
+                                          and not dc.wait(timeout=0)),
+            }
+
+        def _elastic_stop(payload):
+            kind = (payload or {}).get("kind", "")
+            if kind == "rebalance":
+                layer.stop_rebalance()
+            elif kind == "decommission":
+                layer.cancel_decommission()
+            return {"ok": True}
+
+        grid_srv.register("elastic.status", _elastic_status)
+        grid_srv.register("elastic.stop", _elastic_stop)
+        if len(pools) > 1 and worker_id in ("", "0"):
+            # Orphan-recovery loop: resumes a dead coordinator's walk
+            # from its checkpoint once the lease expires.
+            layer.start_elastic_janitor()
     if args.audit_webhook:
         from minio_tpu.s3.trace import AuditLogger
         srv.audit = AuditLogger(args.audit_webhook)
@@ -581,6 +622,7 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         scanner.stop()
         drive_heal.stop()
+        layer.stop_elastic_janitor()
         if getattr(srv, "coherence", None) is not None:
             srv.coherence.stop()
         if ftp is not None:

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import os
 import shutil
+import sys
 import threading
 from typing import Optional
 
@@ -62,6 +63,10 @@ class CrashClock:
         self.crash_at = crash_at
         self.count = 0
         self.fired = False
+        # Which mutator the cut landed in (the caller's function name,
+        # captured at fire time) — sweep assertion messages name the
+        # sub-step instead of just its ordinal.
+        self.fired_op = ""
         self._mu = threading.Lock()
         self._disks: list = []
 
@@ -85,6 +90,10 @@ class CrashClock:
             self.count += 1
             if self.crash_at and self.count == self.crash_at:
                 self.fired = True
+                try:
+                    self.fired_op = sys._getframe(1).f_code.co_name
+                except Exception:  # noqa: BLE001 - diagnostics only
+                    self.fired_op = ""
                 disks = list(self._disks)
             else:
                 return False

@@ -94,11 +94,26 @@ def parse_pools(args: list[str]) -> list[list[str]]:
 
     Mirrors the reference server CLI: every ellipses argument is its own
     pool; all plain (non-ellipses) arguments together form one pool.
+
+    Extension over the reference: a COMMA-SEPARATED argument forms its
+    own pool of exactly those endpoints. Ellipses expansion is cartesian
+    and left-first, so a multi-node pool whose port and drive number
+    must advance together (`http://h:{9000...9003}/d{0...1}`) cannot be
+    written as one ellipses pattern — the comma form spells such pools
+    out explicitly: `http://h:9000/d0,http://h:9001/d0`.
     """
     pools: list[list[str]] = []
     plain: list[str] = []
     for a in args:
-        if has_ellipses(a):
+        if "," in a:
+            eps = [e for e in (s.strip() for s in a.split(",")) if e]
+            if not eps:
+                raise ValueError(f"empty pool spec {a!r}")
+            pool: list[str] = []
+            for e in eps:
+                pool.extend(expand(e) if has_ellipses(e) else [e])
+            pools.append(pool)
+        elif has_ellipses(a):
             pools.append(expand(a))
         else:
             plain.append(a)

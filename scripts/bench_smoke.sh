@@ -18,7 +18,7 @@ fi
 
 echo "== bench smoke (baseline: $latest) =="
 out=$(JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} \
-      MTPU_BENCH_ONLY=put_latency,put_concurrent,get_latency,get_concurrent,meta_listing,small_put,transform_put,distributed,connections \
+      MTPU_BENCH_ONLY=put_latency,put_concurrent,get_latency,get_concurrent,meta_listing,small_put,transform_put,distributed,connections,rebalance \
       MTPU_BENCH_SMALL=1 \
       python bench.py)
 echo "$out"
@@ -71,6 +71,16 @@ import sys
 # ramp ("higher" — fan-in must not degrade the aggregate). Both emit
 # explicit nulls on fd-limited hosts (RLIMIT_NOFILE below the
 # connection target) and the gates skip cleanly there.
+# The rebalance gates watch the elastic fleet plane (ROADMAP item 3):
+# vs_quiescent ("lower") is the foreground PUT p50 during an online
+# drain divided by the quiescent p50 measured in the SAME run — the
+# background admission class must keep yielding to foreground SLOs, so
+# the drain tax ratio is the stable cross-run signal, not either raw
+# latency column. rebalance_identity ("higher") is the fraction of
+# objects that survive the drain byte-identical with a unique listing
+# entry (1.0 = no object lost, torn, or doubly visible). Both emit
+# explicit nulls on hosts where the fixture cannot build and the gates
+# skip cleanly there.
 # The distributed listing gate ("lower") watches the cluster listing
 # page: every measured page pays a real cross-node walk over the
 # remote walk_scan trimmed-summary stream through REAL spawned server
@@ -92,6 +102,8 @@ GATES = [
     ("distributed_list_page_p50_ms", "value", "lower"),
     ("connections_idle_rss_per_conn_kib", "value", "lower"),
     ("connections_get_ramp_gibps", "value", "higher"),
+    ("rebalance_fg_p50_during_ms", "vs_quiescent", "lower"),
+    ("rebalance_identity", "value", "higher"),
 ]
 
 

@@ -71,7 +71,17 @@ class Cluster:
                  ports: Optional[list[int]] = None, parity: Optional[int]
                  = None, set_size: Optional[int] = None,
                  scanner_interval: float = 0.0, boot_timeout: float = 60.0,
-                 env: Optional[dict] = None, extra: tuple = ()):
+                 env: Optional[dict] = None, extra: tuple = (),
+                 pools: Optional[list] = None):
+        """`pools` opts into a MULTI-POOL topology (rebalance/decom
+        tests): a list of pool specs, each an int (drives per node, on
+        every node), or (node_list, drives_per_node) for a pool hosted
+        by a subset of the nodes — e.g. `pools=[2, ([3], 2)]` is one
+        2-drives-per-node pool across all nodes plus a second pool
+        living entirely on node 3 (the drain-and-remove shape). Each
+        pool is passed to every server as ONE comma-separated CLI arg
+        (topology/ellipses.parse_pools comma form). Default (None):
+        the original single-pool flat layout."""
         self.root = str(root)
         self.n = nodes
         self.drives_per_node = drives_per_node
@@ -87,12 +97,35 @@ class Cluster:
                        "--boot-timeout", str(boot_timeout))
         self.env = dict(env or {})
         self.endpoints: list[str] = []
-        for i in range(nodes):
-            for d in range(drives_per_node):
-                path = os.path.join(self.root, f"n{i}", f"d{d}")
-                os.makedirs(path, exist_ok=True)
-                self.endpoints.append(
-                    f"http://127.0.0.1:{self.ports[i]}{path}")
+        self.pool_args: list[str] = []
+        if pools is None:
+            for i in range(nodes):
+                for d in range(drives_per_node):
+                    path = os.path.join(self.root, f"n{i}", f"d{d}")
+                    os.makedirs(path, exist_ok=True)
+                    self.endpoints.append(
+                        f"http://127.0.0.1:{self.ports[i]}{path}")
+        else:
+            self.pool_specs = []
+            for pi, spec in enumerate(pools):
+                if isinstance(spec, int):
+                    spec = (list(range(nodes)), spec)
+                node_list, drives = list(spec[0]), int(spec[1])
+                self.pool_specs.append((node_list, drives))
+                eps = []
+                for i in node_list:
+                    for d in range(drives):
+                        path = os.path.join(self.root, f"n{i}",
+                                            f"p{pi}d{d}")
+                        os.makedirs(path, exist_ok=True)
+                        eps.append(
+                            f"http://127.0.0.1:{self.ports[i]}{path}")
+                # A single-endpoint pool keeps a trailing comma so the
+                # arg still parses as its OWN pool, not a plain arg
+                # merged with others.
+                self.pool_args.append(
+                    ",".join(eps) + ("," if len(eps) == 1 else ""))
+                self.endpoints.extend(eps)
 
     # -- lifecycle -----------------------------------------------------
 
@@ -118,7 +151,7 @@ class Cluster:
                    **self.env)
         cmd = [sys.executable, "-m", "minio_tpu.server",
                "--address", self.address(i), "--ec-backend", "host",
-               *self.extra, *self.endpoints]
+               *self.extra, *(self.pool_args or self.endpoints)]
         log = open(self.log_path(i), "wb")
         self.procs[i] = subprocess.Popen(cmd, stdout=log,
                                          stderr=subprocess.STDOUT, env=env,
@@ -215,6 +248,10 @@ class Cluster:
 
     def drive_dir(self, i: int, d: int) -> str:
         return os.path.join(self.root, f"n{i}", f"d{d}")
+
+    def pool_drive_dir(self, i: int, pool: int, d: int) -> str:
+        """Drive dir in the multi-pool layout (`pools=` ctor arg)."""
+        return os.path.join(self.root, f"n{i}", f"p{pool}d{d}")
 
     # -- teardown ------------------------------------------------------
 

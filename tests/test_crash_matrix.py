@@ -366,6 +366,170 @@ def test_crash_smoke_group_commit(tmp_path):
     assert steps >= 3 * N
 
 
+# -- pool migration (object/decom.migrate_key) ------------------------------
+# The elastic-fleet transfer primitive: snapshot the source stack,
+# restore every version into the destination pool, bump the coherence
+# generation, then verify + delete the source copies under the key
+# lock. The sweep cuts power at EVERY durable sub-step of that chain
+# (snapshot reads don't tick; restore writes, journal commits and the
+# source deletes all do) and asserts the object is never lost, never
+# torn, and never doubly-visible — then that re-running the migration
+# (the checkpointed resume path) converges: source empty, destination
+# complete, byte-identical.
+
+MIG_DEP = "00000000-0000-0000-0000-000000000e1a"
+MIG_V1 = os.urandom(11_000)
+
+
+def _mk_layer(root, wrap=None):
+    """Two-pool ServerPools (src=pool0, dst=pool1) over one shared
+    clock; a fixed deployment id keeps key->set routing stable across
+    remounts."""
+    from minio_tpu.object.pools import ServerPools
+    from minio_tpu.object.sets import ErasureSets
+    pools = []
+    for p in ("src", "dst"):
+        disks = [LocalStorage(str(root / p / f"d{i}")) for i in range(N)]
+        if wrap is not None:
+            disks = [wrap(d) for d in disks]
+        pools.append(ErasureSets([ErasureSet(disks)],
+                                 deployment_id=MIG_DEP))
+    return ServerPools(pools)
+
+
+def migrate_sweep(tmp_path, mode, versioned=False, max_points=400):
+    """Crash sweep over migrate_key. Invariants at every cut, before
+    and after healing: the key reads back byte-identical (the source
+    pool is marked draining — persisted decom state survives the crash
+    in the real flow — so reads resolve destination-first, and the
+    destination holds the full stack before any source delete runs);
+    listings show each (key, version) exactly once; the resumed
+    migration converges to source-empty with nothing lost.
+
+    lose_entry (non-journaling fs, no directory fsync — the documented
+    MTPU_FS_OSYNC exception) keeps the torn/doubly-visible asserts but
+    not the durability one: the destination commit's directory entry
+    can be voided by the cut while later source deletes survive, so
+    the key may legitimately read back absent."""
+    from minio_tpu.object import decom
+    strict = mode != "lose_entry"
+    n = 1
+    while n <= max_points:
+        root = tmp_path / f"mig-{mode}-{n}"
+        lay = _mk_layer(root)
+        lay.make_bucket(BKT)
+        if versioned:
+            lay.pools[0].put_object(BKT, KEY, MIG_V1,
+                                    PutOptions(versioned=True))
+            lay.pools[0].put_object(BKT, KEY, OLD,
+                                    PutOptions(versioned=True))
+        else:
+            lay.pools[0].put_object(BKT, KEY, OLD)
+        lay.close()
+
+        clock = CrashClock(crash_at=n)
+        lay2 = _mk_layer(root, wrap=lambda d: CrashDisk(d, clock, mode))
+        lay2.decommissioning.add(0)
+        completed, err = False, None
+        try:
+            decom.migrate_key(lay2, 0, BKT, KEY, lambda: 1)
+            completed = True
+        except Exception as e:  # noqa: BLE001 - PowerCut/quorum faults
+            err = e
+        lay2.close()
+        if not clock.fired:
+            assert completed, f"migrate failed without a crash: {err!r}"
+        where = f"cut@{n} in {clock.fired_op or 'n/a'}"
+
+        # "Reboot": remount both pools fresh + recovery sweep.
+        for p in ("src", "dst"):
+            for i in range(N):
+                recovery_sweep(LocalStorage(str(root / p / f"d{i}")),
+                               min_age=0)
+        lay3 = _mk_layer(root)
+        lay3.decommissioning.add(0)
+        try:
+            nvers = 2 if versioned else 1
+
+            def check():
+                try:
+                    _, got = lay3.get_object(BKT, KEY)
+                except ObjectNotFound:
+                    got = None
+                if got is not None:
+                    assert got == OLD, f"{where}: object torn"
+                else:
+                    assert not strict, f"{where}: object lost"
+                page = lay3.list_objects(BKT, max_keys=10,
+                                         include_versions=True)
+                vkeys = [(o.name, o.version_id) for o in page.objects]
+                assert len(vkeys) == len(set(vkeys)), \
+                    f"{where}: doubly visible: {vkeys}"
+                if strict:
+                    assert len(vkeys) == nvers, f"{where}: {vkeys}"
+                if versioned and strict:
+                    from minio_tpu.object.types import GetOptions
+                    oldest = min(page.objects, key=lambda o: o.mod_time)
+                    _, v1 = lay3.get_object(
+                        BKT, KEY, GetOptions(version_id=oldest.version_id))
+                    assert v1 == MIG_V1, f"{where}: old version torn"
+
+            check()
+            for pool in lay3.pools:
+                try:
+                    pool.heal_object(BKT, KEY)
+                except Exception:  # noqa: BLE001 - pool without the key
+                    pass
+            check()
+            # The checkpointed resume: re-running the idempotent
+            # migrate must converge (source empty, nothing lost).
+            decom.migrate_key(lay3, 0, BKT, KEY, lambda: 1)
+            check()
+            src_page = lay3.pools[0].list_objects(
+                BKT, max_keys=10, include_versions=True)
+            assert not src_page.objects, \
+                f"{where}: source copies survived the resumed migrate"
+            for pool in lay3.pools:
+                pool.drain_mrf(15)
+            for p in ("src", "dst"):
+                for i in range(N):
+                    for sub in ("tmp", "staging"):
+                        pth = root / p / f"d{i}" / SYS_VOL / sub
+                        assert not os.path.isdir(pth) or \
+                            os.listdir(pth) == [], \
+                            f"{where}: crash garbage in {p}/d{i}/{sub}"
+        finally:
+            lay3.close()
+        shutil.rmtree(root, ignore_errors=True)
+        if not clock.fired:
+            return n - 1
+        n += 1
+    raise AssertionError(f"migrate never completed in {max_points} points")
+
+
+def test_crash_smoke_migrate_key(tmp_path):
+    steps = migrate_sweep(tmp_path, "drop")
+    # At minimum: per-dst-drive restore commit + per-src-drive delete.
+    assert steps >= 2 * N
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["drop", "tear"])
+def test_crash_matrix_migrate_key(tmp_path, mode):
+    migrate_sweep(tmp_path, mode)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["drop", "tear"])
+def test_crash_matrix_migrate_key_versioned(tmp_path, mode):
+    migrate_sweep(tmp_path, mode, versioned=True)
+
+
+@pytest.mark.slow
+def test_crash_matrix_migrate_key_lose_entry(tmp_path):
+    migrate_sweep(tmp_path, "lose_entry")
+
+
 @pytest.mark.slow
 def test_crash_matrix_lost_dir_entries(tmp_path):
     # Non-journaling fs without dir fsync (MTPU_FS_OSYNC off): the last
