@@ -161,6 +161,8 @@ def main() -> None:
             _distributed()
         if _want("connections"):
             _connections()
+        if _want("hot_get"):
+            _hot_get()
         if _want("rebalance"):
             _rebalance()
         return
@@ -288,6 +290,10 @@ def main() -> None:
     # ---- 12. Connection plane: idle fd cost + GET fan-in ramp ---------
     if _want("connections"):
         _connections()
+
+    # ---- 12b. Hot read tier: RAM hit path vs erasure path -------------
+    if _want("hot_get"):
+        _hot_get()
 
     # ---- 13. Elastic fleet: foreground SLO under an online drain ------
     if _want("rebalance"):
@@ -1993,6 +1999,175 @@ def _connections_inner() -> None:
                        / max(ramps[0]["agg_gibps"], 1e-9), 3),
         "pre_pr_threadpath": pre.get("ramp")
         or {"error": pre.get("error", "probe failed")},
+        "workers": 2,
+    }))
+
+
+def _hot_get() -> None:
+    """Hot read tier (ROADMAP item 4): served GET aggregate of the
+    frequency-admitted RAM cache under a zipfian fan-in ramp, against
+    the erasure read path like-for-like in ONE bench run.
+
+    Two back-to-back 2-worker fleets on the same host serve the SAME
+    object set (1 MiB bodies) under the SAME zipfian ramp (rank
+    frequency ∝ 1/(i+1)^alpha — the skew the tinyLFU admission is
+    built for): the first with the hot cache on (a warmup pass pins
+    the set, so the measured window is the RAM hit path — loop
+    short-circuit plus handler hits), the second with MTPU_HOT_CACHE=off
+    (every GET pays the erasure fan-out: the kill-switch column IS the
+    erasure column). The on-fleet's metrics scrape must show
+    response_path{path=hotcache} > 0 or the run is reported as failed —
+    a silently-disengaged cache must not report a throughput win.
+
+    Emits explicit-null lines on fd-limited hosts (RLIMIT_NOFILE below
+    the connection target) so the smoke gate skips cleanly.
+    """
+    try:
+        _hot_get_inner()
+    except Exception as e:  # noqa: BLE001 - boot/socket failure
+        print(json.dumps({"metric": "hot_get_gibps", "value": None,
+                          "skip": f"{type(e).__name__}: {e}"}))
+
+
+def _hot_get_inner() -> None:
+    import shutil
+    import signal
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    repo = _os.path.dirname(_os.path.abspath(__file__))
+    if repo not in _sys.path:
+        _sys.path.insert(0, repo)
+    from tests.s3client import S3Client, ramp_get
+
+    ramp = (16, 64) if _SMALL else (16, 64, 256)
+    ramp_secs = 1.5 if _SMALL else 3.0
+    n_objects = 16 if _SMALL else 32
+    alpha = 1.0
+    body = np.random.default_rng(7).integers(
+        0, 256, size=1 << 20, dtype=np.uint8).tobytes()
+
+    import resource
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    want_fds = max(ramp) * 2 + 512
+    if soft < want_fds and hard >= want_fds:
+        resource.setrlimit(resource.RLIMIT_NOFILE, (want_fds, hard))
+        soft = want_fds
+    if soft < want_fds:
+        print(json.dumps({
+            "metric": "hot_get_gibps", "value": None,
+            "skip": f"RLIMIT_NOFILE {soft} < {want_fds} needed for "
+                    f"{max(ramp)} ramp connections"}))
+        return
+
+    def boot(root: str, hot_on: bool):
+        port = 19560 + (_os.getpid() % 200) + (0 if hot_on else 1)
+        env = dict(_os.environ)
+        env.update(JAX_PLATFORMS="cpu", MTPU_HTTP_WORKERS="2")
+        if hot_on:
+            env.pop("MTPU_HOT_CACHE", None)
+        else:
+            env["MTPU_HOT_CACHE"] = "off"
+        proc = subprocess.Popen(
+            [_sys.executable, "-m", "minio_tpu.server",
+             "--address", f"127.0.0.1:{port}", "--scanner-interval", "0",
+             f"{root}/d{{1...4}}"],
+            env=env, cwd=repo,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        addr = f"127.0.0.1:{port}"
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError("fleet died during boot")
+            try:
+                if S3Client(addr).request(
+                        "GET", "/minio/health/live", sign=False)[0] == 200:
+                    return proc, addr
+            except OSError:
+                time.sleep(0.4)
+        proc.kill()
+        raise RuntimeError("fleet failed to boot in 90s")
+
+    def shutdown(proc) -> None:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=25)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+    def measure(addr: str, hot_on: bool):
+        cli = S3Client(addr)
+        assert cli.request("PUT", "/hotb")[0] == 200
+        paths = []
+        for i in range(n_objects):
+            p = f"/hotb/o{i:03d}"
+            assert cli.request("PUT", p, body=body)[0] == 200
+            paths.append(p)
+        # Warmup: two passes on fresh connections so BOTH workers'
+        # caches admit the set before the measured window.
+        for _ in range(2):
+            for p in paths:
+                st, _, got = S3Client(addr).request("GET", p)
+                assert st == 200 and len(got) == len(body)
+        ramps = []
+        for conns in ramp:
+            ramps.append(ramp_get(addr, paths[0], len(body), conns,
+                                  duration_s=ramp_secs, paths=paths,
+                                  alpha=alpha))
+        hot_total = 0
+        st, _, text = cli.request("GET", "/minio/v2/metrics/cluster")
+        assert st == 200
+        needle = 'minio_tpu_http_response_path_total{path="hotcache"}'
+        for line in text.decode(errors="replace").splitlines():
+            if line.startswith(needle):
+                hot_total = int(float(line.rsplit(" ", 1)[1]))
+        if hot_on and hot_total <= 0:
+            raise RuntimeError("hot cache never engaged during the "
+                               "measured window (hotcache path total 0)")
+        if not hot_on and hot_total > 0:
+            raise RuntimeError("kill switch leaked: hotcache path total "
+                               f"{hot_total} with MTPU_HOT_CACHE=off")
+        return ramps, hot_total
+
+    results: dict = {}
+    for mode in ("hot", "erasure"):
+        root = tempfile.mkdtemp(prefix=f"bench-hotget-{mode}-")
+        try:
+            proc, addr = boot(root, hot_on=(mode == "hot"))
+            try:
+                ramps, hot_total = measure(addr, hot_on=(mode == "hot"))
+            finally:
+                shutdown(proc)
+            results[mode] = {"ramp": ramps, "hot_path_total": hot_total}
+        except Exception as e:  # noqa: BLE001 - explicit error column
+            results[mode] = {"error": f"{type(e).__name__}: {e}"}
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+
+    hot_r = results.get("hot", {})
+    era_r = results.get("erasure", {})
+    if "ramp" not in hot_r:
+        print(json.dumps({"metric": "hot_get_gibps", "value": None,
+                          "skip": hot_r.get("error", "probe failed")}))
+        return
+    tail = hot_r["ramp"][-1]
+    era_tail = era_r["ramp"][-1] if "ramp" in era_r else None
+    print(json.dumps({
+        "metric": "hot_get_gibps",
+        "value": tail["agg_gibps"],
+        "unit": "GiB/s",
+        "connections": tail["connections"],
+        "objects": n_objects,
+        "object_mib": 1,
+        "alpha": alpha,
+        "ramp": hot_r["ramp"],
+        "hot_path_total": hot_r["hot_path_total"],
+        "vs_erasure": (round(tail["agg_gibps"]
+                             / max(era_tail["agg_gibps"], 1e-9), 2)
+                       if era_tail else None),
+        "erasure_hot_cache_off": era_r.get("ramp")
+        or {"error": era_r.get("error", "probe failed")},
         "workers": 2,
     }))
 

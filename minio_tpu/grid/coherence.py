@@ -103,6 +103,13 @@ def make_set_invalidator(sets, layer=None) -> Callable[[str, str], None]:
             fc = getattr(es, "fi_cache", None)
             if fc is not None:
                 fc.invalidate_all()
+        if not bucket:
+            # Wildcard invalidation: the hot-object tier caches buckets
+            # the bump walk above may never have known (GET-only
+            # traffic) — flush every cache in the process explicitly,
+            # like the fi_cache flush above.
+            from minio_tpu.object import hotcache as _hot
+            _hot.flush_process_caches()
     return apply_inv
 
 

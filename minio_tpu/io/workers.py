@@ -298,6 +298,10 @@ def _worker_stat(server, worker_id: int) -> dict:
         "bufpool": global_pool().stats(),
         "engine": engine,
         "fileinfo_cache": fileinfo,
+        # Hot-object read tier: per-worker cache, fleet-merged by the
+        # scraping worker (metrics render / admin info).
+        "hot_cache": getattr(server, "hot_cache", None)
+        and server.hot_cache.stats(),
         # Per-worker group-commit lane occupancy: each worker runs its
         # own lanes, so the fleet view is a merge (group_commit.merge_stats).
         "group_commit": _gc_mod.aggregate_stats(),
@@ -394,6 +398,14 @@ class WorkerContext:
                                  poll_interval=0.25)
             for s in layer_sets(server.object_layer):
                 _wire_set(s, shared, list_gen, meta_gen)
+            # Hot-object tier: each worker holds a private cache, but a
+            # sibling's mutation must flush it — observe the same
+            # list.gen bump file the fileinfo caches ride. Its OWN
+            # SharedGen instance: changed() is stateful per observer.
+            hc = getattr(server, "hot_cache", None)
+            if hc is not None:
+                hc.shared_gen = SharedGen(
+                    os.path.join(shared, "list.gen"))
 
         # Control responder: answer the parent's stat queries.
         threading.Thread(target=self._serve_queries, args=(server,),

@@ -1,0 +1,514 @@
+"""Hot-object read tier: frequency-admitted whole-object RAM cache.
+
+Millions of users hitting a small hot set should be served from memory
+at line rate, not by fanning every GET across the erasure shards and a
+journal read. This module pins hot plaintext objects as contiguous
+buffers and serves them either straight off the epoll event loop
+(s3/eventloop._try_hot, before dispatch — the request never reaches a
+handler thread) or from the handler GET path (s3/server._get_object hit
+branch) — in both cases without touching the object layer.
+
+Admission is tinyLFU-style (Einziger & Friedman, "TinyLFU: A Highly
+Efficient Cache Admission Policy"): a count-min frequency sketch with
+4-bit-capped counters and periodic halving estimates each key's recent
+popularity; a doorkeeper bloom filter absorbs the first access so
+one-hit-wonder scans never increment the sketch, let alone evict the
+genuinely hot set. A candidate is admitted only when the cache has
+free room or its estimated frequency beats the eviction victim's.
+Residency is a segmented LRU (probation/protected): new admits land in
+probation, a second hit promotes to protected, eviction drains
+probation first — scan resistance on the residency side too.
+
+Coherence rides the exact funnel object/fi_cache.py uses, so
+invalidation is already exact cluster-wide:
+
+- every namespace mutation (PUT/DELETE/copy/group-commit batch/peer
+  bump pull) goes through ``metacache.bump`` → our bucket listener
+  drops the bucket synchronously, before any member acks;
+- the token protocol (``token()`` before the read fan-out, checked in
+  ``put()``) makes inserts race-free against concurrent mutations;
+- pre-forked workers observe the shared ``list.gen`` bump file (their
+  own SharedGen instance — ``changed()`` is stateful per observer) and
+  flush wholesale when a sibling worker mutated anything;
+- on distributed sets, hits are served only while every set's
+  ``fi_cache.remote_gate`` (grid/coherence.PeerCoherence.coherent, or
+  the deny-all sentinel on bare remote sets) answers coherent; the
+  walk is dynamic so elastic pool expansion is picked up live, and any
+  gate-down interval or topology change flushes the cache before
+  serving resumes.
+
+Kill switch: ``MTPU_HOT_CACHE=off`` (or 0/false) disables admission
+and lookups wholesale; responses are byte-identical either way because
+a hit replays the handler's own captured header bytes (Date re-spliced
+per second) and the miss path is untouched.
+
+Knobs: ``MTPU_HOT_CACHE_MAX`` (entry cap, default 1024),
+``MTPU_HOT_CACHE_BYTES`` (resident-byte cap, default 256 MiB),
+``MTPU_HOT_CACHE_OBJ_MAX`` (per-object size cap, default 8 MiB).
+"""
+from __future__ import annotations
+
+import email.utils
+import os
+import re
+import threading
+import time
+import weakref
+from collections import OrderedDict
+from typing import Any, Callable, Optional
+
+# Process-wide registry of live caches so the coherence plane
+# (grid/coherence.make_set_invalidator's bucket=="" wildcard) can flush
+# every cache in the process without holding a server reference.
+_REGISTRY: "weakref.WeakSet[HotObjectCache]" = weakref.WeakSet()
+
+
+def flush_process_caches() -> None:
+    """Flush every live HotObjectCache in this process (wildcard
+    cross-node invalidations, topology changes)."""
+    for cache in list(_REGISTRY):
+        try:
+            cache.invalidate_all()
+        except Exception:  # noqa: BLE001 - flush is best-effort
+            pass
+
+
+def _env_int(key: str, default: int) -> int:
+    try:
+        return int(os.environ.get(key, "") or default)
+    except ValueError:
+        return default
+
+
+# ---------------------------------------------------------------------------
+# Date splice: a cached hit replays the exact header bytes the handler
+# produced on the admitting miss, with only the Date value re-stamped.
+# http.server's send_response writes Date via email.utils.formatdate
+# (usegmt) — producing ours with the same function keeps the hit
+# byte-identical in format to a fresh miss.
+
+_DATE_RE = re.compile(rb"\r\nDate: [^\r\n]*\r\n")
+_date_cached: tuple[int, bytes] = (0, b"")
+
+
+def date_bytes() -> bytes:
+    """Current RFC 1123 date, encoded, cached per wall-clock second."""
+    global _date_cached
+    now = time.time()
+    sec = int(now)
+    cached = _date_cached
+    if cached[0] == sec:
+        return cached[1]
+    d = email.utils.formatdate(now, usegmt=True).encode("ascii")
+    _date_cached = (sec, d)
+    return d
+
+
+def split_head(head: bytes) -> Optional[tuple[bytes, bytes]]:
+    """Split captured response-head bytes around the Date value.
+
+    Returns (prefix, suffix) where prefix ends with ``b"Date: "`` and
+    suffix starts with the ``\\r\\n`` that terminated the date line, or
+    None when no Date header is present (template unusable)."""
+    m = _DATE_RE.search(head)
+    if m is None:
+        return None
+    return head[:m.start()] + b"\r\nDate: ", head[m.end() - 2:]
+
+
+# ---------------------------------------------------------------------------
+# TinyLFU admission filter.
+
+class FrequencySketch:
+    """Count-min sketch with 4-bit-capped counters, a doorkeeper bloom
+    filter in front, and periodic halving (aging) so the estimate
+    tracks *recent* frequency.
+
+    The doorkeeper absorbs each key's first occurrence: a pure scan of
+    one-hit wonders only ever sets doorkeeper bits, leaving the sketch
+    untouched — their estimate stays ~1 and never beats a resident
+    victim's, which is the scan resistance TinyLFU is for."""
+
+    ROWS = 4
+    CAP = 15  # 4-bit counters, stored one per byte for simplicity
+
+    def __init__(self, max_entries: int):
+        width = 64
+        while width < 4 * max(16, max_entries):
+            width <<= 1
+        self._width = width
+        self._mask = width - 1
+        self._rows = [bytearray(width) for _ in range(self.ROWS)]
+        self._door = bytearray(width // 8)
+        # Aging: after a sample window of increments, halve everything
+        # and reset the doorkeeper so stale popularity decays.
+        self._sample = 10 * max(16, max_entries)
+        self._increments = 0
+        self._seed = id(self) & 0xFFFF
+
+    def _index(self, row: int, key: str) -> int:
+        return hash((self._seed, row, key)) & self._mask
+
+    def _door_probe(self, key: str) -> tuple[int, int, int, int]:
+        h = hash((self._seed, -1, key))
+        a = h & self._mask
+        b = (h >> 17) & self._mask
+        return a >> 3, 1 << (a & 7), b >> 3, 1 << (b & 7)
+
+    def _door_has(self, key: str) -> bool:
+        i1, m1, i2, m2 = self._door_probe(key)
+        return bool(self._door[i1] & m1) and bool(self._door[i2] & m2)
+
+    def record(self, key: str) -> None:
+        """Count one occurrence of key (access or candidacy)."""
+        if not self._door_has(key):
+            i1, m1, i2, m2 = self._door_probe(key)
+            self._door[i1] |= m1
+            self._door[i2] |= m2
+            return
+        for row in range(self.ROWS):
+            r = self._rows[row]
+            i = self._index(row, key)
+            if r[i] < self.CAP:
+                r[i] += 1
+        self._increments += 1
+        if self._increments >= self._sample:
+            self._age()
+
+    def estimate(self, key: str) -> int:
+        est = min(self._rows[row][self._index(row, key)]
+                  for row in range(self.ROWS))
+        if self._door_has(key):
+            est += 1
+        return est
+
+    def _age(self) -> None:
+        for r in self._rows:
+            for i in range(self._width):
+                r[i] >>= 1
+        self._door = bytearray(self._width // 8)
+        self._increments //= 2
+
+
+class _Entry:
+    __slots__ = ("info", "body", "head_prefix", "head_suffix", "nbytes")
+
+    def __init__(self, info: Any, body: bytes):
+        self.info = info
+        self.body = body
+        # Captured response-head template (split around Date) — None
+        # until the handler back-fills it on an eligible miss; the
+        # event-loop short circuit only engages once it exists.
+        self.head_prefix: Optional[bytes] = None
+        self.head_suffix: Optional[bytes] = None
+        self.nbytes = len(body)
+
+
+class HotObjectCache:
+    """Per-process whole-object read cache with tinyLFU admission.
+
+    Thread-safe; every public method takes the internal lock. The data
+    stored per entry is the *plaintext served body* (bytes, immutable —
+    the event loop writes memoryviews over it with zero copies) plus
+    the ObjectInfo it was served with and, once captured, the response
+    head template for the loop short-circuit."""
+
+    def __init__(self) -> None:
+        self.enabled = os.environ.get(
+            "MTPU_HOT_CACHE", "").lower() not in ("0", "off", "false")
+        self.max_entries = max(1, _env_int("MTPU_HOT_CACHE_MAX", 1024))
+        self.max_bytes = max(1, _env_int("MTPU_HOT_CACHE_BYTES",
+                                         256 * 1024 * 1024))
+        self.obj_max = max(1, _env_int("MTPU_HOT_CACHE_OBJ_MAX",
+                                       8 * 1024 * 1024))
+        self._mu = threading.Lock()
+        # Segmented LRU: MRU at the OrderedDict tail. Keys: (bucket, key).
+        self._probation: "OrderedDict[tuple[str, str], _Entry]" = \
+            OrderedDict()
+        self._protected: "OrderedDict[tuple[str, str], _Entry]" = \
+            OrderedDict()
+        self._protected_cap = max(1, (self.max_entries * 4) // 5)
+        self._bytes = 0
+        self._sketch = FrequencySketch(self.max_entries)
+        # Token protocol (same contract as fi_cache): per-bucket
+        # generation, bumped by invalidation; put() refuses when the
+        # generation moved between token() and put().
+        self._gens: dict[str, int] = {}
+        # Pre-forked workers: shared-generation observer over the
+        # fleet's list.gen bump file (io/workers.attach wires an
+        # instance OF OUR OWN — changed() is stateful per observer).
+        self.shared_gen: Optional[Any] = None
+        # The object layer we front; _serving() walks its sets live so
+        # elastic pool changes and per-set coherence gates are honored
+        # without a static snapshot.
+        self._layer: Optional[Any] = None
+        self._wired_ids: set[int] = set()
+        self._wired_count = -1
+        self._gate_was_down = False
+        # Counters (stats(), metrics).
+        self.hits = 0
+        self.misses = 0
+        self.admits = 0
+        self.rejects = 0
+        self.evictions = 0
+        self.invalidations = 0
+        _REGISTRY.add(self)
+
+    # -- topology / coherence -------------------------------------------
+
+    def attach_layer(self, layer: Any) -> None:
+        """Front the given object layer: subscribe to every set's
+        metacache bump funnel and honor its coherence gates."""
+        self._layer = layer
+        with self._mu:
+            self._wire_sets_locked()
+
+    @staticmethod
+    def _layer_sets(layer: Any) -> list:
+        # Local mirror of metrics.layer_sets (object/ must not import
+        # the s3 front end): pools of sets, a sets list, or a bare set.
+        if layer is None:
+            return []
+        pools = getattr(layer, "pools", None)
+        if pools:
+            out = []
+            for pool in pools:
+                out.extend(getattr(pool, "sets", None) or [pool])
+            return out
+        sets = getattr(layer, "sets", None)
+        if sets:
+            return list(sets)
+        return [layer]
+
+    def _wire_sets_locked(self) -> bool:
+        """Subscribe our bucket invalidator to any set not yet wired.
+        Returns True when the topology changed since the last walk."""
+        sets = self._layer_sets(self._layer)
+        changed = (len(sets) != self._wired_count)
+        for s in sets:
+            if id(s) in self._wired_ids:
+                continue
+            mc = getattr(s, "metacache", None)
+            listeners = getattr(mc, "listeners", None)
+            if listeners is not None:
+                listeners.append(self.invalidate_bucket)
+            self._wired_ids.add(id(s))
+        self._wired_count = len(sets)
+        return changed
+
+    def _serving(self) -> bool:
+        """True when hits may be served right now. Walks the layer's
+        sets live: wires newly-appeared sets (elastic pools — a
+        topology change flushes first), then requires every set's
+        coherence gate to answer coherent, failing closed on any
+        error. Any gate-down interval flushes the cache before serving
+        resumes: bumps broadcast while we were incoherent never
+        reached us, so everything resident is suspect."""
+        if not self.enabled:
+            return False
+        self.maybe_flush()
+        with self._mu:
+            if self._wire_sets_locked() and (self._probation
+                                             or self._protected):
+                self._invalidate_all_locked()
+            sets = self._layer_sets(self._layer)
+        for s in sets:
+            gate = getattr(getattr(s, "fi_cache", None), "remote_gate",
+                           None)
+            if gate is None:
+                continue
+            try:
+                up = bool(gate())
+            except Exception:  # noqa: BLE001 - gate errors = incoherent
+                up = False
+            if not up:
+                self._gate_was_down = True
+                return False
+        if self._gate_was_down:
+            self._gate_was_down = False
+            self.invalidate_all()
+        return True
+
+    def maybe_flush(self) -> None:
+        """Flush wholesale when a sibling worker process bumped the
+        shared generation (any mutation anywhere in the fleet)."""
+        sg = self.shared_gen
+        if sg is not None:
+            try:
+                if sg.changed():
+                    self.invalidate_all()
+            except Exception:  # noqa: BLE001 - observer errors = flush
+                self.invalidate_all()
+
+    # -- token protocol (fi_cache contract) -----------------------------
+
+    def token(self, bucket: str) -> int:
+        """Current generation for bucket; take BEFORE the read fan-out
+        and hand to put(). setdefault (not get) so a concurrent
+        invalidation that bumps the generation is always observed as a
+        mismatch by put()."""
+        self.maybe_flush()
+        with self._mu:
+            return self._gens.setdefault(bucket, 0)
+
+    # -- lookups --------------------------------------------------------
+
+    def get(self, bucket: str, object_: str) -> Optional[_Entry]:
+        """Resident entry for (bucket, object) or None. Counts the
+        access in the admission sketch either way; a probation hit
+        promotes to protected."""
+        if not self._serving():
+            return None
+        key = (bucket, object_)
+        with self._mu:
+            self._sketch.record(bucket + "/" + object_)
+            entry = self._protected.get(key)
+            if entry is not None:
+                self._protected.move_to_end(key)
+                self.hits += 1
+                return entry
+            entry = self._probation.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            # Second hit: promote, demoting the protected LRU back to
+            # probation when the protected segment is full.
+            del self._probation[key]
+            self._protected[key] = entry
+            if len(self._protected) > self._protected_cap:
+                old_key, old = self._protected.popitem(last=False)
+                self._probation[old_key] = old
+                self._probation.move_to_end(old_key)
+            self.hits += 1
+            return entry
+
+    # -- admission / insert ---------------------------------------------
+
+    def admit(self, bucket: str, object_: str, size: int) -> bool:
+        """Should the GET path buffer this object for insertion?
+
+        Free room admits outright (warm-up); otherwise tinyLFU: the
+        candidate must beat the eviction victim's estimated frequency.
+        The doorkeeper means a first-ever access never wins a
+        contested admission."""
+        if not self.enabled or size <= 0 or size > self.obj_max:
+            return False
+        with self._mu:
+            key = (bucket, object_)
+            if key in self._probation or key in self._protected:
+                return False
+            if (len(self._probation) + len(self._protected)
+                    < self.max_entries
+                    and self._bytes + size <= self.max_bytes):
+                return True
+            victim_key = next(iter(self._probation), None) \
+                or next(iter(self._protected), None)
+            if victim_key is None:
+                self.rejects += 1
+                return False
+            skey = bucket + "/" + object_
+            vkey = victim_key[0] + "/" + victim_key[1]
+            if self._sketch.estimate(skey) > self._sketch.estimate(vkey):
+                return True
+            self.rejects += 1
+            return False
+
+    def put(self, bucket: str, object_: str, info: Any, body: bytes,
+            head: Optional[bytes], token: int) -> bool:
+        """Insert a served object. Refused when the bucket generation
+        moved since token() — a mutation raced the read and the bytes
+        may predate it."""
+        if not self.enabled or len(body) > self.obj_max:
+            return False
+        with self._mu:
+            if self._gens.get(bucket, 0) != token:
+                return False
+            key = (bucket, object_)
+            old = self._probation.pop(key, None) \
+                or self._protected.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            entry = _Entry(info, body)
+            if head is not None:
+                tpl = split_head(head)
+                if tpl is not None:
+                    entry.head_prefix, entry.head_suffix = tpl
+            self._probation[key] = entry
+            self._bytes += entry.nbytes
+            self.admits += 1
+            self._evict_locked()
+            return True
+
+    def set_head(self, bucket: str, object_: str, etag: str,
+                 version_id: str, head: bytes) -> None:
+        """Back-fill the response-head template on an entry that was
+        admitted without one (e.g. first hit came through the handler
+        path). Identity-checked so a template from a different object
+        generation can never be spliced onto newer bytes."""
+        with self._mu:
+            key = (bucket, object_)
+            entry = self._protected.get(key) or self._probation.get(key)
+            if entry is None or entry.head_prefix is not None:
+                return
+            if (getattr(entry.info, "etag", None) != etag
+                    or (getattr(entry.info, "version_id", None) or "")
+                    != (version_id or "")):
+                return
+            tpl = split_head(head)
+            if tpl is not None:
+                entry.head_prefix, entry.head_suffix = tpl
+
+    def _evict_locked(self) -> None:
+        while (len(self._probation) + len(self._protected)
+               > self.max_entries or self._bytes > self.max_bytes):
+            if self._probation:
+                _, victim = self._probation.popitem(last=False)
+            elif self._protected:
+                _, victim = self._protected.popitem(last=False)
+            else:
+                break
+            self._bytes -= victim.nbytes
+            self.evictions += 1
+
+    # -- invalidation ----------------------------------------------------
+
+    def invalidate_bucket(self, bucket: str) -> None:
+        """Metacache bump listener: every namespace mutation in the
+        bucket lands here synchronously, before the mutation acks."""
+        with self._mu:
+            self._gens[bucket] = self._gens.get(bucket, 0) + 1
+            for seg in (self._probation, self._protected):
+                for key in [k for k in seg if k[0] == bucket]:
+                    self._bytes -= seg.pop(key).nbytes
+            self.invalidations += 1
+
+    def invalidate_all(self) -> None:
+        with self._mu:
+            self._invalidate_all_locked()
+
+    def _invalidate_all_locked(self) -> None:
+        for bucket in self._gens:
+            self._gens[bucket] += 1
+        self._probation.clear()
+        self._protected.clear()
+        self._bytes = 0
+        self.invalidations += 1
+
+    # -- introspection ---------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "enabled": self.enabled,
+                "entries": len(self._probation) + len(self._protected),
+                "bytes": self._bytes,
+                "max_entries": self.max_entries,
+                "max_bytes": self.max_bytes,
+                "obj_max": self.obj_max,
+                "hits": self.hits,
+                "misses": self.misses,
+                "admits": self.admits,
+                "rejects": self.rejects,
+                "evictions": self.evictions,
+                "invalidations": self.invalidations,
+            }

@@ -188,6 +188,10 @@ class EventLoopServer:
         self.max_conns = max_conns if max_conns is not None else \
             env_int("MTPU_MAX_CONNS", default_max_conns())
         self._native_lib = getattr(HandlerClass, "loop_native_lib", None)
+        # Hot-cache short circuit (object/hotcache.py via the handler's
+        # loop_hot_probe): answer resident GETs ON the loop thread,
+        # before dispatch. None = handler has no hot tier wired.
+        self._hot_probe = getattr(HandlerClass, "loop_hot_probe", None)
         self.socket = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         if reuse_port:
@@ -217,6 +221,7 @@ class EventLoopServer:
         self.reparks_total = 0
         self.reaped_idle_total = 0
         self.dispatch_total = 0
+        self.hot_hits_total = 0
 
     # -- loop ------------------------------------------------------------
 
@@ -442,13 +447,74 @@ class EventLoopServer:
     def _advance(self, conn: _Conn) -> None:
         """Frame-or-park: dispatch when a complete head (or a
         fallback-worthy prefix) is buffered; otherwise stay parked —
-        a partial head never holds an executor thread."""
-        status, head = conn.reader.try_parse_head(self._native_lib)
-        if status == "head":
-            self._dispatch(conn, "head", head)
-        elif status == "fallback":
-            self._dispatch(conn, "fallback", None)
-        # "more": remain parked; the idle deadline covers slow heads.
+        a partial head never holds an executor thread.
+
+        Hot-cache short circuit: each framed head is first offered to
+        the handler's loop_hot_probe — a resident GET is answered right
+        here on the loop thread (no dispatch, no executor round-trip)
+        and the next pipelined head is framed immediately, bounded by
+        the same burst cap the executor applies."""
+        served = 0
+        while True:
+            status, head = conn.reader.try_parse_head(self._native_lib)
+            if status == "head":
+                if served < _PIPELINE_BURST:
+                    hot = self._try_hot(conn, head)
+                    if hot == "served":
+                        served += 1
+                        continue
+                    if hot == "done":
+                        return
+                self._dispatch(conn, "head", head)
+                return
+            if status == "fallback":
+                self._dispatch(conn, "fallback", None)
+                return
+            # "more": remain parked; the idle deadline covers slow
+            # heads. After hot hits, drop the pooled recv buffer like
+            # a re-park does.
+            if served and conn.reader is not None \
+                    and not conn.reader.buffered:
+                conn.reader.hibernate()
+            return
+
+    def _try_hot(self, conn: _Conn, head) -> str | None:
+        """Answer one framed request from the hot-object tier, on the
+        loop thread. Returns None when the probe declines (caller
+        dispatches THIS head to the executor), "served" when the
+        response went out fully and the connection stays parked, or
+        "done" when the connection was destroyed or handed to the
+        EPOLLOUT tail drain."""
+        probe = self._hot_probe
+        if probe is None:
+            return None
+        try:
+            res = probe(conn.handler, head)
+        except Exception:  # noqa: BLE001 - probe failure: full handler
+            return None
+        if res is None:
+            return None
+        bufs, close = res
+        self.hot_hits_total += 1
+        conn.last_activity = time.monotonic()
+        try:
+            _, rest = hotloop.send_nb(conn.sock, bufs)
+        except OSError:
+            self._destroy(conn)
+            return "done"
+        if rest:
+            # Slow reader: the remainder becomes a loop-owned response
+            # tail. No copy needed — hot-entry buffers are immutable
+            # bytes pinned by the cache, unlike pooled windows.
+            conn.pending = rest
+            conn.close_after_write = close
+            conn.state = _WRITING
+            self._register(conn, select.EPOLLOUT)
+            return "done"
+        if close:
+            self._destroy(conn)
+            return "done"
+        return "served"
 
     def _dispatch(self, conn: _Conn, mode: str, head) -> None:
         conn.state = _DISPATCHED
@@ -700,6 +766,7 @@ class EventLoopServer:
             "reparks_total": self.reparks_total,
             "reaped_idle_total": self.reaped_idle_total,
             "dispatch_total": self.dispatch_total,
+            "hot_hits_total": self.hot_hits_total,
             "executor_threads": self._executor.threads,
             "executor_queue": self._executor.depth(),
             "loop_lag": self.loop_lag.state(),

@@ -227,9 +227,9 @@ class Metrics:
                "Requests the native head framer declined to the Python "
                "parser", "counter", [({}, parse_fallbacks)])
         metric("minio_tpu_http_response_path_total",
-               "Responses by final-write mechanism (sendfile "
-               "short-circuit / pooled gathered sendmsg / legacy "
-               "buffered writes)", "counter",
+               "Responses by final-write mechanism (hotcache RAM hit / "
+               "sendfile short-circuit / pooled gathered sendmsg / "
+               "legacy buffered writes)", "counter",
                [({"path": k}, v) for k, v in sorted(resp_path.items())])
         # Event-loop connection plane (s3/eventloop.py): parked vs
         # active fds, fresh accepts vs keep-alive re-parks, shed and
@@ -935,6 +935,59 @@ class Metrics:
             metric("minio_tpu_get_kernel_windows_total",
                    "GET windows decoded, by path",
                    "counter", [({"path": p}, v) for p, v in gk.items()])
+
+        # -- hot-object read tier (object/hotcache.py) ------------------
+        # Hits are GETs that never touched the object layer (served
+        # from a pinned RAM buffer, most straight off the epoll loop);
+        # admits vs rejects say whether tinyLFU is filtering scans;
+        # invalidations say mutations are being observed. Per-worker
+        # caches merge into the fleet view like the loop stats above.
+        hot_states = [p.get("hot_cache") for p in (peer_states or [])
+                      if isinstance(p.get("hot_cache"), dict)]
+        if not hot_states and server is not None:
+            hc = getattr(server, "hot_cache", None)
+            if hc is not None:
+                hot_states = [hc.stats()]
+        if hot_states:
+            hot = {"hits": 0, "misses": 0, "admits": 0, "rejects": 0,
+                   "evictions": 0, "invalidations": 0, "entries": 0,
+                   "bytes": 0}
+            hot_enabled = 0
+            for st in hot_states:
+                if st.get("enabled"):
+                    hot_enabled = 1
+                for key in hot:
+                    hot[key] += st.get(key, 0)
+            metric("minio_tpu_hot_cache_enabled",
+                   "1 when the hot-object read tier is admitting "
+                   "(MTPU_HOT_CACHE kill switch)", "gauge",
+                   [({}, hot_enabled)])
+            for name, help_, type_, key in (
+                    ("minio_tpu_hot_cache_hits_total",
+                     "GETs served from the hot-object RAM tier (no "
+                     "object-layer work)", "counter", "hits"),
+                    ("minio_tpu_hot_cache_misses_total",
+                     "Hot-tier lookups that fell through to the "
+                     "object layer", "counter", "misses"),
+                    ("minio_tpu_hot_cache_admits_total",
+                     "Objects admitted into the hot tier", "counter",
+                     "admits"),
+                    ("minio_tpu_hot_cache_admission_rejects_total",
+                     "Candidates the tinyLFU filter kept out (scan "
+                     "resistance at work)", "counter", "rejects"),
+                    ("minio_tpu_hot_cache_evictions_total",
+                     "Entries evicted by the byte/entry caps",
+                     "counter", "evictions"),
+                    ("minio_tpu_hot_cache_invalidations_total",
+                     "Mutation/coherence flushes of hot entries",
+                     "counter", "invalidations"),
+                    ("minio_tpu_hot_cache_entries",
+                     "Objects currently pinned in the hot tier",
+                     "gauge", "entries"),
+                    ("minio_tpu_hot_cache_bytes",
+                     "Resident bytes pinned in the hot tier", "gauge",
+                     "bytes")):
+                metric(name, help_, type_, [({}, hot[key])])
         # -- distributed plane: grid peer breakers, notify fan-out,
         #    cross-node coherence -----------------------------------------
         from minio_tpu.grid import client as _grid_client
@@ -1056,8 +1109,8 @@ def merge_loop_stats(stats_list) -> dict:
     out = {"enabled": False, "parked": 0, "active": 0, "writing": 0,
            "max_conns": 0, "accepted_total": 0, "shed_total": 0,
            "reparks_total": 0, "reaped_idle_total": 0,
-           "dispatch_total": 0, "executor_threads": 0,
-           "executor_queue": 0}
+           "dispatch_total": 0, "hot_hits_total": 0,
+           "executor_threads": 0, "executor_queue": 0}
     lags = []
     for st in stats_list:
         if not isinstance(st, dict):
@@ -1193,6 +1246,11 @@ def node_info(server) -> dict:
     info["transform"] = tst
     info["io_engine"] = engine
     info["fileinfo_cache"] = fileinfo
+    # Hot-object read tier (object/hotcache.py): this process's cache;
+    # replaced by the fleet merge below in worker mode.
+    hc = getattr(server, "hot_cache", None)
+    if hc is not None:
+        info["hot_cache"] = hc.stats()
     from minio_tpu.storage import meta_scan as _ms
     info["metacache"] = {"sets": metacache, "scan": dict(_ms.counters)}
     info["get_kernel"] = get_kernel
@@ -1214,9 +1272,21 @@ def node_info(server) -> dict:
             info["workers"] = [
                 {k: p.get(k) for k in ("worker", "pid", "in_flight",
                                        "unreachable", "bufpool",
-                                       "fileinfo_cache", "drive_heal")
+                                       "fileinfo_cache", "hot_cache",
+                                       "drive_heal")
                  if k in p}
                 for p in peers]
+            peer_hot = [p.get("hot_cache") for p in peers
+                        if isinstance(p.get("hot_cache"), dict)]
+            if peer_hot:
+                hot_agg: dict = {}
+                for pst in peer_hot:
+                    for k, v in pst.items():
+                        if isinstance(v, bool):
+                            hot_agg[k] = bool(hot_agg.get(k)) or v
+                        elif isinstance(v, (int, float)):
+                            hot_agg[k] = hot_agg.get(k, 0) + v
+                info["hot_cache"] = hot_agg
             http_tot = {"connections_active": 0, "keepalive_reuses": 0,
                         "parse_fallbacks": 0,
                         "response_path": {"sendfile": 0, "pooled": 0,

@@ -123,6 +123,15 @@ class S3Server:
                  reuse_port: bool | None = None):
         self.object_layer = object_layer
         self.credentials = credentials or Credentials()
+        # Hot-object read tier (object/hotcache.py): frequency-admitted
+        # whole-object RAM cache. Hits are served straight off the epoll
+        # loop (the handler class exports loop_hot_probe below) or from
+        # the handler GET path; invalidation rides the metacache bump /
+        # coherence funnel the layer already maintains. MTPU_HOT_CACHE=off
+        # disables it wholesale.
+        from minio_tpu.object.hotcache import HotObjectCache
+        self.hot_cache = HotObjectCache()
+        self.hot_cache.attach_layer(object_layer)
         host, _, port = address.rpartition(":")
         handler = _make_handler(self)
         if reuse_port is None:
@@ -292,6 +301,93 @@ def _make_handler(server: S3Server):
     # native lib) keeps the stock BaseHTTPRequestHandler parse path.
     native_lib = hotloop.lib() if hotloop.native_enabled() else None
     keepalive_s = _keepalive_seconds()
+    from minio_tpu.object import hotcache as hotcache_mod
+
+    # Hot-cache short circuit (object/hotcache.py), run ON the event
+    # loop thread before dispatch: a plain signed whole-object GET whose
+    # object is resident in the hot read tier is answered from the
+    # entry's captured header template (Date re-spliced) + pinned body —
+    # no executor thread, no object-layer call, no erasure fan-out, no
+    # journal read. Anything the probe declines dispatches to the full
+    # handler unchanged, so declined requests are byte-identical to a
+    # cache-off server. Admission gates are deliberately bypassed: a hit
+    # is a RAM copy on the loop thread with none of the drive/CPU
+    # fan-out the per-class admission slots exist to bound.
+    _HOT_DECLINE = ("transfer-encoding", "expect",
+                    "range", "if-match", "if-none-match",
+                    "if-modified-since", "if-unmodified-since",
+                    "x-amz-checksum-mode", "x-amz-security-token",
+                    "x-amz-server-side-encryption-customer-algorithm",
+                    "x-amz-server-side-encryption-customer-key")
+
+    def _hot_probe(handler, head):
+        """(bufs, close_connection) for a servable hot GET, else None.
+
+        Only the root credential short-circuits: root bypasses policy
+        evaluation legitimately (see _authorize); any other identity
+        needs the bucket/IAM policy walk, so the full handler runs.
+        Auth failures also decline — the handler then produces the
+        exact error a cache-off server would."""
+        hc = server.hot_cache
+        if hc is None or not hc.enabled:
+            return None
+        d, method, target, version, http11 = head
+        if method != "GET" or "?" in target:
+            return None
+        if "authorization" not in d:
+            return None
+        # A GET carrying a body would desynchronize the framed stream
+        # (we never read bodies here); an explicit zero length is fine.
+        if d.get("content-length", "0").strip() not in ("", "0"):
+            return None
+        for hk in _HOT_DECLINE:
+            if hk in d:
+                return None
+        t0 = _time_mod.perf_counter()
+        parts = urllib.parse.unquote(target).lstrip("/").split("/", 1)
+        if len(parts) < 2 or not parts[0] or not parts[1]:
+            return None
+        bucket, key = parts[0], parts[1]
+        entry = hc.get(bucket, key)
+        if entry is None or entry.head_prefix is None:
+            return None
+        try:
+            auth = sigv4.verify_request("GET", target, {}, d,
+                                        server.credentials.secret_for)
+        except Exception:  # noqa: BLE001 - any auth failure: full handler
+            return None
+        if auth.anonymous or auth.credential is None \
+                or auth.credential.access_key \
+                != server.credentials.access_key:
+            return None
+        body = entry.body
+        bufs = [entry.head_prefix, hotcache_mod.date_bytes(),
+                entry.head_suffix, body]
+        conntype = d.get("connection", "").lower()
+        if conntype == "close":
+            close = True
+        elif http11:
+            close = False
+        else:
+            close = conntype != "keep-alive"
+        # The loop path never enters _route: replicate its per-request
+        # accounting (metrics, path split, keep-alive reuse, trace and
+        # audit) so hot hits are observable like every other response.
+        handler._count_request()
+        dt = _time_mod.perf_counter() - t0
+        server.metrics.record("GET:object", 200, dt, rx=0, tx=len(body))
+        server.metrics.response_path("hotcache")
+        if server.tracer.active or server.audit is not None:
+            from minio_tpu.s3.trace import make_entry
+            te = make_entry(
+                "GET:object", "GET", target, bucket, key, 200, dt,
+                handler.client_address[0] if handler.client_address
+                else "", auth.credential.access_key, rx=0, tx=len(body))
+            te["worker"] = server.worker_id
+            server.tracer.publish(te)
+            if server.audit is not None:
+                server.audit.submit(te)
+        return bufs, close
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -301,6 +397,7 @@ def _make_handler(server: S3Server):
         # deadline the thread path applies via settimeout.
         loop_native_lib = native_lib
         loop_keepalive_s = keepalive_s
+        loop_hot_probe = staticmethod(_hot_probe)
 
         # -- plumbing ---------------------------------------------------
 
@@ -315,6 +412,10 @@ def _make_handler(server: S3Server):
             self._body_reader = None
             self._defer_head = False
             self._deferred_head = None
+            # Per-response override for the response-path counter
+            # ("hotcache" when _get_object served from the hot tier);
+            # None = the transport default (pooled/legacy/sendfile).
+            self._path_kind = None
             # Set by the event-loop dispatcher (s3/eventloop.py _Conn);
             # None under the thread-per-connection front end.
             self._loop_conn = None
@@ -458,12 +559,13 @@ def _make_handler(server: S3Server):
             lc = self._loop_conn
             if final and lc is not None:
                 self.server.offload_final(lc, bufs)
-                server.metrics.response_path("pooled")
+                server.metrics.response_path(self._path_kind or "pooled")
                 return
             try:
                 hotloop.send_gathered(self.connection, bufs)
                 if final:
-                    server.metrics.response_path("pooled")
+                    server.metrics.response_path(self._path_kind
+                                                 or "pooled")
             except (AttributeError, NotImplementedError):
                 sent = 0
                 try:
@@ -472,7 +574,8 @@ def _make_handler(server: S3Server):
                             self.wfile.write(b)
                             sent += len(b)
                     if final:
-                        server.metrics.response_path("legacy")
+                        server.metrics.response_path(self._path_kind
+                                                     or "legacy")
                 except Exception as e:  # noqa: BLE001 - annotate progress
                     e.mtpu_sent = sent
                     raise
@@ -488,14 +591,21 @@ def _make_handler(server: S3Server):
             the fd."""
             sent = 0
             try:
-                self._send_bufs([head])
-                sfd = self.connection.fileno()
-                while sent < length:
-                    n = os.sendfile(sfd, fd, offset + sent,
-                                    min(length - sent, 1 << 24))
-                    if n == 0:          # truncated source: cut short
-                        break
-                    sent += n
+                # Span the in-kernel copy so the short-circuit shows up
+                # in internal traces and the slow-op log like every
+                # other response path (it never touches the pooled
+                # windows the engine spans cover).
+                with tracing_mod.span("http", "sendfile",
+                                      {"bytes": length}) \
+                        if tracing_mod.ACTIVE else tracing_mod.NOOP:
+                    self._send_bufs([head])
+                    sfd = self.connection.fileno()
+                    while sent < length:
+                        n = os.sendfile(sfd, fd, offset + sent,
+                                        min(length - sent, 1 << 24))
+                        if n == 0:      # truncated source: cut short
+                            break
+                        sent += n
                 self._sent_bytes = getattr(self, "_sent_bytes", 0) + sent
             except OSError:
                 # Headers (a 200) may already be on the wire: all we
@@ -786,6 +896,7 @@ def _make_handler(server: S3Server):
             self._last_status = 0
             self._sent_bytes = 0
             self._auth_key = ""
+            self._path_kind = None
             t0 = _time_mod.perf_counter()
             with server._inflight_mu:
                 server._inflight += 1
@@ -2657,6 +2768,11 @@ def _make_handler(server: S3Server):
                     bucket, key, GetOptions(version_id=vid))
                 if self._check_conditions(h, pre, for_read=True):
                     return self._send_not_modified(pre)
+            hot = getattr(server, "hot_cache", None)
+            hot_entry = None
+            hot_token = None
+            hot_admit = False
+            hot_head = None
             if method == "HEAD":
                 # HEAD: metadata fan-out only, no shard reads.
                 info = server.object_layer.get_object_info(
@@ -2664,6 +2780,22 @@ def _make_handler(server: S3Server):
                 self._sse_check_head(h, info)
                 start, length = (_resolve_head_range(spec, info.size)
                                  if spec else (0, info.size))
+            elif hot is not None and not vid \
+                    and (hot_entry := hot.get(bucket, key)) is not None:
+                # Hot-tier RAM hit (object/hotcache.py): serve the
+                # pinned plaintext body with ZERO object-layer work.
+                # The shared header-assembly + send code below runs
+                # unchanged on the cached ObjectInfo, so the response
+                # is byte-identical to a miss (and to a
+                # MTPU_HOT_CACHE=off server). Cheap ranges resolve
+                # against the resident whole object.
+                info = hot_entry.info
+                start, length = (_resolve_head_range(spec, info.size)
+                                 if spec else (0, info.size))
+                chunks = (w for w in
+                          (memoryview(hot_entry.body)
+                           [start:start + length],))
+                self._path_kind = "hotcache"
             else:
                 # One streaming read, rerouted on the returned info when
                 # the object carries a transform (SSE grows the offset
@@ -2675,6 +2807,12 @@ def _make_handler(server: S3Server):
                 # (unversioned buckets keep a small overwrite race, as
                 # does the reference).
                 from minio_tpu.object.types import InvalidRange as _IR
+                if hot is not None and hot.enabled and not vid:
+                    # Hot-tier token BEFORE the read fan-out (the
+                    # fi_cache contract): a mutation racing this read
+                    # bumps the bucket generation, and put() below
+                    # refuses the stale insert.
+                    hot_token = hot.token(bucket)
                 info = chunks = None
                 try:
                     info, chunks = \
@@ -2701,6 +2839,12 @@ def _make_handler(server: S3Server):
                         bucket, key, vid or info.version_id, spec, info)
                 else:
                     start, length = info.range_start, info.range_length
+                    # Hot-tier admission (tinyLFU): only plaintext
+                    # whole-object reads under the size cap are
+                    # candidates; the sketch decides whether buffering
+                    # this body beats the would-be eviction victim.
+                    if hot_token is not None and spec is None and length:
+                        hot_admit = hot.admit(bucket, key, length)
                     # Whole-object plaintext sendfile short-circuit:
                     # a tier-resident (FS-warm) version's stored bytes
                     # live contiguously in one local file, so the body
@@ -2765,10 +2909,34 @@ def _make_handler(server: S3Server):
                 head = self._take_head()
                 if method == "HEAD":
                     return self._send_bufs([head], final=True)
+                if hot is not None and spec is None \
+                        and h.get("x-amz-checksum-mode",
+                                  "").upper() != "ENABLED":
+                    # A plain whole-object GET's header block is the
+                    # canonical response every later hit must replay
+                    # byte-identically; checksum-mode requests shape
+                    # extra headers, so their head never becomes the
+                    # template (their body may still be admitted).
+                    if hot_entry is not None:
+                        hot.set_head(bucket, key, info.etag,
+                                     info.version_id or "", head)
+                    elif hot_admit:
+                        hot_head = head
                 if send_fd is not None:
-                    return self._sendfile_body(head, send_fd, start,
-                                               length)
+                    self._sendfile_body(head, send_fd, start, length)
+                    if hot_admit and not self.close_connection:
+                        # Tier-resident hit went out in-kernel; admit
+                        # the same bytes from the already-open fd.
+                        try:
+                            hbody = os.pread(send_fd, length, start)
+                        except OSError:
+                            hbody = b""
+                        if len(hbody) == length:
+                            hot.put(bucket, key, info, hbody, hot_head,
+                                    hot_token)
+                    return
                 sent = 0
+                hot_buf = bytearray() if hot_admit else None
                 try:
                     # Gathered zero-copy streaming: the header block
                     # rides the FIRST window's sendmsg; every window is
@@ -2781,6 +2949,10 @@ def _make_handler(server: S3Server):
                     # executor on a slow reader.
                     for chunk in chunks:
                         last = sent + len(chunk) >= length
+                        if hot_buf is not None:
+                            # Copy BEFORE the send: pooled windows are
+                            # recycled when the generator advances.
+                            hot_buf += chunk
                         if head is not None:
                             self._send_bufs([head, chunk], final=last)
                             head = None
@@ -2810,6 +2982,9 @@ def _make_handler(server: S3Server):
                     sent = -1
                 if sent != length:
                     self.close_connection = True
+                elif hot_buf is not None:
+                    hot.put(bucket, key, info, bytes(hot_buf), hot_head,
+                            hot_token)
             finally:
                 if chunks is not None:
                     chunks.close()

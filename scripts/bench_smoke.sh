@@ -18,7 +18,7 @@ fi
 
 echo "== bench smoke (baseline: $latest) =="
 out=$(JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} \
-      MTPU_BENCH_ONLY=put_latency,put_concurrent,get_latency,get_concurrent,meta_listing,small_put,transform_put,distributed,connections,rebalance \
+      MTPU_BENCH_ONLY=put_latency,put_concurrent,get_latency,get_concurrent,meta_listing,small_put,transform_put,distributed,connections,rebalance,hot_get \
       MTPU_BENCH_SMALL=1 \
       python bench.py)
 echo "$out"
@@ -71,6 +71,14 @@ import sys
 # ramp ("higher" — fan-in must not degrade the aggregate). Both emit
 # explicit nulls on fd-limited hosts (RLIMIT_NOFILE below the
 # connection target) and the gates skip cleanly there.
+# The hot_get gates watch the hot read tier (ROADMAP item 4):
+# hot_get_gibps ("higher") is the served GET aggregate of the
+# frequency-admitted RAM cache at the top of a zipfian connection
+# ramp, and vs_erasure ("higher") divides it by the MTPU_HOT_CACHE=off
+# column measured back-to-back in the SAME bench run (the kill-switch
+# fleet pays the full erasure fan-out per GET, so the ratio is the
+# hit-path win and shares the run's scheduler weather). Both emit
+# explicit nulls on fd-limited hosts and the gates skip cleanly there.
 # The rebalance gates watch the elastic fleet plane (ROADMAP item 3):
 # vs_quiescent ("lower") is the foreground PUT p50 during an online
 # drain divided by the quiescent p50 measured in the SAME run — the
@@ -102,6 +110,8 @@ GATES = [
     ("distributed_list_page_p50_ms", "value", "lower"),
     ("connections_idle_rss_per_conn_kib", "value", "lower"),
     ("connections_get_ramp_gibps", "value", "higher"),
+    ("hot_get_gibps", "value", "higher"),
+    ("hot_get_gibps", "vs_erasure", "higher"),
     ("rebalance_fg_p50_during_ms", "vs_quiescent", "lower"),
     ("rebalance_identity", "value", "higher"),
 ]

@@ -35,6 +35,20 @@ if [ "${MTPU_CRASH_SWEEP:-}" = "1" ]; then
         -q -p no:cacheprovider || exit 1
 fi
 
+# Hot-read-tier kill-switch conformance: the S3 conformance subset
+# must be green with the hot cache ON (default) and OFF
+# (MTPU_HOT_CACHE=off) — responses are chartered byte-identical either
+# way, so any divergence is a hot-path bug, not a config choice. The
+# hotcache suite itself (admission, zero-stale chaos, fleet/cluster
+# coherence) runs inside tier-1 below; this up-front pass pins the
+# kill switch specifically.
+echo "== hot-cache kill-switch conformance (on/off) =="
+env JAX_PLATFORMS=cpu python -m pytest tests/test_s3_conformance.py \
+    -q -m 'not slow' -p no:cacheprovider || exit 1
+env JAX_PLATFORMS=cpu MTPU_HOT_CACHE=off python -m pytest \
+    tests/test_s3_conformance.py \
+    -q -m 'not slow' -p no:cacheprovider || exit 1
+
 # Fast cluster subset FIRST: the multi-node-in-one-container harness
 # (tests/cluster.py) booting real server processes with real grid
 # websockets and dsync quorums — kill/partition/walk_scan/coherence

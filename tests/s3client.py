@@ -4,10 +4,12 @@ aws-sdk clients do, so the server-side verification is exercised for real
 
 from __future__ import annotations
 
+import bisect
 import datetime
 import hashlib
 import hmac
 import http.client
+import random
 import socket
 import threading
 import time
@@ -278,7 +280,9 @@ class S3Client:
 def ramp_get(address: str, path: str, body_len: int, connections: int,
              duration_s: float = 2.0, access_key: str = "minioadmin",
              secret_key: str = "minioadmin",
-             region: str = "us-east-1") -> dict:
+             region: str = "us-east-1",
+             paths: list[str] | None = None, alpha: float = 1.0,
+             hot_frac: float = 0.1) -> dict:
     """Multi-connection GET fan-in driver: `connections` client threads,
     each with its OWN persistent raw socket (S3Client.get_into — signed
     head out, recv_into straight into a reusable buffer), all released
@@ -287,7 +291,28 @@ def ramp_get(address: str, path: str, body_len: int, connections: int,
     growing client connection count, instead of one hot socket whose
     single client thread was the bottleneck. Returns {connections, ops,
     bytes, secs, agg_gibps, errors}; the aggregate counts only
-    responses that completed inside the window."""
+    responses that completed inside the window.
+
+    paths: optional zipfian hot-set mode — instead of hammering `path`,
+    every request picks from `paths` (ALL must serve `body_len` bytes)
+    with rank-frequency P(rank i) ∝ 1/(i+1)**alpha, the skew real
+    object workloads show and the distribution the hot read tier's
+    tinyLFU admission is built for. Each thread uses its own seeded
+    RNG so runs are reproducible. hot_frac only adds accounting: the
+    first max(1, round(hot_frac*len(paths))) ranks are the "hot set"
+    and the result gains {hot_set, hot_ops} so callers can relate the
+    served aggregate to expected cache residency."""
+    if paths:
+        weights = [1.0 / (i + 1) ** alpha for i in range(len(paths))]
+        total_w = sum(weights)
+        cum, acc = [], 0.0
+        for w in weights:
+            acc += w
+            cum.append(acc / total_w)
+        hot_set = max(1, round(hot_frac * len(paths)))
+    else:
+        cum = None
+        hot_set = 0
     results: list = [None] * connections
     deadline_box = [0.0]
     # The barrier action runs in exactly one thread at the release
@@ -300,26 +325,37 @@ def ramp_get(address: str, path: str, body_len: int, connections: int,
     def worker(t: int) -> None:
         cli = S3Client(address, access_key=access_key,
                        secret_key=secret_key, region=region)
+        rng = random.Random(0xC0FFEE + t)
+
+        def pick() -> tuple[str, int]:
+            if cum is None:
+                return path, 0
+            i = bisect.bisect_left(cum, rng.random())
+            return paths[i], i
+
         buf = bytearray(body_len)
-        ops = got = errs = 0
+        ops = got = errs = hot_ops = 0
         primed = False
         try:
             # Prime the connection OUTSIDE the measured window (TCP +
             # first-request warmup is setup, not serving).
-            st, n = cli.get_into(path, buf)
+            st, n = cli.get_into(pick()[0], buf)
             assert st == 200 and n == body_len, (st, n)
             primed = True
             barrier.wait()
             deadline = deadline_box[0]
             while time.monotonic() < deadline:
+                p, rank = pick()
                 try:
-                    st, n = cli.get_into(path, buf)
+                    st, n = cli.get_into(p, buf)
                 except OSError:
                     errs += 1
                     continue
                 if st == 200 and n == body_len:
                     ops += 1
                     got += n
+                    if rank < hot_set:
+                        hot_ops += 1
                 else:
                     errs += 1
         except Exception:  # noqa: BLE001 - surface via the error count
@@ -330,7 +366,7 @@ def ramp_get(address: str, path: str, body_len: int, connections: int,
                 except threading.BrokenBarrierError:
                     pass
         finally:
-            results[t] = (ops, got, errs)
+            results[t] = (ops, got, errs, hot_ops)
             cli.close()
 
     threads = [threading.Thread(target=worker, args=(t,), daemon=True)
@@ -345,6 +381,10 @@ def ramp_get(address: str, path: str, body_len: int, connections: int,
     ops = sum(r[0] for r in results if r)
     nbytes = sum(r[1] for r in results if r)
     errors = sum(r[2] for r in results if r)
-    return {"connections": connections, "ops": ops, "bytes": nbytes,
-            "secs": round(secs, 3), "errors": errors,
-            "agg_gibps": round(nbytes / secs / (1 << 30), 4)}
+    out = {"connections": connections, "ops": ops, "bytes": nbytes,
+           "secs": round(secs, 3), "errors": errors,
+           "agg_gibps": round(nbytes / secs / (1 << 30), 4)}
+    if cum is not None:
+        out["hot_set"] = hot_set
+        out["hot_ops"] = sum(r[3] for r in results if r)
+    return out

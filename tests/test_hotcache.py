@@ -1,0 +1,525 @@
+"""Hot-object read tier (object/hotcache.py): tinyLFU admission unit
+tests and zero-stale-read chaos at every process topology.
+
+  * admission sketch — doorkeeper absorbs one-hit wonders (scan
+    resistance), repeated access raises the estimate, aging decays it;
+  * residency — free-room warm-up admits, byte-cap eviction drains
+    probation first, contested admission requires beating the victim's
+    frequency, token protocol refuses puts that raced a mutation;
+  * eligibility — ranged, versioned and SSE GETs never populate the
+    cache; the kill switch disables it wholesale with byte-identical
+    responses;
+  * zero stale reads — concurrent overwrite/delete chaos in one
+    process, across a 2-worker pre-forked fleet (shared-generation
+    flush), and on a 3-node cluster through a partition/rejoin cycle
+    (coherence gate refuses hits while partitioned).
+"""
+
+import os
+import socket
+import threading
+import time
+import types
+
+import pytest
+
+from minio_tpu.object import hotcache
+from minio_tpu.object.erasure_object import ErasureSet
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.local import LocalStorage
+from tests.cluster import Cluster
+from tests.s3client import S3Client
+
+
+def _wait(cond, timeout=30, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def _info(etag="e1", version_id=""):
+    return types.SimpleNamespace(etag=etag, version_id=version_id)
+
+
+def _cache(monkeypatch, max_entries=8, max_bytes=1 << 20,
+           obj_max=1 << 19):
+    monkeypatch.setenv("MTPU_HOT_CACHE_MAX", str(max_entries))
+    monkeypatch.setenv("MTPU_HOT_CACHE_BYTES", str(max_bytes))
+    monkeypatch.setenv("MTPU_HOT_CACHE_OBJ_MAX", str(obj_max))
+    monkeypatch.delenv("MTPU_HOT_CACHE", raising=False)
+    hc = hotcache.HotObjectCache()
+    # Anchor the (empty) topology walk so the first get() does not
+    # register as a topology change and flush the cache under test.
+    hc.attach_layer(None)
+    return hc
+
+
+# ---------------------------------------------------------------------------
+# admission sketch
+# ---------------------------------------------------------------------------
+
+def test_sketch_doorkeeper_absorbs_first_access():
+    sk = hotcache.FrequencySketch(64)
+    sk.record("k")
+    # First occurrence only set doorkeeper bits: sketch counters are 0.
+    assert sk.estimate("k") == 1
+    sk.record("k")
+    assert sk.estimate("k") >= 2
+
+
+def test_sketch_scan_resistance():
+    """A scan of one-hit wonders never outranks a genuinely hot key:
+    single occurrences stop at the doorkeeper (estimate 1) while the
+    hot key's counters keep climbing."""
+    sk = hotcache.FrequencySketch(64)
+    for i in range(500):
+        sk.record(f"scan-{i}")
+    for _ in range(8):
+        sk.record("hot")
+    hot = sk.estimate("hot")
+    assert hot >= 6
+    assert all(sk.estimate(f"scan-{i}") < hot for i in range(0, 500, 50))
+
+
+def test_sketch_aging_decays_estimates():
+    sk = hotcache.FrequencySketch(16)
+    for _ in range(30):
+        sk.record("k")
+    before = sk.estimate("k")
+    sk._age()
+    after = sk.estimate("k")
+    assert after < before
+    # Doorkeeper was reset too: a post-aging single hit is absorbed.
+    sk.record("fresh")
+    assert sk.estimate("fresh") == 1
+
+
+# ---------------------------------------------------------------------------
+# residency: admission, eviction, token protocol
+# ---------------------------------------------------------------------------
+
+def test_free_room_admits_and_roundtrips(monkeypatch):
+    hc = _cache(monkeypatch)
+    assert hc.admit("b", "o", 100)
+    tok = hc.token("b")
+    assert hc.put("b", "o", _info(), b"x" * 100, None, tok)
+    entry = hc.get("b", "o")
+    assert entry is not None and entry.body == b"x" * 100
+    st = hc.stats()
+    assert st["entries"] == 1 and st["bytes"] == 100 and st["hits"] == 1
+
+
+def test_byte_cap_eviction_drains_probation_first(monkeypatch):
+    hc = _cache(monkeypatch, max_entries=64, max_bytes=10_000,
+                obj_max=5_000)
+    tok = hc.token("b")
+    for i in range(3):
+        assert hc.put("b", f"o{i}", _info(), b"x" * 4_000, None, tok)
+    st = hc.stats()
+    assert st["bytes"] <= 10_000
+    assert st["entries"] == 2 and st["evictions"] == 1
+    # The LRU probation entry (o0) was the victim.
+    assert hc.get("b", "o0") is None
+    assert hc.get("b", "o2") is not None
+
+
+def test_contested_admission_requires_frequency(monkeypatch):
+    hc = _cache(monkeypatch, max_entries=4)
+    tok = hc.token("b")
+    for i in range(4):
+        assert hc.put("b", f"r{i}", _info(), b"x" * 10, None, tok)
+    # Cold candidate: estimate 0 does not beat the victim — rejected.
+    assert not hc.admit("b", "cold", 10)
+    assert hc.stats()["rejects"] == 1
+    # A key that keeps missing accumulates frequency (get() records the
+    # sketch on miss too) and eventually wins the contest.
+    for _ in range(4):
+        assert hc.get("b", "hot") is None
+    assert hc.admit("b", "hot", 10)
+
+
+def test_oversized_object_never_admitted(monkeypatch):
+    hc = _cache(monkeypatch, obj_max=1_000)
+    assert not hc.admit("b", "big", 1_001)
+    tok = hc.token("b")
+    assert not hc.put("b", "big", _info(), b"x" * 1_001, None, tok)
+    assert hc.stats()["entries"] == 0
+
+
+def test_token_put_refused_after_bucket_invalidation(monkeypatch):
+    hc = _cache(monkeypatch)
+    tok = hc.token("b")
+    hc.invalidate_bucket("b")          # a mutation raced the read
+    assert not hc.put("b", "o", _info(), b"data", None, tok)
+    assert hc.get("b", "o") is None
+    # A fresh token works again.
+    tok = hc.token("b")
+    assert hc.put("b", "o", _info(), b"data", None, tok)
+
+
+def test_invalidate_bucket_is_exact(monkeypatch):
+    hc = _cache(monkeypatch)
+    ta, tb = hc.token("a"), hc.token("b")
+    assert hc.put("a", "o", _info(), b"aa", None, ta)
+    assert hc.put("b", "o", _info(), b"bb", None, tb)
+    hc.invalidate_bucket("a")
+    assert hc.get("a", "o") is None
+    assert hc.get("b", "o") is not None
+
+
+def test_probation_hit_promotes_to_protected(monkeypatch):
+    hc = _cache(monkeypatch, max_entries=10)
+    tok = hc.token("b")
+    assert hc.put("b", "o", _info(), b"x", None, tok)
+    assert ("b", "o") in hc._probation
+    assert hc.get("b", "o") is not None
+    assert ("b", "o") in hc._protected and ("b", "o") not in hc._probation
+
+
+def test_kill_switch_disables_cache(monkeypatch):
+    monkeypatch.setenv("MTPU_HOT_CACHE", "off")
+    hc = hotcache.HotObjectCache()
+    assert not hc.enabled
+    assert not hc.admit("b", "o", 10)
+    assert not hc.put("b", "o", _info(), b"x", None, hc.token("b"))
+    assert hc.get("b", "o") is None
+
+
+def test_split_head_roundtrip():
+    head = (b"HTTP/1.1 200 OK\r\nServer: MinIO-TPU\r\n"
+            b"Date: Thu, 01 Jan 1970 00:00:00 GMT\r\n"
+            b"ETag: \"abc\"\r\nContent-Length: 3\r\n\r\n")
+    prefix, suffix = hotcache.split_head(head)
+    stamped = prefix + hotcache.date_bytes() + suffix
+    assert stamped.startswith(b"HTTP/1.1 200 OK\r\nServer: MinIO-TPU\r\n"
+                              b"Date: ")
+    assert stamped.endswith(b"ETag: \"abc\"\r\nContent-Length: 3\r\n\r\n")
+    assert hotcache.split_head(b"HTTP/1.1 200 OK\r\n\r\n") is None
+
+
+# ---------------------------------------------------------------------------
+# served-path behavior (in-process server, both front ends)
+# ---------------------------------------------------------------------------
+
+def _make_server(tmp_path, name, env=None, drives=4):
+    saved = {}
+    for k, v in (env or {}).items():
+        saved[k] = os.environ.get(k)
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    try:
+        disks = [LocalStorage(str(tmp_path / name / f"d{i}"))
+                 for i in range(drives)]
+        srv = S3Server(ErasureSet(disks), address="127.0.0.1:0")
+        srv.start()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return srv
+
+
+@pytest.fixture(scope="module", params=["loop", "threads"])
+def srv(request, tmp_path_factory):
+    env = {"MTPU_HOT_CACHE": None}
+    if request.param == "threads":
+        env["MTPU_HTTP_EVENTLOOP"] = "off"
+    server = _make_server(tmp_path_factory.mktemp(f"hc-{request.param}"),
+                          request.param, env)
+    server._front = request.param
+    yield server
+    server.stop()
+
+
+@pytest.fixture(scope="module")
+def cli(srv):
+    c = S3Client(srv.address)
+    assert c.request("PUT", "/hcb")[0] == 200
+    return c
+
+
+def _resident(srv, bucket, key):
+    hc = srv.hot_cache
+    return (bucket, key) in hc._probation or (bucket, key) in hc._protected
+
+
+def test_hit_response_identical_and_path_stamped(srv, cli):
+    body = os.urandom(200_000)
+    assert cli.request("PUT", "/hcb/hot", body=body)[0] == 200
+    st, h_miss, got = cli.request("GET", "/hcb/hot")
+    assert st == 200 and got == body
+    # put() runs after the response's final write — wait for residency.
+    assert _wait(lambda: _resident(srv, "hcb", "hot"))
+    st, h_hit, got = cli.request("GET", "/hcb/hot")
+    assert st == 200 and got == body
+    strip = lambda h: {k: v for k, v in h.items() if k != "Date"}  # noqa: E731
+    assert strip(h_hit) == strip(h_miss)
+    # The thread front end stamps response_path AFTER the final send
+    # returns — the client can finish reading (and this test scrape the
+    # counters) a hair before the server thread runs the stamp line.
+    assert _wait(lambda: srv.metrics.http_conn_stats()
+                 ["response_path"].get("hotcache", 0) >= 1, timeout=5), \
+        srv.metrics.http_conn_stats()["response_path"]
+    assert srv.hot_cache.stats()["hits"] >= 1
+
+
+def test_overwrite_and_delete_never_serve_stale(srv, cli):
+    v1 = os.urandom(64_000)
+    assert cli.request("PUT", "/hcb/mut", body=v1)[0] == 200
+    st, _, got = cli.request("GET", "/hcb/mut")
+    assert st == 200 and got == v1
+    _wait(lambda: _resident(srv, "hcb", "mut"))
+    v2 = os.urandom(64_000)
+    assert cli.request("PUT", "/hcb/mut", body=v2)[0] == 200
+    # The bump listener dropped the entry before the PUT acked: the
+    # very next GET must be the new bytes.
+    st, _, got = cli.request("GET", "/hcb/mut")
+    assert st == 200 and got == v2
+    _wait(lambda: _resident(srv, "hcb", "mut"))
+    assert cli.request("DELETE", "/hcb/mut")[0] == 204
+    st, _, _ = cli.request("GET", "/hcb/mut")
+    assert st == 404
+
+
+def test_concurrent_overwrite_chaos_zero_stale(srv):
+    """Reader threads hammer GET over keep-alive sockets while the
+    writer overwrites through 8 generations: every 200 must be a
+    complete generation body (no torn reads), and after each acked PUT
+    the next synchronous GET must serve the new generation."""
+    size = 32_768
+    gens = [bytes([g]) * size for g in range(8)]
+    assert S3Client(srv.address).request("PUT", "/hcb/chaos",
+                                         body=gens[0])[0] == 200
+    stop = threading.Event()
+    errors: list = []
+
+    def reader():
+        c = S3Client(srv.address, keepalive=True)
+        try:
+            while not stop.is_set():
+                st, _, got = c.request("GET", "/hcb/chaos")
+                if st == 200 and got not in gens:
+                    errors.append(f"torn body len={len(got)}")
+                    return
+        except Exception as e:  # noqa: BLE001 - surfaced via errors
+            if not stop.is_set():
+                errors.append(repr(e))
+        finally:
+            c.close()
+
+    threads = [threading.Thread(target=reader, daemon=True)
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    w = S3Client(srv.address, keepalive=True)
+    try:
+        for g in range(1, 8):
+            assert w.request("PUT", "/hcb/chaos", body=gens[g])[0] == 200
+            st, _, got = w.request("GET", "/hcb/chaos")
+            assert st == 200 and got == gens[g], f"stale gen after PUT {g}"
+    finally:
+        stop.set()
+        w.close()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors
+    assert S3Client(srv.address).request("DELETE", "/hcb/chaos")[0] == 204
+    assert S3Client(srv.address).request("GET", "/hcb/chaos")[0] == 404
+
+
+def test_ranged_versioned_sse_gets_never_populate(srv, cli):
+    body = os.urandom(50_000)
+    assert cli.request("PUT", "/hcb/rng", body=body)[0] == 200
+    st, _, got = cli.request("GET", "/hcb/rng",
+                             headers={"Range": "bytes=10-99"})
+    assert st == 206 and got == body[10:100]
+    assert not _resident(srv, "hcb", "rng")
+    # versionId GETs bypass the cache entirely.
+    st, _, _ = cli.request("GET", "/hcb/rng", query={"versionId": "null"})
+    assert not _resident(srv, "hcb", "rng")
+    # SSE-C objects decrypt per-request and are never pinned.
+    import base64
+    import hashlib
+    key = os.urandom(32)
+    hdr = {"x-amz-server-side-encryption-customer-algorithm": "AES256",
+           "x-amz-server-side-encryption-customer-key":
+           base64.b64encode(key).decode(),
+           "x-amz-server-side-encryption-customer-key-md5":
+           base64.b64encode(hashlib.md5(key).digest()).decode()}
+    assert cli.request("PUT", "/hcb/enc", body=body,
+                       headers=hdr)[0] == 200
+    for _ in range(2):
+        st, _, got = cli.request("GET", "/hcb/enc", headers=hdr)
+        assert st == 200 and got == body
+    time.sleep(0.2)
+    assert not _resident(srv, "hcb", "enc")
+
+
+@pytest.fixture(scope="module")
+def srv_off(tmp_path_factory):
+    server = _make_server(tmp_path_factory.mktemp("hc-off"), "off",
+                          {"MTPU_HOT_CACHE": "off"})
+    yield server
+    server.stop()
+
+
+def test_kill_switch_server_byte_identical(srv_off):
+    """MTPU_HOT_CACHE=off: no admission, no hotcache response path, and
+    repeat GETs stay byte-identical modulo the Date stamp (the miss
+    path is deterministic — proving the knob changes nothing visible)."""
+    assert not srv_off.hot_cache.enabled
+    cli = S3Client(srv_off.address, keepalive=True)
+    assert cli.request("PUT", "/offb")[0] == 200
+    body = os.urandom(100_000)
+    assert cli.request("PUT", "/offb/obj", body=body)[0] == 200
+    st, h1, g1 = cli.request("GET", "/offb/obj")
+    st2, h2, g2 = cli.request("GET", "/offb/obj")
+    assert st == st2 == 200 and g1 == g2 == body
+    strip = lambda h: {k: v for k, v in h.items() if k != "Date"}  # noqa: E731
+    assert strip(h1) == strip(h2)
+    assert srv_off.hot_cache.stats()["entries"] == 0
+    rp = srv_off.metrics.http_conn_stats()["response_path"]
+    assert rp.get("hotcache", 0) == 0, rp
+    cli.close()
+
+
+# ---------------------------------------------------------------------------
+# 2-worker pre-forked fleet: shared-generation flush
+# ---------------------------------------------------------------------------
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    """2 pre-forked workers (subprocess: pytest has JAX loaded and
+    fork-after-JAX is unsafe), each with its own private hot cache
+    coupled only through the shared list.gen bump file."""
+    import signal
+    import subprocess
+    import sys
+
+    root = tmp_path_factory.mktemp("hc-fleet")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", MTPU_HTTP_WORKERS="2")
+    env.pop("MTPU_HOT_CACHE", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "minio_tpu.server",
+         "--address", f"127.0.0.1:{port}", "--scanner-interval", "0",
+         f"{root}/d{{1...4}}"],
+        env=env, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    address = f"127.0.0.1:{port}"
+    deadline = time.time() + 90
+    ready = False
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            break
+        try:
+            if S3Client(address).request(
+                    "GET", "/minio/health/live", sign=False)[0] == 200:
+                ready = True
+                break
+        except OSError:
+            time.sleep(0.4)
+    if not ready:
+        out = proc.stdout.read().decode(errors="replace") \
+            if proc.stdout else ""
+        proc.kill()
+        pytest.skip(f"worker fleet failed to boot: {out[-800:]}")
+    yield address
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(timeout=25)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def test_fleet_overwrite_flushes_sibling_caches(fleet):
+    """Warm BOTH workers' hot caches (fresh connections spread accepts
+    across listeners), overwrite through one worker, then every
+    subsequent GET — whichever worker lands it — must serve the new
+    bytes: the sibling observed the shared generation bump and
+    flushed."""
+    addr = fleet
+    assert S3Client(addr).request("PUT", "/flh")[0] == 200
+    v1 = os.urandom(48_000)
+    assert S3Client(addr).request("PUT", "/flh/obj", body=v1)[0] == 200
+    for _ in range(8):        # warm whichever workers take the accepts
+        st, _, got = S3Client(addr).request("GET", "/flh/obj")
+        assert st == 200 and got == v1
+    v2 = os.urandom(48_000)
+    assert S3Client(addr).request("PUT", "/flh/obj", body=v2)[0] == 200
+    for i in range(8):
+        st, _, got = S3Client(addr).request("GET", "/flh/obj")
+        assert st == 200 and got == v2, f"stale read on GET {i}"
+    assert S3Client(addr).request("DELETE", "/flh/obj")[0] == 204
+    for _ in range(4):
+        assert S3Client(addr).request("GET", "/flh/obj")[0] == 404
+
+
+# ---------------------------------------------------------------------------
+# 3-node cluster: partition/rejoin, gate-down refusal
+# ---------------------------------------------------------------------------
+
+def test_cluster_partition_rejoin_hot_cache_zero_stale(tmp_path):
+    """Warm a node's hot cache with repeat GETs, partition its grid
+    plane, overwrite through the healthy side: the partitioned node's
+    coherence gate is down so the RAM copy must NOT be served; after
+    rejoin the node serves the new bytes and never the old."""
+    with Cluster(tmp_path, nodes=3, drives_per_node=2) as cluster:
+        c0 = cluster.client(0)
+        c2 = cluster.client(2, keepalive=True)
+        assert c0.request("PUT", "/hcl")[0] == 200
+        v1 = os.urandom(200_000)
+        deadline = time.time() + 45
+        while True:
+            st, _, b = c0.request("PUT", "/hcl/obj", body=v1)
+            if st == 200:
+                break
+            assert time.time() < deadline, f"PUT: {st} {b[:200]}"
+            time.sleep(1)
+        # Repeat GETs on node2: miss + admit, then hot hits.
+        for _ in range(3):
+            st, _, got = c2.request("GET", "/hcl/obj")
+            assert st == 200 and got == v1
+
+        cluster.partition(2)
+        time.sleep(1.0)          # > chaos poll + grid sync interval
+        v2 = os.urandom(200_000)
+        deadline = time.time() + 45
+        while True:
+            st, _, b = c0.request("PUT", "/hcl/obj", body=v2)
+            if st == 200:
+                break
+            assert time.time() < deadline, f"PUT: {st} {b[:200]}"
+            time.sleep(1)
+        # The partitioned node holds v1 in RAM, but its gate is down:
+        # an honest error is fine, v1 is never.
+        st, _, got = c2.request("GET", "/hcl/obj")
+        assert not (st == 200 and got == v1), "stale hot-cache hit"
+
+        cluster.rejoin(2)
+        deadline = time.time() + 45
+        while True:
+            st, _, got = c2.request("GET", "/hcl/obj")
+            if st == 200 and got == v2:
+                break
+            assert not (st == 200 and got == v1), "stale read after rejoin"
+            assert time.time() < deadline, f"rejoin GET: {st}"
+            time.sleep(1)
+        # And the fresh bytes are served (hot again) repeatably.
+        for _ in range(2):
+            st, _, got = c2.request("GET", "/hcl/obj")
+            assert st == 200 and got == v2
+        c2.close()

@@ -577,9 +577,14 @@ def test_cross_worker_trace_stream(worker_server):
         assert S3Client(addr).request("PUT", f"/xwb/o{i}",
                                       body=body)[0] == 200
     deadline = time.time() + 25
+    j = 0
     while t.is_alive() and time.time() < deadline:
         # Keep traffic flowing until the count limit closes the stream.
-        S3Client(addr).request("GET", "/xwb/o0")
+        # Overwriting PUTs (not GETs): a repeat GET can be a hot-tier
+        # hit tracing as a single root entry, while every PUT emits the
+        # full storage/engine span fan-out the count budget assumes.
+        S3Client(addr).request("PUT", f"/xwb/o{j % n_req}", body=body)
+        j += 1
         time.sleep(0.1)
     roots = [e for e in entries if e.get("trace_type") == "s3"
              and e.get("api") in ("PUT:object", "GET:object")]
@@ -594,6 +599,7 @@ def test_cross_worker_trace_stream(worker_server):
     assert storage, "no storage spans relayed from the fleet"
     if t.is_alive():
         # Stream still open (count not reached): one last burst.
-        for _ in range(10):
-            S3Client(addr).request("GET", "/xwb/o0")
+        for k in range(10):
+            S3Client(addr).request("PUT", f"/xwb/o{k % n_req}",
+                                   body=body)
         t.join(timeout=10)
