@@ -159,6 +159,8 @@ def main() -> None:
             _transform_get()
         if _want("distributed"):
             _distributed()
+        if _want("cluster_get"):
+            _cluster_get()
         if _want("connections"):
             _connections()
         if _want("hot_get"):
@@ -286,6 +288,10 @@ def main() -> None:
     # ---- 11. Distributed: N-node cluster vs single node ---------------
     if _want("distributed"):
         _distributed()
+
+    # ---- 11b. Inter-node shard fetch: native vs old grid plane --------
+    if _want("cluster_get"):
+        _cluster_get()
 
     # ---- 12. Connection plane: idle fd cost + GET fan-in ramp ---------
     if _want("connections"):
@@ -2186,6 +2192,13 @@ def _distributed() -> None:
                           the remote walk_scan trimmed-summary stream,
                           not a cached stream re-read
 
+    Each metric also carries an in-run OLD-PLANE column: the same
+    multi-node probe against a third cluster booted with
+    MTPU_GRID_NATIVE=off (per-frame msgpack bulk bytes, no sendfile,
+    no raw frames). Both columns share this run's scheduler weather,
+    so vs_old_plane is the stable cross-run signal for the native
+    plane on a loaded host — the raw aggregates measure the box.
+
     Emits explicit-null lines on hosts that cannot run the cluster
     (1 core, or boot failure) so the smoke gate skips cleanly.
 
@@ -2199,6 +2212,7 @@ def _distributed() -> None:
                   "distributed_get_aggregate_gibps",
                   "distributed_list_page_p50_ms"):
             print(json.dumps({"metric": m, "value": None,
+                              "vs_old_plane": None,
                               "skip": f"{type(e).__name__}: {e}"}))
 
 
@@ -2247,6 +2261,20 @@ def _distributed_inner() -> None:
         mk = [S3Client(addrs[0])]
         st, _, b = req(mk, addrs[0], "PUT", "/dbench")
         assert st == 200, b
+
+        # Unmeasured warmup: one PUT+GET round-trip through EVERY
+        # node primes grid connections, breakers, bufpools, and page
+        # cache so the first measured column does not pay cluster
+        # cold-start that the later columns skip (the probe runs
+        # three clusters back-to-back; without this the first one
+        # reads systematically slower regardless of plane).
+        for wi, addr in enumerate(addrs):
+            wcli = [S3Client(addr)]
+            st, _, b = req(wcli, addr, "PUT", f"/dbench/warm-{wi}",
+                           body=body)
+            assert st == 200, b
+            st, _, got = req(wcli, addr, "GET", f"/dbench/warm-{wi}")
+            assert st == 200 and len(got) == len(body)
 
         def put_worker(t):
             addr = addrs[t % len(addrs)]
@@ -2310,6 +2338,14 @@ def _distributed_inner() -> None:
         with Cluster(_os.path.join(root, "multi"), nodes=nodes,
                      drives_per_node=drives_per_node) as cluster:
             multi = probe(cluster)
+        # In-run old-plane column: the SAME multi-node probe with the
+        # native grid data plane killed (per-frame msgpack bulk bytes,
+        # blocking chunked streams, no sendfile). Same host, same run,
+        # same scheduler weather — the ratio is the gateable signal.
+        with Cluster(_os.path.join(root, "old"), nodes=nodes,
+                     drives_per_node=drives_per_node,
+                     env={"MTPU_GRID_NATIVE": "off"}) as old_cluster:
+            old = probe(old_cluster)
         with Cluster(_os.path.join(root, "single"), nodes=1,
                      drives_per_node=total_drives) as single_cluster:
             single = probe(single_cluster)
@@ -2324,6 +2360,9 @@ def _distributed_inner() -> None:
         "single_node_gibps": round(single["put_gibps"], 3),
         "vs_single_node": round(multi["put_gibps"]
                                 / max(single["put_gibps"], 1e-9), 3),
+        "old_plane_gibps": round(old["put_gibps"], 3),
+        "vs_old_plane": round(multi["put_gibps"]
+                              / max(old["put_gibps"], 1e-9), 3),
         "concurrency": threads,
     }))
     print(json.dumps({
@@ -2334,6 +2373,9 @@ def _distributed_inner() -> None:
         "single_node_gibps": round(single["get_gibps"], 3),
         "vs_single_node": round(multi["get_gibps"]
                                 / max(single["get_gibps"], 1e-9), 3),
+        "old_plane_gibps": round(old["get_gibps"], 3),
+        "vs_old_plane": round(multi["get_gibps"]
+                              / max(old["get_gibps"], 1e-9), 3),
         "concurrency": threads,
     }))
     print(json.dumps({
@@ -2346,6 +2388,129 @@ def _distributed_inner() -> None:
         "single_node_p50_ms": round(single["list_p50_ms"], 2),
         "vs_single_node": round(multi["list_p50_ms"]
                                 / max(single["list_p50_ms"], 1e-9), 3),
+        "old_plane_p50_ms": round(old["list_p50_ms"], 2),
+        "vs_old_plane": round(multi["list_p50_ms"]
+                              / max(old["list_p50_ms"], 1e-9), 3),
+    }))
+
+
+def _cluster_get() -> None:
+    """Inter-node shard-fetch throughput: the grid storage read plane
+    in isolation (what a remote GET/heal/migration pays per shard),
+    native vs old plane like-for-like in ONE run.
+
+      value            RemoteStorage.read_file GiB/s over loopback
+                       through a REAL GridServer — raw length-prefixed
+                       frames into pooled leases, shard bytes shipped
+                       drive-fd → socket via os.sendfile
+      old_plane_gibps  the same fetches against a second server booted
+                       under MTPU_GRID_NATIVE=off: per-chunk msgpack
+                       frames read into fresh Python bytes (the
+                       pre-native plane)
+      vs_old_plane     value / old_plane_gibps — both columns share
+                       this run's scheduler weather, so the ratio is
+                       the gateable cross-run signal
+
+    sendfile_bytes is the poller-counter delta across the measured
+    native window: nonzero proves the bytes actually rode the
+    zero-copy path (the section fails rather than reports a win
+    otherwise, and fails if the old-plane column touches sendfile).
+
+    Environment:
+      MTPU_CLUSTER_BENCH_FETCH_MIB   shard file size (default 32,
+                                     8 under MTPU_BENCH_SMALL)
+    """
+    try:
+        _cluster_get_inner()
+    except Exception as e:  # noqa: BLE001 - tiny host / boot failure
+        print(json.dumps({"metric": "cluster_get_shard_fetch_gibps",
+                          "value": None, "vs_old_plane": None,
+                          "skip": f"{type(e).__name__}: {e}"}))
+
+
+def _cluster_get_inner() -> None:
+    import shutil
+    import tempfile
+    from concurrent.futures import ThreadPoolExecutor
+
+    from minio_tpu.grid import loop as gloop
+    from minio_tpu.grid.server import GridServer
+    from minio_tpu.storage.local import LocalStorage
+    from minio_tpu.storage.remote import RemoteStorage, StorageRPCService
+
+    shard_mib = int(_os.environ.get("MTPU_CLUSTER_BENCH_FETCH_MIB", 0)
+                    or (8 if _SMALL else 32))
+    threads = 4                      # erasure fan-out: shards in flight
+    reps = 2 if _SMALL else 4        # passes over the shard set
+    one = bytes((i * 31 + 7) & 0xFF for i in range(4096))
+    body = (one * ((shard_mib << 20) // len(one)))
+
+    root = tempfile.mkdtemp(prefix="bench-cget-")
+    saved = _os.environ.get("MTPU_GRID_NATIVE")
+    servers = []
+    try:
+        drive = LocalStorage(_os.path.join(root, "d0"))
+        drive.make_vol("bench")
+        for t in range(threads):
+            drive.create_file("bench", f"shard-{t}.bin", body)
+
+        def measure() -> float:
+            srv = GridServer(0, host="127.0.0.1")
+            StorageRPCService({drive.root: drive}).register_into(srv)
+            srv.start()
+            servers.append(srv)
+            remote = RemoteStorage("127.0.0.1", srv.port, drive.root)
+            # Warm the connection + verify identity once, unmeasured.
+            assert remote.read_file("bench", "shard-0.bin") == body
+
+            def fetch(t):
+                for _ in range(reps):
+                    got = remote.read_file("bench", f"shard-{t}.bin")
+                    assert len(got) == len(body)
+
+            ex = ThreadPoolExecutor(max_workers=threads)
+            t0 = time.perf_counter()
+            list(ex.map(fetch, range(threads)))
+            wall = time.perf_counter() - t0
+            ex.shutdown(wait=False)
+            return threads * reps * len(body) / (1 << 30) / wall
+
+        before = gloop.stats()
+        native_gibps = measure()
+        mid = gloop.stats()
+        sendfile_bytes = (mid["sendfile_bytes"]
+                         - before["sendfile_bytes"])
+        assert sendfile_bytes >= threads * reps * len(body), \
+            "native fetch did not ride sendfile"
+
+        # Old plane: fresh server under MTPU_GRID_NATIVE=off (the
+        # accept loop latches the switch at boot; the client checks it
+        # per call) — per-chunk msgpack frames, no raw path.
+        _os.environ["MTPU_GRID_NATIVE"] = "off"
+        old_gibps = measure()
+        after = gloop.stats()
+        assert after["sendfile_bytes"] == mid["sendfile_bytes"], \
+            "old-plane column leaked onto the sendfile path"
+    finally:
+        if saved is None:
+            _os.environ.pop("MTPU_GRID_NATIVE", None)
+        else:
+            _os.environ["MTPU_GRID_NATIVE"] = saved
+        for srv in servers:
+            try:
+                srv.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        shutil.rmtree(root, ignore_errors=True)
+
+    print(json.dumps({
+        "metric": "cluster_get_shard_fetch_gibps",
+        "value": round(native_gibps, 3),
+        "unit": "GiB/s",
+        "shard_mib": shard_mib, "threads": threads, "reps": reps,
+        "sendfile_bytes": sendfile_bytes,
+        "old_plane_gibps": round(old_gibps, 3),
+        "vs_old_plane": round(native_gibps / max(old_gibps, 1e-9), 3),
     }))
 
 

@@ -29,6 +29,7 @@ Environment:
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import random
 import socket
@@ -36,7 +37,7 @@ import threading
 import time
 from typing import Callable, Iterator, Optional
 
-from minio_tpu.grid import chaos, wire
+from minio_tpu.grid import chaos, loop, wire
 from minio_tpu.grid.wire import GridError, RemoteCallError
 from minio_tpu.utils import deadline as deadline_mod
 from minio_tpu.utils import tracing
@@ -80,6 +81,15 @@ class GridClient:
         self._pending: dict[int, tuple[socket.socket, "queue.Queue[dict]"]] \
             = {}
         self._reader: Optional[threading.Thread] = None
+        # mux -> Credit for client-push (sink) streams; T_WIN grants
+        # from the peer land here, never in the reply queue.
+        self._credits: dict[int, loop.Credit] = {}
+        # Monotonic stamp of the last frame received on the CURRENT
+        # connection: a per-call timeout while other frames are still
+        # flowing is that stream's problem (slow/hung handler), not
+        # peer death — it must not feed the breaker or disturb the
+        # other in-flight streams on the shared connection.
+        self._last_rx = 0.0
         # -- circuit breaker (mirrors the drive-health breaker) --------
         self.trip_after = trip_after if trip_after is not None \
             else _env_num("MTPU_GRID_TRIP_AFTER", 3, int)
@@ -208,9 +218,17 @@ class GridClient:
         self.connects_total += 1
         if was_attempted:
             self.reconnects_total += 1
-        self._reader = threading.Thread(target=self._read_loop, args=(s,),
-                                        daemon=True)
-        self._reader.start()
+        self._last_rx = time.monotonic()
+        if wire.native_enabled() and loop.available():
+            # Native plane: the process-wide grid poller owns the read
+            # side — no reader thread per peer connection.
+            loop.poller().register(
+                s, on_msg=lambda m: self._on_frame(s, m),
+                on_close=lambda: self._drop_conn(s))
+        else:
+            self._reader = threading.Thread(target=self._read_loop,
+                                            args=(s,), daemon=True)
+            self._reader.start()
 
     def _drop_conn(self, s) -> None:
         with self._mu:
@@ -218,8 +236,13 @@ class GridClient:
                 self._sock = None
             dead = [mux for mux, (sk, _) in self._pending.items() if sk is s]
             pending = [self._pending.pop(mux)[1] for mux in dead]
+            credits = [self._credits.pop(mux) for mux in dead
+                       if mux in self._credits]
         for q in pending:
             q.put({"t": wire.T_ERR, "e": _SENTINEL_ERR, "msg": "conn lost"})
+        for cr in credits:
+            cr.close()          # wake push senders parked on credit
+        loop.discard(s)
         try:
             s.close()
         except OSError:
@@ -230,24 +253,41 @@ class GridClient:
             except Exception:  # noqa: BLE001 - observers must not break I/O
                 pass
 
+    def _on_frame(self, s, msg: dict) -> None:
+        """Route one received frame — shared by the poller callback
+        (native plane) and the legacy reader thread. Raw bulk frames
+        arrive carrying a pooled lease; if no call claims them (the
+        stream was abandoned) the lease is released here."""
+        self._last_rx = time.monotonic()
+        chaos.net("recv")
+        t = msg.get("t")
+        if t == wire.T_PING:
+            with self._wmu:
+                with self._mu:
+                    live = self._sock is s
+                if live:
+                    s.sendall(wire.pack_frame({"t": wire.T_PONG}))
+            return
+        if t == wire.T_PONG:
+            return
+        if t == wire.T_WIN:
+            with self._mu:
+                cr = self._credits.get(msg.get("m"))
+            if cr is not None:
+                cr.grant(msg.get("n", 0))
+            return
+        ent = self._pending.get(msg.get("m"))
+        if ent is not None:
+            ent[1].put(msg)
+        else:
+            lease = msg.get("lease")
+            if lease is not None:
+                lease.release()
+
     def _read_loop(self, s) -> None:
         try:
             while True:
-                msg = wire.read_frame(s)
-                chaos.net("recv")
-                t = msg.get("t")
-                if t == wire.T_PING:
-                    with self._wmu:
-                        with self._mu:
-                            live = self._sock is s
-                        if live:
-                            s.sendall(wire.pack_frame({"t": wire.T_PONG}))
-                    continue
-                if t == wire.T_PONG:
-                    continue
-                ent = self._pending.get(msg.get("m"))
-                if ent is not None:
-                    ent[1].put(msg)
+                self._on_frame(s, wire.read_frame(s))
         except (GridError, OSError, chaos.ChaosInjected):
             self._drop_conn(s)
 
@@ -255,6 +295,7 @@ class GridClient:
         with self._mu:
             s, self._sock = self._sock, None
         if s is not None:
+            loop.discard(s)
             try:
                 s.close()
             except OSError:
@@ -290,7 +331,8 @@ class GridClient:
         with self._mu:
             self._pending.pop(mux, None)
 
-    def _send_with_retry(self, kind: int, handler: str, payload):
+    def _send_with_retry(self, kind: int, handler: str, payload,
+                         window: Optional[int] = None):
         """Send one request frame, retrying transient connect/send
         failures with jittered exponential backoff. Returns (mux, q).
 
@@ -316,9 +358,11 @@ class GridClient:
             self._admit()
             mux = next(self._mux)
             q: "queue.Queue[dict]" = queue.Queue()
+            msg = {"t": kind, "m": mux, "h": handler, "p": payload}
+            if window:
+                msg["w"] = window
             try:
-                self._send({"t": kind, "m": mux, "h": handler,
-                            "p": payload}, mux, q)
+                self._send(msg, mux, q)
                 return mux, q
             except RemoteCallError:
                 raise
@@ -328,7 +372,19 @@ class GridClient:
         raise last if last is not None else GridError(
             f"send {handler} to {self.host}:{self.port} failed")
 
-    def _recv(self, q, handler: str, wait: Optional[float]):
+    def _rx_live(self, mux: int, window: float) -> bool:
+        """True when the call's connection is still the current one
+        AND received any frame within `window` seconds — the transport
+        is provably alive, so this call's timeout is its own handler's
+        problem (slow stream, hung verb), not peer death."""
+        with self._mu:
+            ent = self._pending.get(mux)
+            if ent is None or ent[0] is not self._sock:
+                return False
+        return (time.monotonic() - self._last_rx) < window
+
+    def _recv(self, q, handler: str, wait: Optional[float],
+              mux: Optional[int] = None):
         """One reply frame, waiting at most min(wait, deadline left)."""
         wait = wait or self.call_timeout
         dl = deadline_mod.current()
@@ -353,6 +409,17 @@ class GridClient:
                 raise DeadlineExceeded(
                     f"deadline exceeded awaiting {handler} from "
                     f"{self.host}:{self.port}") from None
+            if mux is not None and self._rx_live(mux, max(eff, 1.0)):
+                # Per-STREAM failure accounting: other frames are still
+                # flowing on the shared connection, so only THIS call
+                # failed. Counted, but never breaker fuel — tripping
+                # the breaker here would fail the unrelated in-flight
+                # streams sharing the socket for one slow handler.
+                with self._mu:
+                    self.rpc_errors_total += 1
+                raise GridError(
+                    f"call {handler} to {self.host}:{self.port} timed "
+                    "out (connection live)") from None
             self._fault()
             raise GridError(
                 f"call {handler} to {self.host}:{self.port} timed out") \
@@ -366,7 +433,7 @@ class GridClient:
                 if tracing.ACTIVE else tracing.NOOP:
             mux, q = self._send_with_retry(wire.T_REQ, handler, payload)
             try:
-                msg = self._recv(q, handler, timeout)
+                msg = self._recv(q, handler, timeout, mux)
                 if msg["t"] == wire.T_RESP:
                     self._ok()
                     return msg.get("p")
@@ -381,9 +448,35 @@ class GridClient:
             finally:
                 self._finish(mux)
 
+    def _grant(self, s, mux: int, n: int) -> None:
+        """Replenish a response stream's credit window (best-effort:
+        a failed grant means the connection is dying and the stream
+        will fail through its sentinel)."""
+        try:
+            frame = wire.pack_frame({"t": wire.T_WIN, "m": mux, "n": n})
+            with self._wmu:
+                with self._mu:
+                    if self._sock is not s:
+                        return
+                s.sendall(frame)
+        except OSError:
+            pass
+
     def stream(self, handler: str, payload=None,
-               timeout: Optional[float] = None) -> Iterator:
+               timeout: Optional[float] = None,
+               raw: bool = False) -> Iterator:
         """Streaming call: yields items until EOF. Raises on error.
+
+        On the native plane the open frame advertises a credit window
+        and consumed chunks are acknowledged back (T_WIN) as this
+        iterator is pulled — a stream nobody drains stalls the SENDER
+        after one window instead of ballooning frames into this
+        process, and bulk streams can't head-of-line-block lock
+        traffic. With raw=True, raw bulk frames are yielded as
+        (payload, lease) pairs — payload is a memoryview into a pooled
+        buffer and the caller MUST release() the lease (None for a
+        v1 peer's plain bytes). With raw=False they are flattened to
+        bytes and the lease is released here.
 
         The span is recorded manually at close (generator `with` would
         leave the thread-local parent pointing into this stream between
@@ -392,14 +485,35 @@ class GridClient:
         t_wall = time.time()
         t0 = time.monotonic()
         chunks = 0
-        mux, q = self._send_with_retry(wire.T_SREQ, handler, payload)
+        window = loop.stream_window() if wire.native_enabled() else None
+        mux, q = self._send_with_retry(wire.T_SREQ, handler, payload,
+                                       window=window)
+        with self._mu:
+            ent = self._pending.get(mux)
+        s = ent[0] if ent is not None else None
+        pulled = 0
         try:
             while True:
-                msg = self._recv(q, handler, timeout)
+                msg = self._recv(q, handler, timeout, mux)
                 t = msg["t"]
                 if t == wire.T_CHUNK:
                     chunks += 1
-                    yield msg.get("p")
+                    if window:
+                        pulled += 1
+                        if pulled >= max(1, window // 2):
+                            self._grant(s, mux, pulled)
+                            pulled = 0
+                    lease = msg.get("lease")
+                    if msg.get("raw"):
+                        if raw:
+                            yield msg.get("p"), lease
+                        else:
+                            p = bytes(msg.get("p") or b"")
+                            if lease is not None:
+                                lease.release()
+                            yield p
+                    else:
+                        yield msg.get("p")
                 elif t == wire.T_EOF:
                     self._ok()
                     return
@@ -418,6 +532,66 @@ class GridClient:
                     (time.monotonic() - t0) * 1000.0,
                     tags={"peer": f"{self.host}:{self.port}",
                           "stream": 1, "chunks": chunks})
+
+    def push_raw(self, handler: str, payload, items,
+                 timeout: Optional[float] = None):
+        """Client-push stream (native plane): open a sink stream, ship
+        `items` as raw bulk frames, then await the handler's unary
+        result. Items are bytes-like buffers (sliced and sent straight
+        off memoryviews, no msgpack wrap) or wire.RawFile descriptors
+        (shipped from the file fd via os.sendfile — zero Python-level
+        copies send-side). Flow-controlled: the receiver grants credit
+        as its handler drains frames, so a slow remote drive stalls
+        this sender instead of ballooning its staging queues."""
+        window = loop.stream_window()
+        stall = loop.stream_stall_s()
+        mux, q = self._send_with_retry(wire.T_SREQ, handler, payload,
+                                       window=window)
+        with self._mu:
+            ent = self._pending.get(mux)
+            s = ent[0] if ent is not None else None
+            credit = loop.Credit(window)
+            self._credits[mux] = credit
+        try:
+            try:
+                for item in items:
+                    if isinstance(item, wire.RawFile):
+                        with open(item.path, "rb") as f:
+                            length = item.length
+                            if length < 0:
+                                length = max(
+                                    0, os.fstat(f.fileno()).st_size
+                                    - item.offset)
+                            loop.send_raw_fd(s, self._wmu, mux,
+                                             f.fileno(), item.offset,
+                                             length, credit, stall)
+                    else:
+                        loop.send_raw_buf(s, self._wmu, mux, item,
+                                          credit, stall)
+                eof = wire.pack_frame({"t": wire.T_EOF, "m": mux})
+                with self._wmu:
+                    chaos.net("send")
+                    s.sendall(eof)
+            except (OSError, chaos.ChaosInjected) as e:
+                self._drop_conn(s)
+                self._fault()
+                raise GridError(
+                    f"push {handler} to {self.host}:{self.port}: {e}") \
+                    from None
+            msg = self._recv(q, handler, timeout, mux)
+            if msg["t"] == wire.T_RESP:
+                self._ok()
+                return msg.get("p")
+            code = msg.get("e", "Internal")
+            if code == _SENTINEL_ERR:
+                self._fault()
+                raise GridError("connection lost mid-push")
+            self._ok()
+            raise RemoteCallError(code, msg.get("msg", ""))
+        finally:
+            self._finish(mux)
+            with self._mu:
+                self._credits.pop(mux, None)
 
     def ping(self, timeout: float = 2.0) -> bool:
         try:

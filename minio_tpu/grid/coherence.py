@@ -32,13 +32,23 @@ window of an ASYMMETRIC partition (pushes to us fail but our pulls
 succeed) to one sync interval; symmetric partitions disarm immediately
 via the connection-loss hook or the first failed call.
 
+N x M worker topology (pre-forked workers on a distributed node):
+exactly ONE PeerCoherence instance runs per node — in worker 0, the
+process that owns the node's grid listener. Sibling workers relay
+their outbound bumps to it over loopback (gen.relay) and gate their
+caches on the state file it publishes (FileGate); inbound peer
+invalidations propagate to siblings through the shared list.gen /
+meta.gen bump files io/workers.py already maintains.
+
 Wire surface (registered on the node's GridServer):
     gen.inv    {"n": node, "c": class, "b": bucket, "g": gen} -> "ok"
     gen.sync   {} -> {"n": node, "g": {class: {bucket: gen}}}
+    gen.relay  {"b": bucket, "c": class} -> "ok"   (loopback siblings)
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import uuid as uuid_mod
@@ -67,6 +77,7 @@ def _shared_push_pool():
 
 INV_HANDLER = "gen.inv"
 SYNC_HANDLER = "gen.sync"
+RELAY_HANDLER = "gen.relay"
 
 # Invalidation classes. LISTING covers the namespace caches that ride
 # the metacache bump funnel (walk streams AND the fileinfo cache);
@@ -113,6 +124,43 @@ def make_set_invalidator(sets, layer=None) -> Callable[[str, str], None]:
     return apply_inv
 
 
+class FileGate:
+    """Sibling-worker view of worker 0's coherence gate (N x M worker
+    topology): worker 0 publishes "1"/"0" (coherent or not) to a shared
+    state file every sync tick; sibling workers' fi_cache/metacache
+    remote gates read it instead of owning a PeerCoherence of their
+    own. The rewrite-per-tick doubles as a heartbeat — a stale mtime
+    (worker 0 dead or mid-respawn) reads as NOT coherent, so sibling
+    caches answer misses during the gap exactly like worker 0's own
+    caches do while its peers re-arm."""
+
+    def __init__(self, path: str, ttl: Optional[float] = None,
+                 poll: float = 0.05):
+        self.path = path
+        # Three missed heartbeats = dead publisher; floor keeps slow
+        # CI boxes from flapping the gate on scheduler hiccups.
+        self.ttl = ttl if ttl is not None else max(
+            15.0, 3.0 * _env_float("MTPU_GRID_SYNC_S", 5.0))
+        self._poll = poll
+        self._at = 0.0
+        self._last = False
+
+    def __call__(self) -> bool:
+        now = time.monotonic()
+        if now - self._at < self._poll:
+            return self._last
+        self._at = now
+        try:
+            st = os.stat(self.path)
+            with open(self.path, "rb") as f:
+                ok = f.read(1) == b"1"
+            ok = ok and (time.time() - st.st_mtime) <= self.ttl
+        except OSError:
+            ok = False
+        self._last = ok
+        return ok
+
+
 class PeerCoherence:
     """One node's view of the cluster's cache-invalidation state."""
 
@@ -150,6 +198,13 @@ class PeerCoherence:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._wake = threading.Event()
+        # N x M worker topology (wired by minio_tpu.server in worker
+        # mode): state_path publishes coherent() for sibling FileGates;
+        # relay_flag_path is the siblings' dead-man escalation — a
+        # sibling whose gen.relay loopback call failed drops the flag,
+        # and the next sync tick converts it into a wildcard broadcast.
+        self.state_path: Optional[str] = None
+        self.relay_flag_path: Optional[str] = None
         # Counters (admin info + Prometheus).
         self.inv_sent = 0
         self.inv_failed = 0
@@ -182,6 +237,57 @@ class PeerCoherence:
         if self._armed.get(peer):
             self._armed[peer] = False
             self._wake.set()
+            # Sibling workers must see the gate drop NOW, not at the
+            # next heartbeat — their caches would serve through the gap.
+            self._publish_state()
+
+    # -- N x M worker topology (state file + sibling relay) ------------
+
+    def _publish_state(self) -> None:
+        path = self.state_path
+        if not path:
+            return
+        tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                f.write("1" if self.coherent() else "0")
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def _check_relay_flag(self) -> None:
+        path = self.relay_flag_path
+        if not path:
+            return
+        try:
+            os.stat(path)
+        except OSError:
+            return
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        # A sibling mutated the namespace but could not relay the bump
+        # (we were restarting): WHICH bucket is lost with the failed
+        # call, so broadcast a wildcard for both classes — peers flush
+        # wholesale, exactly what a missed invalidation demands.
+        for cls in CLASSES:
+            try:
+                self.broadcast("", cls)
+            except Exception:  # noqa: BLE001 - escalation counted inside
+                pass
+
+    def handle_relay(self, payload) -> str:
+        """Loopback verb for sibling workers (same node, no grid
+        listener of their own): bump + fan out an invalidation on their
+        behalf. Their SharedGen bump already covered the node's own
+        processes; this covers the peers."""
+        p = payload or {}
+        self.broadcast(p.get("b", ""), p.get("c", CLASS_LISTING))
+        return "ok"
 
     # -- local mutations -> push ---------------------------------------
 
@@ -374,6 +480,7 @@ class PeerCoherence:
     def register_into(self, srv) -> None:
         srv.register(INV_HANDLER, self.handle_inv)
         srv.register(SYNC_HANDLER, self.handle_sync)
+        srv.register(RELAY_HANDLER, self.handle_relay)
 
     def start(self) -> None:
         if self._thread is not None:
@@ -392,6 +499,11 @@ class PeerCoherence:
         while not self._stop.is_set():
             try:
                 self.resync_all()
+            except Exception:  # noqa: BLE001 - keep the daemon alive
+                pass
+            try:
+                self._check_relay_flag()
+                self._publish_state()
             except Exception:  # noqa: BLE001 - keep the daemon alive
                 pass
             self._wake.wait(self.sync_interval)

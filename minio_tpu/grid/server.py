@@ -3,19 +3,34 @@
 The analogue of the reference's grid handler registry + muxServer
 (internal/grid/handlers.go:42-101, muxserver.go). Unary handlers return
 a msgpack-able payload; stream handlers are generators whose items are
-sent as chunk frames. Handler exceptions map to wire error codes via
-the registered exception table, so the remote client re-raises the
-same storage exception types the local path would see.
+sent as chunk frames — or wire.RawFile / wire.RawBytes descriptors,
+shipped as raw bulk frames (os.sendfile straight from the drive fd for
+RawFile: zero Python-level copies send-side). Sink handlers receive a
+client-push stream of bulk frames and return one unary result (the
+inbound half of the zero-copy shard transfer). Handler exceptions map
+to wire error codes via the registered exception table, so the remote
+client re-raises the same storage exception types the local path would
+see.
+
+On the native plane (MTPU_GRID_NATIVE, grid/wire.py) accepted
+connections park on the process-wide grid epoll poller (grid/loop.py)
+instead of one blocking reader thread each, and response streams
+opened with a credit window ("w" in the open frame) pause after
+`window` unacknowledged frames — a bulk walk_scan whose client stopped
+draining stalls in its worker slot instead of head-of-line-blocking
+lock/coherence traffic or ballooning the receiver's queues.
 """
 
 from __future__ import annotations
 
+import os
+import queue as queue_mod
 import socket
 import threading
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, Optional
+from typing import Callable, Iterator, Optional
 
-from minio_tpu.grid import chaos, wire
+from minio_tpu.grid import chaos, loop, wire
 
 # exception class -> wire code (extended by storage/remote.py, dsync).
 ERROR_CODES: dict[type, str] = {}
@@ -32,6 +47,43 @@ def _code_for(e: Exception) -> str:
     return "Internal"
 
 
+class _ConnState:
+    """Per-connection server state shared by the frame source (poller
+    callback or reader thread) and the handler pool."""
+
+    __slots__ = ("sock", "wlock", "sinks", "credits", "mu")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.wlock = threading.Lock()
+        self.mu = threading.Lock()
+        # mux -> input queue for a running sink handler
+        self.sinks: dict[int, "queue_mod.Queue[dict]"] = {}
+        # mux -> Credit for a flow-controlled response stream
+        self.credits: dict[int, loop.Credit] = {}
+
+    def send(self, msg: dict) -> None:
+        blob = wire.pack_frame(msg)
+        with self.wlock:
+            chaos.net("send")
+            self.sock.sendall(blob)
+
+    def close(self) -> None:
+        """Fail everything parked on this connection: sink handlers
+        get a conn-lost sentinel, stream senders parked on credit wake
+        with failure."""
+        with self.mu:
+            sinks = list(self.sinks.values())
+            credits = list(self.credits.values())
+            self.sinks.clear()
+            self.credits.clear()
+        for q in sinks:
+            q.put({"t": wire.T_ERR, "e": "Internal",
+                   "msg": "connection lost"})
+        for cr in credits:
+            cr.close()
+
+
 class GridServer:
     def __init__(self, port: int, host: str = "0.0.0.0",
                  max_workers: int = 32):
@@ -39,11 +91,12 @@ class GridServer:
         self.port = port
         self._handlers: dict[str, Callable] = {}
         self._streams: dict[str, Callable] = {}
+        self._sinks: dict[str, Callable] = {}
         self._pool = ThreadPoolExecutor(max_workers=max_workers)
         self._sock: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._stopping = threading.Event()
-        self._conns: set = set()
+        self._conns: dict[socket.socket, _ConnState] = {}
         self.register("grid.ping", lambda p: "pong")
 
     def register(self, name: str, fn: Callable) -> None:
@@ -51,6 +104,13 @@ class GridServer:
 
     def register_stream(self, name: str, fn: Callable) -> None:
         self._streams[name] = fn
+
+    def register_sink(self, name: str, fn: Callable) -> None:
+        """fn(payload, frames) -> result: `frames` iterates the pushed
+        bulk payloads (memoryviews into pooled leases, released as the
+        iterator advances); the return value answers the push as one
+        unary result."""
+        self._sinks[name] = fn
 
     # -- lifecycle -----------------------------------------------------
 
@@ -80,12 +140,13 @@ class GridServer:
                 self._sock.close()
             except OSError:
                 pass
-        for conn in list(self._conns):
+        for conn, state in list(self._conns.items()):
             # shutdown() before close(): the per-conn reader thread is
             # blocked in recv, which pins the open socket — a bare
             # close() would neither wake it nor send the FIN, leaving
             # peers parked on a half-dead connection with no signal
             # (their conn-loss hooks — coherence disarm — never fire).
+            loop.discard(conn)
             try:
                 conn.shutdown(socket.SHUT_RDWR)
             except OSError:
@@ -94,56 +155,89 @@ class GridServer:
                 conn.close()
             except OSError:
                 pass
+            state.close()
         self._pool.shutdown(wait=False)
 
     def _accept_loop(self) -> None:
+        native = wire.native_enabled() and loop.available()
         while not self._stopping.is_set():
             try:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._conns.add(conn)
-            threading.Thread(target=self._conn_loop, args=(conn,),
-                             daemon=True).start()
+            state = _ConnState(conn)
+            self._conns[conn] = state
+            if native:
+                # Native plane: the shared epoll poller owns the read
+                # side — no reader thread per accepted connection.
+                loop.poller().register(
+                    conn,
+                    on_msg=lambda m, st=state: self._handle_msg(st, m),
+                    on_close=lambda st=state: self._conn_closed(st))
+            else:
+                threading.Thread(target=self._conn_loop, args=(state,),
+                                 daemon=True).start()
 
     # -- per-connection ------------------------------------------------
 
-    def _conn_loop(self, conn: socket.socket) -> None:
-        wlock = threading.Lock()
+    def _conn_closed(self, state: _ConnState) -> None:
+        self._conns.pop(state.sock, None)
+        state.close()
+        try:
+            state.sock.close()
+        except OSError:
+            pass
 
-        def send(msg: dict) -> None:
-            blob = wire.pack_frame(msg)
-            with wlock:
-                chaos.net("send")
-                conn.sendall(blob)
-
+    def _conn_loop(self, state: _ConnState) -> None:
+        conn = state.sock
         try:
             while True:
-                msg = wire.read_frame(conn)
-                # Node-level chaos (tests/cluster.py): a blackholed
-                # node's server side drops the connection; "drop" mode
-                # swallows request frames silently so callers time out
-                # (the asymmetric-partition shape).
-                chaos.net("recv")
-                t = msg.get("t")
-                if t in (wire.T_REQ, wire.T_SREQ) and chaos.drop_inbound():
-                    continue
-                if t == wire.T_PING:
-                    send({"t": wire.T_PONG})
-                elif t == wire.T_REQ:
-                    self._pool.submit(self._run_unary, send, msg)
-                elif t == wire.T_SREQ:
-                    self._pool.submit(self._run_stream, send, msg)
+                self._handle_msg(state, wire.read_frame(conn))
         except (wire.GridError, OSError, RuntimeError, chaos.ChaosInjected):
             # RuntimeError: pool shut down mid-frame during server stop.
             pass
         finally:
-            self._conns.discard(conn)
-            try:
-                conn.close()
-            except OSError:
-                pass
+            self._conn_closed(state)
+
+    def _handle_msg(self, state: _ConnState, msg: dict) -> None:
+        """One inbound frame — shared by the poller callback (native)
+        and the legacy reader thread. Raises to drop the connection."""
+        # Node-level chaos (tests/cluster.py): a blackholed node's
+        # server side drops the connection; "drop" mode swallows
+        # request frames silently so callers time out (the
+        # asymmetric-partition shape).
+        chaos.net("recv")
+        t = msg.get("t")
+        if t in (wire.T_REQ, wire.T_SREQ) and chaos.drop_inbound():
+            return
+        if t == wire.T_PING:
+            state.send({"t": wire.T_PONG})
+        elif t == wire.T_REQ:
+            self._pool.submit(self._run_unary, state.send, msg)
+        elif t == wire.T_SREQ:
+            if msg.get("h", "") in self._sinks:
+                q: "queue_mod.Queue[dict]" = queue_mod.Queue()
+                with state.mu:
+                    state.sinks[msg.get("m")] = q
+                self._pool.submit(self._run_sink, state, msg, q)
+            else:
+                self._pool.submit(self._run_stream, state, msg)
+        elif t == wire.T_WIN:
+            with state.mu:
+                cr = state.credits.get(msg.get("m"))
+            if cr is not None:
+                cr.grant(msg.get("n", 0))
+        elif t in (wire.T_CHUNK, wire.T_EOF):
+            # Client-push frames for a running sink handler.
+            with state.mu:
+                q = state.sinks.get(msg.get("m"))
+            if q is not None:
+                q.put(msg)
+            else:
+                lease = msg.get("lease")
+                if lease is not None:
+                    lease.release()
 
     def _run_unary(self, send, msg: dict) -> None:
         mux = msg.get("m")
@@ -162,20 +256,122 @@ class GridServer:
             except OSError:
                 pass
 
-    def _run_stream(self, send, msg: dict) -> None:
+    # -- response streams ----------------------------------------------
+
+    def _run_stream(self, state: _ConnState, msg: dict) -> None:
         mux = msg.get("m")
         fn = self._streams.get(msg.get("h", ""))
+        window = msg.get("w")
+        credit: Optional[loop.Credit] = None
+        if window:
+            credit = loop.Credit(int(window))
+            with state.mu:
+                state.credits[mux] = credit
+        stall = loop.stream_stall_s()
         try:
             if fn is None:
-                send({"t": wire.T_ERR, "m": mux, "e": "NoSuchHandler",
-                      "msg": str(msg.get("h"))})
+                state.send({"t": wire.T_ERR, "m": mux,
+                            "e": "NoSuchHandler", "msg": str(msg.get("h"))})
                 return
             for item in fn(msg.get("p")):
-                send({"t": wire.T_CHUNK, "m": mux, "p": item})
-            send({"t": wire.T_EOF, "m": mux})
+                if isinstance(item, wire.RawFile):
+                    self._send_raw_file(state, mux, item, credit, stall)
+                elif isinstance(item, wire.RawBytes):
+                    loop.send_raw_buf(state.sock, state.wlock, mux,
+                                      item.data, credit, stall)
+                else:
+                    if credit is not None and not credit.take(stall):
+                        raise wire.GridError(
+                            "stream credit stall (receiver not draining)")
+                    state.send({"t": wire.T_CHUNK, "m": mux, "p": item})
+            state.send({"t": wire.T_EOF, "m": mux})
         except Exception as e:  # noqa: BLE001 - mapped onto the wire
             try:
-                send({"t": wire.T_ERR, "m": mux, "e": _code_for(e),
-                      "msg": str(e)[:512]})
+                state.send({"t": wire.T_ERR, "m": mux, "e": _code_for(e),
+                            "msg": str(e)[:512]})
             except OSError:
                 pass
+        finally:
+            if credit is not None:
+                with state.mu:
+                    state.credits.pop(mux, None)
+
+    @staticmethod
+    def _send_raw_file(state: _ConnState, mux: int, item: wire.RawFile,
+                       credit: Optional[loop.Credit],
+                       stall: float) -> None:
+        with open(item.path, "rb") as f:
+            length = item.length
+            if length < 0:
+                length = max(0,
+                             os.fstat(f.fileno()).st_size - item.offset)
+            loop.send_raw_fd(state.sock, state.wlock, mux, f.fileno(),
+                             item.offset, length, credit, stall)
+
+    # -- client-push sinks ---------------------------------------------
+
+    def _run_sink(self, state: _ConnState, msg: dict,
+                  q: "queue_mod.Queue[dict]") -> None:
+        mux = msg.get("m")
+        fn = self._sinks[msg.get("h", "")]
+        window = int(msg.get("w") or 0)
+        stall = loop.stream_stall_s()
+        consumed = [0]
+
+        def granted() -> None:
+            # Replenish the pusher's window as frames are drained,
+            # batched at half a window (best-effort: a failed grant
+            # means the connection is dying).
+            consumed[0] += 1
+            if window and consumed[0] >= max(1, window // 2):
+                n, consumed[0] = consumed[0], 0
+                try:
+                    state.send({"t": wire.T_WIN, "m": mux, "n": n})
+                except OSError:
+                    pass
+
+        try:
+            out = fn(msg.get("p"), self._sink_frames(q, stall, granted))
+            state.send({"t": wire.T_RESP, "m": mux, "p": out})
+        except Exception as e:  # noqa: BLE001 - mapped onto the wire
+            try:
+                state.send({"t": wire.T_ERR, "m": mux, "e": _code_for(e),
+                            "msg": str(e)[:512]})
+            except OSError:
+                pass
+        finally:
+            with state.mu:
+                state.sinks.pop(mux, None)
+            # Release leases of frames the handler never consumed.
+            while True:
+                try:
+                    m2 = q.get_nowait()
+                except queue_mod.Empty:
+                    break
+                lease = m2.get("lease")
+                if lease is not None:
+                    lease.release()
+
+    @staticmethod
+    def _sink_frames(q: "queue_mod.Queue[dict]", stall: float,
+                     granted: Callable[[], None]) -> Iterator:
+        """Iterate pushed payloads; each frame's pooled lease is
+        released when the consumer advances past it."""
+        while True:
+            try:
+                msg = q.get(timeout=stall)
+            except queue_mod.Empty:
+                raise wire.GridError(
+                    "push stream stalled (sender gone?)") from None
+            t = msg.get("t")
+            if t == wire.T_EOF:
+                return
+            if t == wire.T_ERR:
+                raise wire.GridError(msg.get("msg", "push stream failed"))
+            lease = msg.get("lease")
+            try:
+                yield msg.get("p")
+            finally:
+                if lease is not None:
+                    lease.release()
+            granted()

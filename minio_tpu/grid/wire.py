@@ -1,28 +1,46 @@
-"""Wire framing for the grid mesh.
+"""Wire framing for the grid mesh (v2: msgpack control + raw bulk).
 
-Frame = 4-byte big-endian length + one msgpack map:
+Control frame = 4-byte big-endian length + one msgpack map:
 
     {"t": TYPE, "m": mux_id, ...}
 
       T_REQ    {"h": handler, "p": payload}      unary call
       T_RESP   {"p": payload}                    unary result
       T_ERR    {"e": code, "msg": str}           call failed
-      T_SREQ   {"h": handler, "p": payload}      open a response stream
+      T_SREQ   {"h": handler, "p": payload,      open a response stream
+                "w": window}                     (initial credit, chunks)
       T_CHUNK  {"p": item}                       one stream item
       T_EOF    {}                                stream end
       T_PING / T_PONG                            keepalive
+      T_WIN    {"n": credits}                    grant stream credits
+
+Raw frame (v2) = the same 4-byte length word with the high bit set,
+followed by a 4-byte big-endian mux id, followed by exactly
+``length & 0x7fffffff - 4`` payload bytes:
+
+    [len | 0x80000000][mux][payload ...]
+
+Raw frames carry bulk stream bytes (shard files, DARE packages)
+without a msgpack encode/decode on either side: the sender can push
+them straight from a drive fd with ``os.sendfile`` and the receiver
+lands them in a pooled bufpool lease. They are semantically a T_CHUNK
+whose item is the payload bytes. Legacy (v1) peers never emit the
+high bit — MAX_FRAME is far below 2**31 — so the two framings coexist
+on one connection and ``MTPU_GRID_NATIVE=off`` reverts to pure v1.
 
 Payloads are anything msgpack can carry (maps/lists/bytes/str/ints).
 The reference's split between grid RPC (small hot calls) and HTTP
 streams (bulk bytes) maps onto T_REQ vs T_SREQ/T_CHUNK on the same
-multiplexed connection (internal/grid/README.md; the frame cap keeps
-bulk chunks from head-of-line-blocking lock traffic).
+multiplexed connection (internal/grid/README.md; the frame cap and
+per-stream credit windows keep bulk chunks from
+head-of-line-blocking lock traffic).
 """
 
 from __future__ import annotations
 
+import os
 import struct
-from typing import Optional
+from typing import Optional, Tuple, Union
 
 import msgpack
 
@@ -34,10 +52,47 @@ T_CHUNK = 4
 T_EOF = 5
 T_PING = 6
 T_PONG = 7
+T_WIN = 8
 
 # A single frame never exceeds this; callers chunk larger payloads.
 MAX_FRAME = 32 << 20
 _LEN = struct.Struct(">I")
+_RAW_BIT = 0x80000000
+_RAW_HDR = struct.Struct(">II")
+
+# Raw payload slice size for sendfile/recv loops. One slice per write
+# lock acquisition, so small control frames interleave between slices.
+RAW_SLICE = 1 << 20
+
+
+def native_enabled() -> bool:
+    """MTPU_GRID_NATIVE kill switch (default on). ``off`` reverts the
+    mesh to the v1 per-frame msgpack path, byte-identical."""
+    return os.environ.get("MTPU_GRID_NATIVE", "on").lower() not in (
+        "off", "0", "false", "no")
+
+
+class RawFile:
+    """Stream item shipped as raw frames straight from the file via
+    os.sendfile (zero Python-level copies send-side). length < 0 means
+    to end-of-file, resolved at send time."""
+
+    __slots__ = ("path", "offset", "length")
+
+    def __init__(self, path: str, offset: int = 0, length: int = -1):
+        self.path = path
+        self.offset = offset
+        self.length = length
+
+
+class RawBytes:
+    """Stream item shipped as raw frames from an in-memory buffer
+    (no msgpack wrap; sendall off memoryview slices)."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data):
+        self.data = data
 
 
 class GridError(Exception):
@@ -59,6 +114,14 @@ def pack_frame(msg: dict) -> bytes:
     return _LEN.pack(len(blob)) + blob
 
 
+def pack_raw_header(mux: int, payload_len: int) -> bytes:
+    """Header for a raw bulk frame: [len|RAW_BIT][mux]. The length
+    word counts the mux field plus the payload bytes that follow."""
+    if payload_len > MAX_FRAME:
+        raise GridError(f"raw frame too large: {payload_len} bytes")
+    return _RAW_HDR.pack((payload_len + 4) | _RAW_BIT, mux)
+
+
 def read_exact(sock, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:
@@ -70,7 +133,17 @@ def read_exact(sock, n: int) -> bytes:
 
 
 def read_frame(sock) -> dict:
+    """v1 reader: one msgpack control frame. Raw frames surface as a
+    synthetic ``{"t": T_CHUNK, "m": mux, "p": bytes, "raw": True}``
+    so blocking readers stay correct against a v2 sender."""
     (length,) = _LEN.unpack(read_exact(sock, 4))
+    if length & _RAW_BIT:
+        payload_len = (length & ~_RAW_BIT) - 4
+        if payload_len < 0 or payload_len > MAX_FRAME:
+            raise GridError(f"oversized raw frame: {length}")
+        (mux,) = _LEN.unpack(read_exact(sock, 4))
+        return {"t": T_CHUNK, "m": mux, "p": read_exact(sock, payload_len),
+                "raw": True}
     if length > MAX_FRAME:
         raise GridError(f"oversized frame: {length}")
     return msgpack.unpackb(read_exact(sock, length), raw=False,

@@ -28,7 +28,10 @@ Cross-process coordination:
     reaps and restarts unexpectedly-dead workers (bounded).
 
 MTPU_HTTP_WORKERS: worker count (default = cores; 0/1 = today's
-in-process mode, used by tests and distributed deployments).
+in-process mode, used by tests). Distributed topologies pre-fork the
+same way: worker 0 additionally owns the node's grid plane — the grid
+listener, lock authority and coherence singleton — and siblings reach
+it over loopback (see minio_tpu.server's worker-topology wiring).
 """
 
 from __future__ import annotations
@@ -594,6 +597,10 @@ class WorkerPool:
         ctx = WorkerContext(worker_id, self.n, query_child, hub_child)
         os.environ["MTPU_HTTP_WORKERS"] = "1"
         os.environ["MTPU_WORKER_ID"] = str(worker_id)
+        # Fleet width, visible to the boot path BEFORE maybe_attach_worker
+        # runs: distributed N x M topologies shard background ownership
+        # (scanner/heal sets) across node_count x worker_count slots.
+        os.environ["MTPU_WORKER_TOTAL"] = str(self.n)
         if respawn:
             # A respawned worker 0 boots while siblings are serving:
             # the boot janitor (stale-staging sweep) must NOT run — it

@@ -335,6 +335,135 @@ def migrate_key(layer, src_idx: int, bucket: str, key: str,
     raise DecomError(f"{bucket}/{key}: version stack kept changing")
 
 
+def _free_space_dst(layer, exclude: set) -> int:
+    """Surviving pool with the most free space, skipping `exclude` and
+    anything decommissioning (shared by decom and rebalance shards)."""
+    best, best_free = None, -1
+    for i, p in enumerate(layer.pools):
+        if i in exclude or i in layer.decommissioning:
+            continue
+        free = p.free_space()
+        if free > best_free:
+            best, best_free = i, free
+    if best is None:
+        raise DecomError("no destination pool available")
+    return best
+
+
+def exec_page(layer, src_idx: int, bucket: str, keys: list,
+              exclude=()) -> dict:
+    """One fleet-sharded migration batch executed on THIS node — the
+    body of the ``mig.page`` grid verb. Migrates `keys` out of pool
+    `src_idx`, yielding to local foreground pressure between keys, and
+    returns aggregate counters ONLY ({migrated, failed, bytes,
+    last_error}): the coordinator owns every checkpoint write, so a
+    peer crash mid-batch loses nothing but that batch's work (the
+    coordinator re-walks the page; migrate_key is idempotent)."""
+    ex = set(int(i) for i in exclude) | {int(src_idx)}
+    pressure = getattr(layer, "migration_pressure", None)
+    poll_s = max(1.0, env_float("MTPU_REBALANCE_YIELD_MS", 50.0)) / 1000.0
+    out = {"migrated": 0, "failed": 0, "bytes": 0, "last_error": None}
+    for key in keys:
+        while pressure is not None and pressure():
+            time.sleep(poll_s)
+        try:
+            moved = migrate_key(layer, src_idx, bucket, key,
+                                lambda: _free_space_dst(layer, ex))
+            out["migrated"] += 1
+            out["bytes"] += int(moved or 0)
+        except Exception as e:  # noqa: BLE001 - keep going, report
+            out["failed"] += 1
+            out["last_error"] = f"{bucket}/{key}: {e}"
+    return out
+
+
+class PageDispatcher:
+    """Fleet-sharded migration walk (N nodes): the coordinator shards
+    each listing page's keys across the cluster by stable key hash —
+    one shard stays local, the rest ship to peer nodes as ``mig.page``
+    grid calls executed against each peer's OWN pools layer — and
+    aggregates the returned counters. Peers write no state: the
+    coordinator alone checkpoints, so resume/crash semantics are
+    exactly the single-walker ones. A peer that is down, partitioned,
+    or running an older build (NoSuchHandler) gets its shard migrated
+    locally — fleet width is a throughput optimization, never a
+    correctness dependency."""
+
+    def __init__(self, layer, peers, timeout: Optional[float] = None):
+        self.layer = layer
+        self.peers = list(peers)
+        self.timeout = timeout if timeout is not None else \
+            env_float("MTPU_MIG_PAGE_TIMEOUT_S", 600.0)
+
+    def run(self, src_idx: int, bucket: str, keys: list,
+            exclude=()) -> dict:
+        import zlib
+        n = len(self.peers) + 1
+        shards: list[list] = [[] for _ in range(n)]
+        for k in keys:
+            shards[zlib.crc32(k.encode()) % n].append(k)
+        agg = {"migrated": 0, "failed": 0, "bytes": 0, "last_error": None}
+        agg_mu = threading.Lock()
+        ex = sorted(set(int(i) for i in exclude) | {int(src_idx)})
+
+        def merge(res: dict) -> None:
+            with agg_mu:
+                agg["migrated"] += int(res.get("migrated", 0))
+                agg["failed"] += int(res.get("failed", 0))
+                agg["bytes"] += int(res.get("bytes", 0))
+                if res.get("last_error"):
+                    agg["last_error"] = res["last_error"]
+
+        def remote(i: int, shard: list) -> None:
+            try:
+                res = self.peers[i].call(
+                    "mig.page", {"src": src_idx, "b": bucket,
+                                 "keys": shard, "ex": ex},
+                    timeout=self.timeout)
+            except Exception:  # noqa: BLE001 - peer down: do it here
+                res = exec_page(self.layer, src_idx, bucket, shard, ex)
+            merge(res)
+
+        threads = [threading.Thread(target=remote, args=(i, shard),
+                                    daemon=True,
+                                    name=f"mig-page-peer{i}")
+                   for i, shard in enumerate(shards[1:]) if shard]
+        for t in threads:
+            t.start()
+        if shards[0]:
+            merge(exec_page(self.layer, src_idx, bucket, shards[0], ex))
+        for t in threads:
+            t.join()
+        return agg
+
+    def iter_batches(self, src_idx: int, bucket: str, keys: list,
+                     exclude=(), gate=None):
+        """Ordered batches of `keys` (MTPU_MIG_BATCH per fleet node
+        each), hash-sharded across the fleet with a barrier per batch,
+        yielding (batch, counters): the caller advances its marker and
+        checkpoints BETWEEN batches, so progress stays observable and
+        a crashed coordinator re-walks one batch, not one page. `gate`
+        (the governor's) runs before each batch — pressure yield,
+        pacing, stop."""
+        per_node = max(1, env_int("MTPU_MIG_BATCH", 8))
+        width = per_node * (len(self.peers) + 1)
+        for i in range(0, len(keys), width):
+            if gate is not None and not gate():
+                return
+            batch = keys[i:i + width]
+            yield batch, self.run(src_idx, bucket, batch, exclude)
+
+
+def page_dispatcher(layer) -> Optional["PageDispatcher"]:
+    """The fleet dispatcher when this deployment has peer nodes wired
+    (server boot sets layer.migration_peers), else None (single-node:
+    the classic local walk)."""
+    peers = getattr(layer, "migration_peers", None)
+    if not peers:
+        return None
+    return PageDispatcher(layer, peers)
+
+
 class Decommission:
     """One pool-drain driver (start fresh or resume from a checkpoint)."""
 
@@ -491,10 +620,14 @@ class Decommission:
         src = self.layer.pools[self.pool_idx]
         gov = self._gov
         since_ckpt = 0
+        # Fleet-sharded walk: with peer nodes wired, each page's keys
+        # spread across the cluster (coordinator aggregates counters
+        # and owns EVERY checkpoint; see PageDispatcher).
+        disp = page_dispatcher(self.layer)
         pool = ThreadPoolExecutor(
             max_workers=gov.workers,
             thread_name_prefix=f"decom{self.pool_idx}-mig") \
-            if gov.workers > 1 else None
+            if disp is None and gov.workers > 1 else None
         try:
             buckets = sorted(b.name for b in src.list_buckets())
             # Resume: skip buckets already fully drained.
@@ -509,7 +642,26 @@ class Decommission:
                                             max_keys=256,
                                             include_versions=True)
                     keys = sorted({o.name for o in page.objects})
-                    if pool is not None:
+                    if disp is not None:
+                        # Fleet migration: ordered batches sharded
+                        # across peer nodes, marker/checkpoint advance
+                        # per completed batch.
+                        for batch, agg in disp.iter_batches(
+                                self.pool_idx, bucket, keys,
+                                exclude={self.pool_idx}, gate=gov.gate):
+                            gov.count("migrated", agg["migrated"])
+                            gov.count("failed", agg["failed"])
+                            gov.count("bytes_moved", agg["bytes"])
+                            if agg.get("last_error"):
+                                self.state["last_error"] = \
+                                    agg["last_error"]
+                            self.state["bucket"] = bucket
+                            self.state["marker"] = batch[-1]
+                            since_ckpt += len(batch)
+                            if since_ckpt >= self.checkpoint_every:
+                                since_ckpt = 0
+                                self._persist()
+                    elif pool is not None:
                         # Page-barrier parallel migration: the marker
                         # only ever advances past a FULLY completed
                         # page, so a crash re-walks at most one page

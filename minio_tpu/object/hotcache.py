@@ -30,12 +30,15 @@ invalidation is already exact cluster-wide:
 - pre-forked workers observe the shared ``list.gen`` bump file (their
   own SharedGen instance — ``changed()`` is stateful per observer) and
   flush wholesale when a sibling worker mutated anything;
-- on distributed sets, hits are served only while every set's
-  ``fi_cache.remote_gate`` (grid/coherence.PeerCoherence.coherent, or
-  the deny-all sentinel on bare remote sets) answers coherent; the
-  walk is dynamic so elastic pool expansion is picked up live, and any
-  gate-down interval or topology change flushes the cache before
-  serving resumes.
+- on distributed sets, hits gate on the OWNING sets' coherence only
+  (each pool's deterministic hash slot for the key, via
+  ``fi_cache.remote_gate`` — grid/coherence.PeerCoherence.coherent, or
+  the deny-all sentinel on bare remote sets): an unrelated set's
+  partition no longer blanks the whole read tier. A set observed
+  down-then-recovered gets its OWN entries selectively flushed before
+  its hits resume (bumps broadcast during the gap never reached us);
+  the walk is dynamic so elastic pool expansion is picked up live, and
+  a topology change still flushes wholesale.
 
 Kill switch: ``MTPU_HOT_CACHE=off`` (or 0/false) disables admission
 and lookups wholesale; responses are byte-identical either way because
@@ -244,7 +247,9 @@ class HotObjectCache:
         self._layer: Optional[Any] = None
         self._wired_ids: set[int] = set()
         self._wired_count = -1
-        self._gate_was_down = False
+        # Sets whose coherence gate we observed DOWN and have not yet
+        # recovery-flushed (by id — sets aren't hashable on content).
+        self._down_ids: set[int] = set()
         # Counters (stats(), metrics).
         self.hits = 0
         self.misses = 0
@@ -296,14 +301,36 @@ class HotObjectCache:
         self._wired_count = len(sets)
         return changed
 
-    def _serving(self) -> bool:
-        """True when hits may be served right now. Walks the layer's
-        sets live: wires newly-appeared sets (elastic pools — a
-        topology change flushes first), then requires every set's
-        coherence gate to answer coherent, failing closed on any
-        error. Any gate-down interval flushes the cache before serving
-        resumes: bumps broadcast while we were incoherent never
-        reached us, so everything resident is suspect."""
+    def _owning_sets(self, object_: str) -> Optional[list]:
+        """The sets that could hold this key — one per pool, each
+        pool's deterministic hash slot. None when the layer shape
+        doesn't expose pool routing (gate on every set instead)."""
+        pools = getattr(self._layer, "pools", None)
+        if not pools:
+            return None
+        out = []
+        for p in pools:
+            sets = getattr(p, "sets", None)
+            idx_fn = getattr(p, "set_index", None)
+            if not sets or idx_fn is None:
+                return None
+            try:
+                out.append(sets[idx_fn(object_)])
+            except Exception:  # noqa: BLE001 - unknown routing: gate all
+                return None
+        return out
+
+    def _serving(self, object_: Optional[str] = None) -> bool:
+        """True when a hit for `object_` may be served right now.
+        Walks the layer's sets live: wires newly-appeared sets
+        (elastic pools — a topology change flushes first), then
+        requires the OWNING sets' coherence gates (every set when no
+        key / no pool routing) to answer coherent, failing closed on
+        any error. Partial coherence serves: only the key's own sets
+        gate its hit, so one partitioned set doesn't blank the tier.
+        A set observed down then coherent again gets its own entries
+        selectively flushed before its hits resume — bumps broadcast
+        while it was incoherent never reached us."""
         if not self.enabled:
             return False
         self.maybe_flush()
@@ -311,7 +338,11 @@ class HotObjectCache:
             if self._wire_sets_locked() and (self._probation
                                              or self._protected):
                 self._invalidate_all_locked()
+        sets = None if object_ is None else self._owning_sets(object_)
+        if sets is None:
             sets = self._layer_sets(self._layer)
+        ok = True
+        recovered = []
         for s in sets:
             gate = getattr(getattr(s, "fi_cache", None), "remote_gate",
                            None)
@@ -322,12 +353,45 @@ class HotObjectCache:
             except Exception:  # noqa: BLE001 - gate errors = incoherent
                 up = False
             if not up:
-                self._gate_was_down = True
-                return False
-        if self._gate_was_down:
-            self._gate_was_down = False
-            self.invalidate_all()
-        return True
+                self._down_ids.add(id(s))
+                ok = False
+            elif id(s) in self._down_ids:
+                recovered.append(s)
+        for s in recovered:
+            self._flush_set(s)
+            self._down_ids.discard(id(s))
+        return ok
+
+    def _flush_set(self, target: Any) -> None:
+        """Recovery flush for ONE set: drop only the entries some pool
+        routes to `target`, bumping their buckets' generations so an
+        in-flight put() racing this flush is refused. Entries owned by
+        other, continuously-coherent sets stay hot."""
+        pools = getattr(self._layer, "pools", None)
+        with self._mu:
+            if not pools:
+                self._invalidate_all_locked()
+                return
+            doomed: list = []
+            for seg in (self._probation, self._protected):
+                for key in seg:
+                    owned = False
+                    for p in pools:
+                        try:
+                            if p.sets[p.set_index(key[1])] is target:
+                                owned = True
+                                break
+                        except Exception:  # noqa: BLE001 - doom it
+                            owned = True
+                            break
+                    if owned:
+                        doomed.append((seg, key))
+            for seg, key in doomed:
+                self._bytes -= seg.pop(key).nbytes
+            for bucket in {key[0] for _, key in doomed}:
+                self._gens[bucket] = self._gens.get(bucket, 0) + 1
+            if doomed:
+                self.invalidations += 1
 
     def maybe_flush(self) -> None:
         """Flush wholesale when a sibling worker process bumped the
@@ -357,7 +421,7 @@ class HotObjectCache:
         """Resident entry for (bucket, object) or None. Counts the
         access in the admission sketch either way; a probation hit
         promotes to protected."""
-        if not self._serving():
+        if not self._serving(object_):
             return None
         key = (bucket, object_)
         with self._mu:

@@ -36,7 +36,8 @@ import time
 from typing import Optional
 
 from minio_tpu.object.decom import (LeaseHeld, MigrationGovernor,
-                                    coordinator_lease, migrate_key)
+                                    coordinator_lease, migrate_key,
+                                    page_dispatcher)
 from minio_tpu.storage.local import SYS_VOL
 
 __all__ = ["Rebalance", "RebalanceError", "LeaseHeld", "load_state",
@@ -301,10 +302,14 @@ class Rebalance:
         pool = self.layer.pools[src]
         gov = self._gov
         since_ckpt = 0
+        # Fleet-sharded walk (see decom.PageDispatcher): pages spread
+        # across peer nodes; this coordinator aggregates counters and
+        # owns every checkpoint.
+        disp = page_dispatcher(self.layer)
         workers = ThreadPoolExecutor(
             max_workers=gov.workers,
             thread_name_prefix=f"rebal{src}-mig") \
-            if gov.workers > 1 else None
+            if disp is None and gov.workers > 1 else None
         try:
             buckets = sorted(b.name for b in pool.list_buckets())
             start_bucket = rec.get("bucket", "")
@@ -321,7 +326,26 @@ class Rebalance:
                     for o in page.objects:
                         sizes[o.name] = sizes.get(o.name, 0) + o.size
                     keys = sorted(sizes)
-                    if workers is not None:
+                    if disp is not None:
+                        for batch, agg in disp.iter_batches(
+                                src, bucket, keys,
+                                exclude=exclude | {src}, gate=gov.gate):
+                            gov.add(rec, "migrated", agg["migrated"])
+                            gov.add(rec, "failed", agg["failed"])
+                            gov.add(rec, "bytes_moved", agg["bytes"])
+                            if agg.get("last_error"):
+                                rec["last_error"] = agg["last_error"]
+                            rec["bucket"] = bucket
+                            rec["marker"] = batch[-1]
+                            since_ckpt += len(batch)
+                            if since_ckpt >= self.checkpoint_every:
+                                since_ckpt = 0
+                                self._persist()
+                            if rec["bytes_moved"] >= rec["bytes_target"]:
+                                rec["done"] = True
+                                self._persist()
+                                return
+                    elif workers is not None:
                         # Page-barrier parallel migration (see
                         # Decommission._drain): the marker advances
                         # only past a FULLY completed page and the

@@ -1007,6 +1007,37 @@ class Metrics:
                "refused connects; remote handler errors excluded)",
                "counter",
                [({"peer": g["peer"]}, g["rpc_errors"]) for g in gstats])
+        # Native data plane (grid/loop.py epoll poller): multiplexed
+        # stream frames, raw bulk transfer, zero-copy sendfile.
+        from minio_tpu.grid import loop as _grid_loop
+        lst_ = _grid_loop.stats()
+        metric("minio_tpu_grid_native_enabled",
+               "1 when the native grid data plane is active "
+               "(MTPU_GRID_NATIVE kill switch + epoll availability)",
+               "gauge", [({}, 1 if lst_["native"] else 0)])
+        metric("minio_tpu_grid_stream_raw_tx_frames_total",
+               "Raw bulk frames sent on the native plane", "counter",
+               [({}, lst_["raw_tx_frames"])])
+        metric("minio_tpu_grid_stream_raw_tx_bytes_total",
+               "Raw bulk payload bytes sent on the native plane",
+               "counter", [({}, lst_["raw_tx_bytes"])])
+        metric("minio_tpu_grid_stream_raw_rx_frames_total",
+               "Raw bulk frames received into pooled leases",
+               "counter", [({}, lst_["raw_rx_frames"])])
+        metric("minio_tpu_grid_stream_raw_rx_bytes_total",
+               "Raw bulk payload bytes received into pooled leases",
+               "counter", [({}, lst_["raw_rx_bytes"])])
+        metric("minio_tpu_grid_stream_credit_stalls_total",
+               "Times a bulk sender parked on an exhausted credit "
+               "window (receiver not draining)", "counter",
+               [({}, lst_["credit_stalls"])])
+        metric("minio_tpu_grid_sendfile_transfers_total",
+               "Shard transfers shipped via os.sendfile (zero "
+               "Python-level copies send-side)", "counter",
+               [({}, lst_["sendfile_transfers"])])
+        metric("minio_tpu_grid_sendfile_bytes_total",
+               "Bytes shipped via os.sendfile", "counter",
+               [({}, lst_["sendfile_bytes"])])
         nst = _grid_peers.notify_stats()
         metric("minio_tpu_peer_notify_sent_total",
                "Peer reload notifications acknowledged", "counter",

@@ -128,21 +128,30 @@ def main(argv=None) -> int:
     # ec-backend detection: those may import and initialize JAX, and
     # forking a process with a live XLA runtime (its thread pools, a
     # claimed TPU device) is undefined — every child does its own
-    # detection instead. Default = cores; distributed topologies keep
-    # one process per node (each worker would need its own grid port —
-    # the mesh already spreads load across nodes).
+    # detection instead. Default = cores. Distributed topologies
+    # pre-fork too (N nodes x M workers): the node's SINGLE grid port
+    # is owned by worker 0, and sibling workers reach the node's lock
+    # authority / coherence singleton over loopback — see the
+    # worker-topology wiring below.
     from minio_tpu.io import workers as workers_mod
     worker_id = os.environ.get("MTPU_WORKER_ID", "")
     if not worker_id:
         n_workers = workers_mod.worker_count_from_env()
         if n_workers > 1:
-            if distributed:
-                print("WARN: MTPU_HTTP_WORKERS > 1 is single-node only; "
-                      "serving from one process", file=sys.stderr)
-            else:
-                return workers_mod.serve_cli(
-                    list(argv) if argv is not None else sys.argv[1:],
-                    args.address, n_workers, main)
+            return workers_mod.serve_cli(
+                list(argv) if argv is not None else sys.argv[1:],
+                args.address, n_workers, main)
+    # Worker identity: "" = plain single-process boot; "0" = the
+    # pre-forked worker that owns node-singleton duties (grid listener,
+    # lock authority, coherence, recovery sweeps); "1".."M-1" = sibling
+    # workers. MTPU_WORKER_TOTAL is the fleet width M (1 outside worker
+    # mode) — background ownership shards over node_count x M slots.
+    is_w0 = worker_id in ("", "0")
+    try:
+        worker_total = max(1, int(os.environ.get("MTPU_WORKER_TOTAL",
+                                                 "") or "1"))
+    except ValueError:
+        worker_total = 1
 
     # Boot self-tests: identical math to the reference or refuse to serve.
     from minio_tpu.erasure.selftest import erasure_self_test
@@ -187,31 +196,53 @@ def main(argv=None) -> int:
         from minio_tpu.grid import GridServer, client_for
         from minio_tpu.grid.dsync import (DistNSLock, LocalLocker,
                                           LockServer, RemoteLocker)
-        grid_srv = GridServer(my_port + GRID_PORT_OFFSET)
-        StorageRPCService(local_disks).register_into(grid_srv)
-        lock_server = LockServer()
-        lock_server.register_into(grid_srv)
-        node_info = {"deployment_id": ""}
-        grid_srv.register("node.info", lambda p: dict(node_info))
-        grid_srv.start()
-        print(f"grid mesh on :{grid_srv.port} "
-              f"({len(local_disks)} local drives)", flush=True)
+        grid_port = my_port + GRID_PORT_OFFSET
+        if is_w0:
+            grid_srv = GridServer(grid_port)
+            StorageRPCService(local_disks).register_into(grid_srv)
+            lock_server = LockServer()
+            lock_server.register_into(grid_srv)
+            node_info = {"deployment_id": ""}
+            grid_srv.register("node.info", lambda p: dict(node_info))
+            grid_srv.start()
+            print(f"grid mesh on :{grid_srv.port} "
+                  f"({len(local_disks)} local drives)", flush=True)
 
-        # Wait for every peer's grid before touching formats (the
-        # reference's bootstrap handshake, cmd/bootstrap-peer-server.go).
-        deadline = time.monotonic() + args.boot_timeout
-        for host, port in remote_nodes:
-            c = client_for(host, port + GRID_PORT_OFFSET)
-            while not c.ping(timeout=2.0):
+            # Wait for every peer's grid before touching formats (the
+            # reference's bootstrap handshake,
+            # cmd/bootstrap-peer-server.go).
+            deadline = time.monotonic() + args.boot_timeout
+            for host, port in remote_nodes:
+                c = client_for(host, port + GRID_PORT_OFFSET)
+                while not c.ping(timeout=2.0):
+                    if time.monotonic() > deadline:
+                        print(f"WARN: peer {host}:{port} unreachable; "
+                              f"its drives boot offline", file=sys.stderr)
+                        break
+                    time.sleep(0.5)
+
+            lockers = [LocalLocker(lock_server)] + [
+                RemoteLocker(client_for(h, p + GRID_PORT_OFFSET))
+                for h, p in remote_nodes]
+        else:
+            # Sibling worker on an N x M node: worker 0 owns the node's
+            # grid plane, so this process binds nothing — the node's own
+            # lock vote is one more RemoteLocker, over loopback. Worker
+            # 0 booted first (the pool forks siblings only after it
+            # accepts), so the wait below only spins across a worker-0
+            # respawn window; an unreachable loopback then degrades to
+            # quorum fast-fails (503s) until it returns, never a wedge.
+            self_client = client_for("127.0.0.1", grid_port)
+            deadline = time.monotonic() + args.boot_timeout
+            while not self_client.ping(timeout=2.0):
                 if time.monotonic() > deadline:
-                    print(f"WARN: peer {host}:{port} unreachable; its "
-                          f"drives boot offline", file=sys.stderr)
+                    print("WARN: node grid plane (worker 0) unreachable; "
+                          "lock quorum degraded", file=sys.stderr)
                     break
-                time.sleep(0.5)
-
-        lockers = [LocalLocker(lock_server)] + [
-            RemoteLocker(client_for(h, p + GRID_PORT_OFFSET))
-            for h, p in remote_nodes]
+                time.sleep(0.2)
+            lockers = [RemoteLocker(self_client)] + [
+                RemoteLocker(client_for(h, p + GRID_PORT_OFFSET))
+                for h, p in remote_nodes]
 
     def make_disk(ep: ellipses.Endpoint):
         if is_local(ep):
@@ -276,8 +307,7 @@ def main(argv=None) -> int:
         # staging names and the age gate add a second line of defense
         # (storage/local.sweep_stale_tmp). MTPU_RECOVERY_SWEEP=off
         # falls back to the plain tmp/staging purge.
-        if worker_id in ("", "0") \
-                and not os.environ.get("MTPU_WORKER_RESPAWN"):
+        if is_w0 and not os.environ.get("MTPU_WORKER_RESPAWN"):
             from minio_tpu.storage.local import (consume_clean_shutdown,
                                                  recovery_sweep,
                                                  sweep_stale_tmp)
@@ -321,10 +351,12 @@ def main(argv=None) -> int:
         n_sets += len(sets)
         n_drives += len(ordered)
 
-    if distributed:
+    if distributed and grid_srv is not None:
         node_info["deployment_id"] = deployment_id
         # Cross-node config handshake: peers must agree on deployment
         # (reference: verifyServerSystemConfig, cmd/server-main.go:928).
+        # Worker 0 only — it owns the node's grid identity; siblings
+        # booted after it already verified.
         from minio_tpu.grid import client_for as _cf
         for host, port in remote_nodes:
             try:
@@ -378,28 +410,50 @@ def main(argv=None) -> int:
     # cmd/data-scanner.go's scanner loop).
     from minio_tpu.object.scanner import Scanner
     all_sets = [s for p in pools for s in p.sets]
-    scanner = Scanner(all_sets, interval=args.scanner_interval)
+    # Fleet-sharded background ownership (N nodes x M workers): every
+    # erasure set is owned by exactly ONE (node, worker) slot, so each
+    # cycle covers each set once FLEET-wide — distributed nodes used to
+    # scan/heal every set on every node (N x duplication), and worker
+    # mode parked all of it on worker 0 while siblings idled. Node
+    # ranks come from the sorted endpoint topology, identical on every
+    # node by construction (the same server command runs everywhere);
+    # a dead slot's sets go unscanned only until its worker respawns.
+    widx = int(worker_id or 0)
+    if distributed:
+        _nodes = sorted({(ep.host, ep.port) for ep in all_eps})
+        _remote = set(remote_nodes)
+        node_rank = next((i for i, hp in enumerate(_nodes)
+                          if hp not in _remote), 0)
+        fleet_slots = len(_nodes) * worker_total
+        bg_slot = node_rank * worker_total + widx
+    else:
+        fleet_slots = worker_total
+        bg_slot = widx
+    owned_sets = [s for i, s in enumerate(all_sets)
+                  if i % fleet_slots == bg_slot]
+    scanner = Scanner(owned_sets, interval=args.scanner_interval)
     # ILM: lifecycle rules stored per bucket evaluate on every scanned
     # object (reference: cmd/bucket-lifecycle.go via the scanner).
     from minio_tpu.object.lifecycle import make_scanner_hook
     scanner.on_object.append(make_scanner_hook())
-    # Worker mode: background sweeps (scanner, heal sampling) run on
-    # worker 0 only — the drives are shared, and n workers scanning
-    # the same sets would multiply every heal/ILM action by n.
-    if args.scanner_interval > 0 and worker_id in ("", "0"):
+    # A slot with no owned sets (more slots than sets) starts nothing;
+    # the single-process single-node boot degenerates to slot 0 of 1
+    # owning everything — exactly the old behavior.
+    if args.scanner_interval > 0 and owned_sets:
         scanner.start()
     layer.scanner = scanner
     # Drive lifecycle manager: detect hot-replaced (fresh) drives while
     # serving, restore their slot format, and run checkpointed bulk
-    # heals that resume across restarts (object/drive_heal). Worker-0
-    # gated like the scanner — n workers bulk-healing shared drives
-    # would multiply every repair by n.
+    # heals that resume across restarts (object/drive_heal). Sharded
+    # over the same ownership slots as the scanner — format restore and
+    # healing markers ride the generic disk interface, so an owner
+    # converges another node's replaced drive over the grid.
     from minio_tpu.object.drive_heal import (DriveHealManager,
                                              admission_pressure)
     drive_heal = DriveHealManager(
-        all_sets, total_hint=lambda: scanner.usage.objects)
+        owned_sets, total_hint=lambda: scanner.usage.objects)
     layer.drive_heal = drive_heal
-    if worker_id in ("", "0"):
+    if owned_sets:
         drive_heal.start(interval=args.scanner_interval
                          if args.scanner_interval > 0 else 10.0)
     # IAM: users/service-accounts/policies, replicated on pool 0's
@@ -440,7 +494,7 @@ def main(argv=None) -> int:
     from minio_tpu.object.batch import BatchJobs
     srv.batch = BatchJobs(layer, pools[0].sets)
     srv.batch.kms = srv.kms
-    if worker_id in ("", "0"):
+    if is_w0:
         # Checkpointed batch jobs resume once, not once per worker.
         try:
             resumed = srv.batch.resume_all()
@@ -465,10 +519,15 @@ def main(argv=None) -> int:
                                           make_reload_handler)
         peer_notifier = PeerNotifier(
             [client_for(h, p + GRID_PORT_OFFSET) for h, p in remote_nodes])
-        grid_srv.register(RELOAD_HANDLER, make_reload_handler(
-            iam=creds.iam, object_layer=layer,
-            apply_config=lambda: cfg_mod.apply_config(
-                srv, cfg_mod.load_config(layer))))
+        if grid_srv is not None:
+            # Inbound reload pings land on the node's grid listener —
+            # worker 0's process. Sibling workers converge through
+            # their per-cache TTLs, the same backstop that covers an
+            # unreachable peer.
+            grid_srv.register(RELOAD_HANDLER, make_reload_handler(
+                iam=creds.iam, object_layer=layer,
+                apply_config=lambda: cfg_mod.apply_config(
+                    srv, cfg_mod.load_config(layer))))
         srv.peer_notify = peer_notifier.broadcast
         srv.peer_notifier = peer_notifier
         creds.iam.on_change = lambda: peer_notifier.broadcast("iam")
@@ -479,50 +538,112 @@ def main(argv=None) -> int:
         # re-arm — the contract that lets fi_cache and the listing
         # caches stay ON cluster-wide.
         from minio_tpu.grid.coherence import (CLASS_BUCKET_META,
-                                              CLASS_LISTING, PeerCoherence,
+                                              CLASS_LISTING, FileGate,
+                                              PeerCoherence, RELAY_HANDLER,
                                               make_set_invalidator)
         all_sets_d = [s for p in pools for s in p.sets]
-        # Self-declared coherence identity: must be UNIQUE per node and
-        # stable across restarts (peers key applied-generation records
-        # by it; restart detection rides the instance id). The bind
-        # address is neither when every node runs the default
-        # 0.0.0.0:9000 — fall back to the hostname, which is what
-        # distinguishes nodes in a same-port deployment.
-        ident_host = my_host if my_host not in ("0.0.0.0", "::", "") \
-            else socket_mod.gethostname()
-        coherence = PeerCoherence(
-            node_id=f"{ident_host}:{my_port}",
-            peers={f"{h}:{p}": client_for(h, p + GRID_PORT_OFFSET)
-                   for h, p in remote_nodes},
-            on_invalidate=make_set_invalidator(all_sets_d, layer=layer))
-        coherence.register_into(grid_srv)
-        layer.on_bucket_meta_change = \
-            lambda bucket: coherence.broadcast(bucket, CLASS_BUCKET_META)
-        # A write on this node orphans peers' walk streams + fileinfo
-        # entries for the bucket (leading-edge coalesced inside
-        # MetaCache.bump, trailing-guaranteed).
-        for s in all_sets_d:
-            s.metacache.on_bump = (
-                lambda bucket: coherence.broadcast(bucket, CLASS_LISTING))
-            # Synchronous acked pushes: a timer-deferred invalidation
-            # would be a cross-node staleness window no gate covers.
-            s.metacache.bump_coalesce = 0.0
-            # EVERY set gates on coherence in distributed mode — a set
-            # whose drives are all local here is remote from the peers'
-            # side, so peers mutate it too.
-            s.fi_cache.remote_gate = coherence.coherent
-            s.metacache.remote_gate = coherence.coherent
-        coherence.start()
-        srv.coherence = coherence
+        # N x M worker topology: the gate state file and relay-failure
+        # flag live in the same shared dir io/workers.py keeps its
+        # bump-generation files in (worker mode only — a plain
+        # single-process node needs neither).
+        shared_dir = None
+        if worker_id:
+            _root = workers_mod._first_drive_root(layer)
+            if _root is not None:
+                shared_dir = os.path.join(_root, ".mtpu.sys", "workers")
+                os.makedirs(shared_dir, exist_ok=True)
+        if grid_srv is not None:
+            # Self-declared coherence identity: must be UNIQUE per node
+            # and stable across restarts (peers key applied-generation
+            # records by it; restart detection rides the instance id).
+            # The bind address is neither when every node runs the
+            # default 0.0.0.0:9000 — fall back to the hostname, which
+            # is what distinguishes nodes in a same-port deployment.
+            ident_host = my_host if my_host not in ("0.0.0.0", "::", "") \
+                else socket_mod.gethostname()
+            coherence = PeerCoherence(
+                node_id=f"{ident_host}:{my_port}",
+                peers={f"{h}:{p}": client_for(h, p + GRID_PORT_OFFSET)
+                       for h, p in remote_nodes},
+                on_invalidate=make_set_invalidator(all_sets_d,
+                                                   layer=layer))
+            coherence.register_into(grid_srv)
+            if shared_dir is not None:
+                coherence.state_path = os.path.join(
+                    shared_dir, "coherence.state")
+                coherence.relay_flag_path = os.path.join(
+                    shared_dir, "coherence.relay-flag")
+            layer.on_bucket_meta_change = \
+                lambda bucket: coherence.broadcast(bucket,
+                                                   CLASS_BUCKET_META)
+            # A write on this node orphans peers' walk streams +
+            # fileinfo entries for the bucket (leading-edge coalesced
+            # inside MetaCache.bump, trailing-guaranteed).
+            for s in all_sets_d:
+                s.metacache.on_bump = (
+                    lambda bucket: coherence.broadcast(bucket,
+                                                       CLASS_LISTING))
+                # Synchronous acked pushes: a timer-deferred
+                # invalidation would be a cross-node staleness window
+                # no gate covers.
+                s.metacache.bump_coalesce = 0.0
+                # EVERY set gates on coherence in distributed mode — a
+                # set whose drives are all local here is remote from
+                # the peers' side, so peers mutate it too.
+                s.fi_cache.remote_gate = coherence.coherent
+                s.metacache.remote_gate = coherence.coherent
+            coherence.start()
+            srv.coherence = coherence
+        else:
+            # Sibling worker: worker 0 owns the node's PeerCoherence.
+            # Outbound bumps relay to it over loopback (it bumps the
+            # node generation and fans out to peers); a failed relay
+            # leaves the dead-man flag its next sync tick converts into
+            # a wildcard broadcast, so a mutation can never vanish into
+            # a worker-0 respawn window. Inbound peer invalidations
+            # reach this process through the shared list.gen/meta.gen
+            # files the wrapped bump funnel already maintains. The
+            # cache gate is worker 0's published state file — stale
+            # heartbeat reads as incoherent (fail closed).
+            relay_client = client_for("127.0.0.1",
+                                      my_port + GRID_PORT_OFFSET)
+            _flag = os.path.join(shared_dir, "coherence.relay-flag") \
+                if shared_dir is not None else None
+
+            def _relay(bucket, cls):
+                try:
+                    relay_client.call(RELAY_HANDLER,
+                                      {"b": bucket, "c": cls},
+                                      timeout=5.0)
+                except Exception:  # noqa: BLE001 - dead-man flag below
+                    if _flag is not None:
+                        try:
+                            with open(_flag, "w"):
+                                pass
+                        except OSError:
+                            pass
+            gate = FileGate(os.path.join(shared_dir, "coherence.state")) \
+                if shared_dir is not None else (lambda: False)
+            layer.on_bucket_meta_change = \
+                lambda bucket: _relay(bucket, CLASS_BUCKET_META)
+            for s in all_sets_d:
+                s.metacache.on_bump = (
+                    lambda bucket: _relay(bucket, CLASS_LISTING))
+                s.metacache.bump_coalesce = 0.0
+                s.fi_cache.remote_gate = gate
+                s.metacache.remote_gate = gate
         # Cluster-wide profiling fan-out (reference: profiling rides
-        # NotificationSys too).
-        from minio_tpu.s3.profiling import (PROFILE_HANDLER,
-                                            make_profile_handler)
-        grid_srv.register(PROFILE_HANDLER,
-                          make_profile_handler(srv.profiler))
-        # Per-node admin-info summaries for the cluster info fan-out.
-        from minio_tpu.s3.metrics import node_info as _node_info
-        grid_srv.register("peer.info", lambda payload: _node_info(srv))
+        # NotificationSys too). Inbound verbs live on the node's grid
+        # listener (worker 0); outbound peer clients on every worker.
+        if grid_srv is not None:
+            from minio_tpu.s3.profiling import (PROFILE_HANDLER,
+                                                make_profile_handler)
+            grid_srv.register(PROFILE_HANDLER,
+                              make_profile_handler(srv.profiler))
+            # Per-node admin-info summaries for the cluster info fan-out.
+            from minio_tpu.s3.metrics import node_info as _node_info
+            grid_srv.register("peer.info",
+                              lambda payload: _node_info(srv))
         srv.profile_peers = [
             (f"{h}:{p}", client_for(h, p + GRID_PORT_OFFSET))
             for h, p in remote_nodes]
@@ -549,9 +670,24 @@ def main(argv=None) -> int:
                 layer.cancel_decommission()
             return {"ok": True}
 
-        grid_srv.register("elastic.status", _elastic_status)
-        grid_srv.register("elastic.stop", _elastic_stop)
-        if len(pools) > 1 and worker_id in ("", "0"):
+        if grid_srv is not None:
+            grid_srv.register("elastic.status", _elastic_status)
+            grid_srv.register("elastic.stop", _elastic_stop)
+            # Fleet-sharded migration batches: the coordinator ships
+            # listing-page shards here; this node migrates them with
+            # its OWN pools layer and returns counters only
+            # (object/decom.exec_page — no peer ever checkpoints).
+            from minio_tpu.object.decom import exec_page as _exec_page
+            grid_srv.register(
+                "mig.page",
+                lambda p: _exec_page(layer, int(p["src"]), p["b"],
+                                     list(p.get("keys") or ()),
+                                     p.get("ex") or ()))
+        # Every worker may win the coordinator lease; the dispatcher
+        # targets each peer NODE's grid plane (its worker 0).
+        layer.migration_peers = [client_for(h, p + GRID_PORT_OFFSET)
+                                 for h, p in remote_nodes]
+        if len(pools) > 1 and is_w0:
             # Orphan-recovery loop: resumes a dead coordinator's walk
             # from its checkpoint once the lease expires.
             layer.start_elastic_janitor()

@@ -21,6 +21,7 @@ from types import SimpleNamespace
 from typing import Iterator, Optional
 
 from minio_tpu.grid import GridError, RemoteCallError, client_for
+from minio_tpu.grid import wire
 from minio_tpu.grid.server import GridServer, register_error
 from minio_tpu.storage.local import (DiskAccessDenied, DiskInfo, LocalStorage,
                                      StorageError, VolInfo, VolumeExists,
@@ -145,6 +146,22 @@ class RemoteStorage:
         if len(data) <= CHUNK:
             self._call("create_file", volume, path, data)
             return
+        if wire.native_enabled():
+            # Native plane: one flow-controlled push stream of raw
+            # frames (no msgpack wrap, no per-chunk copies) staged and
+            # committed by the receiver — replaces the windowed
+            # create_begin/create_chunk/create_commit round-trips.
+            c = client_for(self.host, self.port)
+            try:
+                c.push_raw("st.write_file_raw",
+                           {"d": self.root, "a": [volume, path]},
+                           [memoryview(data)])
+                return
+            except RemoteCallError as e:
+                _raise_mapped(e)
+            except GridError as e:
+                raise StorageError(
+                    f"remote drive {self.endpoint}: {e}") from None
         # Chunked upload: stage under a transfer id, commit on finish.
         # Chunks carry their OFFSET so the windowed sends may complete
         # out of order on the receiver. WINDOW worker threads drain an
@@ -184,7 +201,26 @@ class RemoteStorage:
     def read_file(self, volume: str, path: str, offset: int = 0,
                   length: int = -1) -> bytes:
         c = client_for(self.host, self.port)
+        if 0 <= length <= CHUNK and wire.native_enabled():
+            # Small explicit-length read (the GET path's bitrot-framed
+            # block windows): one unary round-trip — no stream
+            # open/close, no credit machinery, the write-side twin of
+            # create_file's <= CHUNK branch. Falls back to the stream
+            # against an older peer that lacks the verb.
+            try:
+                return self._call("read_file", volume, path, offset,
+                                  length)
+            except StorageError as e:
+                if "NoSuchHandler" not in str(e):
+                    raise
         try:
+            if wire.native_enabled():
+                # Native plane: the peer ships the shard file straight
+                # from its drive fd via os.sendfile (zero Python-level
+                # copies send-side); raw frames land here in pooled
+                # leases and are assembled once into the result.
+                return self._read_file_native(c, volume, path, offset,
+                                              length)
             parts = list(c.stream("st.read_file_stream",
                                   {"d": self.root, "a": [volume, path,
                                                          offset, length]}))
@@ -193,6 +229,38 @@ class RemoteStorage:
         except GridError as e:
             raise StorageError(f"remote drive {self.endpoint}: {e}") from None
         return b"".join(parts)
+
+    def _read_file_native(self, c, volume: str, path: str, offset: int,
+                          length: int) -> bytes:
+        out: Optional[bytearray] = None
+        pos = 0
+        spill = bytearray()
+        for item in c.stream("st.read_file_raw",
+                             {"d": self.root,
+                              "a": [volume, path, offset, length]},
+                             raw=True):
+            if isinstance(item, tuple):          # raw frame: (view, lease)
+                view, lease = item
+                try:
+                    if out is not None and pos + len(view) <= len(out):
+                        out[pos:pos + len(view)] = view
+                        pos += len(view)
+                    else:
+                        spill += view
+                finally:
+                    if lease is not None:
+                        lease.release()
+            elif isinstance(item, dict) and "size" in item:
+                # Size header: preallocate the result once instead of
+                # growing a bytearray per frame.
+                out = bytearray(int(item["size"]))
+            elif item:                           # v1 peer: plain bytes
+                spill += item
+        if out is None:
+            return bytes(spill)
+        if spill:
+            return bytes(out[:pos]) + bytes(spill)
+        return bytes(out[:pos]) if pos != len(out) else bytes(out)
 
     def stat_info_file(self, volume: str, path: str):
         st = self._call("stat_info_file", volume, path)
@@ -303,8 +371,8 @@ class StorageRPCService:
     _UNARY = (
         "read_format write_format disk_id is_online make_vol "
         "make_vol_if_missing delete_vol write_all read_all delete "
-        "create_file stat_info_file read_xl delete_version rename_file "
-        "list_dir"
+        "create_file read_file stat_info_file read_xl delete_version "
+        "rename_file list_dir"
     ).split()
 
     # Chunked uploads whose client died between create_begin and
@@ -364,6 +432,8 @@ class StorageRPCService:
         srv.register("st.create_chunk", self._create_chunk)
         srv.register("st.create_commit", self._create_commit)
         srv.register_stream("st.read_file_stream", self._read_file_stream)
+        srv.register_stream("st.read_file_raw", self._read_file_raw)
+        srv.register_sink("st.write_file_raw", self._write_file_raw)
         srv.register_stream("st.walk_dir", self._walk_dir)
         srv.register_stream("st.walk_scan", self._walk_scan)
 
@@ -476,6 +546,55 @@ class StorageRPCService:
             yield blob[off:off + CHUNK]
         if not blob:
             yield b""
+
+    def _read_file_raw(self, payload):
+        """Zero-copy shard read: a size header, then the file region as
+        raw frames shipped by the server send path via os.sendfile —
+        the bitrot-framed shard bytes never surface into this process.
+        Byte-identical to read_file_stream (both are the raw file
+        content at [offset, offset+length))."""
+        d = self._disk(payload)
+        vol, path, offset, length = payload["a"]
+        full = d._obj_dir(vol, path)
+        try:
+            size = os.path.getsize(full)
+        except OSError:
+            raise FileNotFoundErr(f"{vol}/{path}") from None
+        offset = max(0, int(offset or 0))
+        if length is None or length < 0:
+            length = max(0, size - offset)
+        else:
+            length = max(0, min(int(length), size - offset))
+        yield {"size": length}
+        yield wire.RawFile(full, offset, length)
+
+    def _write_file_raw(self, payload, frames):
+        """Zero-copy shard write: pushed raw frames land in pooled
+        leases and are written straight into a staging file, then
+        fsynced and atomically renamed — the receiver half of the
+        native create_file path (same durability as LocalStorage
+        create_file + the msgpack create_commit)."""
+        d = self._disk(payload)
+        vol, path = payload["a"]
+        tmp = d._tmp_path()
+        os.makedirs(os.path.dirname(tmp), exist_ok=True)
+        try:
+            with open(tmp, "wb") as f:
+                for chunk in frames:
+                    if chunk:
+                        f.write(chunk)
+                f.flush()
+                os.fsync(f.fileno())
+            dest = d._obj_dir(vol, path)
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            os.replace(tmp, dest)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return True
 
     def _walk_dir(self, payload):
         d = self._disk(payload)

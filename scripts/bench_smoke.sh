@@ -18,7 +18,7 @@ fi
 
 echo "== bench smoke (baseline: $latest) =="
 out=$(JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} \
-      MTPU_BENCH_ONLY=put_latency,put_concurrent,get_latency,get_concurrent,meta_listing,small_put,transform_put,distributed,connections,rebalance,hot_get \
+      MTPU_BENCH_ONLY=put_latency,put_concurrent,get_latency,get_concurrent,meta_listing,small_put,transform_put,distributed,cluster_get,connections,rebalance,hot_get \
       MTPU_BENCH_SMALL=1 \
       python bench.py)
 echo "$out"
@@ -95,6 +95,23 @@ import sys
 # processes. A regression means the grid stream, the summary path, or
 # the per-set fan-out got slower. Hosts that cannot boot the cluster
 # emit an explicit null and the gate skips.
+# The native-plane gates watch the cluster data plane (ROADMAP item
+# 2) through in-run ratios — both columns of each ratio share ONE
+# bench run's scheduler weather, so they are stable on a loaded box
+# where the raw cluster aggregates measure the machine:
+#   distributed_get vs_old_plane ("higher"): multi-node GET aggregate
+#   divided by the same probe against a cluster booted under
+#   MTPU_GRID_NATIVE=off. A regression means the raw-frame/sendfile
+#   read path lost its edge over per-frame msgpack bulk bytes.
+#   cluster_get value + vs_old_plane ("higher"): the isolated
+#   inter-node shard fetch (RemoteStorage.read_file through a real
+#   GridServer — drive fd → socket via os.sendfile into pooled
+#   leases) and its ratio over the MTPU_GRID_NATIVE=off column
+#   measured back-to-back in the same run. The bench fails outright
+#   if the native column's bytes did not ride sendfile, so a green
+#   gate is also a zero-copy-proof.
+# Both emit explicit nulls where the fixture cannot boot and the
+# gates skip cleanly.
 GATES = [
     ("put_concurrent_aggregate_gibps", "host_gibps", "higher"),
     ("put_concurrent_aggregate_gibps", "served_ratio", "higher"),
@@ -108,6 +125,9 @@ GATES = [
     ("transform_put_sse_gibps", "vs_plain", "higher"),
     ("transform_put_comp_gibps", "vs_plain", "higher"),
     ("distributed_list_page_p50_ms", "value", "lower"),
+    ("distributed_get_aggregate_gibps", "vs_old_plane", "higher"),
+    ("cluster_get_shard_fetch_gibps", "value", "higher"),
+    ("cluster_get_shard_fetch_gibps", "vs_old_plane", "higher"),
     ("connections_idle_rss_per_conn_kib", "value", "lower"),
     ("connections_get_ramp_gibps", "value", "higher"),
     ("hot_get_gibps", "value", "higher"),

@@ -72,7 +72,7 @@ class Cluster:
                  = None, set_size: Optional[int] = None,
                  scanner_interval: float = 0.0, boot_timeout: float = 60.0,
                  env: Optional[dict] = None, extra: tuple = (),
-                 pools: Optional[list] = None):
+                 pools: Optional[list] = None, workers: int = 1):
         """`pools` opts into a MULTI-POOL topology (rebalance/decom
         tests): a list of pool specs, each an int (drives per node, on
         every node), or (node_list, drives_per_node) for a pool hosted
@@ -95,7 +95,13 @@ class Cluster:
             self.extra += ("--set-size", str(set_size))
         self.extra += ("--scanner-interval", str(scanner_interval),
                        "--boot-timeout", str(boot_timeout))
+        # N x M topology: workers > 1 pre-forks that many SO_REUSEPORT
+        # workers per node (io/workers.py) — worker 0 owns the node's
+        # grid plane. Default 1 keeps every node a single process,
+        # regardless of what MTPU_HTTP_WORKERS says in the test env.
+        self.workers = max(1, int(workers))
         self.env = dict(env or {})
+        self.env.setdefault("MTPU_HTTP_WORKERS", str(self.workers))
         self.endpoints: list[str] = []
         self.pool_args: list[str] = []
         if pools is None:
@@ -153,9 +159,14 @@ class Cluster:
                "--address", self.address(i), "--ec-backend", "host",
                *self.extra, *(self.pool_args or self.endpoints)]
         log = open(self.log_path(i), "wb")
+        # Own session per node so kill() can nuke the WHOLE node — in
+        # worker mode (workers > 1) the Popen pid is only the
+        # supervising parent; SIGKILLing it alone would orphan the
+        # pre-forked workers, which keep serving on the node's ports.
         self.procs[i] = subprocess.Popen(cmd, stdout=log,
                                          stderr=subprocess.STDOUT, env=env,
-                                         cwd=REPO_ROOT)
+                                         cwd=REPO_ROOT,
+                                         start_new_session=True)
 
     def start(self, wait: bool = True) -> "Cluster":
         for i in range(self.n):
@@ -194,16 +205,39 @@ class Cluster:
 
     def kill(self, i: int) -> None:
         """SIGKILL — a crash, not a drain: held dsync locks leak until
-        their TTL, staged writes stay torn, no clean-shutdown stamp."""
+        their TTL, staged writes stay torn, no clean-shutdown stamp.
+        Kills the node's whole process GROUP (worker mode forks)."""
         p = self.procs.get(i)
         if p is None:
             return
         try:
-            p.send_signal(signal.SIGKILL)
+            self._signal_group(p, signal.SIGKILL)
             p.wait(timeout=10)
         except OSError:
             pass
         self.procs[i] = None
+
+    @staticmethod
+    def _signal_group(p: subprocess.Popen, sig: int) -> None:
+        try:
+            os.killpg(p.pid, sig)
+        except (OSError, ProcessLookupError):
+            try:
+                p.send_signal(sig)
+            except OSError:
+                pass
+
+    def worker_pids(self, i: int) -> list[int]:
+        """Pids of node i's pre-forked worker children (empty in
+        single-process mode). /proc walk: children of the Popen pid."""
+        p = self.procs.get(i)
+        if p is None:
+            return []
+        try:
+            with open(f"/proc/{p.pid}/task/{p.pid}/children") as fh:
+                return [int(x) for x in fh.read().split()]
+        except OSError:
+            return []
 
     def restart(self, i: int, wait: bool = True) -> None:
         if self.alive(i):
@@ -259,10 +293,7 @@ class Cluster:
         for i in list(self.procs):
             p = self.procs.get(i)
             if p is not None:
-                try:
-                    p.send_signal(signal.SIGKILL)
-                except OSError:
-                    pass
+                self._signal_group(p, signal.SIGKILL)
         for i in list(self.procs):
             p = self.procs.get(i)
             if p is not None:

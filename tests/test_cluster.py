@@ -802,3 +802,205 @@ def test_cluster_8_node_chaos_matrix(tmp_path):
         t0 = time.time()
         st, _, _ = c0.request("PUT", "/mbkt/kx", body=b"x" * 4096)
         assert st == 503 and time.time() - t0 < 10.0
+
+
+# ---------------------------------------------------------------------------
+# N x M topology: distributed nodes x pre-forked workers
+# ---------------------------------------------------------------------------
+
+def _get_retry(cli, path, want, deadline_s=45):
+    deadline = time.time() + deadline_s
+    while True:
+        try:
+            st, _, got = cli.request("GET", path)
+        except Exception as e:  # noqa: BLE001 - conn reset mid-failover
+            st, got = 0, str(e).encode()
+        if st == 200 and got == want:
+            return
+        assert time.time() < deadline, f"GET {path}: {st}"
+        time.sleep(0.5)
+
+
+def test_cluster_workers_topology_e2e(tmp_path):
+    """2 nodes x 2 drives x 2 workers: worker 0 owns each node's grid
+    plane, siblings reach it over loopback. Cross-node reads, the
+    published coherence state file, sibling-worker respawn and
+    grid-owner (worker 0) respawn all keep serving."""
+    with Cluster(tmp_path, nodes=2, drives_per_node=2,
+                 workers=2) as cluster:
+        # Both workers forked per node.
+        for i in range(2):
+            assert len(cluster.worker_pids(i)) == 2, cluster.logs(i)[-1500:]
+        c0 = cluster.client(0)
+        assert c0.request("PUT", "/wbkt")[0] == 200
+        data = os.urandom(2 << 20)
+        _put_retry(c0, "/wbkt/obj", data)
+        # Cross-node read: node 1 pulls node 0's shards over the grid.
+        st, _, got = cluster.client(1).request("GET", "/wbkt/obj")
+        assert st == 200 and got == data
+        st, _, body = cluster.client(1).request("GET", "/wbkt")
+        assert st == 200 and b"<Key>obj</Key>" in body
+
+        # Worker 0 publishes the coherence gate state file siblings
+        # poll (FileGate) under a drive's system area.
+        states = [os.path.join(cluster.drive_dir(i, d), ".mtpu.sys",
+                               "workers", "coherence.state")
+                  for i in range(2) for d in range(2)]
+        assert any(os.path.exists(p) for p in states), states
+
+        # SIGKILL a sibling worker: the pool respawns it; service
+        # never needs the restart (the other worker keeps accepting).
+        kids = cluster.worker_pids(0)
+        os.kill(kids[1], 9)
+        deadline = time.time() + 30
+        while len(cluster.worker_pids(0)) < 2:
+            assert time.time() < deadline, "sibling worker not respawned"
+            time.sleep(0.5)
+        _get_retry(c0, "/wbkt/obj", data)
+
+        # SIGKILL worker 0 (the GRID OWNER) on node 1: the respawned
+        # worker re-binds the node's grid port with a fresh boot
+        # instance id; cross-node reads recover (peers resync).
+        kids = cluster.worker_pids(1)
+        os.kill(kids[0], 9)
+        deadline = time.time() + 30
+        while len(cluster.worker_pids(1)) < 2:
+            assert time.time() < deadline, "worker 0 not respawned"
+            time.sleep(0.5)
+        _get_retry(c0, "/wbkt/obj", data)
+        _get_retry(cluster.client(1), "/wbkt/obj", data)
+
+
+def test_cluster_workers_sibling_no_stale_reads(tmp_path):
+    """Overwrite through node 0, then hammer node 1 with fresh
+    connections (SO_REUSEPORT sprays them across BOTH workers): no
+    request — whichever worker serves it — may answer the old bytes.
+    Sibling workers learn of the remote write via the worker-0 relay
+    (gen.relay + shared generation files), not their own grid plane."""
+    with Cluster(tmp_path, nodes=2, drives_per_node=2,
+                 workers=2) as cluster:
+        c0 = cluster.client(0)
+        assert c0.request("PUT", "/sbkt")[0] == 200
+        v1 = os.urandom(256 << 10)
+        _put_retry(c0, "/sbkt/obj", v1)
+        # Warm every worker's caches on node 1 (fresh conn each time).
+        for _ in range(8):
+            st, _, got = cluster.client(1).request("GET", "/sbkt/obj")
+            assert st == 200 and got == v1
+        v2 = os.urandom(256 << 10)
+        _put_retry(c0, "/sbkt/obj", v2)
+        # Give the push-invalidation one sync tick (0.5 s in harness).
+        time.sleep(1.5)
+        for _ in range(12):
+            st, _, got = cluster.client(1).request("GET", "/sbkt/obj")
+            assert st == 200, st
+            assert got != v1, "stale read from a sibling worker"
+            assert got == v2
+
+
+@pytest.mark.slow
+def test_cluster_workers_chaos_matrix(tmp_path):
+    """N x M chaos: (a) grid-owner worker respawn while cross-node
+    GETs are in flight — the client-facing answer is always correct
+    bytes or an honest error, never torn data; (b) partition during a
+    bulk sendfile transfer — same guarantee, and after rejoin the
+    object reads back byte-identical; (c) small RPCs stay live while
+    a node's drives hang mid-bulk (mux fairness end to end)."""
+    with Cluster(tmp_path, nodes=3, drives_per_node=2,
+                 workers=2) as cluster:
+        c0 = cluster.client(0, timeout=60)
+        assert c0.request("PUT", "/xbkt")[0] == 200
+        big = os.urandom(8 << 20)
+        _put_retry(c0, "/xbkt/big", big)
+        small = os.urandom(16 << 10)
+        _put_retry(c0, "/xbkt/small", small)
+
+        # (a) kill node 1's grid owner mid-stream, repeatedly GETting
+        # through node 0 (whose erasure set spans node 1's drives).
+        stop = threading.Event()
+        errs: list = []
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    st, _, got = cluster.client(0, timeout=60).request(
+                        "GET", "/xbkt/big")
+                except Exception:  # noqa: BLE001 - conn reset is honest
+                    continue
+                if st == 200 and got != big:
+                    errs.append(f"torn read: {len(got)} bytes")
+                    return
+
+        t = threading.Thread(target=hammer, daemon=True)
+        t.start()
+        time.sleep(0.5)
+        kids = cluster.worker_pids(1)
+        if kids:
+            os.kill(kids[0], 9)          # grid owner, mid-transfer
+        time.sleep(3.0)
+        stop.set()
+        t.join(timeout=90)
+        assert not errs, errs
+        deadline = time.time() + 30
+        while len(cluster.worker_pids(1)) < 2:
+            assert time.time() < deadline, "worker 0 not respawned"
+            time.sleep(0.5)
+        _get_retry(c0, "/xbkt/big", big, deadline_s=60)
+
+        # (b) partition node 2 mid-bulk: in-flight GETs reconstruct
+        # from the surviving shards or fail honestly; after rejoin the
+        # bytes are identical.
+        stop2 = threading.Event()
+        errs2: list = []
+
+        def hammer2():
+            while not stop2.is_set():
+                try:
+                    st, _, got = cluster.client(0, timeout=60).request(
+                        "GET", "/xbkt/big")
+                except Exception:  # noqa: BLE001
+                    continue
+                if st == 200 and got != big:
+                    errs2.append(f"torn read: {len(got)} bytes")
+                    return
+
+        t2 = threading.Thread(target=hammer2, daemon=True)
+        t2.start()
+        time.sleep(0.3)
+        cluster.partition(2)
+        time.sleep(3.0)
+        stop2.set()
+        t2.join(timeout=90)
+        assert not errs2, errs2
+        cluster.rejoin(2)
+        _get_retry(c0, "/xbkt/big", big, deadline_s=60)
+
+        # (c) hang node 2's remote-drive RPCs: bulk reads touching it
+        # stall, but small unary traffic through node 0 keeps flowing
+        # (the grid connection is multiplexed, not head-of-line
+        # blocked behind the hung bulk stream).
+        cluster.hang_drives(2, 20.0)
+        time.sleep(1.0)
+        bulk_done = threading.Event()
+
+        def slow_bulk():
+            try:
+                cluster.client(1, timeout=60).request("GET", "/xbkt/big")
+            except Exception:  # noqa: BLE001
+                pass
+            finally:
+                bulk_done.set()
+
+        tb = threading.Thread(target=slow_bulk, daemon=True)
+        tb.start()
+        time.sleep(0.5)
+        lat = []
+        for _ in range(5):
+            t0 = time.time()
+            st, _, got = c0.request("GET", "/xbkt/small")
+            lat.append(time.time() - t0)
+            assert st == 200 and got == small
+        lat.sort()
+        assert lat[len(lat) // 2] < 5.0, f"small GETs starved: {lat}"
+        cluster.rejoin(2)
+        bulk_done.wait(60)

@@ -179,6 +179,50 @@ def test_probation_hit_promotes_to_protected(monkeypatch):
     assert ("b", "o") in hc._protected and ("b", "o") not in hc._probation
 
 
+def test_partial_coherence_gates_per_owning_set(monkeypatch):
+    """Per-owning-set coherence: a key's hit gates on ITS sets only —
+    an unrelated set's downed gate doesn't blank the tier — and a
+    recovered set gets its own entries selectively flushed before its
+    hits resume."""
+    hc = _cache(monkeypatch)
+    gates = {0: True, 1: True}
+
+    class FakeSet:
+        def __init__(self, i):
+            self.fi_cache = types.SimpleNamespace(
+                remote_gate=lambda i=i: gates[i])
+            self.metacache = types.SimpleNamespace(listeners=[])
+
+    class FakePool:
+        def __init__(self):
+            self.sets = [FakeSet(0), FakeSet(1)]
+
+        def set_index(self, key):
+            return 0 if key.startswith("a") else 1
+
+    hc.attach_layer(types.SimpleNamespace(pools=[FakePool()]))
+    tok = hc.token("b")
+    assert hc.put("b", "a-obj", _info(), b"A" * 100, None, tok)
+    assert hc.put("b", "z-obj", _info(), b"Z" * 100, None, tok)
+    assert hc.get("b", "a-obj") is not None
+    assert hc.get("b", "z-obj") is not None
+
+    gates[1] = False
+    assert hc.get("b", "a-obj") is not None, \
+        "unrelated set's partition blanked the tier"
+    assert hc.get("b", "z-obj") is None, "served through a down gate"
+
+    gates[1] = True
+    # Recovery flush is selective: set 1's entry is gone (bumps during
+    # the gap never reached us), set 0's stays hot.
+    assert hc.get("b", "z-obj") is None
+    assert hc.get("b", "a-obj") is not None
+    # The flushed key re-admits and serves normally afterwards.
+    tok = hc.token("b")
+    assert hc.put("b", "z-obj", _info(), b"Z2" * 50, None, tok)
+    assert hc.get("b", "z-obj") is not None
+
+
 def test_kill_switch_disables_cache(monkeypatch):
     monkeypatch.setenv("MTPU_HOT_CACHE", "off")
     hc = hotcache.HotObjectCache()
