@@ -167,6 +167,8 @@ def main() -> None:
             _hot_get()
         if _want("rebalance"):
             _rebalance()
+        if _want("replication"):
+            _replication()
         return
 
     import jax
@@ -304,6 +306,10 @@ def main() -> None:
     # ---- 13. Elastic fleet: foreground SLO under an online drain ------
     if _want("rebalance"):
         _rebalance()
+
+    # ---- 14. Durable replication: lag + chaos convergence -------------
+    if _want("replication"):
+        _replication()
 
 
 def _put_latency() -> None:
@@ -2683,6 +2689,206 @@ def _rebalance_inner() -> None:
             "unit": "fraction",
             "objects": total, "mismatches": mismatches,
             "yields_under_saturation": yields,
+        }))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _replication() -> None:
+    """Durable replication plane (ROADMAP item 5): enqueue-to-delivered
+    lag p50/p99 under foreground PUT load through a real source->target
+    server pair, with an in-run KILL-SWITCH column (the same load on a
+    pair booted MTPU_REPLICATION_DURABLE=off — the v1 in-memory plane)
+    so the WAL's ack-path cost is measured against its own baseline in
+    the same scheduler weather. Then the chaos probe: target down,
+    writes pile up (WAL + lanes + breaker), target restarts, a delete
+    lands post-heal — replication_convergence is the fraction of the
+    final namespace byte-identical on both sides with ZERO divergent
+    extra objects (1.0 = converged). Emits explicit nulls when the
+    fixture cannot build (gate skips)."""
+    try:
+        _replication_inner()
+    except Exception as e:  # noqa: BLE001 - tiny host / boot failure
+        for m in ("replication_lag_p99_ms", "replication_convergence"):
+            print(json.dumps({"metric": m, "value": None,
+                              "skip": f"{type(e).__name__}: {e}"}))
+
+
+def _replication_inner() -> None:
+    import shutil
+    import sys as _sys
+    import tempfile
+
+    repo = _os.path.dirname(_os.path.abspath(__file__))
+    if repo not in _sys.path:
+        _sys.path.insert(0, repo)
+    from minio_tpu.object.erasure_object import ErasureSet
+    from minio_tpu.object.scanner import Scanner
+    from minio_tpu.replication.engine import ReplicationEngine
+    from minio_tpu.s3.metrics import _lag_summary
+    from minio_tpu.s3.server import S3Server
+    from minio_tpu.storage.local import LocalStorage
+    from tests.s3client import S3Client
+
+    n_objs = 60 if _SMALL else 240
+    chaos_objs = 12 if _SMALL else 40
+    rng = np.random.default_rng(7)
+    base = rng.integers(0, 256, size=64 << 10, dtype=np.uint8).tobytes()
+
+    def body_for(tag: str) -> bytes:
+        return base[:-16] + tag.encode().ljust(16, b".")[:16]
+
+    repl_xml = (b"<ReplicationConfiguration>"
+                b"<Role>arn:minio:replication::r1:role</Role>"
+                b"<Rule><ID>r1</ID><Status>Enabled</Status>"
+                b"<Priority>1</Priority>"
+                b"<DeleteMarkerReplication><Status>Enabled</Status>"
+                b"</DeleteMarkerReplication>"
+                b"<Destination><Bucket>arn:aws:s3:::dstb</Bucket>"
+                b"</Destination></Rule></ReplicationConfiguration>")
+
+    def build_pair(root: str):
+        src_es = ErasureSet([LocalStorage(f"{root}/s{i}")
+                             for i in range(4)])
+        dst_es = ErasureSet([LocalStorage(f"{root}/t{i}")
+                             for i in range(4)])
+        src = S3Server(src_es, address="127.0.0.1:0")
+        dst = S3Server(dst_es, address="127.0.0.1:0")
+        src.replicator = ReplicationEngine(src_es)
+        src.start()
+        dst.start()
+        sc, dc = S3Client(src.address), S3Client(dst.address)
+        assert sc.request("PUT", "/srcb")[0] == 200
+        assert dc.request("PUT", "/dstb")[0] == 200
+        st, _, b = sc.request("PUT", "/minio/admin/v3/set-remote-target",
+                              query={"bucket": "srcb"},
+                              body=json.dumps({
+                                  "endpoint": dst.address,
+                                  "accessKey": "minioadmin",
+                                  "secretKey": "minioadmin",
+                                  "bucket": "dstb"}).encode())
+        assert st == 200, b
+        st, _, b = sc.request("PUT", "/srcb", query={"replication": ""},
+                              body=repl_xml)
+        assert st == 200, b
+        return src, dst, src_es, dst_es, sc, dc
+
+    def load_round(src, sc, prefix: str, n: int) -> tuple[dict, int]:
+        """Foreground PUT load; returns (lag p50/p99 summary from the
+        engine's own enqueue-to-delivered histogram, pending peak)."""
+        peak = 0
+        for i in range(n):
+            k = f"{prefix}-{i:04d}"
+            st, _, b = sc.request("PUT", f"/srcb/{k}",
+                                  body=body_for(k))
+            assert st == 200, b
+            peak = max(peak, src.replicator.stats()["pending"])
+        assert src.replicator.drain(120), "replication never drained"
+        return _lag_summary(src.replicator.stats()["lag_hist"]), peak
+
+    root = tempfile.mkdtemp(prefix="bench-repl-")
+    try:
+        # -- durable plane: lag under load -----------------------------
+        src, dst, src_es, dst_es, sc, dc = build_pair(f"{root}/on")
+        expect: dict = {}
+        lag, pending_peak = load_round(src, sc, "w", n_objs)
+        for i in range(n_objs):
+            expect[f"w-{i:04d}"] = body_for(f"w-{i:04d}")
+
+        # -- chaos: target dies mid-stream, restarts on the same port --
+        dst_addr = dst.address
+        dst.stop()
+        for i in range(chaos_objs):
+            k = f"c-{i:04d}"
+            sc.request("PUT", f"/srcb/{k}", body=body_for(k))
+            expect[k] = body_for(k)
+        # stop() closed the target's object layer — the "restarted
+        # process" is a fresh ErasureSet over the same drive roots.
+        dst_es2 = ErasureSet([LocalStorage(f"{root}/on/t{i}")
+                              for i in range(4)])
+        dst2 = None
+        for _ in range(40):            # port may linger in TIME_WAIT
+            try:
+                dst2 = S3Server(dst_es2, address=dst_addr)
+                dst2.start()
+                break
+            except OSError:
+                time.sleep(0.25)
+        assert dst2 is not None, "target could not rebind its port"
+        dc = S3Client(dst_addr)
+        st, _, _ = sc.request("DELETE", f"/srcb/w-0000")
+        assert st in (200, 204)
+        expect["w-0000"] = None
+
+        # Converge: lanes retry off the timer heap; the scanner pass is
+        # the production safety net re-driving anything that went
+        # terminal-FAILED while the target was dark.
+        scanner = Scanner([src_es], throttle=0)
+        scanner.on_object.append(src.replicator.scanner_hook)
+        live = {k.encode() for k, v in expect.items() if v is not None}
+        deadline = time.monotonic() + (120 if _SMALL else 180)
+        matched, extras = 0, 0
+        while time.monotonic() < deadline:
+            scanner.scan_cycle()
+            src.replicator.drain(10)
+            st, _, body = dc.request("GET", "/dstb",
+                                     query={"max-keys": "1000"})
+            assert st == 200, body
+            import re as _re
+            on_tgt = set(_re.findall(rb"<Key>([^<]+)</Key>", body))
+            extras = len(on_tgt - live)
+            matched = 0
+            for k, want in expect.items():
+                st, _, got = dc.request("GET", f"/dstb/{k}")
+                if (want is None and st == 404) or \
+                        (want is not None and st == 200 and got == want):
+                    matched += 1
+            if matched == len(expect) and extras == 0:
+                break
+            time.sleep(0.5)
+        convergence = matched / len(expect)
+        if extras:                     # divergent objects cap the score
+            convergence = min(convergence, 0.99)
+        src.replicator.stop()
+        src.stop()
+        dst2.stop()
+
+        # -- kill-switch column: v1 in-memory plane, same load ---------
+        saved = _os.environ.get("MTPU_REPLICATION_DURABLE")
+        _os.environ["MTPU_REPLICATION_DURABLE"] = "off"
+        try:
+            osrc, odst, _, _, osc, _ = build_pair(f"{root}/off")
+            off_lag, _ = load_round(osrc, osc, "w", n_objs)
+            osrc.replicator.stop()
+            osrc.stop()
+            odst.stop()
+        finally:
+            if saved is None:
+                _os.environ.pop("MTPU_REPLICATION_DURABLE", None)
+            else:
+                _os.environ["MTPU_REPLICATION_DURABLE"] = saved
+
+        print(json.dumps({
+            "metric": "replication_lag_p99_ms",
+            "value": lag["p99_ms"],
+            "unit": "ms",
+            "p50_ms": lag["p50_ms"],
+            "mean_ms": lag["mean_ms"],
+            "delivered": lag["count"],
+            "pending_peak": pending_peak,
+            "objects": n_objs, "object_bytes": len(base),
+            "durable_off_p99_ms": off_lag["p99_ms"],
+            "durable_off_p50_ms": off_lag["p50_ms"],
+            "vs_durable_off": round(lag["p99_ms"]
+                                    / max(off_lag["p99_ms"], 1e-6), 3),
+        }))
+        print(json.dumps({
+            "metric": "replication_convergence",
+            "value": round(convergence, 4),
+            "unit": "fraction",
+            "objects": len(expect),
+            "divergent": extras,
+            "chaos": "target kill/restart mid-stream + post-heal delete",
         }))
     finally:
         shutil.rmtree(root, ignore_errors=True)

@@ -2805,12 +2805,16 @@ class ErasureSet:
 
     def update_version_metadata(self, bucket: str, object_: str,
                                 version_id: str,
-                                mutate) -> ObjectInfo:
+                                mutate,
+                                allow_delete_marker: bool = False) -> ObjectInfo:
         """Apply `mutate(meta_dict)` to one version's metadata in
         place: each quorum-agreeing drive's own journal copy is
         rewritten, preserving its shard index and inline data
         (reference: PutObjectTags-style updateObjectMeta,
-        cmd/erasure-object.go:1925)."""
+        cmd/erasure-object.go:1925).  Delete markers refuse the update
+        unless allow_delete_marker is set — replication stamps its
+        COMPLETED/FAILED status onto markers, while user-facing tag
+        paths must keep rejecting them."""
         self._check_bucket(bucket)
         with self.ns.write(bucket, object_):
             fis, errors = self._read_version_all(bucket, object_, version_id,
@@ -2820,7 +2824,7 @@ class ErasureSet:
             fi, idxs = self._quorum_fileinfo(fis, quorum)
             if fi is None:
                 raise ObjectNotFound(bucket, object_)
-            if fi.deleted:
+            if fi.deleted and not allow_delete_marker:
                 raise MethodNotAllowed(bucket, object_)
             # Only drives holding the quorum-agreeing copy are written
             # and counted: a success on a stale-version drive must not
@@ -3016,7 +3020,8 @@ class ErasureSet:
             # semantics (any Enabled-era versions stay untouched).
             marker_vid = "" if opts.null_marker else new_uuid()
             fi = FileInfo(volume=bucket, name=object_, version_id=marker_vid,
-                          deleted=True, mod_time=now_ns())
+                          deleted=True, mod_time=now_ns(),
+                          metadata=dict(opts.marker_metadata or {}))
             gc = self.group_commit
             used_group = False
             if gc is not None:

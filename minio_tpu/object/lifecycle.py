@@ -233,10 +233,14 @@ def evaluate(rules: Sequence[Rule], key: str, versions,
     return out
 
 
-def make_scanner_hook(now_fn=None):
+def make_scanner_hook(now_fn=None, on_delete=None):
     """Scanner on_object callback applying ILM to scanned objects.
 
-    now_fn: clock override for accelerated tests."""
+    now_fn: clock override for accelerated tests.
+    on_delete: callback `(es, bucket, key, DeletedObject)` fired after
+    a successful expire_latest — the replication plane uses it to
+    propagate ILM-created delete markers (the handler-side enqueue
+    never sees scanner deletes)."""
     from minio_tpu.object.types import DeleteOptions
 
     cache: dict = {}
@@ -287,8 +291,13 @@ def make_scanner_hook(now_fn=None):
                     # data). Unversioned destroys the only copy — and an
                     # unversioned bucket cannot be lock-enabled, so no
                     # lock check is needed here.
-                    es.delete_object(bucket, key,
-                                     DeleteOptions(versioned=versioned))
+                    deleted = es.delete_object(
+                        bucket, key, DeleteOptions(versioned=versioned))
+                    if on_delete is not None:
+                        try:
+                            on_delete(es, bucket, key, deleted)
+                        except Exception:  # noqa: BLE001 - advisory
+                            pass
                 elif a.kind in ("delete_version", "drop_marker"):
                     if locked(versions, a.version_id):
                         continue

@@ -220,9 +220,10 @@ class ServerPools:
         return self._search("update_object_tags", bucket, object_,
                             version_id, tags)
 
-    def update_version_metadata(self, bucket, object_, version_id, mutate):
+    def update_version_metadata(self, bucket, object_, version_id, mutate,
+                                allow_delete_marker=False):
         return self._search("update_version_metadata", bucket, object_,
-                            version_id, mutate)
+                            version_id, mutate, allow_delete_marker)
 
     def list_versions_all(self, bucket, object_):
         return self._search("list_versions_all", bucket, object_)

@@ -99,6 +99,25 @@ def _walk_all_drives(es, bucket: str, forward_from: str = ""):
         yield path, [(i, blob) for _, i, blob in grp]
 
 
+def walk_bucket_versions(es, bucket: str, forward_from: str = ""):
+    """Full-fidelity (path, [FileInfo...]) walk of one set's bucket,
+    resumable at a key — the driver for checkpointed background sweeps
+    (replication resync).  Each key's versions parse from the first
+    readable journal copy; keys with no readable copy are skipped
+    (heal owns those)."""
+    from minio_tpu.storage.meta import XLMeta
+    for path, copies in _walk_all_drives(es, bucket,
+                                         forward_from=forward_from):
+        for _, blob in copies:
+            try:
+                versions = XLMeta.load(blob).list_versions(bucket, path)
+            except Exception:  # noqa: BLE001 - corrupt journal copy
+                continue
+            if versions:
+                yield path, versions
+            break
+
+
 def scan_set_bucket(es, bucket: str, usage: BucketUsage, state: dict,
                     heal: bool = True, throttle: float = 0.0,
                     on_object: Optional[Callable] = None) -> None:

@@ -222,9 +222,10 @@ class ErasureSets:
         return self.set_for(object_).update_object_tags(
             bucket, object_, version_id, tags)
 
-    def update_version_metadata(self, bucket, object_, version_id, mutate):
+    def update_version_metadata(self, bucket, object_, version_id, mutate,
+                                allow_delete_marker=False):
         return self.set_for(object_).update_version_metadata(
-            bucket, object_, version_id, mutate)
+            bucket, object_, version_id, mutate, allow_delete_marker)
 
     def delete_object(self, bucket, object_, opts=None):
         return self.set_for(object_).delete_object(bucket, object_, opts)

@@ -147,6 +147,11 @@ class DeleteOptions:
     # internal/bucket/versioning/versioning.go:36,76 treats Suspended
     # as a distinct state, not versioning-off).
     null_marker: bool = False
+    # Internal metadata stamped onto a delete marker AT creation (e.g.
+    # the replication PENDING status): markers must carry their status
+    # from the first quorum write, or a crash between delete and stamp
+    # leaves a marker the scanner can never resync.
+    marker_metadata: Optional[dict] = None
 
 
 @dataclasses.dataclass

@@ -7,9 +7,42 @@ replica marker live HERE so a fix reaches both engines.
 
 from __future__ import annotations
 
+# Replica marker header: set on everything we push so the far side can
+# tell replicas apart and never replicates them back (the active-active
+# ping-pong breaker).  Shared by PUTs and delete markers.
+H_REPLICA = "x-amz-meta-mtpu-replica"
+# Source delete-marker version id, carried on replicated deletes so the
+# far side's marker is attributable to ours (versioned markers, not
+# anonymous bare deletes).
+H_REPLICA_DM = "x-mtpu-replica-dm-version"
+
 
 class DeliveryError(Exception):
     pass
+
+
+def is_transport_error(exc: BaseException) -> bool:
+    """True when the failure means the TARGET (or the path to it) is
+    down — connection refused/reset, timeouts, torn responses.  These
+    feed the lane circuit breaker.  A decoded S3 error response means
+    the peer is alive and answering; it retries but never trips."""
+    import http.client as _hc
+
+    from minio_tpu.s3.client import S3ClientError
+    if isinstance(exc, S3ClientError):
+        return False
+    return isinstance(exc, (OSError, _hc.HTTPException))
+
+
+def push_delete_marker(client, target_bucket: str, key: str,
+                       marker_version_id: str = "") -> None:
+    """Replicate a delete: a versioned DELETE on the target carrying
+    the replica marker (so an active-active peer does not replicate
+    the resulting marker back) and the source marker's version id."""
+    headers = {H_REPLICA: "true"}
+    if marker_version_id:
+        headers[H_REPLICA_DM] = marker_version_id
+    client.delete_object(target_bucket, key, headers=headers)
 
 
 def push_object(object_layer, client, bucket: str, key: str,

@@ -96,6 +96,13 @@ class SiteReplicator:
         self._q: queue.Queue = queue.Queue(maxsize=10_000)
         self._stop = threading.Event()
         self.queued = self.completed = self.failed = 0
+        # Items between enqueue and terminal outcome — retries parked
+        # on the timer heap are NOT in self._q, so drain must not key
+        # off unfinished_tasks.
+        self._outstanding = 0
+        self._omu = threading.Lock()
+        from minio_tpu.replication.engine import RetryTimer
+        self._timer = RetryTimer(name="site-repl-timer")
         self._threads = [threading.Thread(target=self._run, daemon=True,
                                           name=f"site-repl-{i}")
                          for i in range(workers)]
@@ -159,6 +166,8 @@ class SiteReplicator:
             # versions per retry).
             self._q.put_nowait((kind, bucket, key, version_id, 0, set()))
             self.queued += 1
+            with self._omu:
+                self._outstanding += 1
         except queue.Full:
             self.failed += 1
 
@@ -175,13 +184,15 @@ class SiteReplicator:
     def drain(self, timeout: float = 30.0) -> bool:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            if self._q.unfinished_tasks == 0:
-                return True
+            with self._omu:
+                if self._outstanding == 0:
+                    return True
             time.sleep(0.05)
         return False
 
     def stop(self) -> None:
         self._stop.set()
+        self._timer.stop()
         for t in self._threads:
             t.join(timeout=5)
 
@@ -250,6 +261,14 @@ class SiteReplicator:
             if st not in (200, 204, 404):
                 raise SiteError(f"delete HTTP {st}")
 
+    def _requeue(self, item) -> None:
+        try:
+            self._q.put_nowait(item)
+        except queue.Full:
+            self.failed += 1
+            with self._omu:
+                self._outstanding -= 1
+
     def _run(self) -> None:
         while not self._stop.is_set():
             try:
@@ -262,13 +281,16 @@ class SiteReplicator:
                 self.completed += 1
             except Exception:  # noqa: BLE001 - retry then count failed
                 if attempt + 1 < self._RETRIES and not self._stop.is_set():
-                    time.sleep(min(0.2 * 2 ** attempt, 5.0))
-                    try:
-                        self._q.put_nowait((kind, bucket, key, vid,
-                                            attempt + 1, done))
-                    except queue.Full:
-                        self.failed += 1
-                else:
-                    self.failed += 1
-            finally:
-                self._q.task_done()
+                    # Backoff rides the shared timer heap, never this
+                    # worker: a dead peer must not head-of-line block
+                    # deliveries to the live ones.
+                    item = (kind, bucket, key, vid, attempt + 1, done)
+                    self._timer.call_later(
+                        min(0.2 * 2 ** attempt, 5.0),
+                        lambda it=item: self._requeue(it))
+                    self._q.task_done()
+                    continue
+                self.failed += 1
+            with self._omu:
+                self._outstanding -= 1
+            self._q.task_done()

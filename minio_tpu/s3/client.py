@@ -79,8 +79,10 @@ class RemoteS3:
         if st != 200:
             raise S3ClientError(st, data)
 
-    def delete_object(self, bucket: str, key: str) -> None:
-        st, _, data = self.request("DELETE", f"/{bucket}/{key}")
+    def delete_object(self, bucket: str, key: str,
+                      headers: Optional[dict] = None) -> None:
+        st, _, data = self.request("DELETE", f"/{bucket}/{key}",
+                                   headers=headers)
         if st not in (200, 204):
             raise S3ClientError(st, data)
 

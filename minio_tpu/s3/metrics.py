@@ -465,6 +465,54 @@ class Metrics:
                 metric("minio_tpu_replication_failed_total",
                        "Bucket-replication tasks failed", "counter",
                        [({}, repl.failed)])
+                # The spilled/dropped split mirrors MRF: spilled items
+                # persist and replay (lossless), dropped is real intent
+                # loss — alert on it staying nonzero.
+                metric("minio_tpu_replication_spilled_total",
+                       "Bucket-replication intents spilled to the "
+                       "persisted pending set on queue overflow "
+                       "(replayed, not lost)", "counter",
+                       [({}, getattr(repl, "spilled", 0))])
+                metric("minio_tpu_replication_dropped_total",
+                       "Bucket-replication intents lost outright "
+                       "(alert on this)", "counter",
+                       [({}, getattr(repl, "dropped", 0))])
+                metric("minio_tpu_replication_sse_skipped_total",
+                       "Versions not replicated because they are "
+                       "SSE-encrypted (keys bind to this cluster)",
+                       "counter", [({}, getattr(repl, "sse_skipped", 0))])
+                if hasattr(repl, "stats"):
+                    rst = repl.stats()
+                    metric("minio_tpu_replication_pending",
+                           "Replication intents between enqueue and "
+                           "terminal outcome (includes spilled backlog)",
+                           "gauge", [({}, rst.get("pending", 0))])
+                    metric("minio_tpu_replication_wal_live",
+                           "Incomplete intents in the replication WAL",
+                           "gauge",
+                           [({}, (rst.get("wal") or {}).get("live", 0))])
+                    lanes = rst.get("lanes") or []
+                    if lanes:
+                        # Breaker state per remote target: closed=0,
+                        # half-open=1, open=2 (same scale as the grid
+                        # transport breakers).
+                        code = {"closed": 0, "half-open": 1, "open": 2}
+                        metric("minio_tpu_replication_breaker_state",
+                               "Delivery-lane circuit state per remote "
+                               "target (0=closed 1=half-open 2=open)",
+                               "gauge",
+                               [({"target": ln["target"]},
+                                 code.get(ln["state"], 0))
+                                for ln in lanes])
+                        metric("minio_tpu_replication_lane_pending",
+                               "Queued intents per delivery lane",
+                               "gauge",
+                               [({"target": ln["target"]},
+                                 ln["pending"]) for ln in lanes])
+                    if rst.get("lag_hist"):
+                        hist_metric("minio_tpu_replication_lag_seconds",
+                                    "Enqueue-to-delivered replication "
+                                    "lag", [({}, rst["lag_hist"])])
             site = getattr(server, "site", None)
             if site is not None:
                 metric("minio_tpu_site_replication_queued_total",
@@ -1221,6 +1269,16 @@ def node_info(server) -> dict:
     aud = getattr(server, "audit", None)
     if aud is not None:
         info["audit"] = aud.stats()
+    repl = getattr(server, "replicator", None)
+    if repl is not None and hasattr(repl, "stats"):
+        try:
+            rst = repl.stats()
+            lag = rst.pop("lag_hist", None)
+            if lag:
+                rst["lag_ms"] = _lag_summary(lag)
+            info["replication"] = rst
+        except Exception:  # noqa: BLE001 - status best effort
+            pass
     # Rolling last-minute latency per API + the recent slow-op records
     # (deep tracing's operator surface: a slow GET names its slow
     # span ancestry here without any trace subscriber attached).

@@ -2370,7 +2370,9 @@ def _make_handler(server: S3Server):
             self._note_quota_write(bucket, plain_size)
             if replicate:
                 server.replicator.enqueue(bucket, key, info.version_id,
-                                          "put")
+                                          "put",
+                                          mod_time=getattr(info,
+                                                           "mod_time", 0))
             self._site_enqueue("put", bucket, key, info.version_id)
             self._notify("s3:ObjectCreated:Put", bucket, key,
                          size=plain_size, etag=info.etag,
@@ -2391,6 +2393,7 @@ def _make_handler(server: S3Server):
                     or not r.should_replicate(bucket, key):
                 return
             from minio_tpu.replication import REPL_STATUS_KEY
+            mod_time = 0
             try:
                 info = server.object_layer.update_version_metadata(
                     bucket, key, version_id,
@@ -2398,9 +2401,10 @@ def _make_handler(server: S3Server):
                     else m.__setitem__(REPL_STATUS_KEY, "PENDING"))
                 if info.internal_metadata.get("x-internal-sse-alg"):
                     return            # SSE objects do not replicate (v1)
+                mod_time = getattr(info, "mod_time", 0)
             except Exception:  # noqa: BLE001 - stamping is advisory
                 pass
-            r.enqueue(bucket, key, version_id, "put")
+            r.enqueue(bucket, key, version_id, "put", mod_time=mod_time)
 
         _QUOTA_TTL = 5.0
 
@@ -3873,8 +3877,26 @@ def _make_handler(server: S3Server):
                 return ok(rec)
             if op == "replication-status" and method == "GET":
                 r = server.replicator
-                return ok({"queued": r.queued, "completed": r.completed,
-                           "failed": r.failed} if r else None)
+                if r is None:
+                    return ok(None)
+                # Keep the v1 keys at top level; the full stats dict
+                # (lanes, WAL, spill, lag) rides alongside them.
+                doc = r.stats() if hasattr(r, "stats") else \
+                    {"queued": r.queued, "completed": r.completed,
+                     "failed": r.failed}
+                return ok(doc)
+            if op == "replication-resync" and method == "POST":
+                r = server.replicator
+                if r is None or not hasattr(r, "start_resync"):
+                    raise S3Error("NotImplemented")
+                bkt = q1.get("bucket", "")
+                server.object_layer.get_bucket_info(bkt)
+                return ok(r.start_resync(bkt))
+            if op == "replication-resync" and method == "GET":
+                r = server.replicator
+                if r is None or not hasattr(r, "resync_status"):
+                    raise S3Error("NotImplemented")
+                return ok(r.resync_status(q1.get("bucket") or None))
 
             iam = server.credentials.iam
             if iam is None:
@@ -3938,21 +3960,37 @@ def _make_handler(server: S3Server):
 
         def _delete_object(self, bucket, key, query):
             vid = query.get("versionId", [""])[0]
-            self._check_version_deletable(bucket, key, vid,
-                                          self._headers_lower())
+            h = self._headers_lower()
+            self._check_version_deletable(bucket, key, vid, h)
             state = _versioning_state(server.object_layer, bucket)
-            deleted = server.object_layer.delete_object(
-                bucket, key, DeleteOptions(
-                    version_id=vid,
-                    versioned=state == "Enabled",
-                    null_marker=state == "Suspended" and not vid))
             # Only versionless deletes (which create markers) replicate;
             # pruning ONE old version must never destroy the replica's
-            # live object (DeleteMarkerReplication semantics).
-            if server.replicator is not None and not vid and \
-                    server.replicator.should_replicate(bucket, key,
-                                                       delete=True):
-                server.replicator.enqueue(bucket, key, op="delete")
+            # live object (DeleteMarkerReplication semantics).  Deletes
+            # arriving FROM a peer carry the replica marker header and
+            # never re-replicate — an active-active pair would
+            # otherwise ping-pong markers forever.
+            replicate = (server.replicator is not None and not vid
+                         and "x-amz-meta-mtpu-replica" not in h
+                         and server.replicator.should_replicate(
+                             bucket, key, delete=True))
+            opts = DeleteOptions(
+                version_id=vid,
+                versioned=state == "Enabled",
+                null_marker=state == "Suspended" and not vid)
+            if replicate and (opts.versioned or opts.null_marker):
+                # Stamp the marker PENDING at creation: the status
+                # commits with the marker's quorum write, so a crash
+                # before the enqueue still leaves the scanner a
+                # resyncable trail.
+                from minio_tpu.replication import REPL_STATUS_KEY
+                opts.marker_metadata = {REPL_STATUS_KEY: "PENDING"}
+            deleted = server.object_layer.delete_object(bucket, key, opts)
+            if replicate:
+                server.replicator.enqueue(
+                    bucket, key,
+                    deleted.delete_marker_version_id
+                    if deleted.delete_marker else "",
+                    op="delete", mod_time=_time_mod.time_ns())
             if not vid:
                 self._site_enqueue("delete", bucket, key)
             self._notify("s3:ObjectRemoved:DeleteMarkerCreated"

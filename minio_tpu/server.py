@@ -435,7 +435,21 @@ def main(argv=None) -> int:
     # ILM: lifecycle rules stored per bucket evaluate on every scanned
     # object (reference: cmd/bucket-lifecycle.go via the scanner).
     from minio_tpu.object.lifecycle import make_scanner_hook
-    scanner.on_object.append(make_scanner_hook())
+
+    def _ilm_deleted(es, bucket, key, deleted):
+        # Late binding: this hook is wired before the replication
+        # engine boots.  ILM-created delete markers replicate like API
+        # deletes — expiry on the source must not strand a live latest
+        # on the target.
+        del es
+        try:
+            r = srv.replicator
+        except NameError:
+            return
+        if r is not None and hasattr(r, "ilm_deleted"):
+            r.ilm_deleted(bucket, key, deleted)
+
+    scanner.on_object.append(make_scanner_hook(on_delete=_ilm_deleted))
     # A slot with no owned sets (more slots than sets) starts nothing;
     # the single-process single-node boot degenerates to slot 0 of 1
     # owning everything — exactly the old behavior.

@@ -18,7 +18,7 @@ fi
 
 echo "== bench smoke (baseline: $latest) =="
 out=$(JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} \
-      MTPU_BENCH_ONLY=put_latency,put_concurrent,get_latency,get_concurrent,meta_listing,small_put,transform_put,distributed,cluster_get,connections,rebalance,hot_get \
+      MTPU_BENCH_ONLY=put_latency,put_concurrent,get_latency,get_concurrent,meta_listing,small_put,transform_put,distributed,cluster_get,connections,rebalance,hot_get,replication \
       MTPU_BENCH_SMALL=1 \
       python bench.py)
 echo "$out"
@@ -112,6 +112,18 @@ import sys
 #   gate is also a zero-copy-proof.
 # Both emit explicit nulls where the fixture cannot boot and the
 # gates skip cleanly.
+# The replication gates watch the durable replication plane (ROADMAP
+# item 5): replication_lag_p99_ms ("lower") is the enqueue-to-delivered
+# p99 from the engine's own lag histogram under foreground PUT load
+# through a real source->target server pair — WAL append + fsync sit on
+# the ack path, so a regression here means the durability tax grew (the
+# line carries an in-run MTPU_REPLICATION_DURABLE=off column for
+# context). replication_convergence ("higher", healthy value 1.0, never
+# 0 — column() treats 0.0 as unmeasured) is the fraction of the final
+# namespace byte-identical on both sides after a target kill/restart
+# mid-stream plus a post-heal delete, with divergent extra objects
+# capping the score below 1. Both emit explicit nulls where the pair
+# cannot boot and the gates skip cleanly.
 GATES = [
     ("put_concurrent_aggregate_gibps", "host_gibps", "higher"),
     ("put_concurrent_aggregate_gibps", "served_ratio", "higher"),
@@ -134,6 +146,8 @@ GATES = [
     ("hot_get_gibps", "vs_erasure", "higher"),
     ("rebalance_fg_p50_during_ms", "vs_quiescent", "lower"),
     ("rebalance_identity", "value", "higher"),
+    ("replication_lag_p99_ms", "value", "lower"),
+    ("replication_convergence", "value", "higher"),
 ]
 
 
