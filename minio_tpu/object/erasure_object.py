@@ -3018,7 +3018,8 @@ class ErasureSet:
             # fresh one — write_metadata's add_version then REPLACES
             # the previous null version, exactly AWS's suspended-state
             # semantics (any Enabled-era versions stay untouched).
-            marker_vid = "" if opts.null_marker else new_uuid()
+            marker_vid = "" if opts.null_marker \
+                else (opts.marker_version_id or new_uuid())
             fi = FileInfo(volume=bucket, name=object_, version_id=marker_vid,
                           deleted=True, mod_time=now_ns(),
                           metadata=dict(opts.marker_metadata or {}))

@@ -152,6 +152,11 @@ class DeleteOptions:
     # from the first quorum write, or a crash between delete and stamp
     # leaves a marker the scanner can never resync.
     marker_metadata: Optional[dict] = None
+    # Version id to mint the delete marker with instead of a fresh
+    # uuid: replicated deletes carry the SOURCE marker's id so the two
+    # clusters' markers are the same version (and re-delivery replaces
+    # rather than stacks).  Ignored for null markers.
+    marker_version_id: str = ""
 
 
 @dataclasses.dataclass

@@ -11,9 +11,11 @@ from __future__ import annotations
 # tell replicas apart and never replicates them back (the active-active
 # ping-pong breaker).  Shared by PUTs and delete markers.
 H_REPLICA = "x-amz-meta-mtpu-replica"
-# Source delete-marker version id, carried on replicated deletes so the
-# far side's marker is attributable to ours (versioned markers, not
-# anonymous bare deletes).
+# Source delete-marker version id, carried on replicated deletes.  The
+# far side's S3 delete handler mints its marker WITH this id (versioned
+# buckets only), so an active-active pair holds the SAME marker version
+# and a re-delivered delete replaces in place instead of stacking a
+# second marker.
 H_REPLICA_DM = "x-mtpu-replica-dm-version"
 
 

@@ -757,6 +757,17 @@ class ReplicationEngine:
         now = time.monotonic()
         if not force and now - self._spill_saved < _PERSIST_EVERY:
             return
+        if not self._spill:
+            # Drained: a stale pending.json would re-enqueue already-
+            # delivered intents at the next boot (an old PUT replayed
+            # after a completed DELETE regresses the target's latest),
+            # so remove the file rather than leave it behind.
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            self._spill_saved = 0.0
+            return
         self._spill_saved = now
         try:
             os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -815,6 +826,10 @@ class ReplicationEngine:
                 seq=rec.get("seq", 0), bucket=rec["b"], key=rec["k"],
                 version_id=rec.get("v", ""), op=rec.get("op", "put"),
                 mod_time=rec.get("mt", 0), t_enq=time.monotonic()))
+            # Keep the on-disk pending set in step with the pops
+            # (forced on the drain-to-empty transition so the file is
+            # removed, not left listing delivered intents).
+            self._maybe_save_spill_locked(force=not self._spill)
 
     # -- WAL replay ------------------------------------------------------
 
@@ -1076,9 +1091,14 @@ class ReplicationEngine:
                 return dict(self._resyncs[bucket])
             doc = self._resyncs.get(bucket)
             if doc is None or doc.get("state") != "running":
-                prior = doc if doc and doc.get("state") == "running" \
+                # A FAILED sweep resumes at its last checkpoint (the
+                # walk up to there already queued); done/fresh sweeps
+                # start over.  `running` docs fall through above and
+                # keep their own set/checkpoint.
+                prior = doc if doc and doc.get("state") == "failed" \
                     else None
                 doc = {"bucket": bucket, "state": "running",
+                       "set": (prior or {}).get("set", 0),
                        "checkpoint": (prior or {}).get("checkpoint", ""),
                        "scanned": 0, "queued": 0,
                        "started": time.time(), "finished": 0.0}
@@ -1118,25 +1138,38 @@ class ReplicationEngine:
 
     def _resync_run(self, bucket: str, doc: dict) -> None:
         from minio_tpu.object.scanner import walk_bucket_versions
-        rule_ok = self.rules_for(bucket)
-        rule = None
-        if rule_ok:
-            rule = next((r for r in rule_ok), None)
+        rules = self.rules_for(bucket) or []
+        start_set = int(doc.get("set", 0))
         try:
-            for es in _layer_sets(self.object_layer):
+            for i, es in enumerate(_layer_sets(self.object_layer)):
+                if i < start_set:
+                    # Finished before the crash/restart.
+                    continue
+                if i != start_set:
+                    # Keys are hash-distributed across sets: each set's
+                    # walk restarts at '' — carrying one set's (lexically
+                    # late) checkpoint into the next would skip most of
+                    # its keys.
+                    doc["set"] = i
+                    doc["checkpoint"] = ""
+                    self._save_resync(doc)
                 for path, versions in walk_bucket_versions(
                         es, bucket, forward_from=doc.get("checkpoint",
                                                          "")):
                     if self._stop.is_set():
                         return
                     doc["scanned"] += 1
+                    # Delete-marker policy is per matching rule, same as
+                    # scanner_hook — the first rule's prefix says nothing
+                    # about keys under a later rule's.
+                    rule = next((r for r in rules if r.matches(path)),
+                                None)
                     for v in versions:
                         meta = getattr(v, "metadata", None) or {}
                         if meta.get(REPL_STATUS_KEY) == COMPLETED:
                             continue
                         if getattr(v, "deleted", False):
-                            if rule is not None and rule.delete_markers \
-                                    and rule.matches(path):
+                            if rule is not None and rule.delete_markers:
                                 self.enqueue(bucket, path, v.version_id,
                                              "delete",
                                              mod_time=v.mod_time)
@@ -1220,7 +1253,8 @@ class ReplicationEngine:
         for t in self._threads:
             t.join(timeout=2)
         with self._mu:
-            if self._spill:
-                self._maybe_save_spill_locked(force=True)
+            # Unconditional: an empty backlog must unlink any stale
+            # pending.json, or the next boot replays delivered intents.
+            self._maybe_save_spill_locked(force=True)
         if self.wal is not None:
             self.wal.close()

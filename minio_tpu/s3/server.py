@@ -3977,6 +3977,24 @@ def _make_handler(server: S3Server):
                 version_id=vid,
                 versioned=state == "Enabled",
                 null_marker=state == "Suspended" and not vid)
+            if not vid and opts.versioned \
+                    and "x-amz-meta-mtpu-replica" in h:
+                # Replicated delete: mint the marker with the SOURCE
+                # marker's version id so active-active peers hold the
+                # same marker version (re-delivery replaces in place
+                # instead of stacking a second marker).  Only honored
+                # on replica traffic, only for uuid-shaped ids — a
+                # suspended source sends "null", which the target's own
+                # versioning state governs instead.
+                import uuid as _uuid
+                from minio_tpu.replication.common import H_REPLICA_DM
+                dmv = h.get(H_REPLICA_DM, "")
+                if dmv and dmv != "null":
+                    try:
+                        _uuid.UUID(dmv)
+                        opts.marker_version_id = dmv
+                    except ValueError:
+                        pass
             if replicate and (opts.versioned or opts.null_marker):
                 # Stamp the marker PENDING at creation: the status
                 # commits with the marker's quorum write, so a crash

@@ -279,6 +279,56 @@ def test_overflow_spills_never_drops(tmp_path):
     assert {r["k"] for r in items} == {"k2", "k3", "k4"}
 
 
+def test_spill_drain_clears_pending_file(tmp_path):
+    """Draining the spill set must not leave a stale pending.json
+    behind: a stale file would re-enqueue already-delivered intents at
+    the next boot (an old PUT replayed after a completed DELETE
+    regresses the target's latest)."""
+    es, eng = _solo_engine(tmp_path)
+    pending = tmp_path / "d0" / ".mtpu.sys" / "repl" / "pending.json"
+    try:
+        eng._q_max = 1
+        for i in range(3):
+            eng.enqueue("srcb", f"k{i}", f"v{i}", "put", mod_time=i)
+        with eng._mu:
+            eng._maybe_save_spill_locked(force=True)
+        assert pending.exists()
+        # Room frees up (deliveries would drive this via _finish).
+        eng._q_max = 100
+        eng._refill_one()
+        eng._refill_one()
+        assert eng.stats()["spill_backlog"] == 0
+        # The drain-to-empty refill removed the file immediately.
+        assert not pending.exists()
+    finally:
+        eng.stop()
+    assert not pending.exists()
+    eng2 = ReplicationEngine(es, workers=0)
+    try:
+        assert eng2.stats()["spill_backlog"] == 0
+    finally:
+        eng2.stop()
+
+
+def test_stop_unlinks_stale_pending_file(tmp_path):
+    """stop() persists the spill state UNCONDITIONALLY: an engine whose
+    spill drained between throttled saves removes the on-disk file at
+    shutdown instead of leaving delivered intents listed."""
+    es, eng = _solo_engine(tmp_path)
+    pending = tmp_path / "d0" / ".mtpu.sys" / "repl" / "pending.json"
+    eng._q_max = 1
+    for i in range(2):
+        eng.enqueue("srcb", f"k{i}", f"v{i}", "put", mod_time=i)
+    with eng._mu:
+        eng._maybe_save_spill_locked(force=True)
+        # Simulate deliveries draining the spill with every throttled
+        # save window missed.
+        eng._spill.clear()
+    assert pending.exists()
+    eng.stop()
+    assert not pending.exists()
+
+
 def test_engine_restart_replays_wal_and_spill(tmp_path):
     """SIGKILL simulation: engine 1 dies (no stop()) with queued +
     spilled intents; engine 2 on the same node root replays every
@@ -384,6 +434,12 @@ def test_versioned_delete_marker_replicates_with_status(tmp_path):
         versions = src_es.list_versions_all("srcb", "vk")
         marker = next(v for v in versions if v.deleted)
         assert marker.metadata.get(REPL_STATUS_KEY) == "COMPLETED"
+        # The target minted its marker WITH the source marker's version
+        # id (the x-mtpu-replica-dm-version header, consumed by the
+        # delete handler) — active-active peers hold the SAME marker.
+        dst_versions = dst_es.list_versions_all("dstb", "vk")
+        dst_marker = next(v for v in dst_versions if v.deleted)
+        assert dst_marker.version_id == marker.version_id
     finally:
         src.replicator.stop()
         src.stop()
@@ -510,6 +566,88 @@ def test_admin_replication_status_and_resync(clusters):
     assert src.replicator.drain(15)
     st, hh, _ = sc.request("HEAD", "/srcb/adm.txt")
     assert hh.get("x-amz-replication-status") == "COMPLETED"
+
+def _multiset_engine(tmp_path, n_keys=40):
+    """Engine over a TWO-set pool with n_keys hash-distributed,
+    unstamped (pre-config) objects — the shape where a shared resync
+    checkpoint across sets silently skips keys."""
+    from minio_tpu.object.sets import ErasureSets
+    sets = [ErasureSet([LocalStorage(str(tmp_path / f"p{s}d{i}"))
+                        for i in range(4)]) for s in range(2)]
+    ess = ErasureSets(
+        sets, deployment_id="8d7a41f2-9b33-4c55-a0ef-3c1d2e4f5a6b")
+    ess.make_bucket("srcb")
+    meta = ess.get_bucket_meta("srcb")
+    meta["config:replication"] = REPL_XML.decode()
+    meta["config:remote-target"] = json.dumps(
+        {"endpoint": "127.0.0.1:1", "accessKey": "a", "secretKey": "s",
+         "bucket": "dstb"})
+    ess.set_bucket_meta("srcb", meta)
+    keys = [f"k{i:03d}" for i in range(n_keys)]
+    for k in keys:
+        ess.put_object("srcb", k, b"x")
+    by_set = {0: [], 1: []}
+    for k in keys:
+        by_set[ess.set_index(k)].append(k)
+    # Both sets populated, and set 1 holds keys sorting BEFORE set 0's
+    # last key — the exact layout a shared checkpoint would skip.
+    assert by_set[0] and by_set[1]
+    assert min(by_set[1]) < max(by_set[0])
+    return ess, ReplicationEngine(ess, workers=0), keys
+
+
+def _wait_resync(eng, bucket, timeout=15):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        doc = eng.resync_status(bucket)
+        if doc and doc.get("state") not in (None, "running"):
+            return doc
+        time.sleep(0.05)
+    return eng.resync_status(bucket)
+
+
+def test_resync_covers_all_sets(tmp_path):
+    """Full-bucket resync walks EVERY erasure set from its own key
+    cursor: set 1's walk must not start at set 0's (lexically late)
+    final checkpoint, or hash-distributed keys in later sets are
+    silently skipped."""
+    ess, eng, keys = _multiset_engine(tmp_path)
+    try:
+        eng.start_resync("srcb")
+        doc = _wait_resync(eng, "srcb")
+        assert doc["state"] == "done"
+        assert doc["queued"] == len(keys)
+        assert doc["scanned"] == len(keys)
+        assert eng.stats()["pending"] == len(keys)
+    finally:
+        eng.stop()
+
+
+def test_resync_failed_sweep_resumes_checkpoint(tmp_path):
+    """Re-kicking a FAILED sweep resumes at its persisted (set,
+    checkpoint) instead of restarting at set 0 / '' — and a done sweep
+    re-kicks from scratch."""
+    ess, eng, keys = _multiset_engine(tmp_path)
+    try:
+        # Prior sweep failed after finishing set 0 and walking set 1
+        # past every key: the resumed sweep has nothing left to queue.
+        eng._resyncs["srcb"] = {
+            "bucket": "srcb", "state": "failed", "set": 1,
+            "checkpoint": "zzz", "scanned": 0, "queued": 0,
+            "started": 0.0, "finished": 0.0}
+        eng.start_resync("srcb")
+        doc = _wait_resync(eng, "srcb")
+        assert doc["state"] == "done"
+        assert doc["queued"] == 0
+        # A fresh kick over the now-done sweep starts over and queues
+        # the whole bucket.
+        eng.start_resync("srcb")
+        doc = _wait_resync(eng, "srcb")
+        assert doc["state"] == "done"
+        assert doc["queued"] == len(keys)
+    finally:
+        eng.stop()
+
 
 # ---------------------------------------------------------------------------
 # Two-cluster chaos convergence matrix (real server processes)
