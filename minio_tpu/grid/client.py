@@ -303,8 +303,15 @@ class GridClient:
 
     # -- calls ---------------------------------------------------------
 
-    def _send(self, msg: dict, mux: int, q) -> None:
-        frame = wire.pack_frame(msg)
+    def _send(self, msg: dict, mux: int, q, tstats=None) -> None:
+        if tstats is None:
+            frame = wire.pack_frame(msg)
+        else:
+            # Armed calls time the msgpack encode so the wire span can
+            # split serialize out of transit.
+            t_ser = time.perf_counter()
+            frame = wire.pack_frame(msg)
+            tstats["ser"] = time.perf_counter() - t_ser
         with self._mu:
             self._connect_locked()
             s = self._sock
@@ -332,7 +339,8 @@ class GridClient:
             self._pending.pop(mux, None)
 
     def _send_with_retry(self, kind: int, handler: str, payload,
-                         window: Optional[int] = None):
+                         window: Optional[int] = None,
+                         tc: Optional[dict] = None, tstats=None):
         """Send one request frame, retrying transient connect/send
         failures with jittered exponential backoff. Returns (mux, q).
 
@@ -361,8 +369,10 @@ class GridClient:
             msg = {"t": kind, "m": mux, "h": handler, "p": payload}
             if window:
                 msg["w"] = window
+            if tc is not None:
+                msg["tc"] = tc
             try:
-                self._send(msg, mux, q)
+                self._send(msg, mux, q, tstats)
                 return mux, q
             except RemoteCallError:
                 raise
@@ -427,22 +437,104 @@ class GridClient:
 
     def call(self, handler: str, payload=None,
              timeout: Optional[float] = None):
-        """Unary call; raises RemoteCallError with the remote's code."""
+        """Unary call; raises RemoteCallError with the remote's code.
+
+        Disarmed, this path touches no span machinery at all — the one
+        `tracing.ACTIVE` attribute check below is its entire tracing
+        cost, and the frames it emits carry zero trace bytes."""
+        if tracing.ACTIVE and tracing.current() is not None:
+            return self._call_traced(handler, payload, timeout)
+        mux, q = self._send_with_retry(wire.T_REQ, handler, payload)
+        try:
+            msg = self._recv(q, handler, timeout, mux)
+            if msg["t"] == wire.T_RESP:
+                self._ok()
+                return msg.get("p")
+            code = msg.get("e", "Internal")
+            if code == _SENTINEL_ERR:
+                self._fault()
+                raise GridError("connection lost mid-call")
+            # The peer ANSWERED — its handler raised. Healthy
+            # transport; never breaker fuel.
+            self._ok()
+            raise RemoteCallError(code, msg.get("msg", ""))
+        finally:
+            self._finish(mux)
+
+    @staticmethod
+    def _trace_tc(ctx, parent: int) -> dict:
+        tc = {"i": ctx.trace_id, "s": parent, "a": 1}
+        if tracing.NODE:
+            tc["n"] = tracing.NODE
+        return tc
+
+    def _stitch(self, ctx, t_wall: float, t0: float, tstats: dict,
+                ts: Optional[dict], fault: Optional[str] = None) -> None:
+        """Record the explicit `wire` span under the current parent
+        (the enclosing grid.<handler> span) and graft the peer's
+        shipped subtree into it. `ts` is the reply's piggyback (None
+        when the transport faulted — the fault is annotated instead,
+        so a partition mid-call still closes the caller's tree)."""
+        total_ms = (time.monotonic() - t0) * 1000.0
+        ser_ms = round(tstats.get("ser", 0.0) * 1000.0, 3)
+        tags = {"peer": f"{self.host}:{self.port}",
+                "serialize_ms": ser_ms}
+        if fault is not None:
+            tags["fault"] = fault
+            ts = None
+        elif ts:
+            q_ms = float(ts.get("q", 0.0))
+            v_ms = float(ts.get("v", 0.0))
+            tags["peer_queue_ms"] = round(q_ms, 3)
+            tags["peer_service_ms"] = round(v_ms, 3)
+            tags["transit_ms"] = round(
+                max(0.0, total_ms - ser_ms - q_ms - v_ms), 3)
+        tracing.stitch_wire(ctx, tracing.current_parent(), t_wall,
+                            total_ms, tags, ts)
+
+    def _call_traced(self, handler: str, payload,
+                     timeout: Optional[float]):
+        """call() under an armed, bound trace context: the request
+        frame carries the compact trace context ("tc"), the peer runs
+        its handler spans under it and ships the subtree back ("ts"),
+        and the reply is stitched into THIS request's tree. A stale
+        reply can never stitch: _finish() unregisters the mux before
+        this frame's queue is abandoned, and unclaimed frames are
+        discarded in _on_frame."""
+        ctx = tracing.current()
         with tracing.span("grid", f"grid.{handler}",
-                          {"peer": f"{self.host}:{self.port}"}) \
-                if tracing.ACTIVE else tracing.NOOP:
-            mux, q = self._send_with_retry(wire.T_REQ, handler, payload)
+                          {"peer": f"{self.host}:{self.port}"}):
+            tc = self._trace_tc(ctx, tracing.current_parent())
+            tstats: dict = {}
+            t_wall = time.time()
+            t0 = time.monotonic()
             try:
-                msg = self._recv(q, handler, timeout, mux)
+                mux, q = self._send_with_retry(
+                    wire.T_REQ, handler, payload, tc=tc, tstats=tstats)
+            except (DeadlineExceeded, GridError) as e:
+                self._stitch(ctx, t_wall, t0, tstats, None,
+                             fault=type(e).__name__)
+                raise
+            try:
+                try:
+                    msg = self._recv(q, handler, timeout, mux)
+                except (DeadlineExceeded, GridError) as e:
+                    self._stitch(ctx, t_wall, t0, tstats, None,
+                                 fault=type(e).__name__)
+                    raise
                 if msg["t"] == wire.T_RESP:
+                    self._stitch(ctx, t_wall, t0, tstats, msg.get("ts"))
                     self._ok()
                     return msg.get("p")
                 code = msg.get("e", "Internal")
                 if code == _SENTINEL_ERR:
+                    self._stitch(ctx, t_wall, t0, tstats, None,
+                                 fault="conn_lost")
                     self._fault()
                     raise GridError("connection lost mid-call")
                 # The peer ANSWERED — its handler raised. Healthy
-                # transport; never breaker fuel.
+                # transport; its spans (up to the raise) still stitch.
+                self._stitch(ctx, t_wall, t0, tstats, msg.get("ts"))
                 self._ok()
                 raise RemoteCallError(code, msg.get("msg", ""))
             finally:
@@ -485,9 +577,28 @@ class GridClient:
         t_wall = time.time()
         t0 = time.monotonic()
         chunks = 0
+        # Armed + bound: the open frame carries the trace context and
+        # the peer ships its span subtree back on the EOF/error frame.
+        ctx, parent = tracing.capture() if tracing.ACTIVE else (None, 0)
+        tc = self._trace_tc(ctx, parent) if ctx is not None else None
+        ts: Optional[dict] = None
+        fault: Optional[str] = None
         window = loop.stream_window() if wire.native_enabled() else None
-        mux, q = self._send_with_retry(wire.T_SREQ, handler, payload,
-                                       window=window)
+        try:
+            mux, q = self._send_with_retry(wire.T_SREQ, handler, payload,
+                                           window=window, tc=tc)
+        except (DeadlineExceeded, GridError) as e:
+            if ctx is not None:
+                dur = (time.monotonic() - t0) * 1000.0
+                sid = tracing.record_span(
+                    ctx, parent, "grid", f"grid.{handler}", t_wall, dur,
+                    tags={"peer": f"{self.host}:{self.port}",
+                          "stream": 1, "chunks": 0})
+                tracing.stitch_wire(
+                    ctx, sid, t_wall, dur,
+                    {"peer": f"{self.host}:{self.port}",
+                     "fault": type(e).__name__}, None)
+            raise
         with self._mu:
             ent = self._pending.get(mux)
         s = ent[0] if ent is not None else None
@@ -515,18 +626,44 @@ class GridClient:
                     else:
                         yield msg.get("p")
                 elif t == wire.T_EOF:
+                    ts = msg.get("ts")
                     self._ok()
                     return
                 else:
+                    ts = msg.get("ts")
                     code = msg.get("e", "Internal")
                     if code == _SENTINEL_ERR:
+                        ts, fault = None, "conn_lost"
                         self._fault()
                         raise GridError("connection lost mid-stream")
                     self._ok()
                     raise RemoteCallError(code, msg.get("msg", ""))
+        except RemoteCallError:
+            raise               # peer answered: its subtree stitches
+        except (DeadlineExceeded, GridError) as e:
+            if fault is None:
+                ts, fault = None, type(e).__name__
+            raise
         finally:
             self._finish(mux)
-            if tracing.ACTIVE:
+            if ctx is not None:
+                dur = (time.monotonic() - t0) * 1000.0
+                peer = f"{self.host}:{self.port}"
+                sid = tracing.record_span(
+                    ctx, parent, "grid", f"grid.{handler}", t_wall, dur,
+                    tags={"peer": peer, "stream": 1, "chunks": chunks})
+                wtags = {"peer": peer}
+                if fault is not None:
+                    wtags["fault"] = fault
+                elif ts:
+                    q_ms = float(ts.get("q", 0.0))
+                    v_ms = float(ts.get("v", 0.0))
+                    wtags["peer_queue_ms"] = round(q_ms, 3)
+                    wtags["peer_service_ms"] = round(v_ms, 3)
+                    wtags["transit_ms"] = round(
+                        max(0.0, dur - q_ms - v_ms), 3)
+                tracing.stitch_wire(ctx, sid, t_wall, dur, wtags, ts)
+            elif tracing.ACTIVE:
                 tracing.record(
                     "grid", f"grid.{handler}", t_wall,
                     (time.monotonic() - t0) * 1000.0,

@@ -27,10 +27,12 @@ import os
 import queue as queue_mod
 import socket
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Iterator, Optional
 
 from minio_tpu.grid import chaos, loop, wire
+from minio_tpu.utils import tracing
 
 # exception class -> wire code (extended by storage/remote.py, dsync).
 ERROR_CODES: dict[type, str] = {}
@@ -211,6 +213,10 @@ class GridServer:
         t = msg.get("t")
         if t in (wire.T_REQ, wire.T_SREQ) and chaos.drop_inbound():
             return
+        if t in (wire.T_REQ, wire.T_SREQ) and "tc" in msg:
+            # Armed caller: stamp frame receipt so the reply can report
+            # dispatch queue-wait separately from handler service.
+            msg["_rx"] = time.monotonic()
         if t == wire.T_PING:
             state.send({"t": wire.T_PONG})
         elif t == wire.T_REQ:
@@ -247,14 +253,52 @@ class GridServer:
                 send({"t": wire.T_ERR, "m": mux, "e": "NoSuchHandler",
                       "msg": str(msg.get("h"))})
                 return
-            out = fn(msg.get("p"))
-            send({"t": wire.T_RESP, "m": mux, "p": out})
+            if "tc" not in msg:
+                out = fn(msg.get("p"))
+                send({"t": wire.T_RESP, "m": mux, "p": out})
+                return
+            out, ts, err = self._call_traced(fn, msg)
+            if err is None:
+                send({"t": wire.T_RESP, "m": mux, "p": out, "ts": ts})
+            else:
+                send({"t": wire.T_ERR, "m": mux, "e": _code_for(err),
+                      "msg": str(err)[:512], "ts": ts})
         except Exception as e:  # noqa: BLE001 - mapped onto the wire
             try:
                 send({"t": wire.T_ERR, "m": mux, "e": _code_for(e),
                       "msg": str(e)[:512]})
             except OSError:
                 pass
+
+    @staticmethod
+    def _call_traced(fn, msg: dict):
+        """Run a unary handler under the caller's shipped trace context
+        ("tc"): the handler's spans (disk.*, engine.*) record into a
+        context seeded with the caller's trace id, and the completed
+        subtree ships back piggybacked on the reply ("ts") with the
+        queue-wait (frame receipt → handler start) / service split.
+        Arming is per-call — the context itself is the arm token, held
+        for exactly this handler's execution. Returns (out, ts, err)."""
+        tc = msg.get("tc") or {}
+        rx = msg.get("_rx")
+        ctx = tracing.TraceContext(trace_id=str(tc.get("i", "")))
+        t_start = time.monotonic()
+        q_ms = (t_start - rx) * 1000.0 if rx is not None else 0.0
+        out = err = None
+        tracing.arm(ctx)
+        try:
+            with tracing.bind(ctx, 0):
+                out = fn(msg.get("p"))
+        except Exception as e:  # noqa: BLE001 - shipped as T_ERR
+            err = e
+        finally:
+            tracing.disarm(ctx)
+        ts = tracing.export_spans(ctx)
+        ts["q"] = round(max(0.0, q_ms), 3)
+        ts["v"] = round((time.monotonic() - t_start) * 1000.0, 3)
+        if tracing.NODE:
+            ts["node"] = tracing.NODE
+        return out, ts, err
 
     # -- response streams ----------------------------------------------
 
@@ -268,30 +312,67 @@ class GridServer:
             with state.mu:
                 state.credits[mux] = credit
         stall = loop.stream_stall_s()
+        # Armed caller ("tc" on the open frame): the generator's spans
+        # record under the shipped trace context and the subtree ships
+        # back on the EOF (or error) frame, same as _call_traced.
+        tctx: Optional[tracing.TraceContext] = None
+        t_start = q_ms = 0.0
+        if "tc" in msg and fn is not None:
+            tc = msg.get("tc") or {}
+            rx = msg.get("_rx")
+            tctx = tracing.TraceContext(trace_id=str(tc.get("i", "")))
+            t_start = time.monotonic()
+            q_ms = (t_start - rx) * 1000.0 if rx is not None else 0.0
+            tracing.arm(tctx)
+
+        def _ts() -> Optional[dict]:
+            if tctx is None:
+                return None
+            ts = tracing.export_spans(tctx)
+            ts["q"] = round(max(0.0, q_ms), 3)
+            ts["v"] = round((time.monotonic() - t_start) * 1000.0, 3)
+            if tracing.NODE:
+                ts["node"] = tracing.NODE
+            return ts
+
         try:
             if fn is None:
                 state.send({"t": wire.T_ERR, "m": mux,
                             "e": "NoSuchHandler", "msg": str(msg.get("h"))})
                 return
-            for item in fn(msg.get("p")):
-                if isinstance(item, wire.RawFile):
-                    self._send_raw_file(state, mux, item, credit, stall)
-                elif isinstance(item, wire.RawBytes):
-                    loop.send_raw_buf(state.sock, state.wlock, mux,
-                                      item.data, credit, stall)
-                else:
-                    if credit is not None and not credit.take(stall):
-                        raise wire.GridError(
-                            "stream credit stall (receiver not draining)")
-                    state.send({"t": wire.T_CHUNK, "m": mux, "p": item})
-            state.send({"t": wire.T_EOF, "m": mux})
+            with tracing.bind(tctx, 0):
+                for item in fn(msg.get("p")):
+                    if isinstance(item, wire.RawFile):
+                        self._send_raw_file(state, mux, item, credit,
+                                            stall)
+                    elif isinstance(item, wire.RawBytes):
+                        loop.send_raw_buf(state.sock, state.wlock, mux,
+                                          item.data, credit, stall)
+                    else:
+                        if credit is not None and not credit.take(stall):
+                            raise wire.GridError(
+                                "stream credit stall "
+                                "(receiver not draining)")
+                        state.send({"t": wire.T_CHUNK, "m": mux,
+                                    "p": item})
+            eof = {"t": wire.T_EOF, "m": mux}
+            ts = _ts()
+            if ts is not None:
+                eof["ts"] = ts
+            state.send(eof)
         except Exception as e:  # noqa: BLE001 - mapped onto the wire
             try:
-                state.send({"t": wire.T_ERR, "m": mux, "e": _code_for(e),
-                            "msg": str(e)[:512]})
+                errf = {"t": wire.T_ERR, "m": mux, "e": _code_for(e),
+                        "msg": str(e)[:512]}
+                ts = _ts()
+                if ts is not None:
+                    errf["ts"] = ts
+                state.send(errf)
             except OSError:
                 pass
         finally:
+            if tctx is not None:
+                tracing.disarm(tctx)
             if credit is not None:
                 with state.mu:
                     state.credits.pop(mux, None)

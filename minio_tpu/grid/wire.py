@@ -14,6 +14,21 @@ Control frame = 4-byte big-endian length + one msgpack map:
       T_PING / T_PONG                            keepalive
       T_WIN    {"n": credits}                    grant stream credits
 
+Trace propagation (armed callers only — a disarmed caller emits these
+frames byte-identical to pre-trace builds):
+
+      T_REQ/T_SREQ may carry  "tc": {"i": trace_id, "s": parent_span,
+                                     "a": 1, "n": caller_node}
+      T_RESP/T_ERR/T_EOF may carry back
+                              "ts": {"spans": [...], "dropped": n,
+                                     "q": queue_wait_ms,
+                                     "v": service_ms, "node": peer}
+
+The peer executes the handler under a trace context seeded from "tc"
+and ships its completed span subtree ("ts", ring-capped at
+MTPU_TRACE_REMOTE_MAX) piggybacked on the reply; the caller stitches
+it under an explicit `wire` span (utils/tracing.stitch_wire).
+
 Raw frame (v2) = the same 4-byte length word with the high bit set,
 followed by a 4-byte big-endian mux id, followed by exactly
 ``length & 0x7fffffff - 4`` payload bytes:

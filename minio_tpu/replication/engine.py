@@ -57,6 +57,8 @@ import xml.etree.ElementTree as ET
 import zlib
 from typing import Optional
 
+from minio_tpu.utils import tracing
+
 REPL_STATUS_KEY = "x-internal-repl-status"
 REMOTE_TARGET_META = "config:remote-target"
 REPLICATION_META = "config:replication"
@@ -701,7 +703,13 @@ class ReplicationEngine:
                          version_id=version_id, op=op, mod_time=mod_time,
                          t_enq=time.monotonic())
         if self.wal is not None:
-            self.wal.append_intent(intent.rec())
+            # Rides the caller's request span tree when armed: the WAL
+            # append (+fsync) sits on the PUT ack path, so a slow PUT
+            # trace names the durability tax explicitly.
+            with tracing.span("repl", "repl.wal_append",
+                              {"bucket": bucket, "op": op}) \
+                    if tracing.ACTIVE else tracing.NOOP:
+                self.wal.append_intent(intent.rec())
         self._admit(intent)
 
     def _admit(self, intent: _Intent) -> None:
@@ -900,6 +908,18 @@ class ReplicationEngine:
             self._work.put((lane_key, ck))
 
     def _service(self, lane_key: str, ck: tuple) -> None:
+        if not tracing.ACTIVE:
+            self._service_inner(lane_key, ck, tracing.NOOP)
+            return
+        # Armed: each delivery attempt is one standalone published span
+        # chain (repl.deliver with lane-wait/breaker tags, repl.wire
+        # for the target apply) so the lag histogram's p99 decomposes
+        # into dequeue wait vs breaker park vs wire time.
+        with tracing.op_span("repl", "repl.deliver",
+                             {"target": lane_key}) as sp:
+            self._service_inner(lane_key, ck, sp)
+
+    def _service_inner(self, lane_key: str, ck: tuple, sp) -> None:
         with self._mu:
             lane = self._lanes.get(lane_key)
             if lane is None:
@@ -915,21 +935,32 @@ class ReplicationEngine:
                     # cooldown on the timer heap — no attempt burned,
                     # no worker blocked.
                     delay = lane.breaker.retry_in() or 0.05
+                    sp.tag(breaker="open",
+                           retry_in_ms=round(delay * 1000.0, 1))
                     self.timer.call_later(
                         delay, lambda: self._requeue_token(lane_key, ck))
                     return
             intent = chain[0]
             lane.active.add(ck)
+        sp.tag(bucket=intent.bucket, key=intent.key, op=intent.op,
+               attempt=intent.attempt + 1,
+               lane_wait_ms=round(
+                   (time.monotonic() - intent.t_enq) * 1000.0, 1)
+               if intent.t_enq else 0.0)
         err: Optional[Exception] = None
         try:
-            if intent.op == "put":
-                self._replicate_put(intent.bucket, intent.key,
-                                    intent.version_id)
-            else:
-                self._replicate_delete(intent.bucket, intent.key,
-                                       intent.version_id)
+            with tracing.span("repl", "repl.wire",
+                              {"target": lane_key}) \
+                    if tracing.ACTIVE else tracing.NOOP:
+                if intent.op == "put":
+                    self._replicate_put(intent.bucket, intent.key,
+                                        intent.version_id)
+                else:
+                    self._replicate_delete(intent.bucket, intent.key,
+                                           intent.version_id)
         except Exception as e:  # noqa: BLE001 - classified below
             err = e
+            sp.tag(error=type(e).__name__)
         if err is None:
             self._finish(lane, ck, intent, ok=True)
             return

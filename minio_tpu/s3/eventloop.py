@@ -99,6 +99,9 @@ def default_max_conns() -> int:
     return max(64, soft - 512)
 
 
+_EXIT = object()                 # _Executor pool-release sentinel
+
+
 class _Executor:
     """Bounded lazy pool of DAEMON worker threads (ThreadPoolExecutor
     threads are non-daemon and would block interpreter exit — the
@@ -145,6 +148,11 @@ class _Executor:
             with self._mu:
                 self._idle += 1
             fn = self._q.get()
+            if fn is _EXIT:
+                with self._mu:
+                    self._idle -= 1
+                    self.threads -= 1
+                return
             with self._mu:
                 self._idle -= 1
                 self._pending -= 1
@@ -152,6 +160,17 @@ class _Executor:
                 fn()
             except Exception:  # noqa: BLE001 - a task must not kill a worker
                 pass
+
+    def shutdown(self) -> None:
+        """Release the pool: one exit sentinel per live thread, queued
+        BEHIND any remaining tasks (SimpleQueue is FIFO, so queued
+        dispatches still drain). Must run after the loop thread has
+        stopped submitting — threads parked in q.get() forever would
+        compound across server lifecycles."""
+        with self._mu:
+            n = self.threads
+        for _ in range(n):
+            self._q.put(_EXIT)
 
     def depth(self) -> int:
         return self._q.qsize()
@@ -738,6 +757,7 @@ class EventLoopServer:
         if not self._done.wait(timeout=10):
             print("eventloop: loop thread failed to stop in 10s",
                   file=sys.stderr)
+        self._executor.shutdown()
 
     def server_close(self) -> None:
         if self._closed:

@@ -124,11 +124,18 @@ class Metrics:
     # -- rendering -------------------------------------------------------
 
     def render(self, object_layer=None, scanner=None, server=None,
-               peer_states=None) -> str:
+               peer_states=None, node_states=None) -> str:
         """Prometheus text. With `peer_states` (every worker's control
         snapshot, this worker included), request counters render as
         the FLEET totals and per-worker gauges are appended — one
-        scrape of any worker sees the whole front-end."""
+        scrape of any worker sees the whole front-end.
+
+        With `node_states` (every cluster node's peer.metrics snapshot,
+        the local node flagged "local": True), the merge goes one level
+        further the same way: remote workers' states join the fleet
+        totals and per-node families (requests, slow ops, last-minute
+        latency, replication lag) are appended with `node` identity
+        labels — one scrape of ANY node answers for the cluster."""
         lines: list[str] = []
 
         def metric(name, help_, type_, samples):
@@ -171,6 +178,20 @@ class Metrics:
         slow_total = _tracing.slow_total
         peer_metrics = [p["metrics"] for p in (peer_states or [])
                         if isinstance(p.get("metrics"), dict)]
+        # Cluster federation: remote nodes' worker states join the
+        # fleet totals (the local node's own states already sit in
+        # peer_metrics — or, single-process, in this instance — so its
+        # node_states entry is flagged "local" and skipped here).
+        remote_states = []
+        for ns in (node_states or []):
+            if not isinstance(ns, dict) or ns.get("local"):
+                continue
+            remote_states.extend(s for s in ns.get("states") or []
+                                 if isinstance(s, dict))
+        if remote_states:
+            if not peer_metrics:
+                peer_metrics = [self.state()]
+            peer_metrics = peer_metrics + remote_states
         if peer_metrics:
             reqs, lat_sum, lat_count = {}, {}, {}
             rx = tx = 0
@@ -1130,6 +1151,90 @@ class Metrics:
                    "Configured pre-forked worker count", "gauge",
                    [({}, len(peer_states))])
 
+        # -- SLO engine (utils/slo.py): burn-rate / budget gauges ------
+        slo = getattr(server, "slo", None) if server is not None else None
+        if slo is not None:
+            snap = slo.snapshot(metrics=self)
+            objs = snap.get("objectives", [])
+            verdict_code = {"pass": 0, "warn": 1, "burn": 2}
+            metric("minio_tpu_slo_objectives",
+                   "Declared SLO objectives under continuous "
+                   "evaluation", "gauge", [({}, len(objs))])
+            metric("minio_tpu_slo_burn_rate",
+                   "Error-budget burn rate per objective (1.0 = "
+                   "burning exactly the declared budget)", "gauge",
+                   [({"objective": o["name"]}, o["burn_rate"])
+                    for o in objs])
+            metric("minio_tpu_slo_error_budget_remaining",
+                   "Fraction of the declared error budget left in the "
+                   "rolling window", "gauge",
+                   [({"objective": o["name"]}, o["budget_remaining"])
+                    for o in objs])
+            metric("minio_tpu_slo_p99_seconds",
+                   "Observed p99 latency of the objective's API class "
+                   "over the last minute", "gauge",
+                   [({"objective": o["name"]}, o["p99_s"])
+                    for o in objs])
+            metric("minio_tpu_slo_shed_rate",
+                   "Fraction of the objective's requests shed (503) in "
+                   "the rolling window", "gauge",
+                   [({"objective": o["name"]}, o["shed_rate"])
+                    for o in objs])
+            metric("minio_tpu_slo_verdict",
+                   "Objective verdict: 0 pass, 1 warn, 2 burn",
+                   "gauge",
+                   [({"objective": o["name"]},
+                     verdict_code.get(o["verdict"], 2)) for o in objs])
+
+        # -- cluster federation: per-node identity families ------------
+        if node_states:
+            node_rows = []
+            for ns in node_states:
+                if isinstance(ns, dict):
+                    node_rows.append((ns.get("node", "?") or "?", ns))
+            metric("minio_tpu_cluster_node_up",
+                   "Per-node reachability of the cluster telemetry "
+                   "verb (peer.metrics)", "gauge",
+                   [({"node": n}, 0 if ns.get("unreachable") else 1)
+                    for n, ns in node_rows])
+            req_rows, slow_rows, lm_rows, lag_rows = [], [], [], []
+            for n, ns in node_rows:
+                if ns.get("unreachable"):
+                    continue
+                total = 0
+                wins = []
+                for st in ns.get("states") or []:
+                    if not isinstance(st, dict):
+                        continue
+                    total += sum(v for _, _, v in
+                                 st.get("requests", []))
+                    wins.extend(w for w in
+                                st.get("last_minute", {}).values())
+                req_rows.append(({"node": n}, total))
+                slow_rows.append(({"node": n}, ns.get("slow_ops", 0)))
+                if wins:
+                    summ = summarize(LastMinute.merge(wins))
+                    for q in ("p50", "p99"):
+                        lm_rows.append(({"node": n, "q": q},
+                                        round(summ.get(q, 0.0), 6)))
+                lag = (ns.get("replication") or {}).get("lag_ms")
+                if isinstance(lag, dict):
+                    for q in ("p50", "p99"):
+                        lag_rows.append(({"node": n, "q": q},
+                                         lag.get(f"{q}_ms", 0.0)))
+            metric("minio_tpu_cluster_node_requests_total",
+                   "HTTP requests served per cluster node (all APIs)",
+                   "counter", req_rows)
+            metric("minio_tpu_cluster_node_slow_ops_total",
+                   "Slow-op records per cluster node", "counter",
+                   slow_rows)
+            metric("minio_tpu_cluster_node_last_minute_seconds",
+                   "Last-minute request latency quantiles per node "
+                   "(all APIs merged)", "gauge", lm_rows)
+            metric("minio_tpu_cluster_node_replication_lag_ms",
+                   "Enqueue-to-delivered replication lag quantiles "
+                   "per node", "gauge", lag_rows)
+
         return "\n".join(lines) + "\n"
 
 
@@ -1205,6 +1310,39 @@ def merge_loop_stats(stats_list) -> dict:
     return out
 
 
+def peer_metrics_state(server) -> dict:
+    """One node's telemetry snapshot for the cluster-federation verb
+    (grid `peer.metrics`): every local worker's Metrics.state() —
+    fleet-merged through the pre-forked hub exactly the way a local
+    scrape merges them, one topology level down — plus the node's
+    slow-op total and replication lag summary, all under the node's
+    self-declared identity. JSON/msgpack-safe by construction."""
+    states = []
+    cs = getattr(server, "cluster_stats", None)
+    if cs is not None:
+        try:
+            states = [w["metrics"] for w in cs()
+                      if isinstance(w.get("metrics"), dict)]
+        except Exception:  # noqa: BLE001 - serve own snapshot
+            states = []
+    if not states:
+        states = [server.metrics.state()]
+    out = {"node": getattr(server, "node_id", "") or "",
+           "states": states,
+           "slow_ops": _tracing.slow_total}
+    repl = getattr(server, "replicator", None)
+    if repl is not None and hasattr(repl, "stats"):
+        try:
+            rst = repl.stats()
+            lag = rst.pop("lag_hist", None)
+            if lag:
+                rst["lag_ms"] = _lag_summary(lag)
+            out["replication"] = rst
+        except Exception:  # noqa: BLE001 - lag is advisory
+            pass
+    return out
+
+
 def node_info(server) -> dict:
     """One node's admin-info summary (drives, usage, heal state) —
     served locally by the admin handler and remotely over the grid's
@@ -1234,6 +1372,7 @@ def node_info(server) -> dict:
                  "last_update": u.last_update}
     info = {
         "mode": "online",
+        "node": getattr(server, "node_id", "") or "",
         "sets": len(sets),
         "drives": drives,
         "drives_online": sum(1 for d in drives if d["state"] == "ok"),
@@ -1302,6 +1441,14 @@ def node_info(server) -> dict:
     info["slow_ops"] = {"total": _tracing.slow_total,
                         "threshold_ms": _tracing.slow_ms(),
                         "recent": _tracing.slow_ops()[-20:]}
+    # Continuous SLO engine (utils/slo.py): per-objective burn-rate /
+    # remaining-budget with pass/warn/burn verdicts.
+    slo = getattr(server, "slo", None)
+    if slo is not None:
+        try:
+            info["slo"] = slo.snapshot(metrics=m)
+        except Exception:  # noqa: BLE001 - verdicts are advisory
+            pass
     # I/O engine: pool + per-drive queue health (and, in worker mode,
     # the whole fleet's per-worker snapshots via the control pipe).
     from minio_tpu.io.bufpool import global_pool

@@ -15,6 +15,7 @@ import email.utils
 import hashlib
 import time as _time_mod
 import os
+import queue as _queue_mod
 import socket as socket_mod
 import threading
 import urllib.parse
@@ -160,6 +161,9 @@ class S3Server:
         self.worker_id = 0
         self.worker_total = 1
         self.cluster_stats = None
+        # Self-declared node identity (distributed boot sets it; empty
+        # on single-node deployments). Labels cluster-merged telemetry.
+        self.node_id = ""
         # Fleet-wide trace subscription hub (io/workers.WorkerContext);
         # None = single-process mode, admin trace subscribes locally.
         self.cluster_trace = None
@@ -169,6 +173,13 @@ class S3Server:
         # serialization would ride the dsync namespace lock.
         self.bucket_meta_lock = threading.Lock()
         self.metrics = Metrics()
+        # Continuous SLO engine (utils/slo.py): declared objectives
+        # evaluated against the rolling windows above; None when
+        # MTPU_SLO=off.
+        from minio_tpu.utils.slo import SLOEngine
+        self.slo = SLOEngine.from_env()
+        if self.slo is not None:
+            self.slo.start(metrics=self.metrics)
         # Admission control: bounded in-flight requests with per-class
         # gates and the per-request deadline budget
         # (MTPU_API_REQUESTS_MAX / _DEADLINE / _TIMEOUT; s3/admission.py).
@@ -263,6 +274,8 @@ class S3Server:
         # closes — a replication/notification worker mid-delivery must
         # not hit a shut-down executor (and their threads must not
         # outlive the server: the leak harness counts them).
+        if self.slo is not None:
+            self.slo.stop()
         if self.site is not None:
             self.site.stop()
         if self.replicator is not None:
@@ -959,6 +972,8 @@ def _make_handler(server: S3Server):
                 status = self._last_status or 500
                 server.metrics.record(api, status, dt,
                                       rx=rx, tx=self._sent_bytes)
+                if server.slo is not None:
+                    server.slo.observe(api, status)
                 if server.tracer.active or server.audit is not None:
                     from minio_tpu.s3.trace import make_entry
                     entry = make_entry(
@@ -967,6 +982,8 @@ def _make_handler(server: S3Server):
                         else "", self._auth_key, rx=rx,
                         tx=self._sent_bytes)
                     entry["worker"] = server.worker_id
+                    if server.node_id:
+                        entry["node"] = server.node_id
                     if tctx is not None:
                         # The request record IS the trace root: span 0,
                         # every internal span parents (transitively)
@@ -1009,11 +1026,23 @@ def _make_handler(server: S3Server):
                             peers = server.cluster_stats()
                         except Exception:  # noqa: BLE001 - serve own
                             peers = None
+                    # Cluster federation: pull every peer NODE's
+                    # telemetry over the grid (peer.metrics verb) so a
+                    # scrape of any node reports the whole cluster
+                    # with per-node labels. ?cluster=false opts out
+                    # (per-node scrape configs avoiding N^2 fan-out).
+                    nodes = None
+                    want_cluster = (query.get("cluster", [""])[0]
+                                    or "").lower() not in (
+                        "false", "0", "off", "no")
+                    if server.profile_peers and want_cluster:
+                        nodes = self._cluster_metrics_states()
                     text = server.metrics.render(
                         object_layer=server.object_layer,
                         scanner=getattr(server.object_layer, "scanner",
                                         None),
-                        server=server, peer_states=peers)
+                        server=server, peer_states=peers,
+                        node_states=nodes)
                     return self._send(200, text.encode(),
                                       content_type="text/plain; "
                                       "version=0.0.4")
@@ -3256,6 +3285,42 @@ def _make_handler(server: S3Server):
             self._send(200, _json.dumps(result).encode(),
                        content_type="application/json")
 
+        def _cluster_metrics_states(self):
+            """Fleet-federated telemetry: the local node's merged
+            snapshot (all pre-forked workers, one level down) plus one
+            grid `peer.metrics` call per peer node — the same merge
+            shape io/workers.py applies to workers, lifted to nodes.
+            Down peers yield an `unreachable` stub so the scrape still
+            reports them (as minio_tpu_cluster_node_up 0)."""
+            from minio_tpu.s3.metrics import peer_metrics_state
+            local = peer_metrics_state(server)
+            local["local"] = True
+            nodes = [local]
+            mu = threading.Lock()
+
+            def _fetch(name, client):
+                try:
+                    st = client.call("peer.metrics", {}, timeout=3)
+                    if not isinstance(st, dict):
+                        raise ValueError("bad peer snapshot")
+                except Exception:  # noqa: BLE001 - peer down
+                    st = {"node": name, "states": [],
+                          "unreachable": True}
+                st.setdefault("node", name)
+                with mu:
+                    nodes.append(st)
+
+            # Concurrent fan-out: serial calls would stack one timeout
+            # per DOWN peer onto every scrape.
+            ts = [threading.Thread(target=_fetch, args=(n, c),
+                                   daemon=True)
+                  for n, c in server.profile_peers]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=4)
+            return nodes
+
         def _admin_trace(self, query):
             """Live trace stream: chunked JSON lines until the client
             disconnects (reference: TraceHandler + pubsub; the `mc
@@ -3268,7 +3333,13 @@ def _make_handler(server: S3Server):
             while requests spread over ALL of them: the handler
             subscribes fleet-wide through the parent control pipe
             (io/workers.py trace pump) instead of its local
-            broadcaster, so entries from every sibling stream here."""
+            broadcaster, so entries from every sibling stream here.
+
+            ?cluster=true lifts the same merge one level up: the
+            subscription fans out over every peer NODE as a grid
+            `trace.stream` and the relays funnel into this response,
+            so one connection tails the whole deployment (entries
+            carry their origin `node`)."""
             import json as _json
             import queue as _queue
             limit = 0
@@ -3286,6 +3357,19 @@ def _make_handler(server: S3Server):
                     & set(tracing_mod.TRACE_TYPES)
                 if not types:
                     types = {"s3"}
+
+            relay_q = relay_stop = None
+            if server.profile_peers and \
+                    (query.get("cluster", [""])[0] or "").lower() in (
+                        "true", "1", "yes", "on"):
+                relay_q = _queue.Queue(maxsize=4096)
+                relay_stop = threading.Event()
+                for name, client in server.profile_peers:
+                    threading.Thread(
+                        target=self._trace_relay,
+                        args=(name, client, sorted(types), relay_q,
+                              relay_stop),
+                        daemon=True).start()
 
             hub = getattr(server, "cluster_trace", None)
             sub = sub_id = None
@@ -3307,24 +3391,29 @@ def _make_handler(server: S3Server):
                     entries = []
                     if hub is not None:
                         entries = hub.trace_poll(sub_id)
-                        if not entries:
-                            if _time_mod.monotonic() - idle_since > 1.0:
-                                # Heartbeat chunk: on an idle server
-                                # this is the only way a disconnected
-                                # client surfaces (EPIPE) — without it
-                                # the thread and subscription leak.
-                                self.wfile.write(b"1\r\n\n\r\n")
-                                self.wfile.flush()
-                                idle_since = _time_mod.monotonic()
-                            _time_mod.sleep(0.2)
-                            continue
                     else:
                         try:
-                            entries = [sub.get(timeout=1.0)]
+                            entries = [sub.get(timeout=0.2)]
                         except _queue.Empty:
+                            pass
+                    if relay_q is not None:
+                        try:
+                            while len(entries) < 1024:
+                                entries.append(relay_q.get_nowait())
+                        except _queue.Empty:
+                            pass
+                    if not entries:
+                        if _time_mod.monotonic() - idle_since > 1.0:
+                            # Heartbeat chunk: on an idle server this
+                            # is the only way a disconnected client
+                            # surfaces (EPIPE) — without it the thread
+                            # and subscriptions leak.
                             self.wfile.write(b"1\r\n\n\r\n")
                             self.wfile.flush()
-                            continue
+                            idle_since = _time_mod.monotonic()
+                        if hub is not None:
+                            _time_mod.sleep(0.2)
+                        continue
                     idle_since = _time_mod.monotonic()
                     for entry in entries:
                         line = _json.dumps(entry).encode() + b"\n"
@@ -3338,6 +3427,8 @@ def _make_handler(server: S3Server):
             except OSError:
                 pass        # client went away
             finally:
+                if relay_stop is not None:
+                    relay_stop.set()
                 if hub is not None:
                     try:
                         hub.trace_unsub(sub_id)
@@ -3346,6 +3437,28 @@ def _make_handler(server: S3Server):
                 else:
                     server.tracer.unsubscribe(sub)
                 self.close_connection = True
+
+        def _trace_relay(self, name, client, types, out_q, stop):
+            """?cluster=true peer relay: one grid trace.stream per peer
+            node, batches funneled into the merge queue. Dies with its
+            stream on peer failure — the merged response keeps serving
+            the surviving nodes. Backpressure drops (full queue) are
+            acceptable for a diagnostics tail."""
+            try:
+                for batch in client.stream("trace.stream",
+                                           {"types": types},
+                                           timeout=10.0):
+                    if stop.is_set():
+                        break
+                    for entry in batch or []:
+                        if isinstance(entry, dict):
+                            entry.setdefault("node", name)
+                        try:
+                            out_q.put_nowait(entry)
+                        except _queue_mod.Full:
+                            pass
+            except Exception:  # noqa: BLE001 - peer gone / stream cut
+                pass
 
         def _admin_info(self):
             import json as _json

@@ -170,6 +170,57 @@ class TraceBroadcaster:
                     pass
 
 
+def make_trace_stream(server):
+    """Grid stream verb (`trace.stream`) backing ?cluster=true admin
+    trace: a peer node pulls THIS node's live trace entries as a
+    stream of batches (lists of entry dicts). Subscribes exactly like
+    the local admin handler — fleet-wide through the worker control
+    pipe when the hub is up, else the local broadcaster — and yields
+    an empty batch at least once per second so the grid client's
+    per-frame liveness window never lapses on an idle node. Ends when
+    the consumer stops draining (credit stall unwinds the generator)
+    or the connection drops."""
+
+    def _stream(payload):
+        spec = payload if isinstance(payload, dict) else {}
+        types = sorted({str(t) for t in spec.get("types") or ["s3"]})
+        hub = getattr(server, "cluster_trace", None)
+        sub = sub_id = None
+        if hub is not None:
+            try:
+                sub_id = hub.trace_sub(types)
+            except Exception:  # noqa: BLE001 - control plane down
+                hub = None
+        if hub is None:
+            sub = server.tracer.subscribe(set(types))
+        try:
+            last_yield = time.monotonic()
+            while True:
+                if hub is not None:
+                    entries = hub.trace_poll(sub_id)
+                    if not entries:
+                        if time.monotonic() - last_yield < 1.0:
+                            time.sleep(0.2)
+                            continue
+                else:
+                    try:
+                        entries = [sub.get(timeout=1.0)]
+                    except queue.Empty:
+                        entries = []
+                yield entries       # empty batch = heartbeat
+                last_yield = time.monotonic()
+        finally:
+            if hub is not None:
+                try:
+                    hub.trace_unsub(sub_id)
+                except Exception:  # noqa: BLE001 - best effort
+                    pass
+            else:
+                server.tracer.unsubscribe(sub)
+
+    return _stream
+
+
 class AuditLogger:
     """Webhook audit target with a bounded in-memory retry deque.
 

@@ -525,6 +525,19 @@ def main(argv=None) -> int:
     except Exception:  # noqa: BLE001 - config is optional
         pass
     if distributed:
+        # Self-declared node identity: unique per node, stable across
+        # restarts. The bind address is neither when every node runs
+        # the default 0.0.0.0:9000 — fall back to the hostname, which
+        # is what distinguishes nodes in a same-port deployment. Every
+        # worker carries it: slow-op records, trace spans, and the
+        # federated telemetry snapshots are labeled with the node that
+        # produced them.
+        from minio_tpu.utils import tracing as tracing_mod
+        ident_host = my_host if my_host not in ("0.0.0.0", "::", "") \
+            else socket_mod.gethostname()
+        node_id = f"{ident_host}:{my_port}"
+        srv.node_id = node_id
+        tracing_mod.set_node(node_id)
         # Peer control plane: mutations of shared state (IAM, config,
         # decom) fan out an immediate cache invalidation to every
         # peer; the per-cache TTL covers unreachable peers
@@ -567,16 +580,11 @@ def main(argv=None) -> int:
                 shared_dir = os.path.join(_root, ".mtpu.sys", "workers")
                 os.makedirs(shared_dir, exist_ok=True)
         if grid_srv is not None:
-            # Self-declared coherence identity: must be UNIQUE per node
-            # and stable across restarts (peers key applied-generation
-            # records by it; restart detection rides the instance id).
-            # The bind address is neither when every node runs the
-            # default 0.0.0.0:9000 — fall back to the hostname, which
-            # is what distinguishes nodes in a same-port deployment.
-            ident_host = my_host if my_host not in ("0.0.0.0", "::", "") \
-                else socket_mod.gethostname()
+            # Coherence reuses the node identity above (peers key
+            # applied-generation records by it; restart detection rides
+            # the instance id).
             coherence = PeerCoherence(
-                node_id=f"{ident_host}:{my_port}",
+                node_id=node_id,
                 peers={f"{h}:{p}": client_for(h, p + GRID_PORT_OFFSET)
                        for h, p in remote_nodes},
                 on_invalidate=make_set_invalidator(all_sets_d,
@@ -658,6 +666,18 @@ def main(argv=None) -> int:
             from minio_tpu.s3.metrics import node_info as _node_info
             grid_srv.register("peer.info",
                               lambda payload: _node_info(srv))
+            # Fleet-federated telemetry: peers pull this node's merged
+            # metrics snapshot (all its workers) in one call, and tail
+            # its live trace entries as a stream (?cluster=true admin
+            # trace). Both land on worker 0, which holds the node's
+            # control plane and merges siblings through it.
+            from minio_tpu.s3.metrics import \
+                peer_metrics_state as _peer_metrics_state
+            from minio_tpu.s3.trace import make_trace_stream
+            grid_srv.register("peer.metrics",
+                              lambda payload: _peer_metrics_state(srv))
+            grid_srv.register_stream("trace.stream",
+                                     make_trace_stream(srv))
         srv.profile_peers = [
             (f"{h}:{p}", client_for(h, p + GRID_PORT_OFFSET))
             for h, p in remote_nodes]

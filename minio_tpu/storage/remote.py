@@ -29,6 +29,7 @@ from minio_tpu.storage.local import (DiskAccessDenied, DiskInfo, LocalStorage,
 from minio_tpu.storage.meta import (FileInfo, FileNotFoundErr, MetaError,
                                     VersionNotFoundErr, fi_from_wire,
                                     fi_to_wire)
+from minio_tpu.utils import tracing
 
 # Bulk transfers chunk at this size (small enough to interleave with
 # lock/metadata frames on the shared connection).
@@ -365,6 +366,36 @@ class RemoteStorage:
         return DiskInfo(**d)
 
 
+def _span_unary(name: str, fn):
+    """Serving-side span for an armed caller: the grid runner executes
+    the handler bound to the shipped trace context, so recording here
+    lands `disk.<op>` in the subtree that piggybacks home. Disarmed
+    cost is one attribute check."""
+    def handler(payload):
+        if not tracing.ACTIVE:
+            return fn(payload)
+        tags = {"drive": payload.get("d", "")} \
+            if isinstance(payload, dict) else None
+        with tracing.span("storage", f"disk.{name}", tags):
+            return fn(payload)
+    return handler
+
+
+def _span_stream(name: str, fn):
+    """Stream twin of _span_unary: the span covers the generator's
+    whole life (first pull to exhaustion), recorded when it closes —
+    before the EOF frame ships the subtree."""
+    def handler(payload):
+        if not tracing.ACTIVE:
+            yield from fn(payload)
+            return
+        tags = {"drive": payload.get("d", "")} \
+            if isinstance(payload, dict) else None
+        with tracing.span("storage", f"disk.{name}", tags):
+            yield from fn(payload)
+    return handler
+
+
 class StorageRPCService:
     """Server side: exposes this node's local drives over the grid."""
 
@@ -419,23 +450,39 @@ class StorageRPCService:
 
     def register_into(self, srv: GridServer) -> None:
         for name in self._UNARY:
-            srv.register(f"st.{name}", self._make_unary(name))
-        srv.register("st.stat_vol", self._stat_vol)
-        srv.register("st.list_vols", self._list_vols)
-        srv.register("st.write_metadata", self._meta_op("write_metadata"))
-        srv.register("st.update_metadata", self._meta_op("update_metadata"))
-        srv.register("st.read_version", self._read_version)
-        srv.register("st.list_versions", self._list_versions)
-        srv.register("st.rename_data", self._rename_data)
-        srv.register("st.disk_info", self._disk_info)
-        srv.register("st.create_begin", self._create_begin)
-        srv.register("st.create_chunk", self._create_chunk)
-        srv.register("st.create_commit", self._create_commit)
-        srv.register_stream("st.read_file_stream", self._read_file_stream)
-        srv.register_stream("st.read_file_raw", self._read_file_raw)
+            srv.register(f"st.{name}",
+                         _span_unary(name, self._make_unary(name)))
+        srv.register("st.stat_vol", _span_unary("stat_vol",
+                                                self._stat_vol))
+        srv.register("st.list_vols", _span_unary("list_vols",
+                                                 self._list_vols))
+        srv.register("st.write_metadata", _span_unary(
+            "write_metadata", self._meta_op("write_metadata")))
+        srv.register("st.update_metadata", _span_unary(
+            "update_metadata", self._meta_op("update_metadata")))
+        srv.register("st.read_version",
+                     _span_unary("read_version", self._read_version))
+        srv.register("st.list_versions",
+                     _span_unary("list_versions", self._list_versions))
+        srv.register("st.rename_data",
+                     _span_unary("rename_data", self._rename_data))
+        srv.register("st.disk_info",
+                     _span_unary("disk_info", self._disk_info))
+        srv.register("st.create_begin",
+                     _span_unary("create_begin", self._create_begin))
+        srv.register("st.create_chunk",
+                     _span_unary("create_chunk", self._create_chunk))
+        srv.register("st.create_commit",
+                     _span_unary("create_commit", self._create_commit))
+        srv.register_stream("st.read_file_stream", _span_stream(
+            "read_file_stream", self._read_file_stream))
+        srv.register_stream("st.read_file_raw", _span_stream(
+            "read_file_raw", self._read_file_raw))
         srv.register_sink("st.write_file_raw", self._write_file_raw)
-        srv.register_stream("st.walk_dir", self._walk_dir)
-        srv.register_stream("st.walk_scan", self._walk_scan)
+        srv.register_stream("st.walk_dir",
+                            _span_stream("walk_dir", self._walk_dir))
+        srv.register_stream("st.walk_scan",
+                            _span_stream("walk_scan", self._walk_scan))
 
     def _make_unary(self, name: str):
         def handler(payload):

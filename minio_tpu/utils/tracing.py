@@ -43,7 +43,22 @@ import uuid
 from typing import Optional
 
 # Every trace type a span may carry; admin trace filters on these.
-TRACE_TYPES = ("s3", "storage", "grid", "kernel", "scanner", "heal")
+TRACE_TYPES = ("s3", "storage", "grid", "kernel", "scanner", "heal",
+               "repl")
+
+# -- node identity ----------------------------------------------------------
+# The node's self-declared identity ("host:port" of its S3 plane, the
+# same string PeerCoherence uses). Stamped on slow-op records and trace
+# entries so cluster-merged streams stay attributable. Empty until the
+# distributed boot calls set_node(); single-node deployments stay
+# unstamped.
+
+NODE = ""
+
+
+def set_node(node_id: str) -> None:
+    global NODE
+    NODE = str(node_id or "")
 
 # -- arming -----------------------------------------------------------------
 # ACTIVE is THE fast-path gate: call sites check it before touching any
@@ -143,6 +158,8 @@ def slow_event(type_: str, name: str, ms: float = 0.0,
 
 def _record_slow(rec: dict) -> None:
     global slow_total, _slow_log_sec, _slow_log_n
+    if NODE and "node" not in rec:
+        rec["node"] = NODE
     sec = int(time.time())
     with _slow_mu:
         _slow_ops.append(rec)
@@ -434,8 +451,16 @@ def record_into(ctx: Optional[TraceContext], parent: int, type_: str,
     the shared dispatch it rode (with per-batch tags), not a gap."""
     if ctx is None or not ACTIVE:
         return
-    rec = {"type": type_, "name": name, "span": ctx.next_id(),
-           "parent": parent,
+    record_span(ctx, parent, type_, name, start_wall, duration_ms, tags)
+
+
+def record_span(ctx: TraceContext, parent: int, type_: str, name: str,
+                start_wall: float, duration_ms: float,
+                tags: Optional[dict] = None) -> int:
+    """record_into(), returning the allocated span id so the caller can
+    hang children (a grid call's stitched remote subtree) under it."""
+    sid = ctx.next_id()
+    rec = {"type": type_, "name": name, "span": sid, "parent": parent,
            "start": start_wall, "duration_ms": round(duration_ms, 3)}
     if tags:
         rec["tags"] = tags
@@ -448,6 +473,77 @@ def record_into(ctx: Optional[TraceContext], parent: int, type_: str,
         slow["trace"] = ctx.trace_id
         _record_slow(slow)
     ctx.add(rec)
+    return sid
+
+
+# -- cross-node propagation -------------------------------------------------
+# A grid peer executing an armed call records its spans into a local
+# TraceContext seeded with the caller's trace id, then ships the
+# completed subtree back piggybacked on the reply (export_spans — wire-
+# safe copies, capped). The caller grafts them under an explicit `wire`
+# span (stitch_wire) that splits serialize / transit / peer-queue-wait
+# / peer-service, remapping the remote span ids into its own sequence.
+
+# Cap on spans shipped back per reply: bounds the piggyback bytes the
+# way MAX_SPANS bounds the local ring.
+REMOTE_MAX = _env_int("MTPU_TRACE_REMOTE_MAX", 128)
+
+_WIRE_KEYS = ("type", "name", "span", "parent", "start", "duration_ms",
+              "tags", "error", "slow", "threshold_ms")
+
+
+def export_spans(ctx: TraceContext, limit: Optional[int] = None) -> dict:
+    """The context's spans as a wire-safe payload: plain-dict copies
+    (ancestry stripped — the caller re-derives paths in its own tree),
+    capped at `limit` (default REMOTE_MAX) with the overflow counted
+    in `dropped` alongside spans the ring itself already shed."""
+    cap = REMOTE_MAX if limit is None else max(0, int(limit))
+    with ctx._mu:
+        spans = list(ctx.spans)
+        dropped = ctx.dropped
+    if len(spans) > cap:
+        dropped += len(spans) - cap
+        spans = spans[:cap]
+    out = []
+    for rec in spans:
+        out.append({k: rec[k] for k in _WIRE_KEYS if k in rec})
+    return {"spans": out, "dropped": dropped}
+
+
+def stitch_wire(ctx: TraceContext, parent: int, start_wall: float,
+                duration_ms: float, tags: Optional[dict],
+                shipped: Optional[dict]) -> int:
+    """Graft a peer's shipped subtree into the caller's tree under an
+    explicit `wire` span. `tags` carries the timing split (serialize_ms
+    / transit_ms / peer_queue_ms / peer_service_ms, plus peer identity
+    or a transport fault annotation); `shipped` is the peer's
+    export_spans() payload (None when the call faulted before a reply).
+    Returns the wire span id."""
+    wire_sid = record_span(ctx, parent, "grid", "wire", start_wall,
+                           duration_ms, tags)
+    if not shipped:
+        return wire_sid
+    remote = shipped.get("spans") or []
+    node = shipped.get("node", "")
+    # Remap remote span ids into the caller's sequence in ascending
+    # order so every parent is remapped before its children (remote
+    # ids are allocated monotonically).
+    sid_map: dict[int, int] = {}
+    for rec in sorted(remote, key=lambda r: r.get("span", 0)):
+        try:
+            new = dict(rec)
+            new["span"] = sid_map[rec["span"]] = ctx.next_id()
+            new["parent"] = sid_map.get(rec.get("parent", 0), wire_sid)
+            if node:
+                new["node"] = node
+            ctx.add(new)
+        except Exception:  # noqa: BLE001 - a malformed remote span
+            pass           # must not break the caller's request
+    extra = shipped.get("dropped", 0)
+    if extra:
+        with ctx._mu:
+            ctx.dropped += int(extra)
+    return wire_sid
 
 
 # -- entry conversion -------------------------------------------------------
@@ -468,9 +564,12 @@ def _entry_from(rec: dict, trace_id: str) -> dict:
         "parent": rec["parent"],
         "durationMs": rec["duration_ms"],
     }
-    for k in ("tags", "error", "slow", "threshold_ms", "ancestry"):
+    for k in ("tags", "error", "slow", "threshold_ms", "ancestry",
+              "node"):
         if k in rec:
             entry[k] = rec[k]
+    if NODE and "node" not in entry:
+        entry["node"] = NODE
     return entry
 
 

@@ -18,7 +18,7 @@ fi
 
 echo "== bench smoke (baseline: $latest) =="
 out=$(JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} \
-      MTPU_BENCH_ONLY=put_latency,put_concurrent,get_latency,get_concurrent,meta_listing,small_put,transform_put,distributed,cluster_get,connections,rebalance,hot_get,replication \
+      MTPU_BENCH_ONLY=put_latency,put_concurrent,get_latency,get_concurrent,meta_listing,small_put,transform_put,distributed,cluster_get,connections,rebalance,hot_get,replication,trace_overhead \
       MTPU_BENCH_SMALL=1 \
       python bench.py)
 echo "$out"
@@ -148,6 +148,12 @@ GATES = [
     ("rebalance_identity", "value", "higher"),
     ("replication_lag_p99_ms", "value", "lower"),
     ("replication_convergence", "value", "higher"),
+    # trace_overhead vs_baseline is min(armed/disarmed) across the
+    # put/get throughput columns and the grid unary-latency column
+    # (inverted): 1.0 = free, lower = more armed tax. "higher" fails
+    # the smoke if the DISARMED-relative cost of watching regresses —
+    # including the cross-node propagation path on the grid wire.
+    ("tracing_overhead_armed_vs_disarmed_pct", "vs_baseline", "higher"),
 ]
 
 

@@ -27,6 +27,29 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 from tests.cluster import Cluster  # noqa: E402
 
 
+def _node_telemetry(cluster, i: int) -> str:
+    """Per-node observability digest for `status`: slow-op count and
+    replication lag pulled over the node's grid plane (`peer.metrics`,
+    the same verb the federated scrape uses — no S3 auth needed)."""
+    if not cluster.alive(i):
+        return ""
+    try:
+        from minio_tpu.grid.client import client_for
+        st = client_for("127.0.0.1",
+                        cluster.ports[i] + 1000).call(
+            "peer.metrics", {}, timeout=2.0)
+    except Exception:  # noqa: BLE001 - grid plane not up yet
+        return ""
+    if not isinstance(st, dict):
+        return ""
+    out = f" slow_ops={st.get('slow_ops', 0)}"
+    lag = (st.get("replication") or {}).get("lag_ms") or {}
+    if lag.get("count"):
+        out += (f" repl_lag_p50={lag.get('p50_ms', 0)}ms"
+                f" p99={lag.get('p99_ms', 0)}ms")
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(prog="cluster_up")
     ap.add_argument("root", help="directory for drives/logs/chaos files")
@@ -67,7 +90,7 @@ def main() -> int:
                                 chaos = fh.read().strip() or "none"
                         print(f"  node {i}: "
                               f"{'up' if cluster.alive(i) else 'DOWN'} "
-                              f"chaos={chaos}")
+                              f"chaos={chaos}{_node_telemetry(cluster, i)}")
                 elif cmd == "kill":
                     cluster.kill(int(rest[0]))
                 elif cmd == "restart":

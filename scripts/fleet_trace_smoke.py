@@ -1,0 +1,114 @@
+#!/usr/bin/env python3
+"""Fleet-trace smoke (wired into scripts/verify.sh).
+
+Boots the 3-node cluster harness, issues ONE armed distributed GET
+while subscribed to node 0's admin trace (types=all), and asserts the
+cross-node propagation contract end to end on real server processes:
+
+  * the caller's span tree is stitched into ONE trace id containing at
+    least one REMOTE `disk.*` span (a span whose `node` label names a
+    peer, grafted under a `wire` span);
+  * every `wire` span carries the timing split
+    (peer_queue_ms / peer_service_ms / transit_ms / serialize_ms);
+  * the federated scrape answers for the whole fleet: /metrics on
+    node 0 reports minio_tpu_cluster_node_up for all three nodes, and
+    the SLO engine exports burn-rate gauges.
+
+Exit 0 on success, 1 with a reason otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tests.cluster import Cluster  # noqa: E402
+from tests.test_fleet_obs import _stream_trace  # noqa: E402
+
+
+def fail(msg: str) -> int:
+    print(f"fleet-trace-smoke: FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="mtpu-fleet-smoke-")
+    with Cluster(tmp, nodes=3, drives_per_node=2, parity=2) as cluster:
+        cli = cluster.client(0)
+        assert cli.request("PUT", "/smoke")[0] == 200
+        body = os.urandom(150_000)
+        assert cli.request("PUT", "/smoke/o", body=body)[0] == 200
+
+        entries: list = []
+        t = threading.Thread(
+            target=_stream_trace,
+            args=(cluster.address(0),
+                  {"types": "all", "count": "120"}, entries),
+            daemon=True)
+        t.start()
+        time.sleep(0.8)                       # subscription armed
+        st, _, got = cli.request("GET", "/smoke/o")
+        if st != 200 or got != body:
+            return fail(f"distributed GET failed: {st}")
+        for _ in range(150):
+            cli.request("GET", "/minio/health/live", sign=False)
+            if not t.is_alive():
+                break
+            time.sleep(0.05)
+        t.join(timeout=30)
+        if t.is_alive() or not entries:
+            return fail("trace stream never closed / no entries")
+
+        gets = [e for e in entries if e.get("trace_type") == "s3"
+                and e.get("api") == "GET:object"]
+        if not gets:
+            return fail("no s3 GET root entry in trace")
+        tid = gets[0]["trace"]
+        tree = [e for e in entries if e.get("trace") == tid]
+        wires = [e for e in tree if e.get("api") == "wire"]
+        if not wires:
+            return fail("no wire spans in the GET's tree")
+        for w in wires:
+            tags = w.get("tags") or {}
+            if "fault" in tags:
+                continue
+            missing = [k for k in ("peer_queue_ms", "peer_service_ms",
+                                   "transit_ms", "serialize_ms")
+                       if k not in tags]
+            if missing:
+                return fail(f"wire span missing timing split {missing}")
+        wire_ids = {e["span"] for e in wires}
+        remote = [e for e in tree
+                  if str(e.get("api", "")).startswith("disk.")
+                  and e.get("node") != gets[0].get("node")
+                  and e.get("parent") in wire_ids]
+        if not remote:
+            return fail("no remote disk.* span stitched under a wire "
+                        "span (cross-node propagation broken)")
+
+        # Federated telemetry: one scrape answers for the fleet.
+        st, _, text = cli.request("GET", "/minio/v2/metrics/cluster")
+        if st != 200:
+            return fail(f"/minio/v2/metrics/cluster -> {st}")
+        text = text.decode()
+        up = [ln for ln in text.splitlines()
+              if ln.startswith("minio_tpu_cluster_node_up{")]
+        if len(up) < 3:
+            return fail(f"scrape reports {len(up)} nodes, want 3")
+        if "minio_tpu_slo_burn_rate{" not in text:
+            return fail("no SLO burn-rate gauges in scrape")
+
+        print(f"fleet-trace-smoke: OK — {len(tree)} spans in the GET "
+              f"tree, {len(wires)} wire spans, {len(remote)} remote "
+              f"disk.* spans, {len(up)} nodes in one scrape")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

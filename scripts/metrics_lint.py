@@ -12,6 +12,14 @@ and `hist_metric(...)` in s3/metrics.py render paths — and asserts:
     registration renders duplicate HELP/TYPE blocks, which Prometheus
     scrapers reject).
 
+Additionally renders one synthetic FLEET exposition (multi-node
+node_states, SLO engine attached, errors across several API classes)
+and runs a label-cardinality guard over it: no family may expose more
+than --cardinality-cap distinct label-sets unless its prefix is on the
+allowlist of genuinely per-drive / per-peer / per-node families. A
+label explosion (per-object key, per-client address, raw path) lands
+here before it lands on a production Prometheus.
+
 Exit 0 clean, 1 with one line per violation.
 """
 
@@ -25,6 +33,20 @@ import sys
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 NAME_RE = re.compile(r"^minio_tpu_[a-z0-9]+(_[a-z0-9]+)*$")
 REGISTRARS = {"metric", "hist_metric"}
+
+# Label-cardinality guard: one sample line of the text exposition.
+SERIES_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{([^}]*)\})?\s")
+CARDINALITY_CAP = 64
+# Families whose label-set count legitimately scales with hardware or
+# topology (drives, grid peers, cluster nodes, replication targets) —
+# bounded by the deployment, not by traffic.
+CARDINALITY_ALLOW = (
+    "minio_tpu_drive_",
+    "minio_tpu_grid_peer_",
+    "minio_tpu_cluster_node_",
+    "minio_tpu_replication_breaker_",
+    "minio_tpu_replication_lane_",
+)
 
 
 def call_name(node: ast.Call) -> str:
@@ -109,6 +131,73 @@ def lint_file(path: str, seen: dict, problems: list) -> None:
             problems.append(f"{loc}: metric name is not a string literal")
 
 
+def check_exposition(text: str, cap: int = CARDINALITY_CAP,
+                     allowlist=CARDINALITY_ALLOW,
+                     problems: list | None = None) -> list:
+    """Count distinct label-sets per metric FAMILY in a rendered text
+    exposition; flag any family over `cap` whose name is not prefixed
+    by an allowlist entry. Histogram series (_bucket/_sum/_count)
+    collapse into their family."""
+    if problems is None:
+        problems = []
+    fams: dict[str, set] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = SERIES_RE.match(line)
+        if m is None:
+            continue
+        name = re.sub(r"_(bucket|sum|count)$", "", m.group(1))
+        # `le` is the bucket-boundary pseudo-label — fixed per
+        # histogram, not a cardinality dimension.
+        labels = re.sub(r'(^|,)le="[^"]*"', "", m.group(2) or "")
+        fams.setdefault(name, set()).add(labels.strip(","))
+    for fam in sorted(fams):
+        n = len(fams[fam])
+        if n > cap and not any(fam.startswith(p) for p in allowlist):
+            problems.append(
+                f"cardinality: family {fam!r} exposes {n} label-sets "
+                f"(cap {cap}); allowlist it ONLY if it genuinely "
+                "scales with hardware/topology, never with traffic")
+    return problems
+
+
+def _synthetic_fleet_exposition() -> str:
+    """Render the fullest exposition the lint can reach without a live
+    server: a populated Metrics registry, the SLO engine, and a
+    node_states fleet (one peer down) — exercising the request, SLO,
+    and per-node family paths the cardinality guard watches."""
+    sys.path.insert(0, ROOT)
+    from types import SimpleNamespace
+
+    from minio_tpu.s3.metrics import Metrics
+    from minio_tpu.utils.slo import SLOEngine
+
+    m = Metrics()
+    for api in ("GET:object", "PUT:object", "HEAD:object", "GET:bucket",
+                "DELETE:object", "GET:metrics"):
+        for status in (200, 404, 500, 503):
+            m.record(api, status, 0.012, rx=1024, tx=2048)
+    slo = SLOEngine()
+    slo.observe("GET:object", 200)
+    slo.observe("PUT:object", 503)
+    srv = SimpleNamespace(slo=slo)
+    nodes = []
+    for i in range(4):
+        nodes.append({
+            "node": f"host{i}:9000",
+            "states": [Metrics().state(), m.state()],
+            "slow_ops": i,
+            "replication": {"lag_ms": {"count": 3, "mean_ms": 1.2,
+                                       "p50_ms": 1.0, "p99_ms": 4.5}},
+            **({"local": True} if i == 0 else {}),
+        })
+    nodes.append({"node": "down:9000", "states": [],
+                  "unreachable": True})
+    return m.render(server=srv, peer_states=[m.state()],
+                    node_states=nodes)
+
+
 def main() -> int:
     seen: dict = {}
     problems: list = []
@@ -120,12 +209,27 @@ def main() -> int:
             if fn.endswith(".py"):
                 lint_file(os.path.join(dirpath, fn), seen, problems)
                 count += 1
+    try:
+        text = _synthetic_fleet_exposition()
+    except Exception as e:  # noqa: BLE001 - a broken render IS a finding
+        problems.append(f"synthetic fleet render failed: {e!r}")
+        text = ""
+    families = 0
+    if text:
+        families = len({re.sub(r"_(bucket|sum|count)$", "",
+                               SERIES_RE.match(ln).group(1))
+                        for ln in text.splitlines()
+                        if ln and not ln.startswith("#")
+                        and SERIES_RE.match(ln)})
+        check_exposition(text, problems=problems)
     if problems:
         for p in problems:
             print(f"metrics-lint: {p}", file=sys.stderr)
         return 1
     print(f"metrics-lint: {len(seen)} metric names across {count} files, "
-          "all minio_tpu_-prefixed snake_case, each registered once")
+          "all minio_tpu_-prefixed snake_case, each registered once; "
+          f"{families} families in the synthetic fleet exposition, "
+          f"label cardinality within cap {CARDINALITY_CAP}")
     return 0
 
 

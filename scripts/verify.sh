@@ -61,6 +61,13 @@ echo "== cluster smoke (fast subset) =="
 env JAX_PLATFORMS=cpu python -m pytest tests/test_cluster.py \
     -q -m 'not slow' -p no:cacheprovider || exit 1
 
+# Fleet-trace smoke: 3-node harness, one ARMED distributed GET must
+# yield a single stitched span tree containing remote disk.* spans
+# under wire spans (cross-node trace propagation), plus a federated
+# scrape reporting every node and the SLO burn-rate gauges.
+echo "== fleet trace smoke =="
+env JAX_PLATFORMS=cpu python scripts/fleet_trace_smoke.py || exit 1
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \

@@ -1,0 +1,490 @@
+"""Fleet-wide observability: cross-node trace propagation over the
+grid, disarmed-path wire conformance, chaos fault annotation, the
+continuous SLO engine, and the metrics label-cardinality guard.
+
+The in-process half runs a REAL GridServer/GridClient pair so the
+armed and disarmed wire formats are tested against the actual frames;
+the cluster half spawns the 3-node harness (tests/cluster.py) and
+drives partition/kill chaos against an armed distributed GET.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac as hmac_mod
+import http.client
+import importlib.util
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from minio_tpu.grid import wire  # noqa: E402
+from minio_tpu.grid.client import GridClient  # noqa: E402
+from minio_tpu.grid.server import GridServer  # noqa: E402
+from minio_tpu.grid.wire import GridError, RemoteCallError  # noqa: E402
+from minio_tpu.s3 import sigv4  # noqa: E402
+from minio_tpu.s3.metrics import Metrics  # noqa: E402
+from minio_tpu.utils import tracing  # noqa: E402
+from minio_tpu.utils.slo import SLOEngine  # noqa: E402
+from tests.cluster import Cluster  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# in-process grid pair
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def grid_pair():
+    srv = GridServer(0, host="127.0.0.1")
+    hold = threading.Event()
+
+    def spanny(p):
+        with tracing.span("storage", "disk.read_file", {"vol": "v"}) \
+                if tracing.ACTIVE else tracing.NOOP:
+            time.sleep(0.002)
+        return "ok"
+
+    def boom(p):
+        with tracing.span("storage", "disk.delete_file", {}) \
+                if tracing.ACTIVE else tracing.NOOP:
+            pass
+        raise ValueError("nope")
+
+    def slow(p):
+        hold.wait(timeout=10)
+        return "done"
+
+    def walk(p):
+        for i in range(3):
+            with tracing.span("storage", "disk.walk", {"page": i}) \
+                    if tracing.ACTIVE else tracing.NOOP:
+                pass
+            yield i
+
+    srv.register("echo", lambda p: p)
+    srv.register("spanny", spanny)
+    srv.register("boom", boom)
+    srv.register("slow", slow)
+    srv.register_stream("walk", walk)
+    srv.start()
+    cli = GridClient("127.0.0.1", srv.port, connect_timeout=2.0)
+    yield srv, cli, hold
+    hold.set()
+    try:
+        srv.stop()
+    except Exception:  # noqa: BLE001 - some tests stop it themselves
+        pass
+
+
+class _Armed:
+    """Arm span collection for one test and bind a fresh context."""
+
+    def __enter__(self):
+        self.tok = object()
+        tracing.arm(self.tok)
+        self.ctx = tracing.TraceContext()
+        self.bind = tracing.bind(self.ctx, 0)
+        self.bind.__enter__()
+        return self.ctx
+
+    def __exit__(self, *exc):
+        self.bind.__exit__(*exc)
+        tracing.disarm(self.tok)
+
+
+def _by_name(ctx, name):
+    return [s for s in ctx.spans if s["name"] == name]
+
+
+def test_armed_unary_stitches_remote_subtree(grid_pair):
+    srv, cli, _ = grid_pair
+    with _Armed() as ctx:
+        assert cli.call("spanny", {"x": 1}, timeout=5.0) == "ok"
+    call = _by_name(ctx, "grid.spanny")
+    wires = _by_name(ctx, "wire")
+    remote = _by_name(ctx, "disk.read_file")
+    assert len(call) == len(wires) == len(remote) == 1
+    # Tree: grid.spanny <- wire <- disk.read_file, ids remapped into
+    # the caller's sequence (all distinct).
+    assert wires[0]["parent"] == call[0]["span"]
+    assert remote[0]["parent"] == wires[0]["span"]
+    ids = {s["span"] for s in ctx.spans}
+    assert len(ids) == len(ctx.spans)
+    # The wire span carries the full timing split.
+    tags = wires[0]["tags"]
+    for k in ("peer", "serialize_ms", "peer_queue_ms",
+              "peer_service_ms", "transit_ms"):
+        assert k in tags, tags
+    assert tags["peer_service_ms"] >= 2.0   # the handler slept 2 ms
+    assert "fault" not in tags
+
+
+def test_armed_stream_stitches_remote_subtree(grid_pair):
+    srv, cli, _ = grid_pair
+    with _Armed() as ctx:
+        got = list(cli.stream("walk", {}, timeout=5.0))
+    assert got == [0, 1, 2]
+    call = _by_name(ctx, "grid.walk")
+    wires = _by_name(ctx, "wire")
+    remote = _by_name(ctx, "disk.walk")
+    assert len(call) == len(wires) == 1 and len(remote) == 3
+    assert call[0]["tags"]["chunks"] == 3
+    assert wires[0]["parent"] == call[0]["span"]
+    assert all(s["parent"] == wires[0]["span"] for s in remote)
+    assert "peer_service_ms" in wires[0]["tags"]
+
+
+def test_remote_error_still_ships_subtree(grid_pair):
+    """A handler that RAISES still answered: its spans ship back on the
+    T_ERR frame and stitch (the fault is the handler's, not the
+    transport's)."""
+    srv, cli, _ = grid_pair
+    with _Armed() as ctx:
+        with pytest.raises(RemoteCallError):
+            cli.call("boom", {}, timeout=5.0)
+    wires = _by_name(ctx, "wire")
+    assert len(wires) == 1 and "fault" not in wires[0]["tags"]
+    assert len(_by_name(ctx, "disk.delete_file")) == 1
+
+
+def test_disarmed_grid_wire_carries_zero_trace_bytes(grid_pair,
+                                                     monkeypatch):
+    """Disarmed-path conformance: no `tc` on requests, no `ts` on
+    replies — the propagation machinery must be invisible on the wire
+    unless the caller armed the request."""
+    srv, cli, _ = grid_pair
+    assert not tracing.ACTIVE
+    frames = []
+    real_pack = wire.pack_frame
+
+    def spy(msg):
+        frames.append(dict(msg))
+        return real_pack(msg)
+
+    monkeypatch.setattr(wire, "pack_frame", spy)
+    assert cli.call("echo", {"a": 1}, timeout=5.0) == {"a": 1}
+    assert list(cli.stream("walk", {}, timeout=5.0)) == [0, 1, 2]
+    reqs = [f for f in frames if f["t"] in (wire.T_REQ, wire.T_SREQ)]
+    resps = [f for f in frames if f["t"] in (wire.T_RESP, wire.T_ERR,
+                                             wire.T_EOF)]
+    assert reqs and resps
+    assert all("tc" not in f and "_rx" not in f for f in reqs), reqs
+    assert all("ts" not in f for f in resps), resps
+
+
+def test_peer_killed_mid_armed_call_annotates_fault(grid_pair):
+    """Transport death mid-armed-call: the caller's tree still
+    completes — the wire span carries the fault, nothing stitches, no
+    arm token leaks — and the now-open breaker fast-fails the next
+    call with the same annotation (a stale reply can never stitch:
+    its mux entry is gone)."""
+    srv, cli, hold = grid_pair
+    with _Armed() as ctx:
+        killer = threading.Timer(0.3, srv.stop)
+        killer.start()
+        with pytest.raises((GridError, Exception)):
+            cli.call("slow", {}, timeout=5.0)
+        killer.join()
+        hold.set()
+        wires = _by_name(ctx, "wire")
+        assert len(wires) == 1
+        assert wires[0]["tags"]["fault"] in (
+            "conn_lost", "GridError", "DeadlineExceeded")
+        # Transport fault => no remote subtree: exactly the grid call
+        # span and its wire span.
+        assert {s["name"] for s in ctx.spans} == {"grid.slow", "wire"}
+        before = len(ctx.spans)
+        # Breaker (or dead socket) path: fails fast, still annotated.
+        with pytest.raises(GridError):
+            cli.call("echo", {}, timeout=1.0)
+        wires = _by_name(ctx, "wire")
+        assert len(wires) == 2 and "fault" in wires[1]["tags"]
+        assert len(ctx.spans) == before + 2    # call + wire, no stitch
+    # No leaked arm tokens: the module gate is back to one attr check.
+    assert not tracing.ACTIVE
+    with tracing._arm_mu:
+        assert not tracing._arm_sources
+
+
+# ---------------------------------------------------------------------------
+# SLO engine
+# ---------------------------------------------------------------------------
+
+def _eng(spec, now, **kw):
+    return SLOEngine(objectives=[spec], eval_s=5.0, now=now, **kw)
+
+
+def test_slo_budget_arithmetic():
+    t = [1000.0]
+    eng = _eng({"name": "o", "match": ["GET:object"], "p99_ms": 0,
+                "error_budget": 0.02, "window_s": 60},
+               now=lambda: t[0])
+    for _ in range(98):
+        eng.observe("GET:object", 200)
+    for _ in range(2):
+        eng.observe("GET:object", 500)
+    eng.observe("PUT:object", 500)          # no match: must not count
+    o = eng.evaluate()[0]
+    assert o["requests"] == 100 and o["errors"] == 2
+    # Exactly at budget: burn 1.0, nothing left, warn (not yet burn).
+    assert o["burn_rate"] == pytest.approx(1.0)
+    assert o["budget_remaining"] == pytest.approx(0.0)
+    assert o["verdict"] == "warn"
+    eng.observe("GET:object", 500)
+    o = eng.evaluate()[0]
+    assert o["burn_rate"] > 1.0 and o["verdict"] == "burn"
+    assert o["budget_remaining"] == 0.0
+
+
+def test_slo_shed_rate_and_warn_thresholds():
+    t = [2000.0]
+    eng = _eng({"name": "o", "match": ["GET:*"], "p99_ms": 0,
+                "error_budget": 0.5, "shed_ceiling": 0.10,
+                "window_s": 60}, now=lambda: t[0])
+    for _ in range(93):
+        eng.observe("GET:object", 200)
+    for _ in range(7):
+        eng.observe("GET:object", 503)      # shed = error too
+    o = eng.evaluate()[0]
+    assert o["sheds"] == 7 and o["errors"] == 7
+    assert o["shed_rate"] == pytest.approx(0.07)
+    # 7% shed: above half the 10% ceiling -> warn, not burn.
+    assert o["verdict"] == "warn"
+    for _ in range(5):
+        eng.observe("GET:object", 503)
+    o = eng.evaluate()[0]
+    assert o["shed_rate"] > 0.10 and o["verdict"] == "burn"
+
+
+def test_slo_window_rollover():
+    t = [5000.0]
+    eng = _eng({"name": "o", "match": ["GET:object"], "p99_ms": 0,
+                "error_budget": 0.5, "window_s": 10},
+               now=lambda: t[0])
+    eng.observe("GET:object", 500)
+    assert eng.evaluate()[0]["requests"] == 1
+    t[0] += 11.0                            # window slid past the slot
+    o = eng.evaluate()[0]
+    assert o["requests"] == 0 and o["burn_rate"] == 0.0
+    assert o["verdict"] == "pass"
+    t[0] = 5010.0                           # same modular slot, reused
+    eng.observe("GET:object", 200)
+    o = eng.evaluate()[0]
+    # Lazy slot reset: the old error must not survive slot reuse.
+    assert o["requests"] == 1 and o["errors"] == 0
+
+
+def test_slo_p99_from_live_rolling_windows():
+    t = [3000.0]
+    m = Metrics()
+    for _ in range(50):
+        m.record("GET:object", 200, 0.400)
+    eng = _eng({"name": "o", "match": ["GET:object"], "p99_ms": 100,
+                "error_budget": 0.5, "window_s": 60},
+               now=lambda: t[0])
+    eng.observe("GET:object", 200)
+    o = eng.evaluate(metrics=m)[0]
+    assert o["p99_s"] >= 0.4 and o["p99_ceiling_s"] == 0.1
+    assert o["verdict"] == "burn"           # latency ceiling blown
+    relaxed = _eng({"name": "o", "match": ["GET:object"],
+                    "p99_ms": 5000, "error_budget": 0.5,
+                    "window_s": 60}, now=lambda: t[0])
+    relaxed.observe("GET:object", 200)
+    assert relaxed.evaluate(metrics=m)[0]["verdict"] == "pass"
+
+
+def test_slo_from_env_and_snapshot(monkeypatch):
+    monkeypatch.setenv("MTPU_SLO", "off")
+    assert SLOEngine.from_env() is None
+    monkeypatch.setenv("MTPU_SLO", json.dumps(
+        [{"name": "mine", "match": ["GET:object"], "error_budget": 0.1}]))
+    eng = SLOEngine.from_env()
+    assert [o.name for o in eng.objectives] == ["mine"]
+    monkeypatch.setenv("MTPU_SLO", "{not json")
+    eng = SLOEngine.from_env()              # malformed -> defaults
+    assert {o.name for o in eng.objectives} == {
+        "get-availability", "put-availability"}
+    snap = eng.snapshot()
+    assert snap["verdict"] == "pass"
+    assert len(snap["objectives"]) == 2
+    for o in snap["objectives"]:
+        assert set(o) >= {"burn_rate", "budget_remaining", "verdict",
+                          "requests", "p99_s"}
+
+
+# ---------------------------------------------------------------------------
+# metrics label-cardinality guard (scripts/metrics_lint.py)
+# ---------------------------------------------------------------------------
+
+def _lint_mod():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "metrics_lint.py")
+    spec = importlib.util.spec_from_file_location("metrics_lint", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_cardinality_guard_flags_explosions():
+    ml = _lint_mod()
+    text = "\n".join(
+        f'minio_tpu_bad_total{{key="{i}"}} 1' for i in range(70))
+    probs = ml.check_exposition(text, cap=64)
+    assert len(probs) == 1 and "minio_tpu_bad_total" in probs[0]
+    # Allowlisted per-drive family at the same cardinality passes.
+    text = "\n".join(
+        f'minio_tpu_drive_queue_depth{{drive="{i}"}} 1'
+        for i in range(70))
+    assert ml.check_exposition(text, cap=64) == []
+    # Histogram `le` is a bucket boundary, not a cardinality dimension.
+    text = "\n".join(
+        f'minio_tpu_h_seconds_bucket{{api="GET",le="{i / 10}"}} 1'
+        for i in range(200))
+    assert ml.check_exposition(text, cap=64) == []
+
+
+def test_cardinality_guard_runs_on_synthetic_fleet():
+    ml = _lint_mod()
+    text = ml._synthetic_fleet_exposition()
+    assert "minio_tpu_cluster_node_up{" in text
+    assert "minio_tpu_slo_burn_rate{" in text
+    assert ml.check_exposition(text) == []
+
+
+# ---------------------------------------------------------------------------
+# cluster chaos: armed distributed GET with a dying peer
+# ---------------------------------------------------------------------------
+
+def _stream_trace(address, query: dict, out: list):
+    """Signed GET of /minio/admin/v3/trace, de-chunked, JSON lines
+    appended to `out` (same shape as tests/test_trace_deep.py)."""
+    now = datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    date = amz_date[:8]
+    scope = f"{date}/us-east-1/s3/aws4_request"
+    payload_hash = hashlib.sha256(b"").hexdigest()
+    hdrs = {"host": address, "x-amz-date": amz_date,
+            "x-amz-content-sha256": payload_hash}
+    signed = sorted(hdrs)
+    q = {k: [v] for k, v in query.items()}
+    canon = sigv4.canonical_request("GET", "/minio/admin/v3/trace", q,
+                                    hdrs, signed, payload_hash)
+    sts = sigv4.string_to_sign(amz_date, scope, canon)
+    skey = sigv4.signing_key("minioadmin", date, "us-east-1")
+    sig = hmac_mod.new(skey, sts.encode(), hashlib.sha256).hexdigest()
+    qs = "&".join(f"{k}={v}" for k, v in sorted(query.items()))
+    conn = http.client.HTTPConnection(address, timeout=60)
+    conn.request("GET", f"/minio/admin/v3/trace?{qs}", headers={
+        **hdrs,
+        "Authorization": f"{sigv4.ALGORITHM} "
+        f"Credential=minioadmin/{scope}, "
+        f"SignedHeaders={';'.join(signed)}, Signature={sig}"})
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    for line in body.splitlines():
+        if line.strip():
+            out.append(json.loads(line))
+
+
+def _collect_trace(cluster, i, count, fn):
+    """Subscribe types=all on node i, run `fn` once armed, pad with
+    health requests until the count limit closes the stream."""
+    entries: list = []
+    t = threading.Thread(target=_stream_trace,
+                         args=(cluster.address(i),
+                               {"types": "all", "count": str(count)},
+                               entries),
+                         daemon=True)
+    t.start()
+    time.sleep(0.8)                 # subscription armed
+    fn()
+    cli = cluster.client(i)
+    for _ in range(150):
+        cli.request("GET", "/minio/health/live", sign=False)
+        if not t.is_alive():
+            break
+        time.sleep(0.05)
+    t.join(timeout=30)
+    return entries
+
+
+@pytest.mark.slow
+def test_cluster_armed_get_chaos_fault_annotation(tmp_path):
+    with Cluster(tmp_path, nodes=3, drives_per_node=2,
+                 parity=2) as cluster:
+        cli = cluster.client(0)
+        assert cli.request("PUT", "/obs")[0] == 200
+        body = os.urandom(200_000)
+        assert cli.request("PUT", "/obs/o", body=body)[0] == 200
+
+        # Healthy armed GET first: ONE stitched tree with remote
+        # disk.* spans labeled by their origin node.
+        ok: dict = {}
+
+        def healthy():
+            st, _, got = cli.request("GET", "/obs/o")
+            ok["st"], ok["match"] = st, got == body
+
+        entries = _collect_trace(cluster, 0, 120, healthy)
+        assert ok == {"st": 200, "match": True}
+        gets = [e for e in entries if e.get("trace_type") == "s3"
+                and e.get("api") == "GET:object"]
+        assert gets, [e.get("api") for e in entries][:20]
+        tid = gets[0]["trace"]
+        tree = [e for e in entries if e.get("trace") == tid]
+        wires = [e for e in tree if e.get("api") == "wire"]
+        remote = [e for e in tree if str(e.get("api", "")
+                                         ).startswith("disk.")
+                  and e.get("node") != gets[0].get("node")]
+        assert wires, "armed distributed GET produced no wire spans"
+        assert remote, "no remote disk.* spans stitched into the tree"
+        wire_ids = {e["span"] for e in wires}
+        assert any(e["parent"] in wire_ids for e in remote)
+
+        # Partition a peer mid-armed-traffic: the tree still
+        # completes, with the transport fault on a wire span.
+        cluster.partition(1)
+        time.sleep(1.2)             # chaos file poll on node 1
+
+        def faulted():
+            st, _, got = cli.request("GET", "/obs/o")
+            ok["st2"], ok["match2"] = st, got == body
+
+        entries = _collect_trace(cluster, 0, 120, faulted)
+        assert ok["st2"] == 200 and ok["match2"]    # parity covers it
+        faults = [e for e in entries if e.get("api") == "wire"
+                  and "fault" in (e.get("tags") or {})]
+        assert faults, "partitioned peer produced no fault-annotated " \
+            "wire span"
+
+        # SIGKILL variant: same contract when the peer process dies.
+        cluster.rejoin(1)
+        time.sleep(1.5)             # node 1 chaos poll clears
+        cluster.kill(2)
+
+        def killed():
+            # Quorum needs node 1 back: retry while its breaker on
+            # node 0 recovers from the partition phase.
+            deadline = time.time() + 20
+            while True:
+                st, _, got = cli.request("GET", "/obs/o")
+                if st == 200 or time.time() > deadline:
+                    break
+                time.sleep(0.5)
+            ok["st3"], ok["match3"] = st, got == body
+
+        entries = _collect_trace(cluster, 0, 120, killed)
+        assert ok["st3"] == 200 and ok["match3"]
+        faults = [e for e in entries if e.get("api") == "wire"
+                  and "fault" in (e.get("tags") or {})]
+        assert faults, "killed peer produced no fault-annotated " \
+            "wire span"

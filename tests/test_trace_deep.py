@@ -242,13 +242,21 @@ def test_grid_call_and_stream_spans(tmp_path):
             assert list(cli.stream("count", 3)) == [0, 1, 2]
         cli.close()
         grid = [s for s in ctx.spans if s["type"] == "grid"]
-        assert {s["name"] for s in grid} == {"grid.echo", "grid.count"}
+        # Armed grid calls now propagate the trace to the peer and
+        # stitch its subtree back under an explicit wire span (one per
+        # round-trip) carrying the serialize/transit/peer timing split.
+        assert {s["name"] for s in grid} == {"grid.echo", "grid.count",
+                                             "wire"}
         by_name = {s["name"]: s for s in grid}
         # The unary call nested under the storage span; the stream span
         # hangs off the root and counted its chunks.
         parent = [s for s in ctx.spans if s["name"] == "disk.remote_op"]
         assert by_name["grid.echo"]["parent"] == parent[0]["span"]
         assert by_name["grid.count"]["tags"]["chunks"] == 3
+        wires = [s for s in grid if s["name"] == "wire"]
+        assert len(wires) == 2
+        assert {w["parent"] for w in wires} == {
+            by_name["grid.echo"]["span"], by_name["grid.count"]["span"]}
     finally:
         tracing.disarm("test-grid")
         gs.stop()
